@@ -1,0 +1,16 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before any jax import anywhere in the test session, hence env is set
+at conftest import time. Data-plane tests exercise multi-chip shardings
+(dp/tp/sp) on these virtual devices; the driver separately dry-runs the
+multi-chip path via __graft_entry__.dryrun_multichip.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,293 @@
+"""API-layer tests: naming, exit codes, defaults, validation, compat.
+
+Mirrors the reference's API test surface: defaults_test.go:78,117,
+validation_test.go:27, util_test.go:19/22, train_util exit-code table.
+"""
+
+import pytest
+
+from tf_operator_tpu.api import compat, defaults, validation
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    ContainerSpec,
+    MeshSpec,
+    PodTemplateSpec,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUSpec,
+    TrainJob,
+    TrainJobSpec,
+    ObjectMeta,
+)
+from tf_operator_tpu.gang.topology import parse_topology, validate_mesh_axes
+from tf_operator_tpu.utils import exit_codes, naming
+
+
+def make_replica(replicas=1, image="img", container="tensorflow"):
+    return ReplicaSpec(
+        replicas=replicas,
+        template=PodTemplateSpec(containers=[ContainerSpec(name=container, image=image)]),
+    )
+
+
+def make_job(name="test-job", **replica_counts) -> TrainJob:
+    specs = {}
+    for rname, count in replica_counts.items():
+        rtype = defaults.canonical_replica_type(rname)
+        specs[rtype] = make_replica(replicas=count)
+    job = TrainJob(
+        metadata=ObjectMeta(name=name, namespace="default", uid="uid-1"),
+        spec=TrainJobSpec(replica_specs=specs),
+    )
+    return defaults.set_defaults(job)
+
+
+class TestNaming:
+    def test_general_name(self):
+        assert naming.gen_general_name("mnist", "Worker", 0) == "mnist-worker-0"
+        assert naming.gen_general_name("a/b", "PS", 3) == "a-b-ps-3"
+
+    def test_expectation_keys(self):
+        assert (
+            naming.gen_expectation_pods_key("default/j", "Worker") == "default/j/worker/pods"
+        )
+        assert (
+            naming.gen_expectation_services_key("default/j", "PS")
+            == "default/j/ps/services"
+        )
+
+    def test_job_key_roundtrip(self):
+        assert naming.split_job_key(naming.job_key("ns", "j")) == ("ns", "j")
+        assert naming.split_job_key("bare") == ("", "bare")
+
+    def test_replica_index(self):
+        assert naming.replica_index_from_name("mnist-worker-12") == 12
+        assert naming.replica_index_from_name("nope") is None
+
+
+class TestExitCodes:
+    @pytest.mark.parametrize("code", [130, 137, 138, 143, 129, 140, 200])
+    def test_retryable(self, code):
+        assert exit_codes.is_retryable_exit_code(code)
+
+    @pytest.mark.parametrize("code", [1, 2, 126, 127, 128, 139, 3, 100])
+    def test_permanent(self, code):
+        assert not exit_codes.is_retryable_exit_code(code)
+
+
+class TestDefaults:
+    def test_port_and_replicas(self):
+        job = make_job(worker=None)
+        spec = job.spec.replica_specs[ReplicaType.WORKER]
+        assert spec.replicas == 1
+        assert spec.restart_policy == RestartPolicy.NEVER
+        ports = {p.name: p.container_port for p in spec.template.containers[0].ports}
+        assert ports["tfjob-port"] == 2222
+        assert ports["coord-port"] == 8476
+
+    def test_clean_pod_policy_default(self):
+        job = make_job(worker=2)
+        assert job.spec.run_policy.clean_pod_policy == CleanPodPolicy.RUNNING
+
+    def test_type_canonicalization(self):
+        job = make_job(ps=1, worker=2, chief=1)
+        assert set(job.spec.replica_specs) == {
+            ReplicaType.PS,
+            ReplicaType.WORKER,
+            ReplicaType.CHIEF,
+        }
+
+    def test_tpu_default_mesh(self):
+        job = make_job(worker=4)
+        job.spec.tpu = TPUSpec(topology="v5e-32")
+        job.spec.mesh = None
+        defaults.set_defaults(job)
+        assert job.spec.mesh.axes == {"dp": 32}
+        assert job.spec.tpu.accelerator == "v5e"
+
+    def test_min_available_default(self):
+        job = make_job(ps=2, worker=4)
+        assert job.spec.run_policy.scheduling.min_available == 6
+
+
+class TestTopology:
+    def test_type_form(self):
+        t = parse_topology("v5e-32")
+        assert t.num_chips == 32 and t.accelerator == "v5e"
+        assert t.num_hosts == 8
+
+    def test_grid_form(self):
+        t = parse_topology("2x2x4", accelerator="v4")
+        assert t.num_chips == 16 and t.grid == (2, 2, 4)
+
+    def test_prefixed_grid(self):
+        t = parse_topology("v4:2x2x4")
+        assert t.accelerator == "v4" and t.num_chips == 16
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_topology("bogus")
+
+    def test_mesh_validation(self):
+        assert validate_mesh_axes({"dp": 4, "tp": 8}, 32) == []
+        assert validate_mesh_axes({"dp": 4}, 32) != []
+        assert validate_mesh_axes({"zz": 32}, 32) != []
+
+
+class TestValidation:
+    def test_valid_job(self):
+        assert validation.validate_job(make_job(worker=2, ps=1)) == []
+
+    def test_empty_spec(self):
+        job = TrainJob(metadata=ObjectMeta(name="j"))
+        assert validation.validate_job(job)
+
+    def test_missing_image(self):
+        job = make_job(worker=1)
+        job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].image = ""
+        probs = validation.validate_job(job)
+        assert any("empty image" in p for p in probs)
+
+    def test_wrong_container_name(self):
+        job = TrainJob(
+            metadata=ObjectMeta(name="j"),
+            spec=TrainJobSpec(
+                replica_specs={
+                    ReplicaType.WORKER: make_replica(container="not-training")
+                }
+            ),
+        )
+        probs = validation.validate_job(job)
+        assert any("training container" in p for p in probs)
+
+    def test_chief_and_master_conflict(self):
+        job = make_job(chief=1, master=1, worker=1)
+        probs = validation.validate_job(job)
+        assert any("not both" in p for p in probs)
+
+    def test_two_chiefs(self):
+        job = make_job(chief=2, worker=1)
+        assert any("<= 1" in p for p in validation.validate_job(job))
+
+    def test_bad_dns_name(self):
+        job = make_job(worker=1)
+        job.metadata.name = "Bad_Name"
+        assert any("DNS" in p for p in validation.validate_job(job))
+
+    def test_bad_mesh(self):
+        job = make_job(worker=1)
+        job.spec.tpu = TPUSpec(topology="v5e-8")
+        job.spec.mesh = MeshSpec(axes={"dp": 3})
+        defaults.set_defaults(job)
+        assert any("multiply" in p for p in validation.validate_job(job))
+
+    def test_unknown_replica_type_reported(self):
+        job = compat.job_from_dict(
+            {
+                "kind": "TFJob",
+                "metadata": {"name": "j"},
+                "spec": {
+                    "tfReplicaSpecs": {
+                        "Worrker": {
+                            "template": {
+                                "spec": {
+                                    "containers": [{"name": "tensorflow", "image": "i"}]
+                                }
+                            }
+                        }
+                    }
+                },
+            }
+        )
+        assert any("unknown replica type" in p for p in validation.validate_job(job))
+
+
+class TestCompat:
+    LEGACY = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": "dist-mnist", "namespace": "kubeflow"},
+        "spec": {
+            "cleanPodPolicy": "All",
+            "backoffLimit": 4,
+            "tfReplicaSpecs": {
+                "PS": {
+                    "replicas": 2,
+                    "restartPolicy": "Never",
+                    "template": {
+                        "spec": {
+                            "containers": [
+                                {"name": "tensorflow", "image": "dist-mnist:1.0"}
+                            ]
+                        }
+                    },
+                },
+                "Worker": {
+                    "replicas": 4,
+                    "restartPolicy": "Never",
+                    "template": {
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": "tensorflow",
+                                    "image": "dist-mnist:1.0",
+                                    "volumeMounts": [
+                                        {
+                                            "name": "data",
+                                            "mountPath": "/data",
+                                            "subPath": "shard-((index))",
+                                        }
+                                    ],
+                                }
+                            ]
+                        }
+                    },
+                },
+            },
+        },
+    }
+
+    def test_legacy_tfjob_parses(self):
+        job = compat.job_from_dict(self.LEGACY)
+        assert job.name == "dist-mnist" and job.namespace == "kubeflow"
+        assert job.spec.replica_specs[ReplicaType.PS].replicas == 2
+        assert job.spec.replica_specs[ReplicaType.WORKER].replicas == 4
+        assert job.spec.run_policy.clean_pod_policy == CleanPodPolicy.ALL
+        assert job.spec.run_policy.backoff_limit == 4
+        assert validation.validate_job(job) == []
+
+    def test_subpath_preserved(self):
+        job = compat.job_from_dict(self.LEGACY)
+        wm = job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].volume_mounts
+        assert wm[0].sub_path == "shard-((index))"
+
+    def test_roundtrip(self):
+        job = compat.job_from_dict(self.LEGACY)
+        job2 = compat.job_from_dict(compat.job_to_dict(job))
+        assert job2.spec.replica_specs[ReplicaType.PS].replicas == 2
+        assert job2.spec.run_policy.clean_pod_policy == CleanPodPolicy.ALL
+
+    def test_native_manifest_with_tpu(self):
+        manifest = {
+            "kind": "TrainJob",
+            "metadata": {"name": "resnet"},
+            "spec": {
+                "replicaSpecs": {
+                    "Worker": {
+                        "replicas": 4,
+                        "template": {
+                            "spec": {"containers": [{"name": "jax", "image": "resnet:1"}]}
+                        },
+                    }
+                },
+                "tpu": {"topology": "v5e-32"},
+                "mesh": {"axes": {"dp": 8, "tp": 4}},
+                "runPolicy": {"backoffLimit": 3, "schedulingPolicy": {"gang": True}},
+            },
+        }
+        job = compat.job_from_dict(manifest)
+        assert job.spec.tpu.topology == "v5e-32"
+        assert job.spec.mesh.axes == {"dp": 8, "tp": 4}
+        assert job.spec.run_policy.backoff_limit == 3
+        assert validation.validate_job(job) == []

@@ -1,0 +1,45 @@
+"""tf_operator_tpu — a TPU-native distributed training-job operator framework.
+
+A ground-up rebuild of the capabilities of the kubeflow/tf-operator (hudson741
+fork, reference at /root/reference): a declarative TrainJob API, a reconciling
+controller that materialises replica pods + stable DNS identity, cluster-spec
+injection (TF_CONFIG parity and a TPU/JAX-native contract), a condition state
+machine, gang scheduling mapped onto atomic TPU-slice acquisition, lifecycle
+policies (restart/backoff/deadline/TTL/cleanup), plus a JAX/XLA data plane
+(models, pallas-ready ops, SPMD parallelism over device meshes) that the
+reference delegated to user containers.
+
+Layer map (mirrors SURVEY.md §1, re-targeted TPU-first):
+
+  api/           TrainJob spec types, defaulting, validation       (ref pkg/apis)
+  core/          cluster substrate, workqueue, expectations,
+                 generic job controller + TrainJob controller      (ref pkg/common/jobcontroller,
+                                                                    pkg/controller.v1/tensorflow)
+  cluster_spec/  TF_CONFIG + TPU/JAX distributed env injection     (ref tensorflow.go)
+  status/        replica counts -> job condition state machine     (ref status.go)
+  gang/          TPU slice topology model + PodGroup gang sched    (ref jobcontroller.go:226)
+  runtime/       executors: local-process runtime, native C++ core
+  testing/       fake workload server + builders                   (ref test/test-server, testutil)
+  models/        JAX/flax model zoo (MNIST, ResNet-50, Transformer)
+  ops/           TPU kernels (pallas) with portable fallbacks
+  parallel/      mesh construction, dp/tp/sp/pp shardings, ring attention
+  utils/         naming, env, exit codes, structured logging
+  cli/           operator entrypoint, metrics, leader election     (ref cmd/tf-operator.v1)
+
+The control plane (api/core/cluster_spec/status/gang/utils/cli) imports no JAX:
+it can run on any host. JAX appears only in the data plane (models/ops/parallel)
+and in workload processes the runtime spawns.
+"""
+
+__version__ = "0.1.0"
+
+from tf_operator_tpu.api.types import (  # noqa: F401
+    CleanPodPolicy,
+    JobConditionType,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    RunPolicy,
+    TrainJob,
+    TrainJobSpec,
+)

@@ -1,0 +1,1 @@
+"""TrainJob API: spec/status types, defaulting, validation, YAML compat."""

@@ -1,0 +1,256 @@
+"""Load TrainJobs from dict/YAML manifests — including reference-format TFJobs.
+
+Drop-in story: a `kind: TFJob, apiVersion: kubeflow.org/v1` manifest (the
+reference CRD, e.g. /root/reference/examples/v1/dist-mnist/tf_job_mnist.yaml)
+parses into a TrainJob with identical semantics, so reference users can submit
+their existing specs unchanged. Native `kind: TrainJob` manifests additionally
+carry `tpu:` and `mesh:` blocks.
+
+Field mapping (reference -> native):
+  spec.tfReplicaSpecs          -> spec.replicaSpecs
+  spec.cleanPodPolicy          -> runPolicy.cleanPodPolicy
+  spec.ttlSecondsAfterFinished -> runPolicy.ttlSecondsAfterFinished
+  spec.activeDeadlineSeconds   -> runPolicy.activeDeadlineSeconds
+  spec.backoffLimit            -> runPolicy.backoffLimit
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from tf_operator_tpu.api import defaults
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    ContainerPort,
+    ContainerSpec,
+    EnvVar,
+    MeshSpec,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    TPUSpec,
+    TrainJob,
+    TrainJobSpec,
+    Volume,
+    VolumeMount,
+)
+
+
+def _container_from_dict(d: dict[str, Any]) -> ContainerSpec:
+    return ContainerSpec(
+        name=d.get("name", ""),
+        image=d.get("image", ""),
+        command=list(d.get("command", []) or []),
+        args=list(d.get("args", []) or []),
+        env=[EnvVar(e.get("name", ""), str(e.get("value", ""))) for e in d.get("env", []) or []],
+        ports=[
+            ContainerPort(p.get("name", ""), int(p.get("containerPort", 0)))
+            for p in d.get("ports", []) or []
+        ],
+        resources=dict((d.get("resources", {}) or {}).get("limits", {}) or {}),
+        volume_mounts=[
+            VolumeMount(
+                name=v.get("name", ""),
+                mount_path=v.get("mountPath", ""),
+                sub_path=v.get("subPath", ""),
+                read_only=bool(v.get("readOnly", False)),
+            )
+            for v in d.get("volumeMounts", []) or []
+        ],
+        working_dir=d.get("workingDir", ""),
+    )
+
+
+def _volume_from_dict(d: dict[str, Any]) -> Volume:
+    return Volume(
+        name=d.get("name", ""),
+        host_path=(d.get("hostPath", {}) or {}).get("path", ""),
+        claim_name=(d.get("persistentVolumeClaim", {}) or {}).get("claimName", ""),
+        empty_dir="emptyDir" in d,
+    )
+
+
+def _template_from_dict(d: dict[str, Any]) -> PodTemplateSpec:
+    meta = d.get("metadata", {}) or {}
+    spec = d.get("spec", {}) or {}
+    return PodTemplateSpec(
+        containers=[_container_from_dict(c) for c in spec.get("containers", []) or []],
+        volumes=[_volume_from_dict(v) for v in spec.get("volumes", []) or []],
+        labels=dict(meta.get("labels", {}) or {}),
+        annotations=dict(meta.get("annotations", {}) or {}),
+        node_selector=dict(spec.get("nodeSelector", {}) or {}),
+        scheduler_name=spec.get("schedulerName", ""),
+        restart_policy=spec.get("restartPolicy", ""),
+    )
+
+
+def _replica_from_dict(d: dict[str, Any]) -> ReplicaSpec:
+    rp = d.get("restartPolicy")
+    return ReplicaSpec(
+        replicas=d.get("replicas"),
+        template=_template_from_dict(d.get("template", {}) or {}),
+        restart_policy=RestartPolicy(rp) if rp else None,
+    )
+
+
+_CAMEL_BOUNDARY = re.compile(r"(?<=[a-z0-9])(?=[A-Z])")
+
+
+def job_from_dict(manifest: dict[str, Any], apply_defaults: bool = True) -> TrainJob:
+    """Build a TrainJob from a parsed manifest (native TrainJob or legacy
+    TFJob). Unknown replica-type keys are preserved so validation can report
+    them (parity with the unstructured-informer tolerance, ref informer.go:82)."""
+    kind = manifest.get("kind", TrainJob.KIND)
+    meta_d = manifest.get("metadata", {}) or {}
+    spec_d = manifest.get("spec", {}) or {}
+
+    replica_key = "tfReplicaSpecs" if kind == "TFJob" else "replicaSpecs"
+    replicas_d = spec_d.get(replica_key) or spec_d.get("tfReplicaSpecs") or {}
+
+    replica_specs: dict[Any, ReplicaSpec] = {}
+    for rname, rd in replicas_d.items():
+        if rd is not None and not isinstance(rd, dict):
+            raise ValueError(
+                f"replica spec {rname!r} must be a mapping, got {type(rd).__name__}"
+            )
+        ct = defaults.canonical_replica_type(rname)
+        replica_specs[ct if ct is not None else rname] = _replica_from_dict(rd or {})
+
+    rp_d = spec_d.get("runPolicy", {}) or {}
+
+    def policy_field(name: str) -> Any:
+        # Native nests under runPolicy; the legacy TFJob spec carries these at
+        # top level (ref types.go:43-72). Accept both.
+        return rp_d.get(name, spec_d.get(name))
+
+    cpp = policy_field("cleanPodPolicy")
+    sched_d = rp_d.get("schedulingPolicy", {}) or {}
+    run_policy = RunPolicy(
+        clean_pod_policy=CleanPodPolicy(cpp) if cpp else None,
+        ttl_seconds_after_finished=policy_field("ttlSecondsAfterFinished"),
+        active_deadline_seconds=policy_field("activeDeadlineSeconds"),
+        backoff_limit=policy_field("backoffLimit"),
+        scheduling=SchedulingPolicy(
+            gang=bool(sched_d.get("gang", True)),
+            queue=sched_d.get("queue", ""),
+            priority_class=sched_d.get("priorityClass", ""),
+            min_available=sched_d.get("minAvailable"),
+        ),
+    )
+
+    tpu_d = spec_d.get("tpu")
+    tpu = (
+        TPUSpec(
+            topology=tpu_d.get("topology", ""),
+            accelerator=tpu_d.get("accelerator", ""),
+            chips_per_host=int(tpu_d.get("chipsPerHost", 0)),
+        )
+        if tpu_d
+        else None
+    )
+    mesh_d = spec_d.get("mesh")
+    mesh = MeshSpec(axes=dict(mesh_d.get("axes", {}) or {})) if mesh_d else None
+
+    job = TrainJob(
+        metadata=ObjectMeta(
+            name=meta_d.get("name", ""),
+            namespace=meta_d.get("namespace", "default"),
+            labels=dict(meta_d.get("labels", {}) or {}),
+            annotations=dict(meta_d.get("annotations", {}) or {}),
+        ),
+        spec=TrainJobSpec(
+            replica_specs=replica_specs, run_policy=run_policy, tpu=tpu, mesh=mesh
+        ),
+    )
+    if apply_defaults:
+        defaults.set_defaults(job)
+    return job
+
+
+def job_from_yaml(text: str, apply_defaults: bool = True) -> TrainJob:
+    import yaml  # deferred: control plane works without pyyaml for dict input
+
+    return job_from_dict(yaml.safe_load(text), apply_defaults=apply_defaults)
+
+
+def job_to_dict(job: TrainJob) -> dict[str, Any]:
+    """Serialize a TrainJob to a native-format manifest dict (round-trippable
+    through job_from_dict for the fields we model)."""
+    replica_specs: dict[str, Any] = {}
+    for rtype, rspec in job.spec.replica_specs.items():
+        replica_specs[str(rtype)] = {
+            "replicas": rspec.replicas,
+            "restartPolicy": str(rspec.restart_policy) if rspec.restart_policy else None,
+            "template": {
+                "metadata": {
+                    "labels": rspec.template.labels,
+                    "annotations": rspec.template.annotations,
+                },
+                "spec": {
+                    "schedulerName": rspec.template.scheduler_name,
+                    "nodeSelector": rspec.template.node_selector,
+                    "containers": [
+                        {
+                            "name": c.name,
+                            "image": c.image,
+                            "command": c.command,
+                            "args": c.args,
+                            "env": [{"name": e.name, "value": e.value} for e in c.env],
+                            "ports": [
+                                {"name": p.name, "containerPort": p.container_port}
+                                for p in c.ports
+                            ],
+                            "resources": {"limits": c.resources},
+                            "volumeMounts": [
+                                {
+                                    "name": v.name,
+                                    "mountPath": v.mount_path,
+                                    "subPath": v.sub_path,
+                                    "readOnly": v.read_only,
+                                }
+                                for v in c.volume_mounts
+                            ],
+                        }
+                        for c in rspec.template.containers
+                    ],
+                },
+            },
+        }
+    rp = job.spec.run_policy
+    out: dict[str, Any] = {
+        "apiVersion": TrainJob.API_VERSION,
+        "kind": TrainJob.KIND,
+        "metadata": {
+            "name": job.metadata.name,
+            "namespace": job.metadata.namespace,
+            "labels": job.metadata.labels,
+            "annotations": job.metadata.annotations,
+        },
+        "spec": {
+            "replicaSpecs": replica_specs,
+            "runPolicy": {
+                "cleanPodPolicy": str(rp.clean_pod_policy) if rp.clean_pod_policy else None,
+                "ttlSecondsAfterFinished": rp.ttl_seconds_after_finished,
+                "activeDeadlineSeconds": rp.active_deadline_seconds,
+                "backoffLimit": rp.backoff_limit,
+                "schedulingPolicy": {
+                    "gang": rp.scheduling.gang,
+                    "queue": rp.scheduling.queue,
+                    "minAvailable": rp.scheduling.min_available,
+                },
+            },
+        },
+    }
+    if job.spec.tpu is not None:
+        out["spec"]["tpu"] = {
+            "topology": job.spec.tpu.topology,
+            "accelerator": job.spec.tpu.accelerator,
+            "chipsPerHost": job.spec.tpu.chips_per_host,
+        }
+    if job.spec.mesh is not None:
+        out["spec"]["mesh"] = {"axes": job.spec.mesh.axes}
+    return out

@@ -1,0 +1,123 @@
+"""Defaulting for TrainJob specs.
+
+Capability parity with pkg/apis/tensorflow/v1/defaults.go:36-108:
+  - default port 2222 named `tfjob-port` on the training container
+  - replicas default 1
+  - restartPolicy default Never
+  - cleanPodPolicy default Running
+  - replica-type name canonicalization ("ps" -> PS, "worker" -> Worker)
+
+TPU-first additions:
+  - a JAX coordinator port (default 8476) alongside the legacy TF port
+  - TPU accelerator/chips-per-host derivation from the topology string
+  - a default mesh (pure data-parallel over all chips) when a TPU slice is
+    requested but no MeshSpec given
+"""
+
+from __future__ import annotations
+
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    ContainerPort,
+    MeshSpec,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TrainJob,
+    TrainJobSpec,
+)
+from tf_operator_tpu.gang.topology import parse_topology
+
+# Legacy TF gRPC mesh port (ref constants.go:31) and its port name.
+DEFAULT_PORT = 2222
+DEFAULT_PORT_NAME = "tfjob-port"
+# JAX distributed coordinator port (jax.distributed default).
+DEFAULT_COORDINATOR_PORT = 8476
+COORDINATOR_PORT_NAME = "coord-port"
+
+# The container the operator injects config into (ref constants.go:29 used the
+# literal name "tensorflow"; we accept either, preferring "tensorflow" for
+# drop-in compat with reference job specs).
+DEFAULT_CONTAINER_NAMES = ("tensorflow", "jax", "train")
+DEFAULT_CONTAINER_NAME = "tensorflow"
+
+_CANONICAL_TYPES = {t.value.lower(): t for t in ReplicaType}
+
+
+def canonical_replica_type(name: str | ReplicaType) -> ReplicaType | None:
+    """'ps'/'PS'/'Ps' -> ReplicaType.PS, etc. (ref defaults.go setTypeNames)."""
+    if isinstance(name, ReplicaType):
+        return name
+    return _CANONICAL_TYPES.get(str(name).lower())
+
+
+def training_container(spec: ReplicaSpec) -> "ContainerSpecOrNone":
+    for candidate in DEFAULT_CONTAINER_NAMES:
+        c = spec.template.container(candidate)
+        if c is not None:
+            return c
+    return None
+
+
+ContainerSpecOrNone = object  # typing alias kept loose to avoid import cycle
+
+
+def set_defaults_replica(spec: ReplicaSpec) -> None:
+    if spec.replicas is None:
+        spec.replicas = 1
+    if spec.restart_policy is None:
+        spec.restart_policy = RestartPolicy.NEVER
+    c = training_container(spec)
+    if c is not None:
+        names = {p.name for p in c.ports}
+        if DEFAULT_PORT_NAME not in names:
+            c.ports.append(ContainerPort(name=DEFAULT_PORT_NAME, container_port=DEFAULT_PORT))
+        if COORDINATOR_PORT_NAME not in names:
+            c.ports.append(
+                ContainerPort(name=COORDINATOR_PORT_NAME, container_port=DEFAULT_COORDINATOR_PORT)
+            )
+
+
+def set_defaults_spec(spec: TrainJobSpec) -> None:
+    # Canonicalize replica-type keys (defaults.go:92-108 setTypeNamesToCamelCase).
+    canonical: dict[ReplicaType, ReplicaSpec] = {}
+    for k, v in spec.replica_specs.items():
+        ct = canonical_replica_type(k)
+        canonical[ct if ct is not None else k] = v  # invalid keys left for validation
+    spec.replica_specs = canonical
+
+    for rspec in spec.replica_specs.values():
+        set_defaults_replica(rspec)
+
+    if spec.run_policy.clean_pod_policy is None:
+        spec.run_policy.clean_pod_policy = CleanPodPolicy.RUNNING
+
+    if spec.tpu is not None and spec.tpu.topology:
+        try:
+            topo = parse_topology(
+                spec.tpu.topology, spec.tpu.accelerator, spec.tpu.chips_per_host
+            )
+        except ValueError:
+            # Unparseable topology is a validation problem, not a defaulting
+            # crash — invalid specs must still construct so the controller can
+            # mark them Failed (parity with the unstructured-informer
+            # tolerance, ref informer.go:34, issue #561).
+            topo = None
+        if topo is not None:
+            if not spec.tpu.accelerator:
+                spec.tpu.accelerator = topo.accelerator
+            if not spec.tpu.chips_per_host:
+                spec.tpu.chips_per_host = topo.chips_per_host
+            if spec.mesh is None:
+                # Default: pure data parallelism over every chip in the slice.
+                spec.mesh = MeshSpec(axes={"dp": topo.num_chips})
+
+    if spec.run_policy.scheduling.min_available is None:
+        total = sum(int(s.replicas or 0) for s in spec.replica_specs.values())
+        spec.run_policy.scheduling.min_available = total
+
+
+def set_defaults(job: TrainJob) -> TrainJob:
+    """Defaults the job in place and returns it (ref SetDefaults_TFJob)."""
+    set_defaults_spec(job.spec)
+    return job

@@ -1,0 +1,332 @@
+"""TrainJob API types.
+
+Capability parity with the reference CRD schema:
+  - pkg/apis/tensorflow/v1/types.go:27-112  (TFJob / TFJobSpec / replica types)
+  - pkg/apis/common/v1/types.go:23-161      (JobStatus / conditions / policies)
+
+TPU-first deltas vs the reference:
+  - A first-class `TPUSpec` (slice topology, e.g. "v5e-32") on the job; the
+    reference was resource-agnostic and left accelerator wiring to the user's
+    PodTemplateSpec + device plugin.
+  - A `MeshSpec` describing the logical parallelism axes (dp/fsdp/tp/sp/ep/pp)
+    the data plane should build over the slice — the reference had no notion of
+    intra-replica parallelism at all (SURVEY.md §2 parallelism table).
+  - Plain dataclasses instead of generated deepcopy/clientset machinery; jobs
+    are value objects and the cluster substrate stores deep copies.
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class ReplicaType(str, enum.Enum):
+    """Typed replica groups (ref types.go:43-72). Values are canonical CamelCase."""
+
+    CHIEF = "Chief"
+    MASTER = "Master"
+    WORKER = "Worker"
+    PS = "PS"
+    EVALUATOR = "Evaluator"
+
+    def __str__(self) -> str:  # so f-strings produce "Worker", not "ReplicaType.WORKER"
+        return self.value
+
+
+class RestartPolicy(str, enum.Enum):
+    """Per-replica restart policy (ref common/v1/types.go:64-77)."""
+
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    NEVER = "Never"
+    EXIT_CODE = "ExitCode"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+class CleanPodPolicy(str, enum.Enum):
+    """What to do with pods when the job terminates (ref common/v1/types.go)."""
+
+    ALL = "All"
+    RUNNING = "Running"
+    NONE = "None"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+class JobConditionType(str, enum.Enum):
+    """Job-level condition vocabulary (ref common/v1/types.go:106-132)."""
+
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+@dataclass
+class EnvVar:
+    name: str
+    value: str = ""
+
+
+@dataclass
+class VolumeMount:
+    name: str
+    mount_path: str
+    sub_path: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class Volume:
+    """Minimal volume model: a named source (host path / pvc / empty dir)."""
+
+    name: str
+    host_path: str = ""
+    claim_name: str = ""
+    empty_dir: bool = False
+
+
+@dataclass
+class ContainerPort:
+    name: str = ""
+    container_port: int = 0
+
+
+@dataclass
+class ContainerSpec:
+    """One container of a replica pod (subset of core/v1 Container we honor)."""
+
+    name: str
+    image: str = ""
+    command: list[str] = field(default_factory=list)
+    args: list[str] = field(default_factory=list)
+    env: list[EnvVar] = field(default_factory=list)
+    ports: list[ContainerPort] = field(default_factory=list)
+    resources: dict[str, Any] = field(default_factory=dict)  # e.g. {"google.com/tpu": 4}
+    volume_mounts: list[VolumeMount] = field(default_factory=list)
+    working_dir: str = ""
+
+    def env_dict(self) -> dict[str, str]:
+        return {e.name: e.value for e in self.env}
+
+    def set_env(self, name: str, value: str) -> None:
+        for e in self.env:
+            if e.name == name:
+                e.value = value
+                return
+        self.env.append(EnvVar(name=name, value=value))
+
+
+@dataclass
+class PodTemplateSpec:
+    """The pod template each replica is stamped from (copied verbatim into
+    pods, like ref pod.go:195-243)."""
+
+    containers: list[ContainerSpec] = field(default_factory=list)
+    volumes: list[Volume] = field(default_factory=list)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    node_selector: dict[str, str] = field(default_factory=dict)
+    scheduler_name: str = ""
+    restart_policy: str = ""  # pod-level (k8s) policy; operator manages its own
+
+    def container(self, name: str) -> ContainerSpec | None:
+        for c in self.containers:
+            if c.name == name:
+                return c
+        return None
+
+
+@dataclass
+class ReplicaSpec:
+    """A typed replica group (ref common/v1/types.go:64)."""
+
+    replicas: int | None = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    restart_policy: RestartPolicy | None = None
+
+
+@dataclass
+class TPUSpec:
+    """TPU slice request — the TPU-native analogue of `nvidia.com/gpu` pod
+    resources + NCCL env in the reference's north-star configs.
+
+    topology: either an accelerator-type string ("v5e-32", "v4-16") or an
+    explicit chip grid ("2x2x4"). The gang scheduler treats one slice as an
+    atomic unit (SURVEY.md §2: a v5e-32 slice is inherently gang).
+    """
+
+    topology: str = ""
+    accelerator: str = ""  # e.g. "v5e"; derived from topology when empty
+    chips_per_host: int = 0  # derived from accelerator when 0
+
+
+@dataclass
+class MeshSpec:
+    """Logical parallelism axes for the data plane: maps onto jax.sharding.Mesh.
+
+    axes: ordered {axis_name: size}; product must equal total device count.
+    Recognized axis names: dp (data), fsdp (fully-sharded dp), tp (tensor),
+    sp (sequence/context), ep (expert), pp (pipeline).
+    """
+
+    axes: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class SchedulingPolicy:
+    """Gang scheduling knobs (ref jobcontroller.go:226-250 + volcano)."""
+
+    gang: bool = True
+    queue: str = ""
+    priority_class: str = ""
+    min_available: int | None = None  # default: sum of all replicas
+
+
+@dataclass
+class RunPolicy:
+    """Job-level lifecycle policy (ref common/v1 RunPolicy fields spread over
+    TFJobSpec in types.go:43-72)."""
+
+    clean_pod_policy: CleanPodPolicy | None = None
+    ttl_seconds_after_finished: int | None = None
+    active_deadline_seconds: int | None = None
+    backoff_limit: int | None = None
+    scheduling: SchedulingPolicy = field(default_factory=SchedulingPolicy)
+
+
+@dataclass
+class SuccessPolicy:
+    """When is the job Succeeded: default mirrors the reference's chief-else-
+    worker-0 rule (ref status.go:89-140); ALL_WORKERS requires every worker."""
+
+    policy: str = "default"  # "default" | "AllWorkers"
+
+
+@dataclass
+class TrainJobSpec:
+    replica_specs: dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    tpu: TPUSpec | None = None
+    mesh: MeshSpec | None = None
+    success_policy: SuccessPolicy = field(default_factory=SuccessPolicy)
+
+
+@dataclass
+class JobCondition:
+    """One entry of status.conditions (ref common/v1/types.go:106)."""
+
+    type: JobConditionType
+    status: bool
+    reason: str = ""
+    message: str = ""
+    last_update_time: float = 0.0
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class ReplicaStatus:
+    """Per-replica-type counts (ref common/v1/types.go:134-145)."""
+
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class JobStatus:
+    conditions: list[JobCondition] = field(default_factory=list)
+    replica_statuses: dict[ReplicaType, ReplicaStatus] = field(default_factory=dict)
+    start_time: float | None = None
+    completion_time: float | None = None
+    last_reconcile_time: float | None = None
+
+
+@dataclass
+class ObjectMeta:
+    """Minimal object metadata (the slice of metav1.ObjectMeta we honor)."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = field(default_factory=time.time)
+    deletion_timestamp: float | None = None
+    owner_references: list["OwnerReference"] = field(default_factory=list)
+    resource_version: int = 0
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+    block_owner_deletion: bool = False
+
+
+@dataclass
+class TrainJob:
+    """The job object: Kind `TrainJob`, group `tpujob.dev/v1` (capability
+    parity with TFJob kubeflow.org/v1, ref register.go:31-51)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TrainJobSpec = field(default_factory=TrainJobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+    API_GROUP = "tpujob.dev"
+    API_VERSION = "tpujob.dev/v1"
+    KIND = "TrainJob"
+    # Singular/plural for CLI & REST parity with CRD naming.
+    SINGULAR = "trainjob"
+    PLURAL = "trainjobs"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def deep_copy(self) -> "TrainJob":
+        return copy.deepcopy(self)
+
+    def total_replicas(self) -> int:
+        return sum(int(s.replicas or 0) for s in self.spec.replica_specs.values())
+
+
+def has_condition(status: JobStatus, cond_type: JobConditionType) -> bool:
+    return any(c.type == cond_type and c.status for c in status.conditions)
+
+
+def is_succeeded(status: JobStatus) -> bool:
+    return has_condition(status, JobConditionType.SUCCEEDED)
+
+
+def is_failed(status: JobStatus) -> bool:
+    return has_condition(status, JobConditionType.FAILED)
+
+
+def is_terminal(status: JobStatus) -> bool:
+    return is_succeeded(status) or is_failed(status)

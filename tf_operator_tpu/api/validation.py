@@ -1,0 +1,107 @@
+"""TrainJob spec validation.
+
+Capability parity with pkg/apis/tensorflow/validation/validation.go:27-73:
+  - spec must have at least one replica spec, with known replica-type keys
+  - each replica must have containers, with a training container present
+    (reference required a container literally named "tensorflow";
+    we accept the DEFAULT_CONTAINER_NAMES set) and a non-empty image
+  - at most one Chief/Master combined; at most one Evaluator
+
+TPU-first additions:
+  - topology string must parse; mesh axes must be known names and multiply
+    to the slice's chip count
+  - replica counts must be positive; DNS-safe job name (the reference enforced
+    this indirectly via the API server; we are the API server here)
+"""
+
+from __future__ import annotations
+
+from tf_operator_tpu.api.defaults import DEFAULT_CONTAINER_NAMES, training_container
+from tf_operator_tpu.api.types import ReplicaType, TrainJob, TrainJobSpec
+from tf_operator_tpu.gang.topology import parse_topology, validate_mesh_axes
+from tf_operator_tpu.utils.naming import is_valid_dns_name
+
+
+class ValidationError(ValueError):
+    """Raised for invalid specs; message lists every problem found."""
+
+    def __init__(self, problems: list[str]):
+        self.problems = problems
+        super().__init__("; ".join(problems))
+
+
+def validate_spec(spec: TrainJobSpec) -> list[str]:
+    """Returns all problems found (empty list = valid). Mirrors
+    ValidateV1TFJobSpec (validation.go:27) but reports every issue at once."""
+    problems: list[str] = []
+    if not spec.replica_specs:
+        problems.append("replicaSpecs must not be empty")
+        return problems
+
+    chief_like = 0
+    evaluators = 0
+    for rtype, rspec in spec.replica_specs.items():
+        if not isinstance(rtype, ReplicaType):
+            problems.append(f"unknown replica type {rtype!r}")
+            continue
+        label = rtype.value
+        if rspec.replicas is not None and rspec.replicas < 0:
+            problems.append(f"{label}: replicas must be >= 0")
+        if not rspec.template.containers:
+            problems.append(f"{label}: pod template has no containers")
+            continue
+        c = training_container(rspec)
+        if c is None:
+            problems.append(
+                f"{label}: no training container (need one named "
+                f"{' / '.join(DEFAULT_CONTAINER_NAMES)})"
+            )
+        elif not c.image:
+            problems.append(f"{label}: training container has empty image")
+        if rtype in (ReplicaType.CHIEF, ReplicaType.MASTER):
+            chief_like += int(rspec.replicas or 1) if (rspec.replicas or 1) > 1 else 1
+            if (rspec.replicas or 1) > 1:
+                problems.append(f"{label}: replicas must be <= 1")
+        if rtype is ReplicaType.EVALUATOR:
+            evaluators += 1
+            if (rspec.replicas or 1) > 1:
+                problems.append("Evaluator: replicas must be <= 1")
+
+    if ReplicaType.CHIEF in spec.replica_specs and ReplicaType.MASTER in spec.replica_specs:
+        problems.append("job may have Chief or Master, not both")
+
+    if spec.tpu is not None and spec.tpu.topology:
+        try:
+            topo = parse_topology(
+                spec.tpu.topology, spec.tpu.accelerator, spec.tpu.chips_per_host
+            )
+        except ValueError as e:
+            problems.append(str(e))
+        else:
+            if spec.mesh is not None and spec.mesh.axes:
+                problems.extend(validate_mesh_axes(spec.mesh.axes, topo.num_chips))
+    elif spec.mesh is not None and spec.mesh.axes:
+        # Mesh without TPU slice: still check axis names/sizes are sane.
+        problems.extend(
+            p
+            for p in validate_mesh_axes(spec.mesh.axes, 0)
+            if not p.startswith("mesh axes")  # size/product check needs a slice
+        )
+    return problems
+
+
+def validate_job(job: TrainJob) -> list[str]:
+    problems: list[str] = []
+    if not is_valid_dns_name(job.metadata.name):
+        problems.append(
+            f"job name {job.metadata.name!r} is not a valid DNS-1035 label "
+            "(lowercase alphanumerics and '-', <= 63 chars)"
+        )
+    problems.extend(validate_spec(job.spec))
+    return problems
+
+
+def must_validate(job: TrainJob) -> None:
+    problems = validate_job(job)
+    if problems:
+        raise ValidationError(problems)

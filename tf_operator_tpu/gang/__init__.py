@@ -1,0 +1,1 @@
+"""Gang scheduling: TPU slice topology model + PodGroup atomic acquisition."""

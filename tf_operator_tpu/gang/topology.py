@@ -1,0 +1,135 @@
+"""TPU slice topology model.
+
+The reference had no accelerator model at all — pods requested `nvidia.com/gpu`
+opaquely and NCCL formed the fabric inside user containers (SURVEY.md §2,
+"Distributed communication backend"). On TPU the slice topology is a
+first-class scheduling *and* parallelism concern: a slice is an atomic gang
+unit, its chip grid determines the ICI mesh, and the data plane lays logical
+axes (dp/fsdp/tp/sp/ep/pp) over that grid.
+
+Topology strings accepted:
+  - accelerator-type form: "v5e-32", "v4-16", "v5p-128"  (chip count suffix)
+  - grid form: "2x2x4" (chips per ICI dimension), optionally with an
+    accelerator prefix: "v4:2x2x4"
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+
+# chips per host VM by accelerator generation (public platform shapes).
+_CHIPS_PER_HOST = {
+    "v2": 4,
+    "v3": 4,
+    "v4": 4,
+    "v5e": 4,
+    "v5litepod": 4,
+    "v5p": 4,
+    "v6e": 4,
+}
+DEFAULT_ACCELERATOR = "v5e"
+
+_TYPE_RE = re.compile(r"^(v\d+[a-z]*(?:pod)?)-(\d+)$")
+_GRID_RE = re.compile(r"^(?:(v\d+[a-z]*(?:pod)?):)?(\d+(?:x\d+)*)$")
+
+
+@dataclass
+class SliceTopology:
+    """A parsed TPU slice: chip grid + host decomposition."""
+
+    accelerator: str
+    grid: tuple[int, ...]  # chips per ICI dimension
+    chips_per_host: int
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.grid)
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.num_chips // self.chips_per_host)
+
+    @property
+    def name(self) -> str:
+        return f"{self.accelerator}-{self.num_chips}"
+
+    def device_grid(self) -> tuple[int, ...]:
+        return self.grid
+
+    def host_local_chips(self) -> int:
+        return min(self.num_chips, self.chips_per_host)
+
+
+def _default_grid(num_chips: int) -> tuple[int, ...]:
+    """Factor a chip count into a near-square 2D grid (v5e-style 2D ICI torus)."""
+    if num_chips <= 0:
+        raise ValueError(f"invalid chip count {num_chips}")
+    a = int(math.isqrt(num_chips))
+    while a > 1 and num_chips % a:
+        a -= 1
+    return (a, num_chips // a) if a > 1 else (num_chips,)
+
+
+def parse_topology(
+    topology: str, accelerator: str = "", chips_per_host: int = 0
+) -> SliceTopology:
+    """Parse "v5e-32" / "2x2x4" / "v4:2x2x4" into a SliceTopology."""
+    topology = topology.strip()
+    m = _TYPE_RE.match(topology)
+    if m:
+        acc, chips = m.group(1), int(m.group(2))
+        grid = _default_grid(chips)
+    else:
+        g = _GRID_RE.match(topology)
+        if not g:
+            raise ValueError(f"unparseable TPU topology {topology!r}")
+        acc = g.group(1) or accelerator or DEFAULT_ACCELERATOR
+        grid = tuple(int(d) for d in g.group(2).split("x"))
+    acc = accelerator or acc
+    cph = chips_per_host or _CHIPS_PER_HOST.get(acc, 4)
+    return SliceTopology(accelerator=acc, grid=grid, chips_per_host=cph)
+
+
+@dataclass
+class MeshPlan:
+    """Resolved mapping of logical parallelism axes onto a slice's chips.
+
+    axes: ordered {name: size}; product == num_chips of the slice (or, for
+    multi-host jobs, == chips * num replica processes when the job spans
+    processes — the data plane multiplies in process count).
+    """
+
+    axes: dict[str, int] = field(default_factory=dict)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.axes.values()) if self.axes else 1
+
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(self.axes.keys())
+
+    def shape(self) -> tuple[int, ...]:
+        return tuple(self.axes.values())
+
+
+VALID_AXIS_NAMES = ("dp", "fsdp", "tp", "sp", "ep", "pp")
+
+
+def validate_mesh_axes(axes: dict[str, int], num_devices: int) -> list[str]:
+    """Return a list of human-readable problems (empty = ok)."""
+    problems = []
+    for name, size in axes.items():
+        if name not in VALID_AXIS_NAMES:
+            problems.append(
+                f"unknown mesh axis {name!r} (valid: {', '.join(VALID_AXIS_NAMES)})"
+            )
+        if not isinstance(size, int) or size < 1:
+            problems.append(f"mesh axis {name!r} has invalid size {size!r}")
+    prod = math.prod(s for s in axes.values() if isinstance(s, int) and s >= 1)
+    if axes and prod != num_devices:
+        problems.append(
+            f"mesh axes {axes} multiply to {prod}, but the slice has {num_devices} chips"
+        )
+    return problems

@@ -1,0 +1,1 @@
+"""Shared control-plane utilities (no JAX imports here)."""

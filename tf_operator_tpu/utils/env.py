@@ -1,0 +1,31 @@
+"""Typed environment-variable helpers.
+
+Capability parity with the fork's pkg/util/util.go:79-104 (Getenv /
+GetenvInt32 / GetenvBool), which back its configurable TTL defaults.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def getenv(key: str, default: str = "") -> str:
+    v = os.environ.get(key)
+    return v if v not in (None, "") else default
+
+
+def getenv_int(key: str, default: int) -> int:
+    v = os.environ.get(key)
+    if v in (None, ""):
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def getenv_bool(key: str, default: bool) -> bool:
+    v = os.environ.get(key)
+    if v in (None, ""):
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")

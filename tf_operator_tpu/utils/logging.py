@@ -1,0 +1,96 @@
+"""Structured, key-tagged logging for the control plane.
+
+Capability parity with pkg/logger/logger.go:26-80: every log line carries the
+job / replica-type / replica-index / uid it concerns so operator logs can be
+filtered per job (the reference emits JSON for Stackdriver; we emit
+logfmt-style by default and JSON when TPUJOB_LOG_JSON=1).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Any
+
+from tf_operator_tpu.utils.env import getenv_bool
+
+_ROOT = logging.getLogger("tpujob")
+_CONFIGURED = False
+
+
+def _configure() -> None:
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(_StructuredFormatter(json_mode=getenv_bool("TPUJOB_LOG_JSON", False)))
+    _ROOT.addHandler(handler)
+    _ROOT.setLevel(logging.INFO)
+    _ROOT.propagate = False
+    _CONFIGURED = True
+
+
+class _StructuredFormatter(logging.Formatter):
+    def __init__(self, json_mode: bool):
+        super().__init__()
+        self.json_mode = json_mode
+
+    def format(self, record: logging.LogRecord) -> str:
+        fields: dict[str, Any] = getattr(record, "fields", {}) or {}
+        if self.json_mode:
+            payload = {
+                "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)),
+                "level": record.levelname.lower(),
+                "msg": record.getMessage(),
+                **fields,
+            }
+            return json.dumps(payload, sort_keys=True)
+        kv = " ".join(f"{k}={v}" for k, v in sorted(fields.items()))
+        return f"{record.levelname[0]} {record.getMessage()}" + (f"  [{kv}]" if kv else "")
+
+
+class FieldLogger:
+    """A logger bound to a fixed set of structured fields."""
+
+    def __init__(self, fields: dict[str, Any]):
+        _configure()
+        self.fields = fields
+
+    def _log(self, level: int, msg: str, *args: Any) -> None:
+        _ROOT.log(level, msg % args if args else msg, extra={"fields": self.fields})
+
+    def info(self, msg: str, *args: Any) -> None:
+        self._log(logging.INFO, msg, *args)
+
+    def warning(self, msg: str, *args: Any) -> None:
+        self._log(logging.WARNING, msg, *args)
+
+    def error(self, msg: str, *args: Any) -> None:
+        self._log(logging.ERROR, msg, *args)
+
+    def debug(self, msg: str, *args: Any) -> None:
+        self._log(logging.DEBUG, msg, *args)
+
+    def with_fields(self, **extra: Any) -> "FieldLogger":
+        return FieldLogger({**self.fields, **extra})
+
+
+def logger_for_job(namespace: str, name: str, uid: str = "") -> FieldLogger:
+    f: dict[str, Any] = {"job": f"{namespace}.{name}"}
+    if uid:
+        f["uid"] = uid
+    return FieldLogger(f)
+
+
+def logger_for_replica(namespace: str, name: str, rtype: str) -> FieldLogger:
+    return FieldLogger({"job": f"{namespace}.{name}", "replica-type": rtype})
+
+
+def logger_for_pod(namespace: str, pod_name: str) -> FieldLogger:
+    return FieldLogger({"pod": f"{namespace}.{pod_name}"})
+
+
+def logger_for_key(key: str) -> FieldLogger:
+    return FieldLogger({"job": key.replace("/", ".")})

@@ -1,0 +1,60 @@
+"""Deterministic naming for replicas, services, and expectation keys.
+
+Capability parity with the reference's pkg/common/jobcontroller/util.go:24-56
+(GenGeneralName / GenExpectationPodsKey / GenPodGroupName): the naming contract
+`{job}-{replica-type}-{index}` is load-bearing — it is the DNS identity each
+replica is addressed by in the injected cluster spec, and the reference pins it
+with pod_names_validation_tests.py.
+"""
+
+from __future__ import annotations
+
+import re
+
+# K8s DNS-1035/1123 label constraints that names must satisfy.
+_NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+MAX_NAME_LEN = 63
+
+
+def gen_general_name(job_name: str, replica_type: str, index: int | str) -> str:
+    """`{job}-{type}-{index}`, lowercased, '/'-free (ref util.go:24-32)."""
+    n = f"{job_name}-{replica_type}-{index}".lower()
+    return n.replace("/", "-")
+
+
+def gen_expectation_pods_key(job_key: str, replica_type: str) -> str:
+    """Expectation-cache key for pod creations/deletions (ref util.go:46)."""
+    return f"{job_key}/{replica_type.lower()}/pods"
+
+
+def gen_expectation_services_key(job_key: str, replica_type: str) -> str:
+    """Expectation-cache key for service creations (ref util.go:50)."""
+    return f"{job_key}/{replica_type.lower()}/services"
+
+
+def gen_podgroup_name(job_name: str) -> str:
+    """PodGroup shares the job's name (ref util.go:54-56)."""
+    return job_name
+
+
+def job_key(namespace: str, name: str) -> str:
+    """Workqueue key, `namespace/name` (client-go MetaNamespaceKeyFunc shape)."""
+    return f"{namespace}/{name}" if namespace else name
+
+
+def split_job_key(key: str) -> tuple[str, str]:
+    """Inverse of job_key; returns (namespace, name)."""
+    if "/" not in key:
+        return "", key
+    ns, name = key.split("/", 1)
+    return ns, name
+
+
+def is_valid_dns_name(name: str) -> bool:
+    return bool(name) and len(name) <= MAX_NAME_LEN and _NAME_RE.match(name) is not None
+
+
+def replica_index_from_name(pod_name: str) -> int | None:
+    """Extract trailing `-{index}` from a replica pod name; None if absent."""
+    m = re.search(r"-(\d+)$", pod_name)
+    return int(m.group(1)) if m else None
