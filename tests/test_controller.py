@@ -1,0 +1,579 @@
+"""Reconcile-core tests.
+
+Mirrors the reference Tier-1 matrix: TestNormalPath (controller_test.go:66),
+TestClusterSpec/TestRestartPolicy/TestExitCode (pod_test.go), cleanPodPolicy/
+TTL/ActiveDeadline/Backoff (job_test.go), condition machine (status_test.go).
+Pods/phases are injected directly into the cluster substrate, like testutil
+SetPodsStatuses.
+"""
+
+import json
+
+import pytest
+
+from tf_operator_tpu.api import defaults
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    ContainerSpec,
+    JobConditionType,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TrainJob,
+    TrainJobSpec,
+    is_failed,
+    is_succeeded,
+)
+from tf_operator_tpu.core.cluster import InMemoryCluster, PodPhase
+from tf_operator_tpu.core.controller import (
+    LABEL_REPLICA_INDEX,
+    LABEL_REPLICA_TYPE,
+    LABEL_JOB_ROLE,
+)
+from tf_operator_tpu.core.trainjob_controller import TrainJobController
+from tf_operator_tpu.gang.podgroup import SliceAllocator
+
+
+def make_job(
+    name="test-job",
+    namespace="default",
+    gang=False,
+    clean_pod_policy=None,
+    restart_policy=None,
+    **replica_counts,
+) -> TrainJob:
+    specs = {}
+    for rname, count in replica_counts.items():
+        rtype = defaults.canonical_replica_type(rname)
+        specs[rtype] = ReplicaSpec(
+            replicas=count,
+            restart_policy=restart_policy,
+            template=PodTemplateSpec(
+                containers=[ContainerSpec(name="tensorflow", image="img:1")]
+            ),
+        )
+    job = TrainJob(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=TrainJobSpec(replica_specs=specs),
+    )
+    job.spec.run_policy.scheduling.gang = gang
+    job.spec.run_policy.clean_pod_policy = clean_pod_policy
+    return defaults.set_defaults(job)
+
+
+@pytest.fixture
+def env():
+    cluster = InMemoryCluster()
+    controller = TrainJobController(cluster, enable_gang=False)
+    return cluster, controller
+
+
+def submit_and_sync(cluster, controller, job, timeout=10.0):
+    cluster.create_job(job)
+    assert controller.run_until_idle(timeout)
+    return cluster.get_job(job.namespace, job.name)
+
+
+def set_phase(cluster, controller, ns, name, phase, exit_code=None, restart_count=None):
+    cluster.set_pod_phase(ns, name, phase, exit_code=exit_code, restart_count=restart_count)
+    assert controller.run_until_idle()
+
+
+class TestNormalPath:
+    """Desired-vs-actual pod diffing matrix (controller_test.go:66-357)."""
+
+    @pytest.mark.parametrize(
+        "workers,ps",
+        [(1, 0), (4, 2), (8, 4), (1, 1)],
+    )
+    def test_creates_all_pods_and_services(self, env, workers, ps):
+        cluster, controller = env
+        counts = {"worker": workers}
+        if ps:
+            counts["ps"] = ps
+        job = make_job(**counts)
+        submit_and_sync(cluster, controller, job)
+
+        pods = cluster.list_pods("default")
+        svcs = cluster.list_services("default")
+        assert len(pods) == workers + ps
+        assert len(svcs) == workers + ps
+        names = {p.name for p in pods}
+        for i in range(workers):
+            assert f"test-job-worker-{i}" in names
+        for i in range(ps):
+            assert f"test-job-ps-{i}" in names
+
+    def test_no_double_create_on_resync(self, env):
+        cluster, controller = env
+        job = make_job(worker=3)
+        submit_and_sync(cluster, controller, job)
+        # Force several more sync passes.
+        for _ in range(3):
+            controller.enqueue(job.key())
+            assert controller.run_until_idle()
+        assert len(cluster.list_pods("default")) == 3
+
+    def test_partial_state_reconciles(self, env):
+        cluster, controller = env
+        job = make_job(worker=4)
+        submit_and_sync(cluster, controller, job)
+        cluster.delete_pod("default", "test-job-worker-2")
+        assert controller.run_until_idle()
+        names = {p.name for p in cluster.list_pods("default")}
+        assert "test-job-worker-2" in names and len(names) == 4
+
+    def test_created_condition_set(self, env):
+        cluster, controller = env
+        job = submit_and_sync(*env, make_job(worker=1))
+        assert any(
+            c.type == JobConditionType.CREATED and c.status for c in job.status.conditions
+        )
+
+    def test_labels_and_master_role(self, env):
+        cluster, controller = env
+        submit_and_sync(cluster, controller, make_job(worker=2))
+        w0 = cluster.get_pod("default", "test-job-worker-0")
+        w1 = cluster.get_pod("default", "test-job-worker-1")
+        assert w0.metadata.labels[LABEL_REPLICA_TYPE] == "worker"
+        assert w0.metadata.labels[LABEL_REPLICA_INDEX] == "0"
+        # no chief -> worker-0 is master
+        assert w0.metadata.labels.get(LABEL_JOB_ROLE) == "master"
+        assert LABEL_JOB_ROLE not in w1.metadata.labels
+
+    def test_chief_gets_master_role(self, env):
+        cluster, controller = env
+        submit_and_sync(cluster, controller, make_job(chief=1, worker=2))
+        chief = cluster.get_pod("default", "test-job-chief-0")
+        w0 = cluster.get_pod("default", "test-job-worker-0")
+        assert chief.metadata.labels.get(LABEL_JOB_ROLE) == "master"
+        assert LABEL_JOB_ROLE not in w0.metadata.labels
+
+
+class TestClusterSpec:
+    """Exact TF_CONFIG content (pod_test.go:102 TestClusterSpec)."""
+
+    def test_tf_config_json(self, env):
+        cluster, controller = env
+        job = make_job(name="dist", worker=2, ps=1)
+        submit_and_sync(cluster, controller, job)
+        pod = cluster.get_pod("default", "dist-worker-1")
+        envd = pod.spec.containers[0].env_dict()
+        cfg = json.loads(envd["TF_CONFIG"])
+        assert cfg == {
+            "cluster": {
+                "worker": [
+                    "dist-worker-0.default.svc:2222",
+                    "dist-worker-1.default.svc:2222",
+                ],
+                "ps": ["dist-ps-0.default.svc:2222"],
+            },
+            "task": {"type": "worker", "index": 1},
+            "environment": "cloud",
+        }
+
+    def test_single_replica_no_tf_config(self, env):
+        """isDistributed (pod_test.go TestIsDistributed)."""
+        cluster, controller = env
+        submit_and_sync(cluster, controller, make_job(worker=1))
+        pod = cluster.get_pod("default", "test-job-worker-0")
+        assert "TF_CONFIG" not in pod.spec.containers[0].env_dict()
+
+    def test_evaluator_excluded_from_cluster(self, env):
+        cluster, controller = env
+        job = make_job(name="ev", chief=1, worker=2, evaluator=1)
+        submit_and_sync(cluster, controller, job)
+        pod = cluster.get_pod("default", "ev-evaluator-0")
+        cfg = json.loads(pod.spec.containers[0].env_dict()["TF_CONFIG"])
+        assert "evaluator" not in cfg["cluster"]
+        assert cfg["task"] == {"type": "evaluator", "index": 0}
+
+    def test_jax_env(self, env):
+        cluster, controller = env
+        job = make_job(name="jx", chief=1, worker=2)
+        submit_and_sync(cluster, controller, job)
+        chief = cluster.get_pod("default", "jx-chief-0").spec.containers[0].env_dict()
+        w1 = cluster.get_pod("default", "jx-worker-1").spec.containers[0].env_dict()
+        assert chief["JAX_PROCESS_ID"] == "0"
+        assert chief["JAX_NUM_PROCESSES"] == "3"
+        assert w1["JAX_PROCESS_ID"] == "2"
+        assert w1["JAX_COORDINATOR_ADDRESS"] == "jx-chief-0.default.svc:8476"
+        assert w1["TPU_WORKER_HOSTNAMES"].split(",")[0] == "jx-chief-0.default.svc"
+
+    def test_tpu_resources_injected(self, env):
+        cluster, controller = env
+        job = make_job(name="tp", worker=2)
+        from tf_operator_tpu.api.types import TPUSpec
+
+        job.spec.tpu = TPUSpec(topology="v5e-8")
+        defaults.set_defaults(job)
+        submit_and_sync(cluster, controller, job)
+        pod = cluster.get_pod("default", "tp-worker-0")
+        assert pod.spec.containers[0].resources["google.com/tpu"] == 4
+        assert pod.spec.containers[0].env_dict()["TPUJOB_TOPOLOGY"] == "v5e-8"
+        assert json.loads(pod.spec.containers[0].env_dict()["TPUJOB_MESH"]) == {"dp": 8}
+
+
+class TestStatusMachine:
+    def test_running_condition(self, env):
+        cluster, controller = env
+        job = make_job(worker=2)
+        submit_and_sync(cluster, controller, job)
+        set_phase(cluster, controller, "default", "test-job-worker-0", PodPhase.RUNNING)
+        set_phase(cluster, controller, "default", "test-job-worker-1", PodPhase.RUNNING)
+        job = cluster.get_job("default", "test-job")
+        assert any(
+            c.type == JobConditionType.RUNNING and c.status for c in job.status.conditions
+        )
+        assert job.status.replica_statuses[ReplicaType.WORKER].active == 2
+
+    def test_worker0_success(self, env):
+        """worker-0 completion succeeds the job when no chief (status.go:99-140)."""
+        cluster, controller = env
+        job = make_job(worker=2)
+        submit_and_sync(cluster, controller, job)
+        set_phase(cluster, controller, "default", "test-job-worker-0", PodPhase.RUNNING)
+        set_phase(cluster, controller, "default", "test-job-worker-1", PodPhase.RUNNING)
+        set_phase(
+            cluster, controller, "default", "test-job-worker-0",
+            PodPhase.SUCCEEDED, exit_code=0,
+        )
+        job = cluster.get_job("default", "test-job")
+        assert is_succeeded(job.status)
+        assert job.status.completion_time is not None
+
+    def test_chief_success_overrides_workers(self, env):
+        cluster, controller = env
+        job = make_job(chief=1, worker=2)
+        submit_and_sync(cluster, controller, job)
+        for p in ("test-job-chief-0", "test-job-worker-0", "test-job-worker-1"):
+            set_phase(cluster, controller, "default", p, PodPhase.RUNNING)
+        set_phase(
+            cluster, controller, "default", "test-job-chief-0",
+            PodPhase.SUCCEEDED, exit_code=0,
+        )
+        job = cluster.get_job("default", "test-job")
+        assert is_succeeded(job.status)
+
+    def test_worker_failure_fails_job(self, env):
+        cluster, controller = env
+        job = make_job(worker=2)  # restartPolicy defaults Never
+        submit_and_sync(cluster, controller, job)
+        set_phase(
+            cluster, controller, "default", "test-job-worker-1",
+            PodPhase.FAILED, exit_code=1,
+        )
+        job = cluster.get_job("default", "test-job")
+        assert is_failed(job.status)
+
+    def test_all_workers_success_policy(self, env):
+        cluster, controller = env
+        job = make_job(worker=2)
+        job.spec.success_policy.policy = "AllWorkers"
+        submit_and_sync(cluster, controller, job)
+        set_phase(
+            cluster, controller, "default", "test-job-worker-0",
+            PodPhase.SUCCEEDED, exit_code=0,
+        )
+        job = cluster.get_job("default", "test-job")
+        assert not is_succeeded(job.status)
+        set_phase(
+            cluster, controller, "default", "test-job-worker-1",
+            PodPhase.SUCCEEDED, exit_code=0,
+        )
+        job = cluster.get_job("default", "test-job")
+        assert is_succeeded(job.status)
+
+
+class TestExitCode:
+    """ExitCode restart policy (pod_test.go:263 TestExitCode)."""
+
+    def test_retryable_exit_restarts_pod(self, env):
+        cluster, controller = env
+        job = make_job(worker=1, restart_policy=RestartPolicy.EXIT_CODE)
+        submit_and_sync(cluster, controller, job)
+        pod0 = cluster.get_pod("default", "test-job-worker-0")
+        set_phase(
+            cluster, controller, "default", "test-job-worker-0",
+            PodPhase.FAILED, exit_code=130,
+        )
+        # Pod was deleted and recreated fresh.
+        pod1 = cluster.get_pod("default", "test-job-worker-0")
+        assert pod1.metadata.uid != pod0.metadata.uid
+        assert pod1.status.phase == PodPhase.PENDING
+        job = cluster.get_job("default", "test-job")
+        assert any(
+            c.type == JobConditionType.RESTARTING and c.status
+            for c in job.status.conditions
+        )
+        assert not is_failed(job.status)
+
+    def test_permanent_exit_fails_job(self, env):
+        cluster, controller = env
+        job = make_job(worker=1, restart_policy=RestartPolicy.EXIT_CODE)
+        submit_and_sync(cluster, controller, job)
+        set_phase(
+            cluster, controller, "default", "test-job-worker-0",
+            PodPhase.FAILED, exit_code=1,
+        )
+        job = cluster.get_job("default", "test-job")
+        assert is_failed(job.status)
+        # Pod not deleted (kept for debugging, fork job.go:162).
+        assert cluster.try_get_pod("default", "test-job-worker-0") is not None
+
+    def test_exit_code_pod_restart_policy_never(self, env):
+        cluster, controller = env
+        job = make_job(worker=1, restart_policy=RestartPolicy.EXIT_CODE)
+        submit_and_sync(cluster, controller, job)
+        pod = cluster.get_pod("default", "test-job-worker-0")
+        assert pod.spec.restart_policy == "Never"
+
+
+class TestCleanPodPolicy:
+    """deletePodsAndServices matrix (job_test.go:200)."""
+
+    def run_to_success(self, cluster, controller, policy):
+        job = make_job(worker=2, clean_pod_policy=policy)
+        submit_and_sync(cluster, controller, job)
+        set_phase(cluster, controller, "default", "test-job-worker-1", PodPhase.RUNNING)
+        set_phase(
+            cluster, controller, "default", "test-job-worker-0",
+            PodPhase.SUCCEEDED, exit_code=0,
+        )
+        return cluster.get_job("default", "test-job")
+
+    def test_policy_all(self, env):
+        cluster, controller = env
+        job = self.run_to_success(cluster, controller, CleanPodPolicy.ALL)
+        assert is_succeeded(job.status)
+        assert cluster.list_pods("default") == []
+        assert cluster.list_services("default") == []
+
+    def test_policy_running(self, env):
+        cluster, controller = env
+        self.run_to_success(cluster, controller, CleanPodPolicy.RUNNING)
+        names = {p.name for p in cluster.list_pods("default")}
+        assert names == {"test-job-worker-0"}  # succeeded pod kept, running deleted
+        assert cluster.list_services("default") == []
+
+    def test_policy_none(self, env):
+        cluster, controller = env
+        self.run_to_success(cluster, controller, CleanPodPolicy.NONE)
+        assert len(cluster.list_pods("default")) == 2
+
+    def test_failed_job_keeps_pods(self, env):
+        """Fork behavior: failed jobs keep pods for debugging (job.go:162)."""
+        cluster, controller = env
+        job = make_job(worker=2, clean_pod_policy=CleanPodPolicy.ALL)
+        submit_and_sync(cluster, controller, job)
+        set_phase(
+            cluster, controller, "default", "test-job-worker-0",
+            PodPhase.FAILED, exit_code=1,
+        )
+        job = cluster.get_job("default", "test-job")
+        assert is_failed(job.status)
+        assert len(cluster.list_pods("default")) == 2
+
+
+class TestTTL:
+    """cleanupTFJob (job_test.go:379 TestCleanupTFJob)."""
+
+    def test_explicit_ttl_deletes_job(self, env):
+        cluster, controller = env
+        job = make_job(worker=1)
+        job.spec.run_policy.ttl_seconds_after_finished = 100
+        submit_and_sync(cluster, controller, job)
+        set_phase(
+            cluster, controller, "default", "test-job-worker-0",
+            PodPhase.SUCCEEDED, exit_code=0,
+        )
+        assert cluster.try_get_job("default", "test-job") is not None
+        # Travel past the TTL.
+        real_now = controller._now()
+        controller._now = lambda: real_now + 101
+        controller.enqueue(job.key())
+        assert controller.run_until_idle()
+        assert cluster.try_get_job("default", "test-job") is None
+
+    def test_fork_default_ttl_clean(self, env):
+        """cleanPodPolicy=All + success -> 900s default TTL (job.go:194-201)."""
+        cluster, controller = env
+        job = make_job(worker=1, clean_pod_policy=CleanPodPolicy.ALL)
+        submit_and_sync(cluster, controller, job)
+        set_phase(
+            cluster, controller, "default", "test-job-worker-0",
+            PodPhase.SUCCEEDED, exit_code=0,
+        )
+        real_now = controller._now()
+        controller._now = lambda: real_now + 901
+        controller.enqueue(job.key())
+        assert controller.run_until_idle()
+        assert cluster.try_get_job("default", "test-job") is None
+
+    def test_fork_default_ttl_debug_for_failed(self, env):
+        """Failed jobs get the 7d debug TTL even with cleanPodPolicy=All."""
+        cluster, controller = env
+        job = make_job(worker=1, clean_pod_policy=CleanPodPolicy.ALL)
+        submit_and_sync(cluster, controller, job)
+        set_phase(
+            cluster, controller, "default", "test-job-worker-0",
+            PodPhase.FAILED, exit_code=1,
+        )
+        real_now = controller._now()
+        controller._now = lambda: real_now + 901
+        controller.enqueue(job.key())
+        assert controller.run_until_idle()
+        assert cluster.try_get_job("default", "test-job") is not None  # 7d not reached
+
+
+class TestActiveDeadline:
+    """TestActiveDeadlineSeconds (job_test.go:553)."""
+
+    def test_deadline_fails_job(self, env):
+        cluster, controller = env
+        job = make_job(worker=1)
+        job.spec.run_policy.active_deadline_seconds = 60
+        submit_and_sync(cluster, controller, job)
+        set_phase(cluster, controller, "default", "test-job-worker-0", PodPhase.RUNNING)
+        real_now = controller._now()
+        controller._now = lambda: real_now + 61
+        controller.enqueue(job.key())
+        assert controller.run_until_idle()
+        job = cluster.get_job("default", "test-job")
+        assert is_failed(job.status)
+        assert any("DeadlineExceeded" == c.reason for c in job.status.conditions)
+
+
+class TestBackoff:
+    """TestBackoffForOnFailure (job_test.go:697)."""
+
+    def test_backoff_limit_exceeded(self, env):
+        cluster, controller = env
+        job = make_job(worker=1, restart_policy=RestartPolicy.ON_FAILURE)
+        job.spec.run_policy.backoff_limit = 3
+        submit_and_sync(cluster, controller, job)
+        # kubelet restarted the container 3 times in place.
+        cluster.set_pod_phase(
+            "default", "test-job-worker-0", PodPhase.RUNNING, restart_count=3
+        )
+        assert controller.run_until_idle()
+        job = cluster.get_job("default", "test-job")
+        assert is_failed(job.status)
+        assert any("BackoffLimitExceeded" == c.reason for c in job.status.conditions)
+
+    def test_never_policy_not_counted(self, env):
+        cluster, controller = env
+        job = make_job(worker=1, restart_policy=RestartPolicy.NEVER)
+        job.spec.run_policy.backoff_limit = 0
+        submit_and_sync(cluster, controller, job)
+        cluster.set_pod_phase(
+            "default", "test-job-worker-0", PodPhase.RUNNING, restart_count=5
+        )
+        assert controller.run_until_idle()
+        job = cluster.get_job("default", "test-job")
+        assert not is_failed(job.status)
+
+
+class TestInvalidSpec:
+    """invalid_tfjob_tests behavior: Failed condition, no crash."""
+
+    def test_invalid_job_marked_failed(self, env):
+        cluster, controller = env
+        job = make_job(worker=1)
+        job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].image = ""
+        cluster.create_job(job)
+        assert controller.run_until_idle()
+        job = cluster.get_job("default", "test-job")
+        assert is_failed(job.status)
+        assert any(
+            c.reason == "TrainJobFailedValidation" for c in job.status.conditions
+        )
+        assert cluster.list_pods("default") == []
+        events = cluster.events_for("TrainJob", "default", "test-job")
+        assert any(e.reason == "TrainJobFailedValidation" for e in events)
+
+
+class TestGang:
+    def test_podgroup_created_and_deleted(self):
+        cluster = InMemoryCluster()
+        controller = TrainJobController(cluster, enable_gang=True)
+        job = make_job(worker=2, ps=1, gang=True)
+        cluster.create_job(job)
+        assert controller.run_until_idle()
+        pgs = cluster.list_podgroups("default")
+        assert len(pgs) == 1 and pgs[0].min_member == 3
+        pod = cluster.get_pod("default", "test-job-worker-0")
+        assert pod.scheduler_name == "volcano"
+        assert pod.metadata.annotations["scheduling.k8s.io/group-name"] == "test-job"
+        # Success -> podgroup removed.
+        cluster.set_pod_phase(
+            "default", "test-job-worker-0", PodPhase.SUCCEEDED, exit_code=0
+        )
+        assert controller.run_until_idle()
+        assert cluster.list_podgroups("default") == []
+
+    def test_slice_gating(self):
+        from tf_operator_tpu.api.types import TPUSpec
+
+        cluster = InMemoryCluster()
+        allocator = SliceAllocator.of("v5e-8")
+        controller = TrainJobController(
+            cluster, enable_gang=True, slice_allocator=allocator
+        )
+        j1 = make_job(name="job-a", worker=2, gang=True)
+        j1.spec.tpu = TPUSpec(topology="v5e-8")
+        defaults.set_defaults(j1)
+        j2 = make_job(name="job-b", worker=2, gang=True)
+        j2.spec.tpu = TPUSpec(topology="v5e-8")
+        defaults.set_defaults(j2)
+
+        cluster.create_job(j1)
+        assert controller.run_until_idle()
+        cluster.create_job(j2)
+        assert controller.run_until_idle()
+
+        pods = {p.name for p in cluster.list_pods("default")}
+        # job-a got the slice; job-b is gang-waiting with zero pods.
+        assert pods == {"job-a-worker-0", "job-a-worker-1"}
+        assert allocator.free_slices() == 0
+        events = cluster.events_for("TrainJob", "default", "job-b")
+        assert any(e.reason == "SliceUnavailable" for e in events)
+
+        # job-a completes -> slice freed -> job-b schedules.
+        cluster.set_pod_phase(
+            "default", "job-a-worker-0", PodPhase.SUCCEEDED, exit_code=0
+        )
+        assert controller.run_until_idle()
+        controller.enqueue(j2.key())  # in prod the delayed requeue fires
+        assert controller.run_until_idle()
+        pods = {p.name for p in cluster.list_pods("default")}
+        assert "job-b-worker-0" in pods
+
+
+class TestSubPathSubstitution:
+    """Fork ((index)) shard substitution (pod.go:50-85)."""
+
+    def test_index_substituted(self, env):
+        from tf_operator_tpu.api.types import VolumeMount
+
+        cluster, controller = env
+        job = make_job(worker=3)
+        job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].volume_mounts = [
+            VolumeMount(name="data", mount_path="/data", sub_path="shard-((index))")
+        ]
+        submit_and_sync(cluster, controller, job)
+        for i in range(3):
+            pod = cluster.get_pod("default", f"test-job-worker-{i}")
+            assert pod.spec.containers[0].volume_mounts[0].sub_path == f"shard-{i}"
+
+
+class TestEvents:
+    def test_creation_events_recorded(self, env):
+        cluster, controller = env
+        submit_and_sync(cluster, controller, make_job(worker=2))
+        events = cluster.events_for("TrainJob", "default", "test-job")
+        reasons = [e.reason for e in events]
+        assert reasons.count("SuccessfulCreatePod") == 2
+        assert reasons.count("SuccessfulCreateService") == 2

@@ -1,0 +1,1 @@
+"""Cluster-spec injection: the control->data plane env contract."""

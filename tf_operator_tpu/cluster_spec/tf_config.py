@@ -1,0 +1,95 @@
+"""TF_CONFIG generation — legacy TensorFlow cluster-spec emitter.
+
+Exact-shape parity with pkg/controller.v1/tensorflow/tensorflow.go:40-142:
+
+  TF_CONFIG = {
+    "cluster": { "<type>": ["<job>-<type>-<i>.<ns>.svc[<domain>]:<port>", ...] },
+    "task":    { "type": "<type>", "index": <i> },
+    "environment": "cloud",
+  }
+
+  - replica types are lowercased in the cluster map (genClusterSpec:106)
+  - Evaluator is excluded from the cluster map (tensorflow.go:110-114)
+  - DNS names come from per-replica headless services; an optional cluster
+    domain suffix is appended when CUSTOM_CLUSTER_DOMAIN is set
+    (EnvCustomClusterDomain, tensorflow.go:32, issue #1063 behavior)
+  - the port is the training container's `tfjob-port` (constants.go:31)
+  - single-replica jobs get no TF_CONFIG at all (isDistributed, pod.go:292)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tf_operator_tpu.api import defaults
+from tf_operator_tpu.api.types import ReplicaType, TrainJob
+from tf_operator_tpu.utils.naming import gen_general_name
+
+ENV_TF_CONFIG = "TF_CONFIG"
+ENV_CUSTOM_CLUSTER_DOMAIN = "CUSTOM_CLUSTER_DOMAIN"
+
+# Stable emission order for cluster keys (dict order is insertion order; a
+# deterministic order keeps the JSON reproducible across reconciles).
+_TYPE_ORDER = [
+    ReplicaType.CHIEF,
+    ReplicaType.MASTER,
+    ReplicaType.WORKER,
+    ReplicaType.PS,
+    ReplicaType.EVALUATOR,
+]
+
+
+def replica_port(job: TrainJob, rtype: ReplicaType, port_name: str = defaults.DEFAULT_PORT_NAME) -> int:
+    """Port of the training container's named port (ref GetPortFromTFJob)."""
+    spec = job.spec.replica_specs.get(rtype)
+    if spec is not None:
+        c = defaults.training_container(spec)
+        if c is not None:
+            for p in c.ports:
+                if p.name == port_name:
+                    return p.container_port
+    return defaults.DEFAULT_PORT if port_name == defaults.DEFAULT_PORT_NAME else defaults.DEFAULT_COORDINATOR_PORT
+
+
+def replica_host(job: TrainJob, rtype: ReplicaType, index: int, domain: str | None = None) -> str:
+    """DNS name of one replica via its headless service (service.go:98-109)."""
+    if domain is None:
+        domain = os.environ.get(ENV_CUSTOM_CLUSTER_DOMAIN, "")
+    base = f"{gen_general_name(job.name, str(rtype), index)}.{job.namespace}.svc"
+    if domain:
+        if not domain.startswith("."):
+            domain = "." + domain
+        base += domain
+    return base
+
+
+def gen_cluster_spec(job: TrainJob, domain: str | None = None) -> dict[str, list[str]]:
+    """cluster map {lowercase type: [host:port,...]}; evaluator excluded."""
+    cluster: dict[str, list[str]] = {}
+    for rtype in _TYPE_ORDER:
+        spec = job.spec.replica_specs.get(rtype)
+        if spec is None or rtype is ReplicaType.EVALUATOR:
+            continue
+        port = replica_port(job, rtype)
+        cluster[str(rtype).lower()] = [
+            f"{replica_host(job, rtype, i, domain)}:{port}"
+            for i in range(int(spec.replicas or 0))
+        ]
+    return cluster
+
+
+def gen_tf_config(job: TrainJob, rtype: ReplicaType, index: int, domain: str | None = None) -> str:
+    """The TF_CONFIG JSON string for one replica (genTFConfigJSONStr:73)."""
+    payload = {
+        "cluster": gen_cluster_spec(job, domain),
+        "task": {"type": str(rtype).lower(), "index": index},
+        "environment": "cloud",
+    }
+    return json.dumps(payload)
+
+
+def is_distributed(job: TrainJob) -> bool:
+    """TF_CONFIG is only injected for >1 total replicas (isDistributed,
+    pod.go:292-313)."""
+    return job.total_replicas() > 1

@@ -1,0 +1,136 @@
+"""TPU/JAX-native cluster contract — the replacement for NCCL/GPU wiring.
+
+The reference's north-star GPU path was: pods request `nvidia.com/gpu`,
+TF_CONFIG wires a gRPC mesh, NCCL forms the collective fabric inside user
+containers (SURVEY.md §2 "Distributed communication backend"). The TPU-native
+contract this module emits instead:
+
+  - `jax.distributed` coordination env: JAX process id / count / coordinator
+    address (the chief's — or worker-0's — headless-service DNS name on the
+    coordinator port), so user code needs only `jax.distributed.initialize()`.
+  - TPUClusterResolver-compatible env (TPU_WORKER_ID, TPU_WORKER_HOSTNAMES,
+    KUBE_GOOGLE_CLOUD_TPU_ENDPOINTS) so legacy TF-on-TPU user code resolves
+    the same topology transparently (north-star transparency requirement).
+  - The slice/mesh description (TPUJOB_TOPOLOGY / TPUJOB_MESH) that
+    tf_operator_tpu.parallel uses to build its jax.sharding.Mesh: logical
+    axes over ICI within a slice, DCN across processes.
+  - Resource mutation: the training container gets `google.com/tpu` set to
+    the slice's host-local chip count (the reference copied pod templates
+    verbatim and left accelerator resources to the user, pod.go:195-243).
+
+Collectives then ride ICI within the slice and DCN across hosts via XLA —
+there is no NCCL anywhere in this framework.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tf_operator_tpu.api import defaults
+from tf_operator_tpu.api.types import ReplicaType, TrainJob
+from tf_operator_tpu.cluster_spec.tf_config import replica_host, replica_port
+from tf_operator_tpu.gang.topology import parse_topology
+
+ENV_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"
+ENV_PROCESS_ID = "JAX_PROCESS_ID"
+ENV_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_TPU_ENDPOINTS = "KUBE_GOOGLE_CLOUD_TPU_ENDPOINTS"
+ENV_TOPOLOGY = "TPUJOB_TOPOLOGY"
+ENV_MESH = "TPUJOB_MESH"
+ENV_JOB_NAME = "TPUJOB_NAME"
+ENV_REPLICA_TYPE = "TPUJOB_REPLICA_TYPE"
+ENV_REPLICA_INDEX = "TPUJOB_REPLICA_INDEX"
+
+TPU_RESOURCE = "google.com/tpu"
+
+# Replica types that participate as JAX processes, in process-id order: the
+# coordinator-bearing type first. PS/Evaluator are control-side helpers, not
+# SPMD processes.
+_PROCESS_TYPES = [ReplicaType.CHIEF, ReplicaType.MASTER, ReplicaType.WORKER]
+
+
+def _process_replicas(job: TrainJob) -> list[tuple[ReplicaType, int]]:
+    """(rtype, index) for every SPMD process, in global process-id order."""
+    out: list[tuple[ReplicaType, int]] = []
+    for rtype in _PROCESS_TYPES:
+        spec = job.spec.replica_specs.get(rtype)
+        if spec is None:
+            continue
+        out.extend((rtype, i) for i in range(int(spec.replicas or 0)))
+    return out
+
+
+def process_id(job: TrainJob, rtype: ReplicaType, index: int) -> int | None:
+    """Global JAX process id of a replica; None for non-SPMD replicas."""
+    for pid, (rt, i) in enumerate(_process_replicas(job)):
+        if rt is rtype and i == index:
+            return pid
+    return None
+
+
+def coordinator_address(job: TrainJob, domain: str | None = None) -> str | None:
+    """Chief (else worker-0) DNS name on the coordinator port."""
+    procs = _process_replicas(job)
+    if not procs:
+        return None
+    rt, i = procs[0]
+    port = replica_port(job, rt, defaults.COORDINATOR_PORT_NAME)
+    return f"{replica_host(job, rt, i, domain)}:{port}"
+
+
+def worker_hostnames(job: TrainJob, domain: str | None = None) -> list[str]:
+    return [replica_host(job, rt, i, domain) for rt, i in _process_replicas(job)]
+
+
+def gen_tpu_env(
+    job: TrainJob, rtype: ReplicaType, index: int, domain: str | None = None
+) -> dict[str, str]:
+    """All TPU/JAX env vars for one replica. Empty dict for non-SPMD replicas
+    (they still get TF_CONFIG for legacy PS-strategy parity)."""
+    pid = process_id(job, rtype, index)
+    env: dict[str, str] = {
+        ENV_JOB_NAME: job.name,
+        ENV_REPLICA_TYPE: str(rtype).lower(),
+        ENV_REPLICA_INDEX: str(index),
+    }
+    if pid is None:
+        return env
+    procs = _process_replicas(job)
+    hosts = worker_hostnames(job, domain)
+    coord = coordinator_address(job, domain)
+    tf_port = replica_port(job, rtype)
+    env.update(
+        {
+            ENV_COORDINATOR_ADDRESS: coord or "",
+            ENV_PROCESS_ID: str(pid),
+            ENV_NUM_PROCESSES: str(len(procs)),
+            ENV_TPU_WORKER_ID: str(pid),
+            ENV_TPU_WORKER_HOSTNAMES: ",".join(hosts),
+            ENV_TPU_ENDPOINTS: ",".join(f"grpc://{h}:{tf_port}" for h in hosts),
+        }
+    )
+    if job.spec.tpu is not None and job.spec.tpu.topology:
+        env[ENV_TOPOLOGY] = job.spec.tpu.topology
+    if job.spec.mesh is not None and job.spec.mesh.axes:
+        env[ENV_MESH] = json.dumps(job.spec.mesh.axes)
+    return env
+
+
+def tpu_resource_count(job: TrainJob) -> int | None:
+    """`google.com/tpu` chips each SPMD pod should request: the slice's
+    host-local chip count. None when the job requests no TPU slice."""
+    if job.spec.tpu is None or not job.spec.tpu.topology:
+        return None
+    try:
+        topo = parse_topology(
+            job.spec.tpu.topology, job.spec.tpu.accelerator, job.spec.tpu.chips_per_host
+        )
+    except ValueError:
+        return None
+    return topo.host_local_chips()
+
+
+def is_spmd_replica(rtype: ReplicaType) -> bool:
+    return rtype in _PROCESS_TYPES

@@ -1,0 +1,1 @@
+"""Reconcile core: cluster substrate, workqueue, expectations, controllers."""

@@ -1,0 +1,434 @@
+"""The cluster substrate: an in-process API server + informer bus.
+
+The reference talks to a Kubernetes API server through generated clientsets
+and shared informers (SURVEY.md §1 L2/L3). This framework's equivalent is a
+pluggable `Cluster` substrate holding the same object kinds (jobs, pods,
+services, pod groups, events) with:
+
+  - CRUD with optimistic resource versions and AlreadyExists/NotFound errors
+  - label-selector listing (the slice of selector algebra the operator uses)
+  - synchronous add/update/delete handlers per kind — the informer-event
+    contract the controllers consume (ref jobcontroller.go:81-138 handlers)
+  - an Event recorder doubling as a test assertion surface (ref
+    control/pod_control.go:139-148; E2E get_creation_failures_from_tfjob)
+
+`InMemoryCluster` is simultaneously the Tier-1 test fake (tests set pod
+phases directly, like testutil.SetPodsStatuses) and the real substrate for
+the local-process runtime, which materialises pods as OS processes and feeds
+their exit codes back into pod status. A future backend can adapt the same
+interface to a real K8s API server.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable
+
+from tf_operator_tpu.api.types import ObjectMeta, OwnerReference, PodTemplateSpec, TrainJob
+
+
+class PodPhase(str, Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+@dataclass
+class ContainerStatus:
+    name: str
+    running: bool = False
+    exit_code: int | None = None
+    reason: str = ""
+    restart_count: int = 0
+
+
+@dataclass
+class PodStatus:
+    phase: PodPhase = PodPhase.PENDING
+    container_statuses: list[ContainerStatus] = field(default_factory=list)
+    start_time: float | None = None
+    message: str = ""
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta
+    spec: PodTemplateSpec
+    status: PodStatus = field(default_factory=PodStatus)
+    node_name: str = ""
+    scheduler_name: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def controller_ref(self) -> OwnerReference | None:
+        for ref in self.metadata.owner_references:
+            if ref.controller:
+                return ref
+        return None
+
+    def is_finished(self) -> bool:
+        return self.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+
+    def main_exit_code(self, container_name: str | None = None) -> int | None:
+        """Exit code of the training container (ref pod.go:137-146 pulls the
+        tensorflow container's terminated state)."""
+        for cs in self.status.container_statuses:
+            if container_name is None or cs.name == container_name:
+                if cs.exit_code is not None:
+                    return cs.exit_code
+        return None
+
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta
+    selector: dict[str, str] = field(default_factory=dict)
+    ports: list[ServicePort] = field(default_factory=list)
+    cluster_ip: str = "None"  # headless: stable DNS, no VIP (ref service.go:98-109)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def controller_ref(self) -> OwnerReference | None:
+        for ref in self.metadata.owner_references:
+            if ref.controller:
+                return ref
+        return None
+
+
+@dataclass
+class PodGroup:
+    """Gang-scheduling unit (ref SyncPodGroup, jobcontroller.go:226-250)."""
+
+    metadata: ObjectMeta
+    min_member: int = 0
+    queue: str = ""
+    priority_class: str = ""
+    # TPU twist: a pod group may pin an atomic slice allocation.
+    tpu_topology: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class Event:
+    kind: str
+    namespace: str
+    name: str
+    type: str  # "Normal" | "Warning"
+    reason: str
+    message: str
+    timestamp: float = field(default_factory=time.time)
+
+
+class ApiError(Exception):
+    pass
+
+
+class NotFoundError(ApiError):
+    pass
+
+
+class AlreadyExistsError(ApiError):
+    pass
+
+
+class ConflictError(ApiError):
+    pass
+
+
+Handler = Callable[[Any], None]
+UpdateHandler = Callable[[Any, Any], None]
+
+KIND_JOB = "TrainJob"
+KIND_POD = "Pod"
+KIND_SERVICE = "Service"
+KIND_PODGROUP = "PodGroup"
+
+
+class InMemoryCluster:
+    """Thread-safe in-process cluster state with informer-style handlers.
+
+    Handlers are invoked synchronously after the mutation commits, outside the
+    store lock (so handlers may call back into the API). Objects are deep-
+    copied on the way in and out: callers never share mutable state with the
+    store, matching API-server value semantics (the reference relies on
+    DeepCopy before mutation, controller.go:312)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._stores: dict[str, dict[tuple[str, str], Any]] = {
+            KIND_JOB: {},
+            KIND_POD: {},
+            KIND_SERVICE: {},
+            KIND_PODGROUP: {},
+        }
+        self._events: list[Event] = []
+        self._rv = itertools.count(1)
+        self._add_handlers: dict[str, list[Handler]] = {}
+        self._update_handlers: dict[str, list[UpdateHandler]] = {}
+        self._delete_handlers: dict[str, list[Handler]] = {}
+
+    # ---- handler registration (informer contract) ----
+
+    def on_add(self, kind: str, fn: Handler) -> None:
+        with self._lock:
+            self._add_handlers.setdefault(kind, []).append(fn)
+
+    def on_update(self, kind: str, fn: UpdateHandler) -> None:
+        with self._lock:
+            self._update_handlers.setdefault(kind, []).append(fn)
+
+    def on_delete(self, kind: str, fn: Handler) -> None:
+        with self._lock:
+            self._delete_handlers.setdefault(kind, []).append(fn)
+
+    def _fire_add(self, kind: str, obj: Any) -> None:
+        for fn in list(self._add_handlers.get(kind, [])):
+            fn(copy.deepcopy(obj))
+
+    def _fire_update(self, kind: str, old: Any, new: Any) -> None:
+        for fn in list(self._update_handlers.get(kind, [])):
+            fn(copy.deepcopy(old), copy.deepcopy(new))
+
+    def _fire_delete(self, kind: str, obj: Any) -> None:
+        for fn in list(self._delete_handlers.get(kind, [])):
+            fn(copy.deepcopy(obj))
+
+    # ---- generic CRUD ----
+
+    def _create(self, kind: str, obj: Any) -> Any:
+        with self._lock:
+            key = (obj.metadata.namespace, obj.metadata.name)
+            if key in self._stores[kind]:
+                raise AlreadyExistsError(f"{kind} {key[0]}/{key[1]} already exists")
+            if not obj.metadata.uid:
+                obj.metadata.uid = str(uuid.uuid4())
+            obj.metadata.resource_version = next(self._rv)
+            stored = copy.deepcopy(obj)
+            self._stores[kind][key] = stored
+        self._fire_add(kind, stored)
+        return copy.deepcopy(stored)
+
+    def _get(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            obj = self._stores[kind].get((namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def _try_get(self, kind: str, namespace: str, name: str) -> Any | None:
+        try:
+            return self._get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def _update(self, kind: str, obj: Any) -> Any:
+        with self._lock:
+            key = (obj.metadata.namespace, obj.metadata.name)
+            old = self._stores[kind].get(key)
+            if old is None:
+                raise NotFoundError(f"{kind} {key[0]}/{key[1]} not found")
+            obj.metadata.resource_version = next(self._rv)
+            stored = copy.deepcopy(obj)
+            self._stores[kind][key] = stored
+        self._fire_update(kind, old, stored)
+        return copy.deepcopy(stored)
+
+    def _delete(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            obj = self._stores[kind].pop((namespace, name), None)
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+        self._fire_delete(kind, obj)
+        return obj
+
+    def _list(self, kind: str, namespace: str | None, selector: dict[str, str] | None) -> list[Any]:
+        with self._lock:
+            out = []
+            for (ns, _), obj in self._stores[kind].items():
+                if namespace is not None and ns != namespace:
+                    continue
+                if selector and not self._matches(obj.metadata.labels, selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    @staticmethod
+    def _matches(labels: dict[str, str], selector: dict[str, str]) -> bool:
+        return all(labels.get(k) == v for k, v in selector.items())
+
+    # ---- jobs ----
+
+    def create_job(self, job: TrainJob) -> TrainJob:
+        return self._create(KIND_JOB, job)
+
+    def get_job(self, namespace: str, name: str) -> TrainJob:
+        return self._get(KIND_JOB, namespace, name)
+
+    def try_get_job(self, namespace: str, name: str) -> TrainJob | None:
+        return self._try_get(KIND_JOB, namespace, name)
+
+    def update_job(self, job: TrainJob) -> TrainJob:
+        return self._update(KIND_JOB, job)
+
+    def update_job_status(self, job: TrainJob) -> TrainJob:
+        """Status-subresource write: only .status (+ bookkeeping annotations)
+        are persisted from `job` (ref UpdateStatus, k8sutil/client.go:85)."""
+        with self._lock:
+            key = (job.metadata.namespace, job.metadata.name)
+            old = self._stores[KIND_JOB].get(key)
+            if old is None:
+                raise NotFoundError(f"TrainJob {key[0]}/{key[1]} not found")
+            new = copy.deepcopy(old)
+            new.status = copy.deepcopy(job.status)
+            new.metadata.annotations = dict(job.metadata.annotations)
+            new.metadata.resource_version = next(self._rv)
+            self._stores[KIND_JOB][key] = new
+        self._fire_update(KIND_JOB, old, new)
+        return copy.deepcopy(new)
+
+    def delete_job(self, namespace: str, name: str) -> TrainJob:
+        return self._delete(KIND_JOB, namespace, name)
+
+    def list_jobs(self, namespace: str | None = None) -> list[TrainJob]:
+        return self._list(KIND_JOB, namespace, None)
+
+    # ---- pods ----
+
+    def create_pod(self, pod: Pod) -> Pod:
+        return self._create(KIND_POD, pod)
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        return self._get(KIND_POD, namespace, name)
+
+    def try_get_pod(self, namespace: str, name: str) -> Pod | None:
+        return self._try_get(KIND_POD, namespace, name)
+
+    def update_pod(self, pod: Pod) -> Pod:
+        return self._update(KIND_POD, pod)
+
+    def delete_pod(self, namespace: str, name: str) -> Pod:
+        return self._delete(KIND_POD, namespace, name)
+
+    def list_pods(
+        self, namespace: str | None = None, selector: dict[str, str] | None = None
+    ) -> list[Pod]:
+        return self._list(KIND_POD, namespace, selector)
+
+    def set_pod_phase(
+        self,
+        namespace: str,
+        name: str,
+        phase: PodPhase,
+        exit_code: int | None = None,
+        restart_count: int | None = None,
+        container: str = "tensorflow",
+    ) -> Pod:
+        """Test/runtime helper: mutate a pod's status (kubelet stand-in)."""
+        pod = self.get_pod(namespace, name)
+        pod.status.phase = phase
+        if pod.status.start_time is None and phase != PodPhase.PENDING:
+            pod.status.start_time = time.time()
+        cs = None
+        for c in pod.status.container_statuses:
+            if c.name == container:
+                cs = c
+        if cs is None:
+            cs = ContainerStatus(name=container)
+            pod.status.container_statuses.append(cs)
+        cs.running = phase == PodPhase.RUNNING
+        if exit_code is not None:
+            cs.exit_code = exit_code
+        if restart_count is not None:
+            cs.restart_count = restart_count
+        return self.update_pod(pod)
+
+    # ---- services ----
+
+    def create_service(self, svc: Service) -> Service:
+        return self._create(KIND_SERVICE, svc)
+
+    def get_service(self, namespace: str, name: str) -> Service:
+        return self._get(KIND_SERVICE, namespace, name)
+
+    def update_service(self, svc: Service) -> Service:
+        return self._update(KIND_SERVICE, svc)
+
+    def delete_service(self, namespace: str, name: str) -> Service:
+        return self._delete(KIND_SERVICE, namespace, name)
+
+    def list_services(
+        self, namespace: str | None = None, selector: dict[str, str] | None = None
+    ) -> list[Service]:
+        return self._list(KIND_SERVICE, namespace, selector)
+
+    # ---- pod groups ----
+
+    def create_podgroup(self, pg: PodGroup) -> PodGroup:
+        return self._create(KIND_PODGROUP, pg)
+
+    def try_get_podgroup(self, namespace: str, name: str) -> PodGroup | None:
+        return self._try_get(KIND_PODGROUP, namespace, name)
+
+    def update_podgroup(self, pg: PodGroup) -> PodGroup:
+        return self._update(KIND_PODGROUP, pg)
+
+    def delete_podgroup(self, namespace: str, name: str) -> PodGroup:
+        return self._delete(KIND_PODGROUP, namespace, name)
+
+    def list_podgroups(self, namespace: str | None = None) -> list[PodGroup]:
+        return self._list(KIND_PODGROUP, namespace, None)
+
+    # ---- events ----
+
+    def record_event(
+        self, kind: str, namespace: str, name: str, etype: str, reason: str, message: str
+    ) -> None:
+        with self._lock:
+            self._events.append(Event(kind, namespace, name, etype, reason, message))
+
+    def events_for(self, kind: str, namespace: str, name: str) -> list[Event]:
+        with self._lock:
+            return [
+                e
+                for e in self._events
+                if e.kind == kind and e.namespace == namespace and e.name == name
+            ]
+
+    def all_events(self) -> list[Event]:
+        with self._lock:
+            return list(self._events)

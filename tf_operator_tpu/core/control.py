@@ -1,0 +1,111 @@
+"""Pod/Service control: create/delete with events + owner-ref stamping.
+
+Capability parity with pkg/control/{pod_control,service_control}.go: every
+create/delete goes through one chokepoint that (a) stamps the controller
+owner reference, (b) records a K8s-style Event (Events double as a test
+assertion surface, ref pod_control.go:139-148), (c) reports failure without
+raising so the reconciler can keep going and rely on requeue.
+"""
+
+from __future__ import annotations
+
+from tf_operator_tpu.api.types import OwnerReference, TrainJob
+from tf_operator_tpu.core.cluster import (
+    ApiError,
+    InMemoryCluster,
+    Pod,
+    Service,
+)
+
+EVENT_SUCCESSFUL_CREATE_POD = "SuccessfulCreatePod"
+EVENT_FAILED_CREATE_POD = "FailedCreatePod"
+EVENT_SUCCESSFUL_DELETE_POD = "SuccessfulDeletePod"
+EVENT_FAILED_DELETE_POD = "FailedDeletePod"
+EVENT_SUCCESSFUL_CREATE_SERVICE = "SuccessfulCreateService"
+EVENT_FAILED_CREATE_SERVICE = "FailedCreateService"
+EVENT_SUCCESSFUL_DELETE_SERVICE = "SuccessfulDeleteService"
+EVENT_FAILED_DELETE_SERVICE = "FailedDeleteService"
+
+
+def gen_owner_reference(job: TrainJob) -> OwnerReference:
+    """Controller ownership marker (ref GenOwnerReference, jobcontroller.go:198)."""
+    return OwnerReference(
+        api_version=TrainJob.API_VERSION,
+        kind=TrainJob.KIND,
+        name=job.name,
+        uid=job.uid,
+        controller=True,
+        block_owner_deletion=True,
+    )
+
+
+class PodControl:
+    def __init__(self, cluster: InMemoryCluster):
+        self.cluster = cluster
+
+    def create_pod(self, pod: Pod, job: TrainJob) -> bool:
+        pod.metadata.owner_references = [gen_owner_reference(job)]
+        try:
+            self.cluster.create_pod(pod)
+        except ApiError as e:
+            self.cluster.record_event(
+                TrainJob.KIND, job.namespace, job.name, "Warning",
+                EVENT_FAILED_CREATE_POD, f"Error creating pod {pod.name}: {e}",
+            )
+            return False
+        self.cluster.record_event(
+            TrainJob.KIND, job.namespace, job.name, "Normal",
+            EVENT_SUCCESSFUL_CREATE_POD, f"Created pod: {pod.name}",
+        )
+        return True
+
+    def delete_pod(self, namespace: str, name: str, job: TrainJob) -> bool:
+        try:
+            self.cluster.delete_pod(namespace, name)
+        except ApiError as e:
+            self.cluster.record_event(
+                TrainJob.KIND, job.namespace, job.name, "Warning",
+                EVENT_FAILED_DELETE_POD, f"Error deleting pod {name}: {e}",
+            )
+            return False
+        self.cluster.record_event(
+            TrainJob.KIND, job.namespace, job.name, "Normal",
+            EVENT_SUCCESSFUL_DELETE_POD, f"Deleted pod: {name}",
+        )
+        return True
+
+
+class ServiceControl:
+    def __init__(self, cluster: InMemoryCluster):
+        self.cluster = cluster
+
+    def create_service(self, svc: Service, job: TrainJob) -> bool:
+        svc.metadata.owner_references = [gen_owner_reference(job)]
+        try:
+            self.cluster.create_service(svc)
+        except ApiError as e:
+            self.cluster.record_event(
+                TrainJob.KIND, job.namespace, job.name, "Warning",
+                EVENT_FAILED_CREATE_SERVICE, f"Error creating service {svc.name}: {e}",
+            )
+            return False
+        self.cluster.record_event(
+            TrainJob.KIND, job.namespace, job.name, "Normal",
+            EVENT_SUCCESSFUL_CREATE_SERVICE, f"Created service: {svc.name}",
+        )
+        return True
+
+    def delete_service(self, namespace: str, name: str, job: TrainJob) -> bool:
+        try:
+            self.cluster.delete_service(namespace, name)
+        except ApiError as e:
+            self.cluster.record_event(
+                TrainJob.KIND, job.namespace, job.name, "Warning",
+                EVENT_FAILED_DELETE_SERVICE, f"Error deleting service {name}: {e}",
+            )
+            return False
+        self.cluster.record_event(
+            TrainJob.KIND, job.namespace, job.name, "Normal",
+            EVENT_SUCCESSFUL_DELETE_SERVICE, f"Deleted service: {name}",
+        )
+        return True

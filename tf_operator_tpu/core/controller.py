@@ -1,0 +1,315 @@
+"""Generic job-controller framework.
+
+Capability parity with pkg/common/jobcontroller/ (SURVEY.md §1 L4): the
+reusable, framework-agnostic base the reference exposed as
+`ControllerInterface` + `JobController` so PyTorch/MXNet operators could
+share one reconcile engine. Here the plug-point is the abstract methods of
+`JobControllerBase`; `TrainJobController` (trainjob_controller.py) is the
+TrainJob implementation.
+
+Responsibilities at this layer (ref jobcontroller.go:81-301, pod.go, service.go):
+  - informer event handlers: pod/service add/update/delete -> resolve the
+    owning job via controller ref -> expectation bookkeeping -> enqueue key
+  - rate-limited workqueue worker loop
+  - label generation and label-selector based claim/adopt of pods & services
+  - index-sliced replica views (GetPodSlices)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from tf_operator_tpu.api.types import TrainJob
+from tf_operator_tpu.core.cluster import (
+    KIND_POD,
+    KIND_SERVICE,
+    KIND_JOB,
+    InMemoryCluster,
+    Pod,
+    Service,
+)
+from tf_operator_tpu.core.control import PodControl, ServiceControl
+from tf_operator_tpu.core.expectations import ControllerExpectations
+from tf_operator_tpu.core.workqueue import RateLimitingQueue
+from tf_operator_tpu.utils import naming
+from tf_operator_tpu.utils.logging import logger_for_key
+
+# Label vocabulary (ref jobcontroller.go GenLabels + pod.go:187-193).
+LABEL_GROUP_NAME = "group-name"
+LABEL_JOB_NAME = "job-name"
+LABEL_REPLICA_TYPE = "replica-type"
+LABEL_REPLICA_INDEX = "replica-index"
+LABEL_JOB_ROLE = "job-role"
+
+
+def gen_labels(job_name: str) -> dict[str, str]:
+    return {
+        LABEL_GROUP_NAME: TrainJob.API_GROUP,
+        LABEL_JOB_NAME: job_name.replace("/", "-"),
+    }
+
+
+class JobControllerBase:
+    """Reconcile engine: workqueue + expectations + claim/adopt."""
+
+    def __init__(self, cluster: InMemoryCluster):
+        self.cluster = cluster
+        self.queue = RateLimitingQueue()
+        self.expectations = ControllerExpectations()
+        self.pod_control = PodControl(cluster)
+        self.service_control = ServiceControl(cluster)
+        self._stop = threading.Event()
+        self._workers: list[threading.Thread] = []
+        self._in_flight = 0
+        self._idle_cond = threading.Condition()
+        self._register_handlers()
+
+    # ---- plug-points (ControllerInterface, jobcontroller.go:33-63) ----
+
+    def sync_job(self, key: str) -> None:
+        raise NotImplementedError
+
+    # ---- informer wiring ----
+
+    def _register_handlers(self) -> None:
+        self.cluster.on_add(KIND_JOB, self._on_job_add)
+        self.cluster.on_update(KIND_JOB, self._on_job_update)
+        self.cluster.on_delete(KIND_JOB, self._on_job_delete)
+        self.cluster.on_add(KIND_POD, self._on_pod_add)
+        self.cluster.on_update(KIND_POD, self._on_pod_update)
+        self.cluster.on_delete(KIND_POD, self._on_pod_delete)
+        self.cluster.on_add(KIND_SERVICE, self._on_service_add)
+        self.cluster.on_update(KIND_SERVICE, self._on_service_update)
+        self.cluster.on_delete(KIND_SERVICE, self._on_service_delete)
+
+    def enqueue(self, key: str) -> None:
+        self.queue.add(key)
+
+    def _on_job_add(self, job: TrainJob) -> None:
+        self.enqueue(job.key())
+
+    def _on_job_update(self, old: TrainJob, new: TrainJob) -> None:
+        self.enqueue(new.key())
+
+    def _on_job_delete(self, job: TrainJob) -> None:
+        key = job.key()
+        for rtype in job.spec.replica_specs:
+            self.expectations.delete_expectations(
+                naming.gen_expectation_pods_key(key, str(rtype))
+            )
+            self.expectations.delete_expectations(
+                naming.gen_expectation_services_key(key, str(rtype))
+            )
+        self.queue.forget(key)
+        # Cascade deletion: the reference relied on the K8s garbage collector
+        # following ownerReferences (blockOwnerDeletion); this substrate IS
+        # the API server, so the controller collects the children itself.
+        for pod in self.cluster.list_pods(job.namespace, gen_labels(job.name)):
+            ref = pod.controller_ref()
+            if ref is not None and ref.uid == job.uid:
+                try:
+                    self.cluster.delete_pod(pod.namespace, pod.name)
+                except Exception:
+                    pass
+        for svc in self.cluster.list_services(job.namespace, gen_labels(job.name)):
+            ref = svc.controller_ref()
+            if ref is not None and ref.uid == job.uid:
+                try:
+                    self.cluster.delete_service(svc.namespace, svc.name)
+                except Exception:
+                    pass
+        pg = self.cluster.try_get_podgroup(job.namespace, job.name)
+        if pg is not None:
+            try:
+                self.cluster.delete_podgroup(job.namespace, job.name)
+            except Exception:
+                pass
+        # One final sync of the now-missing key releases slice allocations
+        # and expectation entries (sync_job's not-found path).
+        self.enqueue(key)
+
+    def _owner_key(self, obj: Pod | Service) -> tuple[str, str] | None:
+        """(job_key, replica_type) for an object owned by one of our jobs
+        (ref resolveControllerRef, jobcontroller/pod.go:20-67)."""
+        ref = obj.controller_ref()
+        if ref is None or ref.kind != TrainJob.KIND:
+            return None
+        job = self.cluster.try_get_job(obj.metadata.namespace, ref.name)
+        if job is None or (ref.uid and job.uid and job.uid != ref.uid):
+            return None
+        rtype = obj.metadata.labels.get(LABEL_REPLICA_TYPE, "")
+        return naming.job_key(job.namespace, job.name), rtype
+
+    def _on_pod_add(self, pod: Pod) -> None:
+        owner = self._owner_key(pod)
+        if owner is None:
+            return
+        key, rtype = owner
+        self.expectations.creation_observed(naming.gen_expectation_pods_key(key, rtype))
+        self.enqueue(key)
+
+    def _on_pod_update(self, old: Pod, new: Pod) -> None:
+        if old.metadata.resource_version == new.metadata.resource_version:
+            return
+        owner = self._owner_key(new)
+        if owner is not None:
+            self.enqueue(owner[0])
+
+    def _on_pod_delete(self, pod: Pod) -> None:
+        owner = self._owner_key(pod)
+        if owner is None:
+            return
+        key, rtype = owner
+        self.expectations.deletion_observed(naming.gen_expectation_pods_key(key, rtype))
+        self.enqueue(key)
+
+    def _on_service_add(self, svc: Service) -> None:
+        owner = self._owner_key(svc)
+        if owner is None:
+            return
+        key, rtype = owner
+        self.expectations.creation_observed(
+            naming.gen_expectation_services_key(key, rtype)
+        )
+        self.enqueue(key)
+
+    def _on_service_update(self, old: Service, new: Service) -> None:
+        # Parity note: the reference leaves service update/delete as TODO
+        # no-ops (service.go:58-66); we at least re-enqueue the owner.
+        owner = self._owner_key(new)
+        if owner is not None:
+            self.enqueue(owner[0])
+
+    def _on_service_delete(self, svc: Service) -> None:
+        owner = self._owner_key(svc)
+        if owner is not None:
+            self.enqueue(owner[0])
+
+    # ---- claim/adopt (ref ClaimPods/ClaimServices + ref managers) ----
+
+    def get_pods_for_job(self, job: TrainJob) -> list[Pod]:
+        selector = gen_labels(job.name)
+        pods = self.cluster.list_pods(job.namespace, selector)
+        return self._claim(pods, job, self.cluster.update_pod)
+
+    def get_services_for_job(self, job: TrainJob) -> list[Service]:
+        selector = gen_labels(job.name)
+        services = self.cluster.list_services(job.namespace, selector)
+        return self._claim(services, job, self.cluster.update_service)
+
+    def _claim(self, objs, job: TrainJob, updater: Callable | None):
+        """Keep objects our controller ref owns; adopt label-matching orphans
+        (ref service_ref_manager.go:83-160). Objects owned by another
+        controller are left alone."""
+        from tf_operator_tpu.core.control import gen_owner_reference
+
+        claimed = []
+        for obj in objs:
+            ref = obj.controller_ref()
+            if ref is not None:
+                if ref.uid == job.uid:
+                    claimed.append(obj)
+                continue
+            # Orphan with matching labels: adopt unless job is being deleted.
+            if job.metadata.deletion_timestamp is None:
+                obj.metadata.owner_references.append(gen_owner_reference(job))
+                if updater is not None:
+                    obj = updater(obj)
+                claimed.append(obj)
+        return claimed
+
+    @staticmethod
+    def filter_pods_for_replica_type(pods: list[Pod], rtype: str) -> list[Pod]:
+        return [p for p in pods if p.metadata.labels.get(LABEL_REPLICA_TYPE) == rtype.lower()]
+
+    @staticmethod
+    def get_pod_slices(pods: list[Pod], replicas: int) -> list[list[Pod]]:
+        """Index-sliced view: slices[i] = pods labeled replica-index=i
+        (ref GetPodSlices, jobcontroller/pod.go:222)."""
+        slices: list[list[Pod]] = [[] for _ in range(replicas)]
+        for p in pods:
+            try:
+                idx = int(p.metadata.labels.get(LABEL_REPLICA_INDEX, ""))
+            except ValueError:
+                continue
+            if 0 <= idx < replicas:
+                slices[idx].append(p)
+        return slices
+
+    @staticmethod
+    def filter_services_for_replica_type(services: list[Service], rtype: str) -> list[Service]:
+        return [s for s in services if s.metadata.labels.get(LABEL_REPLICA_TYPE) == rtype.lower()]
+
+    @staticmethod
+    def get_service_slices(services: list[Service], replicas: int) -> list[list[Service]]:
+        slices: list[list[Service]] = [[] for _ in range(replicas)]
+        for s in services:
+            try:
+                idx = int(s.metadata.labels.get(LABEL_REPLICA_INDEX, ""))
+            except ValueError:
+                continue
+            if 0 <= idx < replicas:
+                slices[idx].append(s)
+        return slices
+
+    # ---- worker loop (ref controller.go:182-270) ----
+
+    def run(self, workers: int = 1) -> None:
+        self._stop.clear()
+        for i in range(workers):
+            t = threading.Thread(target=self._worker, name=f"reconciler-{i}", daemon=True)
+            t.start()
+            self._workers.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shut_down()
+        for t in self._workers:
+            t.join(timeout=5)
+        self._workers.clear()
+
+    def _process_item(self, item) -> None:
+        """Sync one key; on failure, requeue with backoff (controller.go:267)."""
+        try:
+            self.sync_job(item)
+            self.queue.forget(item)
+        except Exception as e:
+            from tf_operator_tpu.status import metrics
+
+            metrics.reconcile_errors.inc()
+            logger_for_key(str(item)).error("sync failed: %s: %s", type(e).__name__, e)
+            self.queue.add_rate_limited(item)
+        finally:
+            self.queue.done(item)
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            item = self.queue.get(timeout=0.2)
+            if item is None:
+                continue
+            with self._idle_cond:
+                self._in_flight += 1
+            try:
+                self._process_item(item)
+            finally:
+                with self._idle_cond:
+                    self._in_flight -= 1
+                    self._idle_cond.notify_all()
+
+    def run_until_idle(self, timeout: float = 10.0) -> bool:
+        """Test/E2E helper: process queued work until the queue drains.
+        Returns False on timeout. Delayed items (add_after) are NOT waited
+        for — idle means 'nothing ready now'."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            item = self.queue.get(timeout=0.05)
+            if item is None:
+                with self._idle_cond:
+                    if self._in_flight == 0 and len(self.queue) == 0:
+                        return True
+                continue
+            self._process_item(item)
+        return False

@@ -1,0 +1,83 @@
+"""Controller expectations cache.
+
+The informer-lag dance (SURVEY.md §7 "hard parts"): after the controller
+issues N pod creations, the informer cache won't reflect them immediately; a
+re-sync in that window would double-create. The expectations cache records
+"I expect to observe N adds / M deletes for key K" and the event handlers
+decrement it; `satisfied()` gates reconciliation (ref jobcontroller.go:110-126,
+controller.go:477-496, modeled on k8s controller.ControllerExpectations).
+
+Expectations expire after 5 minutes (k8s ExpectationsTimeout) so a lost event
+can't wedge a job forever.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+EXPECTATIONS_TIMEOUT_S = 5 * 60.0
+
+
+class _Entry:
+    __slots__ = ("adds", "dels", "timestamp")
+
+    def __init__(self, adds: int, dels: int):
+        self.adds = adds
+        self.dels = dels
+        self.timestamp = time.monotonic()
+
+    def fulfilled(self) -> bool:
+        return self.adds <= 0 and self.dels <= 0
+
+    def expired(self) -> bool:
+        return time.monotonic() - self.timestamp > EXPECTATIONS_TIMEOUT_S
+
+
+class ControllerExpectations:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: dict[str, _Entry] = {}
+
+    def expect_creations(self, key: str, n: int) -> None:
+        with self._lock:
+            self._entries[key] = _Entry(n, 0)
+
+    def expect_deletions(self, key: str, n: int) -> None:
+        with self._lock:
+            self._entries[key] = _Entry(0, n)
+
+    def raise_expectations(self, key: str, adds: int, dels: int) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self._entries[key] = _Entry(adds, dels)
+            else:
+                e.adds += adds
+                e.dels += dels
+
+    def creation_observed(self, key: str) -> None:
+        self._lower(key, 1, 0)
+
+    def deletion_observed(self, key: str) -> None:
+        self._lower(key, 0, 1)
+
+    def _lower(self, key: str, adds: int, dels: int) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                e.adds -= adds
+                e.dels -= dels
+
+    def satisfied(self, key: str) -> bool:
+        """True if expectations are fulfilled, expired, or never set — the
+        exact gate of k8s SatisfiedExpectations."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return True
+            return e.fulfilled() or e.expired()
+
+    def delete_expectations(self, key: str) -> None:
+        with self._lock:
+            self._entries.pop(key, None)

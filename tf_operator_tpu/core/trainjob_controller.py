@@ -1,0 +1,537 @@
+"""The TrainJob controller: reconcile desired replica state into pods/services.
+
+Capability parity with pkg/controller.v1/tensorflow/ (SURVEY.md §1 L5, §3.2-3.3):
+  - syncTFJob/reconcileTFJobs orchestration     (controller.go:286-471)
+  - per-replica pod diffing + creation          (pod.go:89-330)
+  - headless service per replica                (service.go:35-128)
+  - terminal handling: cleanPodPolicy, TTL GC,
+    backoffLimit, activeDeadlineSeconds         (job.go:155-219, controller.go:371-438)
+  - exit-code restart semantics                 (pod.go:135-156 + train_util.go)
+  - gang scheduling + atomic TPU-slice admission(jobcontroller.go:226, pod.go:224-238)
+  - fork behaviors preserved: default TTLs (900s only when cleanPodPolicy=All
+    and the job did not fail, else 7d debug TTL — job.go:181-219), failed jobs
+    keep their pods for debugging (job.go:162), `((index))` subPath
+    substitution for per-replica data shards (pod.go:50-85)
+
+TPU-native deltas:
+  - pods get the JAX/TPU cluster contract (cluster_spec.tpu_env) in addition
+    to legacy TF_CONFIG; SPMD pods get `google.com/tpu` resources
+  - gang admission is whole-slice: a job requesting `tpu.topology` only gets
+    pods once a free slice of that shape exists (SliceAllocator)
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+
+from tf_operator_tpu.api import defaults as api_defaults
+from tf_operator_tpu.api import validation as api_validation
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    JobConditionType,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TrainJob,
+    is_failed,
+    is_succeeded,
+    is_terminal,
+)
+from tf_operator_tpu.cluster_spec import tf_config, tpu_env
+from tf_operator_tpu.core import controller as ctrl
+from tf_operator_tpu.core.cluster import (
+    InMemoryCluster,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    Service,
+    ServicePort,
+)
+from tf_operator_tpu.gang import podgroup as gang
+from tf_operator_tpu.status import engine as status_engine
+from tf_operator_tpu.status import metrics
+from tf_operator_tpu.utils import naming
+from tf_operator_tpu.utils.env import getenv_int
+from tf_operator_tpu.utils.exit_codes import is_retryable_exit_code
+
+# Fork TTL defaults (ref job.go:25-26,183-202): a finished job with no
+# explicit TTL is GC'd after 15min ONLY when cleanPodPolicy==All and the job
+# did not fail; anything else keeps 7 days for debugging.
+ENV_TTL_CLEAN = "ttlSecondsAfterFinished"
+ENV_TTL_DEBUG = "ttlSecondsAfterFinishedDebug"
+DEFAULT_TTL_CLEAN_S = 15 * 60
+DEFAULT_TTL_DEBUG_S = 7 * 24 * 3600
+
+ANNOTATION_SLICE = "tpujob.dev/slice"
+
+SLICE_RETRY_DELAY_S = 15.0
+
+
+class TrainJobController(ctrl.JobControllerBase):
+    def __init__(
+        self,
+        cluster: InMemoryCluster,
+        enable_gang: bool = True,
+        gang_scheduler_name: str = gang.DEFAULT_GANG_SCHEDULER,
+        slice_allocator: gang.SliceAllocator | None = None,
+        keep_failed_pods: bool = True,
+    ):
+        super().__init__(cluster)
+        self.enable_gang = enable_gang
+        self.gang_scheduler_name = gang_scheduler_name
+        self.slice_allocator = slice_allocator
+        self.keep_failed_pods = keep_failed_pods
+        self._now = time.time  # injectable clock for TTL/deadline tests
+        self.cluster.on_add("TrainJob", self._count_created)
+        self.cluster.on_delete("TrainJob", self._count_deleted)
+
+    @staticmethod
+    def _count_created(job: TrainJob) -> None:
+        metrics.jobs_created.inc()
+
+    @staticmethod
+    def _count_deleted(job: TrainJob) -> None:
+        metrics.jobs_deleted.inc()
+
+    # ------------------------------------------------------------------ sync
+
+    def sync_job(self, key: str) -> None:
+        """One reconcile pass for one job (syncTFJob, controller.go:286)."""
+        metrics.reconcile_total.inc()
+        ns, name = naming.split_job_key(key)
+        shared = self.cluster.try_get_job(ns, name)
+        if shared is None:
+            # Deleted between enqueue and sync: drop bookkeeping.
+            for rtype in ReplicaType:
+                self.expectations.delete_expectations(
+                    naming.gen_expectation_pods_key(key, str(rtype))
+                )
+                self.expectations.delete_expectations(
+                    naming.gen_expectation_services_key(key, str(rtype))
+                )
+            if self.slice_allocator is not None:
+                self.slice_allocator.release(key)
+            return
+
+        job = shared.deep_copy()
+        api_defaults.set_defaults(job)
+
+        # Invalid spec: mark Failed, emit event, never crash (parity with the
+        # unstructured-informer tolerance + invalid_tfjob_tests behavior).
+        problems = api_validation.validate_job(job)
+        if problems:
+            msg = "; ".join(problems)
+            self.cluster.record_event(
+                TrainJob.KIND, ns, name, "Warning",
+                status_engine.REASON_INVALID_SPEC, msg,
+            )
+            changed = status_engine.set_condition(
+                job.status, JobConditionType.FAILED,
+                status_engine.REASON_INVALID_SPEC, msg, self._now(),
+            )
+            if job.status.completion_time is None:
+                job.status.completion_time = self._now()
+                changed = True
+            if changed:
+                metrics.jobs_failed.inc()
+                self.cluster.update_job_status(job)
+            return
+
+        if not self._expectations_satisfied(key, job):
+            return
+
+        self.reconcile(job)
+
+    def _expectations_satisfied(self, key: str, job: TrainJob) -> bool:
+        """satisfiedExpectations (controller.go:477-496)."""
+        for rtype in job.spec.replica_specs:
+            if not self.expectations.satisfied(
+                naming.gen_expectation_pods_key(key, str(rtype))
+            ):
+                return False
+            if not self.expectations.satisfied(
+                naming.gen_expectation_services_key(key, str(rtype))
+            ):
+                return False
+        return True
+
+    # ------------------------------------------------------------- reconcile
+
+    def reconcile(self, job: TrainJob) -> None:
+        """reconcileTFJobs (controller.go:332)."""
+        key = job.key()
+        old_status = copy.deepcopy(job.status)
+
+        status_engine.set_condition(
+            job.status, JobConditionType.CREATED, status_engine.REASON_CREATED,
+            f"TrainJob {key} is created.", self._now(),
+        )
+
+        pods = self.get_pods_for_job(job)
+        services = self.get_services_for_job(job)
+
+        exceeded, exceed_reason, exceed_msg = self._past_limits(job, pods)
+
+        if is_terminal(job.status) or exceeded:
+            if exceeded and not is_terminal(job.status):
+                self.cluster.record_event(
+                    TrainJob.KIND, job.namespace, job.name, "Warning",
+                    exceed_reason, exceed_msg,
+                )
+                status_engine.set_condition(
+                    job.status, JobConditionType.FAILED, exceed_reason,
+                    exceed_msg, self._now(),
+                )
+                if job.status.completion_time is None:
+                    job.status.completion_time = self._now()
+                metrics.jobs_failed.inc()
+            self._delete_pods_and_services(job, pods, services)
+            if self.enable_gang:
+                gang.delete_podgroup(self.cluster, job)
+            if self.slice_allocator is not None:
+                self.slice_allocator.release(job.key())
+            # Status must be durable before TTL GC may delete the job.
+            if job.status != old_status:
+                self.cluster.update_job_status(job)
+            self._cleanup_by_ttl(job)
+            return
+
+        # Gang: PodGroup + atomic slice admission gate pod creation.
+        if self.enable_gang and job.spec.run_policy.scheduling.gang:
+            gang.sync_podgroup(self.cluster, job)
+            if not self._admit_slice(job, key):
+                if job.status != old_status:
+                    self.cluster.update_job_status(job)
+                self.queue.add_after(key, SLICE_RETRY_DELAY_S)
+                return
+
+        for rtype, spec in sorted(
+            job.spec.replica_specs.items(), key=lambda kv: str(kv[0])
+        ):
+            self.reconcile_pods(job, pods, rtype, spec)
+            self.reconcile_services(job, services, rtype, spec)
+
+        # Schedule a wake-up at the active deadline so expiry is noticed even
+        # with no pod events (ref job.go:136-152).
+        deadline = job.spec.run_policy.active_deadline_seconds
+        if deadline is not None and job.status.start_time is not None:
+            remaining = job.status.start_time + deadline - self._now()
+            if remaining > 0:
+                self.queue.add_after(key, remaining + 0.1)
+
+        if job.status != old_status:
+            job.status.last_reconcile_time = self._now()
+            self.cluster.update_job_status(job)
+
+    def _admit_slice(self, job: TrainJob, key: str) -> bool:
+        """Whole-slice admission; True when pods may be created."""
+        if (
+            self.slice_allocator is None
+            or job.spec.tpu is None
+            or not job.spec.tpu.topology
+        ):
+            return True
+        slice_id = self.slice_allocator.admit(key, job.spec.tpu.topology)
+        if slice_id is None:
+            self.cluster.record_event(
+                TrainJob.KIND, job.namespace, job.name, "Warning",
+                "SliceUnavailable",
+                f"no free {job.spec.tpu.topology} slice; gang-waiting",
+            )
+            return False
+        if job.metadata.annotations.get(ANNOTATION_SLICE) != slice_id:
+            job.metadata.annotations[ANNOTATION_SLICE] = slice_id
+        return True
+
+    # ---------------------------------------------------------- limit checks
+
+    def _past_limits(self, job: TrainJob, pods: list[Pod]) -> tuple[bool, str, str]:
+        if self._past_active_deadline(job):
+            return (
+                True,
+                status_engine.REASON_DEADLINE_EXCEEDED,
+                f"TrainJob {job.key()} has exceeded its activeDeadlineSeconds "
+                f"({job.spec.run_policy.active_deadline_seconds}s)",
+            )
+        if self._past_backoff_limit(job, pods):
+            return (
+                True,
+                status_engine.REASON_BACKOFF_EXCEEDED,
+                f"TrainJob {job.key()} has exceeded its backoffLimit "
+                f"({job.spec.run_policy.backoff_limit} restarts)",
+            )
+        return False, "", ""
+
+    def _past_active_deadline(self, job: TrainJob) -> bool:
+        """pastActiveDeadline (controller.go:539)."""
+        deadline = job.spec.run_policy.active_deadline_seconds
+        if deadline is None or job.status.start_time is None:
+            return False
+        return self._now() - job.status.start_time >= deadline
+
+    def _past_backoff_limit(self, job: TrainJob, pods: list[Pod]) -> bool:
+        """pastBackoffLimit (controller.go:500-536): container restart counts
+        are only accumulated for replicas whose policy is OnFailure/Always —
+        Never/ExitCode replicas fail/restart via pod replacement instead."""
+        limit = job.spec.run_policy.backoff_limit
+        if limit is None:
+            return False
+        restarts = 0
+        for rtype, spec in job.spec.replica_specs.items():
+            if spec.restart_policy not in (RestartPolicy.ON_FAILURE, RestartPolicy.ALWAYS):
+                continue
+            for pod in self.filter_pods_for_replica_type(pods, str(rtype)):
+                if pod.status.phase in (PodPhase.RUNNING, PodPhase.PENDING):
+                    restarts += sum(
+                        cs.restart_count for cs in pod.status.container_statuses
+                    )
+        if limit == 0:
+            return restarts > 0
+        return restarts >= limit
+
+    # ------------------------------------------------------------- terminal
+
+    def _delete_pods_and_services(self, job: TrainJob, pods: list[Pod], services: list[Service]) -> None:
+        """deletePodsAndServices (job.go:155-179). Fork behavior: a FAILED
+        job keeps everything for debugging (job.go:162) when keep_failed_pods."""
+        policy = job.spec.run_policy.clean_pod_policy or CleanPodPolicy.RUNNING
+        if policy == CleanPodPolicy.NONE:
+            return
+        if self.keep_failed_pods and is_failed(job.status):
+            return
+        for pod in pods:
+            if policy == CleanPodPolicy.RUNNING and pod.status.phase not in (
+                PodPhase.RUNNING,
+                PodPhase.PENDING,
+            ):
+                continue
+            self.pod_control.delete_pod(pod.namespace, pod.name, job)
+        # Services have no "running" notion: any cleanup policy removes them
+        # together with the pods (ref job.go:171-178 deletes services with All
+        # and Running alike).
+        for svc in services:
+            self.service_control.delete_service(svc.namespace, svc.name, job)
+
+    def _effective_ttl(self, job: TrainJob) -> int:
+        ttl = job.spec.run_policy.ttl_seconds_after_finished
+        if ttl is not None:
+            return int(ttl)
+        clean = (
+            job.spec.run_policy.clean_pod_policy == CleanPodPolicy.ALL
+            and not is_failed(job.status)
+        )
+        if clean:
+            return getenv_int(ENV_TTL_CLEAN, DEFAULT_TTL_CLEAN_S)
+        return getenv_int(ENV_TTL_DEBUG, DEFAULT_TTL_DEBUG_S)
+
+    def _cleanup_by_ttl(self, job: TrainJob) -> None:
+        """cleanupTFJob (job.go:181-219): delete the job ttl seconds after
+        completion, else schedule a delayed re-sync."""
+        if job.status.completion_time is None:
+            return
+        ttl = self._effective_ttl(job)
+        if ttl < 0:
+            return
+        expiry = job.status.completion_time + ttl
+        now = self._now()
+        if now >= expiry:
+            try:
+                self.cluster.delete_job(job.namespace, job.name)
+            except Exception:
+                pass
+        else:
+            self.queue.add_after(job.key(), expiry - now + 0.1)
+
+    # ------------------------------------------------------------- replicas
+
+    def reconcile_pods(
+        self, job: TrainJob, pods: list[Pod], rtype: ReplicaType, spec: ReplicaSpec
+    ) -> None:
+        """reconcilePods (pod.go:89-170)."""
+        replicas = int(spec.replicas or 0)
+        rpods = self.filter_pods_for_replica_type(pods, str(rtype))
+        slices = self.get_pod_slices(rpods, replicas)
+        key = job.key()
+        exp_key = naming.gen_expectation_pods_key(key, str(rtype))
+
+        restart = False
+        worker0_completed = self._worker0_completed(job, pods)
+        masters_present = status_engine.has_chief_or_master(job)
+
+        for index, pod_slice in enumerate(slices):
+            if not pod_slice:
+                master_role = (
+                    rtype in (ReplicaType.CHIEF, ReplicaType.MASTER)
+                    if masters_present
+                    else (rtype is ReplicaType.WORKER and index == 0)
+                )
+                self._create_new_pod(job, rtype, index, spec, master_role, exp_key)
+                continue
+            if len(pod_slice) > 1:
+                # Duplicate index: keep the oldest, delete the rest.
+                pod_slice.sort(key=lambda p: p.metadata.creation_timestamp)
+                for dup in pod_slice[1:]:
+                    self.expectations.raise_expectations(exp_key, 0, 1)
+                    if not self.pod_control.delete_pod(dup.namespace, dup.name, job):
+                        self.expectations.deletion_observed(exp_key)
+            pod = pod_slice[0]
+
+            # Exit-code restart: a failed pod whose training container exited
+            # with a retryable code is deleted; the next sync recreates it
+            # (pod.go:135-156 + train_util.go:18).
+            if (
+                spec.restart_policy == RestartPolicy.EXIT_CODE
+                and pod.status.phase == PodPhase.FAILED
+            ):
+                code = pod.main_exit_code()
+                if code is not None and is_retryable_exit_code(code):
+                    self.cluster.record_event(
+                        TrainJob.KIND, job.namespace, job.name, "Normal",
+                        "ExitedWithCode",
+                        f"Pod {pod.name} exited with code {code}; restarting",
+                    )
+                    # The restart decision stands even if the delete races a
+                    # concurrent out-of-band removal: either way the replica
+                    # is being replaced, not permanently failed.
+                    restart = True
+                    self.expectations.raise_expectations(exp_key, 0, 1)
+                    if not self.pod_control.delete_pod(pod.namespace, pod.name, job):
+                        # Pod already gone: its delete event (if any) fired
+                        # before our expectation was raised; roll it back.
+                        self.expectations.deletion_observed(exp_key)
+
+        status_engine.update_replica_status_counts(
+            job.status, rtype, self.filter_pods_for_replica_type(pods, str(rtype))
+        )
+        status_engine.update_status_single(
+            job, rtype, replicas, restart, worker0_completed, self._now()
+        )
+
+    def _worker0_completed(self, job: TrainJob, pods: list[Pod]) -> bool:
+        """worker-0 success detection (pod.go:159-162)."""
+        for pod in self.filter_pods_for_replica_type(pods, str(ReplicaType.WORKER)):
+            if pod.metadata.labels.get(ctrl.LABEL_REPLICA_INDEX) == "0":
+                if pod.status.phase == PodPhase.SUCCEEDED:
+                    return True
+                code = pod.main_exit_code()
+                if code == 0 and pod.is_finished():
+                    return True
+        return False
+
+    def _create_new_pod(
+        self,
+        job: TrainJob,
+        rtype: ReplicaType,
+        index: int,
+        spec: ReplicaSpec,
+        master_role: bool,
+        exp_key: str,
+    ) -> None:
+        """createNewPod (pod.go:171-258)."""
+        self.expectations.raise_expectations(exp_key, 1, 0)
+
+        template = copy.deepcopy(spec.template)
+        labels = {
+            **template.labels,
+            **ctrl.gen_labels(job.name),
+            ctrl.LABEL_REPLICA_TYPE: str(rtype).lower(),
+            ctrl.LABEL_REPLICA_INDEX: str(index),
+        }
+        if master_role:
+            labels[ctrl.LABEL_JOB_ROLE] = "master"
+
+        name = naming.gen_general_name(job.name, str(rtype), index)
+
+        # Cluster-spec injection into the training container (pod.go:208,260).
+        container = api_defaults.training_container(spec)
+        tgt = template.container(container.name) if container is not None else None
+        if tgt is not None:
+            if tf_config.is_distributed(job):
+                tgt.set_env(tf_config.ENV_TF_CONFIG, tf_config.gen_tf_config(job, rtype, index))
+            for k, v in tpu_env.gen_tpu_env(job, rtype, index).items():
+                tgt.set_env(k, v)
+            # TPU resources for SPMD pods (reference copied templates verbatim
+            # and left GPU resources to the user; the TPU slice is ours to wire).
+            chips = tpu_env.tpu_resource_count(job)
+            if chips is not None and tpu_env.is_spmd_replica(rtype):
+                tgt.resources.setdefault(tpu_env.TPU_RESOURCE, chips)
+
+        # Fork `((index))` subPath substitution (pod.go:50-85): each replica
+        # mounts its own data shard.
+        for c in template.containers:
+            for vm in c.volume_mounts:
+                if "((index))" in vm.sub_path:
+                    vm.sub_path = vm.sub_path.replace("((index))", str(index))
+
+        # Restart policy mapping (setRestartPolicy, pod.go:315): ExitCode is
+        # operator-managed, so the pod itself must not restart.
+        if spec.restart_policy == RestartPolicy.EXIT_CODE:
+            template.restart_policy = "Never"
+        elif spec.restart_policy is not None:
+            template.restart_policy = str(spec.restart_policy)
+
+        annotations = dict(template.annotations)
+        scheduler_name = template.scheduler_name
+        if self.enable_gang and job.spec.run_policy.scheduling.gang:
+            scheduler_name = self.gang_scheduler_name
+            annotations[gang.ANNOTATION_GROUP_NAME] = naming.gen_podgroup_name(job.name)
+        template.annotations = annotations
+
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=name,
+                namespace=job.namespace,
+                labels=labels,
+                annotations=annotations,
+            ),
+            spec=template,
+            scheduler_name=scheduler_name,
+        )
+        if not self.pod_control.create_pod(pod, job):
+            # Creation failed: lower the expectation so the job isn't stuck
+            # until the 5-minute expectation timeout.
+            self.expectations.creation_observed(exp_key)
+
+    # ------------------------------------------------------------- services
+
+    def reconcile_services(
+        self, job: TrainJob, services: list[Service], rtype: ReplicaType, spec: ReplicaSpec
+    ) -> None:
+        """reconcileServices (service.go:35-128): one headless service per
+        replica gives each process its stable DNS identity."""
+        replicas = int(spec.replicas or 0)
+        rsvcs = self.filter_services_for_replica_type(services, str(rtype))
+        slices = self.get_service_slices(rsvcs, replicas)
+        exp_key = naming.gen_expectation_services_key(job.key(), str(rtype))
+
+        for index, svc_slice in enumerate(slices):
+            if svc_slice:
+                continue
+            self.expectations.raise_expectations(exp_key, 1, 0)
+            name = naming.gen_general_name(job.name, str(rtype), index)
+            selector = {
+                **ctrl.gen_labels(job.name),
+                ctrl.LABEL_REPLICA_TYPE: str(rtype).lower(),
+                ctrl.LABEL_REPLICA_INDEX: str(index),
+            }
+            svc = Service(
+                metadata=ObjectMeta(
+                    name=name, namespace=job.namespace, labels=dict(selector)
+                ),
+                selector=selector,
+                ports=[
+                    ServicePort(
+                        name=api_defaults.DEFAULT_PORT_NAME,
+                        port=tf_config.replica_port(job, rtype),
+                    ),
+                    ServicePort(
+                        name=api_defaults.COORDINATOR_PORT_NAME,
+                        port=tf_config.replica_port(
+                            job, rtype, api_defaults.COORDINATOR_PORT_NAME
+                        ),
+                    ),
+                ],
+            )
+            if not self.service_control.create_service(svc, job):
+                self.expectations.creation_observed(exp_key)

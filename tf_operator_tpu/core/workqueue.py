@@ -1,0 +1,191 @@
+"""Rate-limited, deduplicating work queue.
+
+Semantics match client-go's workqueue, which the reference builds its hot loop
+on (ref jobcontroller.go:128-133, controller.go:198-270):
+
+  - **Dedup**: an item added while queued coalesces to one entry.
+  - **In-flight exclusivity**: an item re-added while being processed is not
+    handed to a second worker; it re-queues when `done()` is called. This is
+    the property that makes one-job-at-a-time reconciliation safe with many
+    workers.
+  - **Per-item exponential backoff** (`add_rate_limited`): 5ms * 2^failures,
+    capped at 1000s, reset by `forget()` — client-go's
+    DefaultControllerRateLimiter shape.
+  - **Overall token bucket**: 10 qps / burst 100 across all rate-limited adds.
+  - **Delayed adds** (`add_after`): the delaying queue used for TTL GC and
+    ActiveDeadline re-syncs (ref job.go:136-152).
+
+A C++ implementation of the same interface lives in native/ (runtime.native);
+this pure-Python one is the always-available fallback and the reference for
+its behavior.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Any, Hashable
+
+
+class ItemExponentialFailureRateLimiter:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._failures: dict[Hashable, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: Hashable) -> float:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        return min(self.base_delay * (2**n), self.max_delay)
+
+    def forget(self, item: Hashable) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: Hashable) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class BucketRateLimiter:
+    """Token bucket: qps refill, burst capacity. Returns the wait time."""
+
+    def __init__(self, qps: float = 10.0, burst: int = 100):
+        self.qps = qps
+        self.burst = burst
+        self._tokens = float(burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def when(self, item: Hashable = None) -> float:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return 0.0
+            need = 1.0 - self._tokens
+            self._tokens -= 1.0
+            return need / self.qps
+
+    def forget(self, item: Hashable = None) -> None:
+        pass
+
+    def num_requeues(self, item: Hashable = None) -> int:
+        return 0
+
+
+class MaxOfRateLimiter:
+    def __init__(self, *limiters: Any):
+        self.limiters = limiters
+
+    def when(self, item: Hashable) -> float:
+        return max(rl.when(item) for rl in self.limiters)
+
+    def forget(self, item: Hashable) -> None:
+        for rl in self.limiters:
+            rl.forget(item)
+
+    def num_requeues(self, item: Hashable) -> int:
+        return max(rl.num_requeues(item) for rl in self.limiters)
+
+
+def default_rate_limiter() -> MaxOfRateLimiter:
+    return MaxOfRateLimiter(
+        ItemExponentialFailureRateLimiter(0.005, 1000.0), BucketRateLimiter(10.0, 100)
+    )
+
+
+class RateLimitingQueue:
+    def __init__(self, rate_limiter: Any | None = None):
+        self._rl = rate_limiter or default_rate_limiter()
+        self._cond = threading.Condition()
+        self._queue: list[Hashable] = []  # FIFO of ready items
+        self._dirty: set[Hashable] = set()
+        self._processing: set[Hashable] = set()
+        self._waiting: list[tuple[float, int, Hashable]] = []  # (ready_at, seq, item)
+        self._seq = 0
+        self._shutdown = False
+
+    # -- core add/get/done (client-go Type semantics) --
+
+    def add(self, item: Hashable) -> None:
+        with self._cond:
+            if self._shutdown or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item not in self._processing:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._cond:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._waiting, (time.monotonic() + delay, self._seq, item))
+            self._cond.notify()
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        self.add_after(item, self._rl.when(item))
+
+    def forget(self, item: Hashable) -> None:
+        self._rl.forget(item)
+
+    def num_requeues(self, item: Hashable) -> int:
+        return self._rl.num_requeues(item)
+
+    def _drain_ready(self) -> None:
+        now = time.monotonic()
+        while self._waiting and self._waiting[0][0] <= now:
+            _, _, item = heapq.heappop(self._waiting)
+            if item not in self._dirty:
+                self._dirty.add(item)
+                if item not in self._processing:
+                    self._queue.append(item)
+
+    def get(self, timeout: float | None = None) -> Hashable | None:
+        """Blocks until an item is available; None on timeout or shutdown."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                self._drain_ready()
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._dirty.discard(item)
+                    self._processing.add(item)
+                    return item
+                if self._shutdown:
+                    return None
+                wait = None
+                if self._waiting:
+                    wait = max(0.0, self._waiting[0][0] - time.monotonic())
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cond.wait(wait)
+
+    def done(self, item: Hashable) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)

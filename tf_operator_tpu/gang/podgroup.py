@@ -1,0 +1,120 @@
+"""Gang scheduling: PodGroup sync + atomic TPU-slice admission.
+
+Capability parity with the reference's kube-batch integration
+(jobcontroller.go:226-258, pod.go:224-238): a PodGroup sized
+minMember=ΣReplicas is created before pods, each pod carries the
+`scheduling.k8s.io/group-name` annotation and the gang scheduler's name, and
+the PodGroup is deleted when the job terminates.
+
+TPU twist (SURVEY.md §2 gang row): a TPU slice is an inherently atomic unit —
+you get the whole v5e-32 slice or nothing. `SliceAllocator` models a fleet of
+slices and admits a PodGroup only when a whole slice matching the requested
+topology is free, which is exactly the all-or-nothing placement kube-batch
+provided for GPU pods, with the granularity raised from "pod fits on a node"
+to "job fits on a slice". This prevents the partial-placement deadlock the
+reference used gang scheduling to avoid.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from tf_operator_tpu.api.types import ObjectMeta, TrainJob
+from tf_operator_tpu.core.cluster import InMemoryCluster, PodGroup
+from tf_operator_tpu.gang.topology import SliceTopology, parse_topology
+from tf_operator_tpu.utils.naming import gen_podgroup_name
+
+ANNOTATION_GROUP_NAME = "scheduling.k8s.io/group-name"
+DEFAULT_GANG_SCHEDULER = "volcano"  # ref options.go default
+
+
+def sync_podgroup(cluster: InMemoryCluster, job: TrainJob) -> PodGroup:
+    """Create-or-update the job's PodGroup (ref SyncPodGroup:226)."""
+    name = gen_podgroup_name(job.name)
+    min_member = job.spec.run_policy.scheduling.min_available
+    if min_member is None:
+        min_member = job.total_replicas()
+    existing = cluster.try_get_podgroup(job.namespace, name)
+    if existing is not None:
+        if existing.min_member != min_member:
+            existing.min_member = min_member
+            return cluster.update_podgroup(existing)
+        return existing
+    pg = PodGroup(
+        metadata=ObjectMeta(
+            name=name,
+            namespace=job.namespace,
+            labels={"job-name": job.name},
+            owner_references=[],
+        ),
+        min_member=min_member,
+        queue=job.spec.run_policy.scheduling.queue,
+        priority_class=job.spec.run_policy.scheduling.priority_class,
+        tpu_topology=job.spec.tpu.topology if job.spec.tpu else "",
+    )
+    return cluster.create_podgroup(pg)
+
+
+def delete_podgroup(cluster: InMemoryCluster, job: TrainJob) -> bool:
+    """Delete the job's PodGroup if present (ref DeletePodGroup:252)."""
+    name = gen_podgroup_name(job.name)
+    if cluster.try_get_podgroup(job.namespace, name) is None:
+        return False
+    cluster.delete_podgroup(job.namespace, name)
+    return True
+
+
+@dataclass
+class SliceState:
+    topology: SliceTopology
+    slice_id: str
+    held_by: str | None = None  # "{ns}/{podgroup}" when allocated
+
+
+@dataclass
+class SliceAllocator:
+    """Atomic whole-slice admission control.
+
+    The fleet is a set of slices (e.g. four v5e-32 slices). `admit` grants a
+    PodGroup a whole free slice of the requested topology or rejects it —
+    never a partial allocation. Thread-safe; idempotent per holder."""
+
+    slices: list[SliceState] = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    @classmethod
+    def of(cls, *topologies: str) -> "SliceAllocator":
+        return cls(
+            slices=[
+                SliceState(topology=parse_topology(t), slice_id=f"slice-{i}")
+                for i, t in enumerate(topologies)
+            ]
+        )
+
+    def admit(self, holder: str, topology: str) -> str | None:
+        """Returns a slice_id, or None when no whole slice is free."""
+        want = parse_topology(topology)
+        with self._lock:
+            for s in self.slices:
+                if s.held_by == holder:
+                    return s.slice_id  # idempotent re-admission
+            for s in self.slices:
+                if (
+                    s.held_by is None
+                    and s.topology.accelerator == want.accelerator
+                    and s.topology.num_chips == want.num_chips
+                ):
+                    s.held_by = holder
+                    return s.slice_id
+        return None
+
+    def release(self, holder: str) -> None:
+        with self._lock:
+            for s in self.slices:
+                if s.held_by == holder:
+                    s.held_by = None
+
+    def free_slices(self) -> int:
+        with self._lock:
+            return sum(1 for s in self.slices if s.held_by is None)

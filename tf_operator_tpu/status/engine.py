@@ -1,0 +1,177 @@
+"""Job condition state machine.
+
+Capability parity with pkg/controller.v1/tensorflow/status.go:61-304:
+
+  - replica-phase counts -> job conditions Created/Running/Restarting/
+    Succeeded/Failed
+  - success semantics: when a Chief/Master exists the job succeeds iff the
+    chief completes; otherwise worker-0 acts as chief (worker0_completed), or
+    — under SuccessPolicy AllWorkers — every worker must finish
+  - failed>0 resolves to Restarting when the controller just restarted a
+    replica (restart flag), else Failed + completion time
+  - condition exclusivity: Running and Restarting displace each other;
+    terminal conditions demote Running to status=False
+    (setCondition/filterOutCondition, status.go:256-304)
+  - Prometheus counters on success/failure/restart transitions
+"""
+
+from __future__ import annotations
+
+import time
+
+from tf_operator_tpu.api.types import (
+    JobCondition,
+    JobConditionType,
+    JobStatus,
+    ReplicaStatus,
+    ReplicaType,
+    TrainJob,
+)
+from tf_operator_tpu.core.cluster import Pod, PodPhase
+from tf_operator_tpu.status import metrics
+
+# Condition reasons (stable API surface; tests and events assert on these).
+REASON_CREATED = "TrainJobCreated"
+REASON_RUNNING = "TrainJobRunning"
+REASON_RESTARTING = "TrainJobRestarting"
+REASON_SUCCEEDED = "TrainJobSucceeded"
+REASON_FAILED = "TrainJobFailed"
+REASON_INVALID_SPEC = "TrainJobFailedValidation"
+REASON_BACKOFF_EXCEEDED = "BackoffLimitExceeded"
+REASON_DEADLINE_EXCEEDED = "DeadlineExceeded"
+
+
+def _find(status: JobStatus, ctype: JobConditionType) -> JobCondition | None:
+    for c in status.conditions:
+        if c.type == ctype:
+            return c
+    return None
+
+
+def set_condition(status: JobStatus, ctype: JobConditionType, reason: str, message: str,
+                  now: float | None = None) -> bool:
+    """Append/replace a condition; returns True when status changed.
+    Mirrors setCondition + filterOutCondition (status.go:256-304)."""
+    now = time.time() if now is None else now
+    cur = _find(status, ctype)
+    if cur is not None and cur.status and cur.reason == reason and cur.message == message:
+        return False
+
+    new_cond = JobCondition(
+        type=ctype, status=True, reason=reason, message=message,
+        last_update_time=now, last_transition_time=now,
+    )
+    keep: list[JobCondition] = []
+    for c in status.conditions:
+        if c.type == ctype:
+            continue
+        # Running and Restarting are mutually exclusive views of "active".
+        if ctype == JobConditionType.RESTARTING and c.type == JobConditionType.RUNNING:
+            continue
+        if ctype == JobConditionType.RUNNING and c.type == JobConditionType.RESTARTING:
+            continue
+        # A terminal condition demotes Running to status=False.
+        if (
+            ctype in (JobConditionType.SUCCEEDED, JobConditionType.FAILED)
+            and c.type == JobConditionType.RUNNING
+            and c.status
+        ):
+            c.status = False
+            c.last_transition_time = now
+        keep.append(c)
+    keep.append(new_cond)
+    status.conditions = keep
+    return True
+
+
+def initialize_replica_statuses(status: JobStatus, rtype: ReplicaType) -> None:
+    status.replica_statuses[rtype] = ReplicaStatus()
+
+
+def update_replica_status_counts(
+    status: JobStatus, rtype: ReplicaType, pods: list[Pod]
+) -> None:
+    """Pod phases -> active/succeeded/failed counts (status.go:202)."""
+    rs = status.replica_statuses.setdefault(rtype, ReplicaStatus())
+    rs.active = sum(1 for p in pods if p.status.phase == PodPhase.RUNNING)
+    rs.succeeded = sum(1 for p in pods if p.status.phase == PodPhase.SUCCEEDED)
+    rs.failed = sum(1 for p in pods if p.status.phase == PodPhase.FAILED)
+
+
+def has_chief_or_master(job: TrainJob) -> bool:
+    return (
+        ReplicaType.CHIEF in job.spec.replica_specs
+        or ReplicaType.MASTER in job.spec.replica_specs
+    )
+
+
+def update_status_single(
+    job: TrainJob,
+    rtype: ReplicaType,
+    replicas: int,
+    restart: bool,
+    worker0_completed: bool,
+    now: float | None = None,
+) -> None:
+    """Fold one replica type's counts into job conditions
+    (updateStatusSingle, status.go:61-171)."""
+    now = time.time() if now is None else now
+    status = job.status
+    if status.start_time is None:
+        status.start_time = now
+
+    rs = status.replica_statuses.get(rtype, ReplicaStatus())
+    expected = replicas - rs.succeeded
+    running, failed = rs.active, rs.failed
+    name = f"{job.namespace}/{job.name}"
+
+    if has_chief_or_master(job):
+        if rtype in (ReplicaType.CHIEF, ReplicaType.MASTER):
+            if running > 0:
+                set_condition(
+                    status, JobConditionType.RUNNING, REASON_RUNNING,
+                    f"TrainJob {name} is running.", now,
+                )
+            if expected == 0:
+                if set_condition(
+                    status, JobConditionType.SUCCEEDED, REASON_SUCCEEDED,
+                    f"TrainJob {name} successfully completed.", now,
+                ):
+                    metrics.jobs_successful.inc()
+                if status.completion_time is None:
+                    status.completion_time = now
+    else:
+        if rtype is ReplicaType.WORKER:
+            all_workers_done = expected == 0
+            default_policy = job.spec.success_policy.policy != "AllWorkers"
+            if all_workers_done or (worker0_completed and default_policy):
+                if set_condition(
+                    status, JobConditionType.SUCCEEDED, REASON_SUCCEEDED,
+                    f"TrainJob {name} successfully completed.", now,
+                ):
+                    metrics.jobs_successful.inc()
+                if status.completion_time is None:
+                    status.completion_time = now
+            elif running > 0:
+                set_condition(
+                    status, JobConditionType.RUNNING, REASON_RUNNING,
+                    f"TrainJob {name} is running.", now,
+                )
+
+    if failed > 0:
+        if restart:
+            if set_condition(
+                status, JobConditionType.RESTARTING, REASON_RESTARTING,
+                f"TrainJob {name} is restarting because {failed} {rtype} "
+                "replica(s) failed.", now,
+            ):
+                metrics.jobs_restarted.inc()
+        else:
+            if set_condition(
+                status, JobConditionType.FAILED, REASON_FAILED,
+                f"TrainJob {name} has failed because {failed} {rtype} "
+                "replica(s) failed.", now,
+            ):
+                metrics.jobs_failed.inc()
+            if status.completion_time is None:
+                status.completion_time = now

@@ -1,0 +1,93 @@
+"""Operator metrics: Prometheus-compatible counters/gauges.
+
+Capability parity with the reference's prometheus client usage:
+tpujob_operator_jobs_{created,deleted,successful,failed,restarted}_total
+(ref job.go:30-34, controller.go:68-72, status.go:46-58) and the leader gauge
+(server.go:62-66). Exposed in Prometheus text format by cli.metrics_server.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._v += n
+
+    def value(self) -> float:
+        with self._lock:
+            return self._v
+
+
+class Gauge(Counter):
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = v
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, Counter] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = Counter(name, help_text)
+            return self._metrics[name]
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = Gauge(name, help_text)
+            m = self._metrics[name]
+            assert isinstance(m, Gauge)
+            return m
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        with self._lock:
+            lines = []
+            for m in self._metrics.values():
+                kind = "gauge" if isinstance(m, Gauge) else "counter"
+                if m.help:
+                    lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# TYPE {m.name} {kind}")
+                lines.append(f"{m.name} {m.value()}")
+            return "\n".join(lines) + "\n"
+
+
+DEFAULT = Registry()
+
+jobs_created = DEFAULT.counter(
+    "tpujob_operator_jobs_created_total", "Total TrainJobs observed as created"
+)
+jobs_deleted = DEFAULT.counter(
+    "tpujob_operator_jobs_deleted_total", "Total TrainJobs deleted"
+)
+jobs_successful = DEFAULT.counter(
+    "tpujob_operator_jobs_successful_total", "Total TrainJobs that succeeded"
+)
+jobs_failed = DEFAULT.counter(
+    "tpujob_operator_jobs_failed_total", "Total TrainJobs that failed"
+)
+jobs_restarted = DEFAULT.counter(
+    "tpujob_operator_jobs_restarted_total", "Total TrainJobs that entered Restarting"
+)
+is_leader = DEFAULT.gauge(
+    "tpujob_operator_is_leader", "1 when this operator instance holds leadership"
+)
+reconcile_total = DEFAULT.counter(
+    "tpujob_operator_reconcile_total", "Total reconcile passes"
+)
+reconcile_errors = DEFAULT.counter(
+    "tpujob_operator_reconcile_errors_total", "Total reconcile passes that errored"
+)
