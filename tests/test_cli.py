@@ -1,0 +1,172 @@
+"""CLI + REST API tests (entrypoint/dashboard-backend parity surface)."""
+
+import json
+import subprocess
+import sys
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from tf_operator_tpu.api import compat
+from tf_operator_tpu.cli.server import ApiServer
+from tf_operator_tpu.core.cluster import InMemoryCluster
+from tf_operator_tpu.core.trainjob_controller import TrainJobController
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+PY = sys.executable
+
+SIMPLE_YAML = """
+apiVersion: tpujob.dev/v1
+kind: TrainJob
+metadata:
+  name: cli-smoke
+spec:
+  replicaSpecs:
+    Worker:
+      replicas: 1
+      template:
+        spec:
+          containers:
+            - name: tensorflow
+              image: local
+              command: [%s, "-c", "import time; time.sleep(0.2)"]
+""" % json.dumps(PY)
+
+
+def run_cli(*args, timeout=60):
+    return subprocess.run(
+        [PY, "-m", "tf_operator_tpu.cli.main", *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO_ROOT,
+    )
+
+
+class TestCli:
+    def test_version(self):
+        r = run_cli("version")
+        assert r.returncode == 0 and "tpujob" in r.stdout
+
+    def test_validate_ok(self, tmp_path):
+        f = tmp_path / "job.yaml"
+        f.write_text(SIMPLE_YAML)
+        r = run_cli("validate", str(f))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "OK" in r.stdout
+
+    def test_validate_bad(self, tmp_path):
+        f = tmp_path / "job.yaml"
+        f.write_text(SIMPLE_YAML.replace("image: local", "image: ''"))
+        r = run_cli("validate", str(f))
+        assert r.returncode == 1
+        assert "INVALID" in r.stdout
+
+    def test_run_to_success(self, tmp_path):
+        f = tmp_path / "job.yaml"
+        f.write_text(SIMPLE_YAML)
+        r = run_cli("run", str(f), "--timeout", "60")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "SUCCEEDED" in r.stdout
+
+    def test_run_failure_exit_code(self, tmp_path):
+        f = tmp_path / "job.yaml"
+        f.write_text(
+            SIMPLE_YAML.replace('"import time; time.sleep(0.2)"', '"import sys; sys.exit(3)"')
+        )
+        r = run_cli("run", str(f), "--timeout", "60")
+        assert r.returncode == 1
+        assert "FAILED" in r.stdout
+
+
+class TestRestApi:
+    @pytest.fixture
+    def served(self):
+        cluster = InMemoryCluster()
+        controller = TrainJobController(cluster, enable_gang=False)
+        api = ApiServer(cluster, port=0)
+        api.start()
+        yield cluster, controller, f"127.0.0.1:{api.port}"
+        api.stop()
+        controller.stop()
+
+    def _get(self, server, path):
+        with urllib.request.urlopen(f"http://{server}{path}", timeout=5) as r:
+            return json.loads(r.read())
+
+    def test_submit_list_get_delete(self, served):
+        cluster, controller, server = served
+        manifest = {
+            "kind": "TrainJob",
+            "metadata": {"name": "rest-job", "namespace": "team-a"},
+            "spec": {
+                "replicaSpecs": {
+                    "Worker": {
+                        "replicas": 2,
+                        "template": {
+                            "spec": {"containers": [{"name": "jax", "image": "x"}]}
+                        },
+                    }
+                }
+            },
+        }
+        req = urllib.request.Request(
+            f"http://{server}/api/trainjobs",
+            data=json.dumps(manifest).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 201
+
+        controller.run_until_idle()
+
+        jobs = self._get(server, "/api/trainjobs")["items"]
+        assert len(jobs) == 1
+        one = self._get(server, "/api/trainjobs/team-a/rest-job")
+        assert one["manifest"]["metadata"]["name"] == "rest-job"
+        assert any(c["type"] == "Created" for c in one["status"]["conditions"])
+
+        assert self._get(server, "/api/namespaces")["namespaces"] == ["team-a"]
+        pods = self._get(server, "/api/pods/team-a")["items"]
+        assert {p["name"] for p in pods} == {"rest-job-worker-0", "rest-job-worker-1"}
+
+        req = urllib.request.Request(
+            f"http://{server}/api/trainjobs/team-a/rest-job", method="DELETE"
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+        assert self._get(server, "/api/trainjobs")["items"] == []
+
+    def test_invalid_manifest_400(self, served):
+        _, _, server = served
+        req = urllib.request.Request(
+            f"http://{server}/api/trainjobs",
+            data=b'{"spec": {"replicaSpecs": {"Worker": "junk"}}}',
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 400
+
+    def test_metrics_endpoint(self, served):
+        _, _, server = served
+        with urllib.request.urlopen(f"http://{server}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "tpujob_operator_jobs_created_total" in text
+
+
+class TestLeaderElection:
+    def test_single_leader(self, tmp_path):
+        from tf_operator_tpu.utils.leader import LeaderElector
+
+        lock = str(tmp_path / "op.lock")
+        a = LeaderElector(lock, identity="a")
+        b = LeaderElector(lock, identity="b")
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        a.release()
+        assert b.try_acquire()
+        b.release()

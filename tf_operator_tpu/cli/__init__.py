@@ -1,0 +1,1 @@
+"""Operator entrypoint, REST/metrics servers, leader election."""

@@ -1,0 +1,241 @@
+"""tpujob CLI — the operator binary and job client.
+
+Capability parity with cmd/tf-operator.v1 (options.go:27-83, server.go:68-223)
+re-targeted at the local substrate:
+
+  tpujob run JOB.yaml          submit + execute locally, stream conditions
+  tpujob validate JOB.yaml     defaulting + validation report
+  tpujob operator [flags]      long-running operator: REST API on
+                               --monitoring-port (default 8443, /metrics +
+                               /healthz + dashboard API), leader election
+                               (--enable-leader-election, file lock), gang
+                               scheduling (--enable-gang-scheduling,
+                               --gang-scheduler-name, --tpu-slices), worker
+                               threads (--threadiness)
+  tpujob get [NS [NAME]]       query a running operator's REST API
+  tpujob submit JOB.yaml       submit to a running operator via REST
+  tpujob version               version info (pkg/version parity)
+
+Exit codes: run returns 0 on Succeeded, 1 on Failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import urllib.request
+
+from tf_operator_tpu import __version__
+from tf_operator_tpu.api import compat, defaults, validation
+from tf_operator_tpu.api.types import JobConditionType
+from tf_operator_tpu.utils.logging import FieldLogger
+
+
+def _load_job(path: str):
+    with open(path) as f:
+        return compat.job_from_yaml(f.read())
+
+
+def cmd_validate(args) -> int:
+    job = _load_job(args.manifest)
+    problems = validation.validate_job(job)
+    if problems:
+        for p in problems:
+            print(f"INVALID: {p}")
+        return 1
+    print(f"OK: TrainJob {job.namespace}/{job.name} is valid")
+    for rtype, spec in job.spec.replica_specs.items():
+        print(f"  {rtype}: replicas={spec.replicas} restartPolicy={spec.restart_policy}")
+    if job.spec.tpu:
+        print(f"  tpu: topology={job.spec.tpu.topology}")
+    if job.spec.mesh:
+        print(f"  mesh: {job.spec.mesh.axes}")
+    return 0
+
+
+def cmd_run(args) -> int:
+    from tf_operator_tpu.api.types import is_succeeded
+    from tf_operator_tpu.gang.podgroup import SliceAllocator
+    from tf_operator_tpu.runtime.session import LocalSession
+
+    job = _load_job(args.manifest)
+    problems = validation.validate_job(job)
+    if problems:
+        for p in problems:
+            print(f"INVALID: {p}", file=sys.stderr)
+        return 1
+
+    allocator = SliceAllocator.of(*args.tpu_slices) if args.tpu_slices else None
+    session = LocalSession(
+        enable_gang=bool(args.tpu_slices),
+        slice_allocator=allocator,
+        log_dir=args.log_dir,
+    )
+    log = FieldLogger({"job": job.key()})
+    try:
+        session.submit(job)
+        log.info("submitted; waiting for completion")
+        seen: set[str] = set()
+
+        import time
+
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            cur = session.get(job.namespace, job.name)
+            if cur is None:
+                print("DELETED: job was removed before completion", file=sys.stderr)
+                return 2
+            for c in cur.status.conditions:
+                tag = f"{c.type}:{c.status}:{c.reason}"
+                if c.status and tag not in seen:
+                    seen.add(tag)
+                    print(f"[{c.type}] {c.message}")
+            if cur.status.completion_time is not None:
+                ok = is_succeeded(cur.status)
+                print("SUCCEEDED" if ok else "FAILED")
+                return 0 if ok else 1
+            time.sleep(0.2)
+        print("TIMEOUT", file=sys.stderr)
+        return 2
+    finally:
+        session.close()
+
+
+def cmd_operator(args) -> int:
+    from tf_operator_tpu.cli.server import ApiServer
+    from tf_operator_tpu.core.cluster import InMemoryCluster
+    from tf_operator_tpu.core.trainjob_controller import TrainJobController
+    from tf_operator_tpu.gang.podgroup import SliceAllocator
+    from tf_operator_tpu.runtime.local import LocalProcessRuntime
+    from tf_operator_tpu.utils.leader import LeaderElector
+
+    log = FieldLogger({"component": "operator"})
+    cluster = InMemoryCluster()
+    allocator = SliceAllocator.of(*args.tpu_slices) if args.tpu_slices else None
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+
+    def lead() -> None:
+        # The API binds only on the leader: a hot standby must not collide on
+        # the monitoring port while waiting for the lock.
+        api = ApiServer(cluster, port=args.monitoring_port, log_dir=args.log_dir)
+        api.start()
+        log.info("REST/metrics API on 127.0.0.1:%d", api.port)
+        controller = TrainJobController(
+            cluster,
+            enable_gang=args.enable_gang_scheduling,
+            gang_scheduler_name=args.gang_scheduler_name,
+            slice_allocator=allocator,
+        )
+        runtime = LocalProcessRuntime(cluster, log_dir=args.log_dir)
+        controller.run(workers=args.threadiness)
+        log.info("controllers running (threadiness=%d)", args.threadiness)
+        stop.wait()
+        runtime.stop()
+        controller.stop()
+        api.stop()
+
+    if args.enable_leader_election:
+        LeaderElector(args.lock_file).run_or_die(lead, stop)
+    else:
+        lead()
+    return 0
+
+
+def _api_get(server: str, path: str) -> dict:
+    with urllib.request.urlopen(f"http://{server}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def cmd_get(args) -> int:
+    path = "/api/trainjobs"
+    if args.namespace:
+        path += f"/{args.namespace}"
+        if args.name:
+            path += f"/{args.name}"
+    data = _api_get(args.server, path)
+    print(json.dumps(data, indent=2, default=str))
+    return 0
+
+
+def cmd_submit(args) -> int:
+    job = _load_job(args.manifest)
+    body = json.dumps(compat.job_to_dict(job)).encode()
+    req = urllib.request.Request(
+        f"http://{args.server}/api/trainjobs",
+        data=body,
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        print(json.dumps(json.loads(r.read()), indent=2)[:2000])
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(f"tpujob {__version__} (python {sys.version.split()[0]})")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="tpujob")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("validate")
+    p.add_argument("manifest")
+    p.set_defaults(fn=cmd_validate)
+
+    p = sub.add_parser("run")
+    p.add_argument("manifest")
+    p.add_argument("--timeout", type=float, default=3600.0)
+    p.add_argument("--log-dir", default=None)
+    p.add_argument("--tpu-slices", nargs="*", default=None,
+                   help="gang-admission slice fleet, e.g. v5e-8 v5e-8")
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("operator")
+    p.add_argument("--threadiness", type=int, default=2)  # options.go default
+    p.add_argument("--monitoring-port", type=int, default=8443)
+    p.add_argument("--enable-gang-scheduling", action="store_true")
+    p.add_argument("--gang-scheduler-name", default="volcano")
+    p.add_argument("--enable-leader-election", action="store_true")
+    p.add_argument("--lock-file", default="/tmp/tpujob-operator.lock")
+    p.add_argument("--log-dir", default=None)
+    p.add_argument("--tpu-slices", nargs="*", default=None)
+    p.set_defaults(fn=cmd_operator)
+
+    p = sub.add_parser("get")
+    p.add_argument("namespace", nargs="?", default=None)
+    p.add_argument("name", nargs="?", default=None)
+    p.add_argument("--server", default="127.0.0.1:8443")
+    p.set_defaults(fn=cmd_get)
+
+    p = sub.add_parser("submit")
+    p.add_argument("manifest")
+    p.add_argument("--server", default="127.0.0.1:8443")
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("version")
+    p.set_defaults(fn=cmd_version)
+    return ap
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except FileNotFoundError as e:
+        print(f"error: {e.filename or e}: no such file", file=sys.stderr)
+        return 2
+    except (ValueError, OSError) as e:
+        print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,185 @@
+"""REST API + metrics HTTP server.
+
+Dashboard-backend parity (dashboard/backend/handler/api_handler.go:42-267):
+  GET    /api/trainjobs                      list all jobs (all namespaces)
+  GET    /api/trainjobs/{ns}                 list jobs in a namespace
+  GET    /api/trainjobs/{ns}/{name}          one job (spec + status + events)
+  POST   /api/trainjobs                      submit a manifest (JSON body)
+  DELETE /api/trainjobs/{ns}/{name}          delete a job
+  GET    /api/namespaces                     namespaces in use
+  GET    /api/pods/{ns}                      pods in a namespace
+  GET    /api/logs/{ns}/{pod}                pod logs (local runtime log files)
+
+Operator-ops parity (main.go:38-46, options.go:74):
+  GET    /metrics                            Prometheus text format
+  GET    /healthz                            liveness
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import asdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tf_operator_tpu.api import compat
+from tf_operator_tpu.api.types import TrainJob
+from tf_operator_tpu.core.cluster import InMemoryCluster
+from tf_operator_tpu.status import metrics
+
+
+def _job_payload(cluster: InMemoryCluster, job: TrainJob) -> dict:
+    return {
+        "manifest": compat.job_to_dict(job),
+        "status": {
+            "conditions": [
+                {
+                    "type": str(c.type),
+                    "status": c.status,
+                    "reason": c.reason,
+                    "message": c.message,
+                }
+                for c in job.status.conditions
+            ],
+            "replicaStatuses": {
+                str(rt): asdict(rs) for rt, rs in job.status.replica_statuses.items()
+            },
+            "startTime": job.status.start_time,
+            "completionTime": job.status.completion_time,
+        },
+        "events": [
+            {"type": e.type, "reason": e.reason, "message": e.message, "ts": e.timestamp}
+            for e in cluster.events_for(TrainJob.KIND, job.namespace, job.name)
+        ],
+    }
+
+
+class ApiServer:
+    def __init__(self, cluster: InMemoryCluster, port: int = 8443,
+                 log_dir: str | None = None):
+        self.cluster = cluster
+        self.log_dir = log_dir
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, payload, code=200, content_type="application/json"):
+                body = (
+                    payload.encode()
+                    if isinstance(payload, str)
+                    else json.dumps(payload).encode()
+                )
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                parts = [p for p in self.path.split("?")[0].split("/") if p]
+                try:
+                    if parts == ["metrics"]:
+                        self._send(metrics.DEFAULT.expose(), content_type="text/plain")
+                    elif parts == ["healthz"]:
+                        self._send({"ok": True})
+                    elif parts == ["api", "namespaces"]:
+                        ns = sorted({j.namespace for j in outer.cluster.list_jobs()})
+                        self._send({"namespaces": ns})
+                    elif parts[:2] == ["api", "trainjobs"] and len(parts) == 2:
+                        self._send(
+                            {
+                                "items": [
+                                    _job_payload(outer.cluster, j)
+                                    for j in outer.cluster.list_jobs()
+                                ]
+                            }
+                        )
+                    elif parts[:2] == ["api", "trainjobs"] and len(parts) == 3:
+                        self._send(
+                            {
+                                "items": [
+                                    _job_payload(outer.cluster, j)
+                                    for j in outer.cluster.list_jobs(parts[2])
+                                ]
+                            }
+                        )
+                    elif parts[:2] == ["api", "trainjobs"] and len(parts) == 4:
+                        job = outer.cluster.try_get_job(parts[2], parts[3])
+                        if job is None:
+                            self._send({"error": "not found"}, 404)
+                        else:
+                            self._send(_job_payload(outer.cluster, job))
+                    elif parts[:2] == ["api", "pods"] and len(parts) == 3:
+                        pods = outer.cluster.list_pods(parts[2])
+                        self._send(
+                            {
+                                "items": [
+                                    {
+                                        "name": p.name,
+                                        "phase": str(p.status.phase),
+                                        "labels": p.metadata.labels,
+                                        "restartCount": sum(
+                                            c.restart_count
+                                            for c in p.status.container_statuses
+                                        ),
+                                    }
+                                    for p in pods
+                                ]
+                            }
+                        )
+                    elif parts[:2] == ["api", "logs"] and len(parts) == 4:
+                        if outer.log_dir is None:
+                            self._send({"error": "log collection disabled"}, 404)
+                            return
+                        import os
+
+                        path = os.path.join(outer.log_dir, f"{parts[2]}_{parts[3]}.log")
+                        if not os.path.exists(path):
+                            self._send({"error": "no logs"}, 404)
+                            return
+                        with open(path, "rb") as f:
+                            data = f.read()[-65536:]
+                        self._send(data.decode(errors="replace"), content_type="text/plain")
+                    else:
+                        self._send({"error": "not found"}, 404)
+                except Exception as e:  # surface handler bugs as 500s, not hangs
+                    self._send({"error": f"{type(e).__name__}: {e}"}, 500)
+
+            def do_POST(self):
+                parts = [p for p in self.path.split("?")[0].split("/") if p]
+                if parts[:2] != ["api", "trainjobs"]:
+                    self._send({"error": "not found"}, 404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    manifest = json.loads(self.rfile.read(length))
+                    job = compat.job_from_dict(manifest)
+                    created = outer.cluster.create_job(job)
+                    self._send(_job_payload(outer.cluster, created), 201)
+                except Exception as e:
+                    self._send({"error": f"{type(e).__name__}: {e}"}, 400)
+
+            def do_DELETE(self):
+                parts = [p for p in self.path.split("?")[0].split("/") if p]
+                if parts[:2] == ["api", "trainjobs"] and len(parts) == 4:
+                    try:
+                        outer.cluster.delete_job(parts[2], parts[3])
+                        self._send({"deleted": f"{parts[2]}/{parts[3]}"})
+                    except Exception as e:
+                        self._send({"error": str(e)}, 404)
+                else:
+                    self._send({"error": "not found"}, 404)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -257,6 +257,12 @@ class JobControllerBase:
 
     def run(self, workers: int = 1) -> None:
         self._stop.clear()
+        # Initial resync: jobs that existed before this controller was
+        # constructed (operator restart, late leader) must still reconcile —
+        # informer handlers only cover future events (WaitForCacheSync +
+        # initial-list parity, controller.go:192).
+        for job in self.cluster.list_jobs():
+            self.enqueue(job.key())
         for i in range(workers):
             t = threading.Thread(target=self._worker, name=f"reconciler-{i}", daemon=True)
             t.start()

@@ -1,0 +1,1 @@
+"""Executors: materialise pods as real processes; native C++ runtime core."""

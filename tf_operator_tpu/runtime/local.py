@@ -1,0 +1,308 @@
+"""Local-process runtime: run a cluster's pods as OS processes on this host.
+
+The reference's data plane was "kubelet starts the `tensorflow` container"
+(SURVEY.md §3.3); the operator never executed anything itself. This runtime is
+the kubelet stand-in for a single host: it watches pod creations on the
+cluster substrate, spawns one subprocess per pod, feeds phase transitions and
+exit codes back into pod status (which drives the controller's state machine
+exactly as container statuses did, pod.go:135-162), and emulates kubelet
+restart policy (Always/OnFailure restart the process in place and bump
+restart_count — the counts pastBackoffLimit sums).
+
+Networking: the injected cluster spec uses in-cluster DNS names
+(`{job}-{type}-{i}.{ns}.svc:2222`). Those don't resolve on a laptop/CI host,
+so the runtime allocates per-replica localhost ports and rewrites every env
+value (TF_CONFIG JSON, JAX_COORDINATOR_ADDRESS, TPU_WORKER_HOSTNAMES,
+KUBE_GOOGLE_CLOUD_TPU_ENDPOINTS) from DNS identity to 127.0.0.1:port. Real
+multi-process jax.distributed / TF gRPC meshes then form locally — the same
+contract a multi-host deployment gets from headless services, scaled down to
+one machine.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+import socket
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tf_operator_tpu.core.cluster import (
+    KIND_POD,
+    ContainerStatus,
+    InMemoryCluster,
+    Pod,
+    PodPhase,
+)
+from tf_operator_tpu.utils.logging import logger_for_pod
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class _Proc:
+    pod_uid: str
+    process: subprocess.Popen
+    restart_count: int = 0
+    stopping: bool = False
+
+
+@dataclass
+class PortMap:
+    """Per-job mapping: (DNS host, declared port) -> unique localhost port.
+
+    Ports are whatever the manifest declared (default 2222/8476, but any
+    containerPort works): every distinct host:port endpoint gets its own
+    localhost port so replicas never collide on one machine."""
+
+    ports: dict[str, dict[int, int]] = field(default_factory=dict)
+
+    def local_port(self, host: str, port: int) -> int | None:
+        return self.ports.get(host, {}).get(port)
+
+    def rewrite(self, value: str) -> str:
+        # host:port pairs first (longest match), then bare hostnames.
+        for host, mapping in self.ports.items():
+            for port, local in mapping.items():
+                value = value.replace(f"{host}:{port}", f"127.0.0.1:{local}")
+        for host in self.ports:
+            value = value.replace(host, "127.0.0.1")
+        return value
+
+
+class LocalProcessRuntime:
+    """Kubelet stand-in: one subprocess per pod, status fed back to the
+    cluster substrate."""
+
+    def __init__(
+        self,
+        cluster: InMemoryCluster,
+        env_overrides: dict[str, str] | None = None,
+        inherit_env: bool = True,
+        log_dir: str | None = None,
+    ):
+        self.cluster = cluster
+        self.env_overrides = env_overrides or {}
+        self.inherit_env = inherit_env
+        self.log_dir = log_dir
+        self._procs: dict[tuple[str, str], _Proc] = {}
+        self._port_maps: dict[str, PortMap] = {}  # job label -> map
+        self._lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        self._stopped = False
+        cluster.on_add(KIND_POD, self._on_pod_add)
+        cluster.on_delete(KIND_POD, self._on_pod_delete)
+
+    # ----------------------------------------------------------- port wiring
+
+    _HOSTPORT_RE = re.compile(
+        r"([a-z0-9.-]+\.svc(?:\.[a-z0-9.-]+)?):(\d+)"
+    )
+
+    def _port_map_for(self, pod: Pod) -> PortMap:
+        """Build (incrementally, per job) the DNS->localhost port map from
+        every `host.svc[:port]` endpoint the pod's env mentions (TF_CONFIG
+        JSON, coordinator address, TPU endpoints, worker hostnames)."""
+        job_name = pod.metadata.labels.get("job-name", "")
+        with self._lock:
+            pm = self._port_maps.get(job_name)
+            if pm is None:
+                pm = PortMap()
+                self._port_maps[job_name] = pm
+            endpoints: set[tuple[str, int]] = set()
+            bare_hosts: set[str] = set()
+            for c in pod.spec.containers:
+                declared = [p.container_port for p in c.ports if p.container_port]
+                for e in c.env:
+                    for host, port in self._HOSTPORT_RE.findall(e.value):
+                        endpoints.add((host, int(port)))
+                    # Bare hostnames (TPU_WORKER_HOSTNAMES): give them every
+                    # port their container declares.
+                    for token in e.value.replace(",", " ").split():
+                        t = token.strip('"')
+                        if (t.endswith(".svc") or ".svc." in t) and ":" not in t:
+                            bare_hosts.add(t)
+                for h in bare_hosts:
+                    for port in declared:
+                        endpoints.add((h, port))
+            for host, port in endpoints:
+                pm.ports.setdefault(host, {})
+                if port not in pm.ports[host]:
+                    pm.ports[host][port] = _free_port()
+            return pm
+
+    # ------------------------------------------------------------- lifecycle
+
+    def _on_pod_add(self, pod: Pod) -> None:
+        if self._stopped:
+            return
+        t = threading.Thread(
+            target=self._run_pod, args=(pod,), name=f"pod-{pod.name}", daemon=True
+        )
+        with self._lock:
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+        t.start()
+
+    def _on_pod_delete(self, pod: Pod) -> None:
+        with self._lock:
+            proc = self._procs.pop((pod.namespace, pod.name), None)
+        if proc is not None:
+            proc.stopping = True
+            self._terminate(proc.process)
+
+    @staticmethod
+    def _terminate(process: subprocess.Popen) -> None:
+        if process.poll() is None:
+            try:
+                process.send_signal(signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+
+    def _build_env(self, pod: Pod, pm: PortMap) -> dict[str, str]:
+        env = dict(os.environ) if self.inherit_env else {}
+        container = pod.spec.containers[0]
+        for e in container.env:
+            env[e.name] = pm.rewrite(e.value)
+        # This replica's own listen ports: the localhost ports its DNS
+        # identity was rewritten to, keyed by the container's declared ports.
+        own_host = next((h for h in pm.ports if h.startswith(f"{pod.name}.")), None)
+        if own_host is not None:
+            port_by_name = {p.name: p.container_port for p in container.ports}
+            tf_local = pm.local_port(own_host, port_by_name.get("tfjob-port", 2222))
+            coord_local = pm.local_port(own_host, port_by_name.get("coord-port", 8476))
+            if tf_local is not None:
+                env["TPUJOB_LISTEN_PORT"] = str(tf_local)
+            if coord_local is not None:
+                env["TPUJOB_COORD_LISTEN_PORT"] = str(coord_local)
+        env.update(self.env_overrides)
+        return env
+
+    def _run_pod(self, pod: Pod) -> None:
+        """Process lifecycle for one pod, including kubelet-style in-place
+        restarts for Always/OnFailure pod restart policies."""
+        log = logger_for_pod(pod.namespace, pod.name)
+        if not pod.spec.containers or not (
+            pod.spec.containers[0].command or pod.spec.containers[0].args
+        ):
+            self.cluster.record_event(
+                KIND_POD, pod.namespace, pod.name, "Warning", "NoCommand",
+                "pod template has no command; the local-process runtime cannot "
+                "pull container images — set spec.containers[].command",
+            )
+            self._set_status(pod, PodPhase.FAILED, None, 0, reason="NoCommand")
+            return
+        container = pod.spec.containers[0]
+        cmd = list(container.command) + list(container.args)
+        pm = self._port_map_for(pod)
+        env = self._build_env(pod, pm)
+        restart_policy = pod.spec.restart_policy or "Never"
+        restart_count = 0
+
+        while True:
+            stdout = subprocess.DEVNULL
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                stdout = open(
+                    os.path.join(self.log_dir, f"{pod.namespace}_{pod.name}.log"), "ab"
+                )
+            try:
+                process = subprocess.Popen(
+                    cmd,
+                    env=env,
+                    stdout=stdout,
+                    stderr=subprocess.STDOUT,
+                    cwd=container.working_dir or None,
+                )
+            except OSError as e:
+                if stdout is not subprocess.DEVNULL:
+                    stdout.close()
+                log.error("spawn failed: %s", e)
+                self._set_status(pod, PodPhase.FAILED, 127, restart_count, reason="SpawnError")
+                return
+
+            entry = _Proc(pod.metadata.uid, process, restart_count)
+            with self._lock:
+                self._procs[(pod.namespace, pod.name)] = entry
+            self._set_status(pod, PodPhase.RUNNING, None, restart_count)
+
+            code = process.wait()
+            if stdout is not subprocess.DEVNULL:
+                stdout.close()
+            if entry.stopping or self._stopped:
+                return  # deleted: pod object is already gone
+
+            should_restart = restart_policy == "Always" or (
+                restart_policy == "OnFailure" and code != 0
+            )
+            if should_restart:
+                restart_count += 1
+                self._set_status(pod, PodPhase.RUNNING, code, restart_count)
+                time.sleep(min(0.1 * restart_count, 2.0))
+                continue
+
+            phase = PodPhase.SUCCEEDED if code == 0 else PodPhase.FAILED
+            self._set_status(pod, phase, code, restart_count)
+            with self._lock:
+                self._procs.pop((pod.namespace, pod.name), None)
+            return
+
+    def _set_status(
+        self,
+        pod: Pod,
+        phase: PodPhase,
+        exit_code: int | None,
+        restart_count: int,
+        reason: str = "",
+    ) -> None:
+        try:
+            cur = self.cluster.get_pod(pod.namespace, pod.name)
+        except Exception:
+            return
+        if cur.metadata.uid != pod.metadata.uid:
+            return  # replaced by a newer pod with the same name
+        cur.status.phase = phase
+        if cur.status.start_time is None and phase != PodPhase.PENDING:
+            cur.status.start_time = time.time()
+        cname = pod.spec.containers[0].name
+        cs = next((c for c in cur.status.container_statuses if c.name == cname), None)
+        if cs is None:
+            cs = ContainerStatus(name=cname)
+            cur.status.container_statuses.append(cs)
+        cs.running = phase == PodPhase.RUNNING
+        cs.exit_code = exit_code
+        cs.restart_count = restart_count
+        cs.reason = reason
+        try:
+            self.cluster.update_pod(cur)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------ stop
+
+    def stop(self) -> None:
+        self._stopped = True
+        with self._lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+        for p in procs:
+            p.stopping = True
+            self._terminate(p.process)
+        deadline = time.time() + 5
+        for p in procs:
+            remaining = max(0.1, deadline - time.time())
+            try:
+                p.process.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                p.process.kill()
+
+    def port_map(self, job_name: str) -> PortMap | None:
+        with self._lock:
+            return self._port_maps.get(job_name)

@@ -1,0 +1,155 @@
+"""LocalSession: a single-host, fully-running instance of the framework.
+
+Wires together the cluster substrate, the TrainJob controller (threaded), and
+the local-process runtime, and exposes the client-side verbs the reference's
+E2E harness built on (py/kubeflow/tf_operator/tf_job_client.py):
+
+  submit / wait_for_condition / wait_for_delete / delete
+  terminate_replica (the /exit fault-injection hook, tf_job_client.py:302-352)
+  replica_address  (reach a replica's HTTP surface through the port map)
+
+This is what `tpujob run job.yaml` and bench.py drive; E2E tests use it to
+reproduce the reference's eight behavior suites on one machine.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+from tf_operator_tpu.api.types import JobConditionType, TrainJob
+from tf_operator_tpu.core.cluster import InMemoryCluster
+from tf_operator_tpu.core.trainjob_controller import TrainJobController
+from tf_operator_tpu.gang.podgroup import SliceAllocator
+from tf_operator_tpu.runtime.local import LocalProcessRuntime
+from tf_operator_tpu.utils.naming import gen_general_name
+
+
+class TimeoutError_(TimeoutError):
+    pass
+
+
+class LocalSession:
+    def __init__(
+        self,
+        enable_gang: bool = False,
+        slice_allocator: SliceAllocator | None = None,
+        workers: int = 2,
+        env_overrides: dict[str, str] | None = None,
+        log_dir: str | None = None,
+    ):
+        self.cluster = InMemoryCluster()
+        self.controller = TrainJobController(
+            self.cluster, enable_gang=enable_gang, slice_allocator=slice_allocator
+        )
+        self.runtime = LocalProcessRuntime(
+            self.cluster, env_overrides=env_overrides, log_dir=log_dir
+        )
+        self.controller.run(workers=workers)
+
+    # ------------------------------------------------------------- client API
+
+    def submit(self, job: TrainJob) -> TrainJob:
+        return self.cluster.create_job(job)
+
+    def get(self, namespace: str, name: str) -> TrainJob | None:
+        return self.cluster.try_get_job(namespace, name)
+
+    def delete(self, namespace: str, name: str) -> None:
+        self.cluster.delete_job(namespace, name)
+
+    def wait_for_condition(
+        self,
+        namespace: str,
+        name: str,
+        conditions: tuple[JobConditionType, ...],
+        timeout: float = 60.0,
+        poll: float = 0.05,
+    ) -> TrainJob:
+        """Block until the job has any of `conditions` with status=True
+        (tf_job_client.wait_for_condition:117)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            job = self.cluster.try_get_job(namespace, name)
+            if job is not None:
+                for c in job.status.conditions:
+                    if c.status and c.type in conditions:
+                        return job
+            time.sleep(poll)
+        raise TimeoutError_(
+            f"job {namespace}/{name} did not reach {[str(c) for c in conditions]} "
+            f"within {timeout}s"
+        )
+
+    def wait_for_delete(self, namespace: str, name: str, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.cluster.try_get_job(namespace, name) is None:
+                return
+            time.sleep(0.05)
+        raise TimeoutError_(f"job {namespace}/{name} not deleted within {timeout}s")
+
+    # -------------------------------------------------- fault injection / HTTP
+
+    def replica_address(
+        self, job_name: str, namespace: str, rtype: str, index: int, port: int = 2222
+    ) -> str | None:
+        """127.0.0.1:port HTTP address of a replica's workload server
+        (`port` is the declared containerPort, default tfjob-port 2222)."""
+        pm = self.runtime.port_map(job_name)
+        if pm is None:
+            return None
+        host = f"{gen_general_name(job_name, rtype, index)}.{namespace}.svc"
+        for h, mapping in pm.ports.items():
+            if h.startswith(host):
+                local = mapping.get(port)
+                if local is None and mapping:
+                    local = sorted(mapping.values())[0]
+                return f"127.0.0.1:{local}" if local is not None else None
+        return None
+
+    def replica_http(self, job_name: str, namespace: str, rtype: str, index: int,
+                     path: str, timeout: float = 5.0) -> dict:
+        addr = self.replica_address(job_name, namespace, rtype, index)
+        if addr is None:
+            raise RuntimeError(f"no address for {job_name} {rtype}-{index}")
+        with urllib.request.urlopen(f"http://{addr}{path}", timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def terminate_replica(
+        self, job_name: str, namespace: str, rtype: str, index: int, exit_code: int = 0
+    ) -> dict:
+        """Force a replica to exit with a chosen code via the workload's
+        /exit endpoint (tf_job_client.terminate_replicas:317)."""
+        return self.replica_http(
+            job_name, namespace, rtype, index, f"/exit?exitCode={exit_code}"
+        )
+
+    def wait_replica_serving(
+        self, job_name: str, namespace: str, rtype: str, index: int, timeout: float = 20.0
+    ) -> None:
+        deadline = time.monotonic() + timeout
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            try:
+                self.replica_http(job_name, namespace, rtype, index, "/health", timeout=1.0)
+                return
+            except Exception as e:
+                last = e
+                time.sleep(0.1)
+        raise TimeoutError_(
+            f"replica {rtype}-{index} of {job_name} never served /health: {last}"
+        )
+
+    # ------------------------------------------------------------------ stop
+
+    def close(self) -> None:
+        self.runtime.stop()
+        self.controller.stop()
+
+    def __enter__(self) -> "LocalSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,1 @@
+"""Test harness: controllable fake workload + builders."""

@@ -1,0 +1,135 @@
+"""Controllable fake workload — the replica container for E2E tests.
+
+Capability parity with the reference's test-server (test/test-server/
+test_app.py, SURVEY.md §4 Tier 3): a tiny HTTP server run *as* the training
+replica so the harness can
+
+  GET /tfconfig    -> the TF_CONFIG the operator injected (verify topology)
+  GET /runconfig   -> the resolved runtime config (cluster spec + task + TPU env)
+  GET /exit?exitCode=N -> terminate this replica with exit code N
+                          (deterministic restart/shutdown-policy testing)
+  GET /health      -> liveness
+
+plus a TPU addition the reference couldn't have: /topology returns the
+TPU slice/mesh env (TPUJOB_TOPOLOGY, TPUJOB_MESH, JAX process wiring) so
+tests can assert the TPU-native contract the same way estimator_runconfig
+tests asserted TF_CONFIG.
+
+Run: python -m tf_operator_tpu.testing.workload [--port N] [--exit-after S]
+Port resolution order: --port, $TPUJOB_LISTEN_PORT (set by the local runtime
+to this replica's rewritten DNS port), $PORT, else 8000.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+_exit_code: list[int | None] = [None]
+
+
+def _runtime_config() -> dict:
+    tf_config = os.environ.get("TF_CONFIG", "")
+    parsed = None
+    if tf_config:
+        try:
+            parsed = json.loads(tf_config)
+        except ValueError:
+            parsed = {"raw": tf_config}
+    tpu_keys = (
+        "JAX_COORDINATOR_ADDRESS",
+        "JAX_PROCESS_ID",
+        "JAX_NUM_PROCESSES",
+        "TPU_WORKER_ID",
+        "TPU_WORKER_HOSTNAMES",
+        "KUBE_GOOGLE_CLOUD_TPU_ENDPOINTS",
+        "TPUJOB_TOPOLOGY",
+        "TPUJOB_MESH",
+        "TPUJOB_NAME",
+        "TPUJOB_REPLICA_TYPE",
+        "TPUJOB_REPLICA_INDEX",
+    )
+    return {
+        "tf_config": parsed,
+        "tpu": {k: os.environ[k] for k in tpu_keys if k in os.environ},
+        "pid": os.getpid(),
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # silence request logging
+        pass
+
+    def _send(self, payload: dict, code: int = 200) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        url = urlparse(self.path)
+        if url.path == "/tfconfig":
+            self._send({"TF_CONFIG": os.environ.get("TF_CONFIG", "")})
+        elif url.path == "/runconfig":
+            self._send(_runtime_config())
+        elif url.path == "/topology":
+            self._send(_runtime_config()["tpu"])
+        elif url.path == "/health":
+            self._send({"ok": True})
+        elif url.path == "/exit":
+            code = int(parse_qs(url.query).get("exitCode", ["0"])[0])
+            self._send({"exiting": code})
+            _exit_code[0] = code
+            threading.Thread(target=self.server.shutdown, daemon=True).start()
+        else:
+            self._send({"error": "not found"}, 404)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument(
+        "--exit-after", type=float, default=None,
+        help="exit 0 after N seconds (self-terminating workload)",
+    )
+    ap.add_argument(
+        "--exit-code", type=int, default=None,
+        help="with --exit-after, exit with this code instead of 0",
+    )
+    args = ap.parse_args(argv)
+
+    port = args.port
+    if port is None:
+        for var in ("TPUJOB_LISTEN_PORT", "PORT"):
+            if os.environ.get(var):
+                port = int(os.environ[var])
+                break
+    if port is None:
+        port = 8000
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    if args.exit_after is not None:
+
+        def _later():
+            import time
+
+            time.sleep(args.exit_after)
+            _exit_code[0] = args.exit_code or 0
+            server.shutdown()
+
+        threading.Thread(target=_later, daemon=True).start()
+
+    server.serve_forever()
+    server.server_close()
+    return _exit_code[0] or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,72 @@
+"""Leader election via an fcntl file lock.
+
+Capability parity with the reference's Endpoints-lock leader election
+(app/server.go:157-182, 15s lease / 5s renew / 3s retry): multiple operator
+processes on one host serialize on a lock file; exactly one runs the
+controllers, the rest block as hot standbys and take over when the leader
+dies (the kernel releases the lock on process exit, so failover is
+immediate — no lease timers needed for the single-host case).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import threading
+import time
+from typing import Callable
+
+from tf_operator_tpu.status import metrics
+from tf_operator_tpu.utils.logging import FieldLogger
+
+DEFAULT_LOCK_PATH = "/tmp/tpujob-operator.lock"
+
+
+class LeaderElector:
+    def __init__(self, lock_path: str = DEFAULT_LOCK_PATH, identity: str | None = None):
+        self.lock_path = lock_path
+        self.identity = identity or f"pid-{os.getpid()}"
+        self._fd: int | None = None
+        self._log = FieldLogger({"component": "leader-election", "id": self.identity})
+
+    def try_acquire(self) -> bool:
+        fd = os.open(self.lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return False
+        os.ftruncate(fd, 0)
+        os.write(fd, self.identity.encode())
+        self._fd = fd
+        metrics.is_leader.set(1)
+        return True
+
+    def run_or_die(
+        self,
+        on_started_leading: Callable[[], None],
+        stop: threading.Event,
+        retry_period: float = 3.0,
+    ) -> None:
+        """Block until leadership is acquired, then run the callback
+        (leaderelection.RunOrDie shape, server.go:170)."""
+        while not stop.is_set():
+            if self.try_acquire():
+                self._log.info("became leader")
+                try:
+                    on_started_leading()
+                finally:
+                    self.release()
+                return
+            self._log.info("waiting for leadership")
+            stop.wait(retry_period)
+
+    def release(self) -> None:
+        if self._fd is not None:
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_UN)
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = None
+            metrics.is_leader.set(0)
