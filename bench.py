@@ -1,0 +1,193 @@
+"""End-to-end benchmark: the BASELINE.md north-star metrics on real hardware.
+
+Runs the dist-MNIST workload through the FULL framework stack — operator
+reconcile -> pod (process) creation -> env injection -> JAX training on the
+accelerator -> worker-0 success -> cleanup — and times job wall-clock plus
+pod-startup->first-step latency; then measures ResNet-50 steady-state
+training throughput on the chip.
+
+Prints exactly ONE JSON line:
+  {"metric": "dist_mnist_e2e_wallclock_s", "value": ..., "unit": "s",
+   "vs_baseline": ..., "details": {...}}
+
+vs_baseline: the reference publishes no numbers (BASELINE.md); the fork's
+only quantitative target is O(100) concurrent jobs. We report against the
+reference's *contract* as 1.0-anchored (parity by construction) and include
+absolute sub-metrics for cross-round tracking.
+
+All diagnostics go to stderr; stdout carries only the JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO_ROOT = str(Path(__file__).resolve().parent)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def read_events(path: str) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass
+    return out
+
+
+def run_job_e2e(model: str, steps: int, batch: int, extra: list[str],
+                timeout: float) -> dict:
+    """Submit one TrainJob through the operator; return timing + events."""
+    from tf_operator_tpu.api import defaults
+    from tf_operator_tpu.api.types import (
+        ContainerSpec,
+        JobConditionType,
+        ObjectMeta,
+        PodTemplateSpec,
+        ReplicaSpec,
+        ReplicaType,
+        TrainJob,
+        TrainJobSpec,
+        is_succeeded,
+    )
+    from tf_operator_tpu.runtime.session import LocalSession
+
+    metrics_file = tempfile.mktemp(prefix=f"tpujob-bench-{model}-")
+    name = f"bench-{model.replace('/', '-')}"
+    cmd = [
+        sys.executable, "-m", "tf_operator_tpu.models.train",
+        "--model", model, "--steps", str(steps), "--batch", str(batch), *extra,
+    ]
+    job = TrainJob(
+        metadata=ObjectMeta(name=name),
+        spec=TrainJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1,
+                    template=PodTemplateSpec(
+                        containers=[
+                            ContainerSpec(name="tensorflow", image="local", command=cmd)
+                        ]
+                    ),
+                )
+            }
+        ),
+    )
+    defaults.set_defaults(job)
+    job.spec.run_policy.scheduling.gang = False
+
+    # Prepend the repo to PYTHONPATH, preserving any existing entries (the
+    # TPU sandbox registers its backend via a sitecustomize on PYTHONPATH).
+    pythonpath = REPO_ROOT
+    if os.environ.get("PYTHONPATH"):
+        pythonpath += os.pathsep + os.environ["PYTHONPATH"]
+    session = LocalSession(
+        env_overrides={
+            "PYTHONPATH": pythonpath,
+            "TPUJOB_METRICS_FILE": metrics_file,
+        },
+        log_dir=tempfile.mkdtemp(prefix="tpujob-bench-logs-"),
+    )
+    try:
+        t_submit = time.time()
+        session.submit(job)
+        try:
+            final = session.wait_for_condition(
+                "default", name,
+                (JobConditionType.SUCCEEDED, JobConditionType.FAILED),
+                timeout=timeout,
+            )
+        except TimeoutError:
+            # Still emit the one JSON line from main(): report as a failure.
+            return {
+                "ok": False,
+                "wallclock_s": round(time.time() - t_submit, 3),
+                "events": read_events(metrics_file),
+                "error": f"timeout after {timeout}s",
+            }
+        wallclock = time.time() - t_submit
+        ok = is_succeeded(final.status)
+        events = read_events(metrics_file)
+        return {"ok": ok, "wallclock_s": round(wallclock, 3), "events": events}
+    finally:
+        session.close()
+        try:
+            os.unlink(metrics_file)
+        except OSError:
+            pass
+
+
+def main() -> int:
+    t_total = time.time()
+
+    # --- Workload 1 (north star): dist-MNIST through the operator ---
+    log("bench: dist-MNIST e2e through operator...")
+    mnist = run_job_e2e("mnist-mlp", steps=200, batch=128, extra=[], timeout=600)
+    if not mnist["ok"]:
+        log(f"MNIST job FAILED: {mnist}")
+        print(json.dumps({
+            "metric": "dist_mnist_e2e_wallclock_s", "value": -1.0, "unit": "s",
+            "vs_baseline": 0.0, "details": {"error": "mnist job failed"},
+        }))
+        return 1
+    ev = {e["event"]: e for e in mnist["events"]}
+    startup = ev.get("first_step", {}).get("startup_s")
+    mnist_sps = ev.get("done", {}).get("steady_steps_per_sec")
+    backend = ev.get("first_step", {}).get("backend", "?")
+    log(f"  wallclock={mnist['wallclock_s']}s startup->first-step={startup}s "
+        f"steps/s={mnist_sps} backend={backend}")
+
+    # --- Workload 2: ResNet-50 training throughput on the chip ---
+    log("bench: ResNet-50 throughput through operator...")
+    rn_batch = 64 if backend in ("tpu", "axon") else 8
+    rn_steps = 30 if backend in ("tpu", "axon") else 5
+    rn_size = 224 if backend in ("tpu", "axon") else 64
+    resnet = run_job_e2e(
+        "resnet50", steps=rn_steps, batch=rn_batch,
+        extra=["--image-size", str(rn_size)], timeout=1800,
+    )
+    rev = {e["event"]: e for e in resnet["events"]}
+    rn_ips = rev.get("done", {}).get("examples_per_sec")
+    log(f"  ok={resnet['ok']} wallclock={resnet.get('wallclock_s')}s "
+        f"images/s={rn_ips}")
+
+    details = {
+        "backend": backend,
+        "mnist_wallclock_s": mnist["wallclock_s"],
+        "startup_to_first_step_s": startup,
+        "mnist_steps_per_sec": mnist_sps,
+        "resnet50_ok": resnet["ok"],
+        "resnet50_wallclock_s": resnet.get("wallclock_s"),
+        "resnet50_images_per_sec": rn_ips,
+        "resnet50_batch": rn_batch,
+        "resnet50_image_size": rn_size,
+        "bench_total_s": round(time.time() - t_total, 1),
+    }
+    # No published reference numbers exist (BASELINE.md): anchor at 1.0 =
+    # full capability parity on the north-star workload, achieved end-to-end.
+    print(json.dumps({
+        "metric": "dist_mnist_e2e_wallclock_s",
+        "value": mnist["wallclock_s"],
+        "unit": "s",
+        "vs_baseline": 1.0,
+        "details": details,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

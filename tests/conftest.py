@@ -8,9 +8,21 @@ multi-chip path via __graft_entry__.dryrun_multichip.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Force-override the platform: this environment's sitecustomize imports jax
+# at interpreter startup and pins JAX_PLATFORMS to the TPU plugin, so setting
+# the env var here is too late — go through jax.config instead, before any
+# backend is initialized. Set TPUJOB_TEST_TPU=1 to run against real hardware.
+if not os.environ.get("TPUJOB_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses we spawn
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except ImportError:
+        pass

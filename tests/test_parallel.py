@@ -1,0 +1,250 @@
+"""Data-plane tests on the virtual 8-device CPU mesh: mesh/sharding
+construction, ring-attention numerics vs reference, SPMD train steps
+across dp/fsdp/tp/sp mesh shapes, model forwards."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tf_operator_tpu.models import mnist as mnist_models
+from tf_operator_tpu.models import transformer as tfm
+from tf_operator_tpu.models.resnet import ResNet, init_resnet
+from tf_operator_tpu.parallel import mesh as mesh_lib
+from tf_operator_tpu.parallel import sharding_rules
+from tf_operator_tpu.parallel.ring_attention import (
+    attention_reference,
+    ring_attention,
+)
+from tf_operator_tpu.parallel.train_step import (
+    create_train_state,
+    make_train_step,
+    shard_state,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+class TestMesh:
+    def test_default_dp(self):
+        m = mesh_lib.make_mesh()
+        assert m.axis_names == ("dp",) and m.shape["dp"] == 8
+
+    def test_axis_order_canonical(self):
+        m = mesh_lib.make_mesh({"tp": 2, "dp": 4})
+        assert m.axis_names == ("dp", "tp")  # dp outer, tp inner
+
+    def test_bad_product(self):
+        with pytest.raises(ValueError):
+            mesh_lib.make_mesh({"dp": 3})
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("TPUJOB_MESH", '{"dp": 2, "tp": 4}')
+        m = mesh_lib.mesh_from_env()
+        assert m.shape == {"dp": 2, "tp": 4}
+
+    def test_local_batch(self):
+        m = mesh_lib.make_mesh({"dp": 4, "tp": 2})
+        assert mesh_lib.local_batch_size(m, 32) == 8
+
+
+class TestShardingRules:
+    def test_transformer_rules(self):
+        m = mesh_lib.make_mesh({"dp": 2, "tp": 4})
+        model = tfm.Transformer(tfm.TINY)
+        params = model.init(jax.random.key(0), jnp.zeros((1, 16), jnp.int32))["params"]
+        shardings = sharding_rules.tree_shardings(
+            params, m, sharding_rules.TRANSFORMER_TP_RULES
+        )
+        flat = {
+            sharding_rules.path_str(p): s.spec
+            for p, s in jax.tree_util.tree_flatten_with_path(shardings)[0]
+        }
+        from jax.sharding import PartitionSpec as P
+
+        assert flat["layer_0/attn/query/kernel"] == P(None, "tp")
+        assert flat["layer_0/attn/attn_out/kernel"] == P("tp", None)
+        assert flat["layer_0/mlp_in/kernel"] == P(None, "tp")
+        assert flat["layer_0/mlp_out/kernel"] == P("tp", None)
+        assert flat["embed/embedding"] == P("tp", None)
+
+    def test_fsdp_composition(self):
+        m = mesh_lib.make_mesh({"fsdp": 8})
+        model = tfm.Transformer(tfm.TINY)
+        params = model.init(jax.random.key(0), jnp.zeros((1, 16), jnp.int32))["params"]
+        shardings = sharding_rules.tree_shardings(
+            params, m, sharding_rules.TRANSFORMER_TP_RULES
+        )
+        kernel_spec = shardings["layer_0"]["mlp_in"]["kernel"].spec
+        assert "fsdp" in str(kernel_spec)
+
+    def test_indivisible_dim_left_replicated(self):
+        m = mesh_lib.make_mesh({"tp": 8})
+        # hidden 128 / heads: qkv kernel out dim 128 divisible by 8; pick a
+        # shape that isn't: 10-class head.
+        params = {"lm_head": {"kernel": jnp.zeros((128, 10))}}
+        sh = sharding_rules.tree_shardings(
+            params, m, sharding_rules.TRANSFORMER_TP_RULES
+        )
+        from jax.sharding import PartitionSpec as P
+
+        assert sh["lm_head"]["kernel"].spec == P(None, None)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        m = mesh_lib.make_mesh({"sp": 8})
+        k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+        shape = (2, 4, 64, 32)  # [B, H, T, D], T sharded 8-way
+        q = jax.random.normal(k1, shape, jnp.float32)
+        k = jax.random.normal(k2, shape, jnp.float32)
+        v = jax.random.normal(k3, shape, jnp.float32)
+        expected = attention_reference(q, k, v, causal=causal)
+        with jax.sharding.use_mesh(m) if hasattr(jax.sharding, "use_mesh") else m:
+            got = ring_attention(q, k, v, mesh=m, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+    def test_grad_flows(self):
+        m = mesh_lib.make_mesh({"sp": 8})
+        q = jax.random.normal(jax.random.key(1), (1, 2, 32, 16))
+
+        def loss(q):
+            return jnp.sum(ring_attention(q, q, q, mesh=m, causal=True) ** 2)
+
+        g = jax.grad(loss)(q)
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_mixed_mesh_axes(self):
+        m = mesh_lib.make_mesh({"dp": 2, "sp": 2, "tp": 2})
+        k1, k2, k3 = jax.random.split(jax.random.key(2), 3)
+        shape = (2, 2, 32, 16)
+        q, k, v = (jax.random.normal(kk, shape) for kk in (k1, k2, k3))
+        expected = attention_reference(q, k, v, causal=True)
+        got = ring_attention(q, k, v, mesh=m, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def _tiny_lm_setup(mesh, seq=32, batch=8):
+    from tf_operator_tpu.parallel.ring_attention import make_attention_fn
+
+    cfg = tfm.TINY_LM
+    attn = make_attention_fn(mesh, causal=True)
+    model = tfm.TransformerLM(cfg, attn_fn=attn)
+    # init with the unsharded model: params are attention-impl independent,
+    # and shard_map can't run on an init-sized batch of 1.
+    params = tfm.TransformerLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, seq), jnp.int32)
+    )["params"]
+
+    def loss_fn(params, model_state, batch, rng):
+        logits = model.apply({"params": params}, batch["tokens"])
+        return tfm.lm_loss(logits, batch["tokens"]), model_state
+
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0, cfg.vocab_size)
+    return model, params, loss_fn, {"tokens": tokens}
+
+
+class TestTrainStep:
+    @pytest.mark.parametrize(
+        "axes",
+        [
+            {"dp": 8},
+            {"fsdp": 8},
+            {"dp": 2, "tp": 4},
+            {"dp": 2, "sp": 2, "tp": 2},
+            {"dp": 2, "fsdp": 2, "tp": 2},
+        ],
+        ids=lambda a: "x".join(f"{k}{v}" for k, v in a.items()),
+    )
+    def test_loss_decreases(self, axes):
+        mesh = mesh_lib.make_mesh(axes)
+        model, params, loss_fn, batch = _tiny_lm_setup(mesh)
+        tx = optax.adam(1e-3)
+        state = create_train_state(params, tx)
+        state = shard_state(state, mesh, sharding_rules.TRANSFORMER_TP_RULES)
+        _, compile_step = make_train_step(
+            loss_fn, tx, mesh, rules=sharding_rules.TRANSFORMER_TP_RULES
+        )
+        step = compile_step(state, batch)
+        rng = jax.random.key(0)
+        state, m0 = step(state, batch, rng)
+        for _ in range(10):
+            state, metrics = step(state, batch, rng)
+        assert float(metrics["loss"]) < float(m0["loss"])
+        assert int(state.step) == 11
+
+    def test_dp_matches_single_device(self):
+        """The same step on dp=8 and dp=1 must produce identical losses."""
+        results = {}
+        for axes, devs in (({"dp": 8}, None), ({"dp": 1}, jax.devices()[:1])):
+            mesh = mesh_lib.make_mesh(axes, devices=devs)
+            model, params, loss_fn, batch = _tiny_lm_setup(mesh)
+            tx = optax.sgd(1e-2)
+            state = create_train_state(params, tx)
+            state = shard_state(state, mesh)
+            _, compile_step = make_train_step(loss_fn, tx, mesh)
+            step = compile_step(state, batch)
+            rng = jax.random.key(0)
+            for _ in range(3):
+                state, metrics = step(state, batch, rng)
+            results[str(axes)] = float(metrics["loss"])
+        a, b = results.values()
+        assert abs(a - b) < 2e-3, results
+
+
+class TestModels:
+    def test_mnist_mlp_trains(self):
+        mesh = mesh_lib.make_mesh({"dp": 8})
+        model = mnist_models.MLP()
+        x = jax.random.normal(jax.random.key(0), (16, 28, 28))
+        y = jax.random.randint(jax.random.key(1), (16,), 0, 10)
+        params = model.init(jax.random.key(2), x)["params"]
+
+        def loss_fn(params, model_state, batch, rng):
+            logits = model.apply({"params": params}, batch["x"])
+            return mnist_models.cross_entropy_loss(logits, batch["y"]), model_state
+
+        tx = optax.adam(1e-3)
+        state = shard_state(create_train_state(params, tx), mesh)
+        _, compile_step = make_train_step(loss_fn, tx, mesh)
+        batch = {"x": x, "y": y}
+        step = compile_step(state, batch)
+        state, m0 = step(state, batch, jax.random.key(0))
+        for _ in range(20):
+            state, m = step(state, batch, jax.random.key(0))
+        assert float(m["loss"]) < float(m0["loss"])
+
+    def test_resnet_forward_and_batchstats(self):
+        model = ResNet(stage_sizes=[1, 1], num_classes=10, width=8)
+        params, batch_stats = init_resnet(model, jax.random.key(0), image_size=32)
+        x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+        logits, mut = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=True,
+            mutable=["batch_stats"],
+        )
+        assert logits.shape == (2, 10) and logits.dtype == jnp.float32
+        assert "batch_stats" in mut
+
+    def test_resnet50_param_count(self):
+        from tf_operator_tpu.models.resnet import ResNet50
+
+        model = ResNet50(num_classes=1000)
+        params, _ = init_resnet(model, jax.random.key(0), image_size=64)
+        n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+        assert 25.4e6 < n < 25.8e6, n  # canonical ResNet-50 ~25.56M params
+
+    def test_bert_base_param_count(self):
+        model = tfm.Transformer(tfm.BERT_BASE)
+        params = model.init(jax.random.key(0), jnp.zeros((1, 16), jnp.int32))["params"]
+        n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+        assert 105e6 < n < 115e6, n  # BERT-base trunk ~110M
+
+    def test_classifier_head(self):
+        model = tfm.TransformerClassifier(tfm.TINY, num_classes=3)
+        params = model.init(jax.random.key(0), jnp.zeros((2, 16), jnp.int32))["params"]
+        out = model.apply({"params": params}, jnp.zeros((2, 16), jnp.int32))
+        assert out.shape == (2, 3)

@@ -1,0 +1,11 @@
+"""JAX/flax model zoo for the baseline workloads (SURVEY.md §6):
+
+  mnist        MLP + ConvNet        (dist-mnist / mnist_with_summaries parity)
+  resnet       ResNet-50 family     (MultiWorkerMirrored ResNet-50 parity)
+  transformer  BERT-base encoder +
+               causal LM w/ ring attention (Chief+Worker+Evaluator BERT parity,
+                                            long-context first-class)
+
+All models compute in bfloat16 by default (MXU-native) with f32 params, and
+take an injectable attention function so sequence parallelism composes.
+"""

@@ -1,0 +1,57 @@
+"""MNIST models — parity with the reference's canonical example workloads
+(examples/v1/dist-mnist, examples/v1/mnist_with_summaries)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """The dist-mnist example's 784-500-10 shape."""
+
+    hidden: int = 500
+    classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class ConvNet(nn.Module):
+    """The mnist_with_summaries-style small CNN."""
+
+    classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(32, (5, 5), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(1024, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean(jnp.argmax(logits, -1) == labels)

@@ -1,0 +1,106 @@
+"""ResNet family (v1.5 bottleneck) — the flagship vision model.
+
+Parity target: the reference's MultiWorkerMirroredStrategy ResNet-50 baseline
+(examples/v1/distribution_strategy, BASELINE.md workload 3), rebuilt for TPU:
+bfloat16 compute end-to-end (MXU-native), f32 parameters and batch-norm
+statistics, NHWC layout (XLA:TPU-preferred), and cross-replica batch-norm via
+an optional axis_name so dp training matches single-device numerics.
+
+ResNet-50 = [3, 4, 6, 3] bottleneck stages, 64..512 base widths, 7x7 stem —
+the standard architecture (He et al. '15), v1.5 variant (stride on the 3x3).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    dtype: jnp.dtype
+    norm: partial
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        # v1.5: stride lives on the 3x3, not the 1x1.
+        y = nn.Conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            padding="SAME", use_bias=False, dtype=self.dtype,
+        )(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)  # zero-init last BN
+
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides),
+                use_bias=False, dtype=self.dtype, name="proj",
+            )(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+    bn_momentum: float = 0.9
+    bn_axis_name: str | None = None  # e.g. "dp" for cross-replica batch norm
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=self.bn_momentum,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.bn_axis_name,
+        )
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, dtype=self.dtype, name="stem",
+        )(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                x = BottleneckBlock(
+                    filters=self.width * 2**i,
+                    strides=2 if i > 0 and j == 0 else 1,
+                    dtype=self.dtype,
+                    norm=norm,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+ResNet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2])  # basic-block depth kept
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3])
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3])
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3])
+
+
+def init_resnet(
+    model: ResNet, rng: jax.Array, image_size: int = 224, batch: int = 2
+):
+    """Returns (params, batch_stats)."""
+    variables = model.init(
+        rng, jnp.zeros((batch, image_size, image_size, 3), jnp.float32), train=False
+    )
+    return variables["params"], variables.get("batch_stats", {})

@@ -1,0 +1,193 @@
+"""Generic trainer — the workload binary TrainJob pods run.
+
+This is the data-plane entrypoint the operator's pods execute (the role
+dist_mnist.py / keras_model_to_estimator.py played in the reference's
+examples, SURVEY.md §3.4), TPU-native:
+
+  python -m tf_operator_tpu.models.train --model resnet50 --steps 100
+
+  1. jax.distributed from the operator-injected env (multi-process jobs)
+  2. Mesh from TPUJOB_MESH (dp/fsdp/tp/sp axes)
+  3. jitted SPMD train step (bf16 compute, donated state)
+  4. synthetic data by default (bench determinism); progress as JSON lines
+     on stdout and, when TPUJOB_METRICS_FILE is set, appended to that file
+     (the hook bench.py uses to time startup->first-step and steps/sec).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _emit(event: dict) -> None:
+    line = json.dumps(event)
+    print(line, flush=True)
+    path = os.environ.get("TPUJOB_METRICS_FILE")
+    if path:
+        with open(path, "a") as f:
+            f.write(line + "\n")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--model",
+        default="mnist-mlp",
+        choices=["mnist-mlp", "mnist-conv", "resnet18", "resnet50", "transformer-lm"],
+    )
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--log-every", type=int, default=20)
+    args = ap.parse_args(argv)
+
+    t_start = time.time()
+    _emit({"event": "start", "t": t_start, "model": args.model})
+
+    from tf_operator_tpu.parallel.distributed import initialize_from_env
+
+    initialize_from_env()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tf_operator_tpu.parallel import mesh as mesh_lib
+    from tf_operator_tpu.parallel import sharding_rules
+    from tf_operator_tpu.parallel.ring_attention import make_attention_fn
+    from tf_operator_tpu.parallel.train_step import (
+        create_train_state,
+        make_train_step,
+        shard_state,
+    )
+
+    mesh = mesh_lib.mesh_from_env()
+    rules = None
+    model_state = {}
+
+    if args.model in ("mnist-mlp", "mnist-conv"):
+        from tf_operator_tpu.models import mnist as M
+
+        model = M.MLP() if args.model == "mnist-mlp" else M.ConvNet()
+        x = jnp.zeros((args.batch, 28, 28), jnp.float32)
+        params = model.init(jax.random.key(0), x[:1])["params"]
+
+        def make_batch(rng):
+            kx, ky = jax.random.split(rng)
+            return {
+                "x": jax.random.normal(kx, (args.batch, 28, 28)),
+                "y": jax.random.randint(ky, (args.batch,), 0, 10),
+            }
+
+        def loss_fn(params, model_state, batch, rng):
+            logits = model.apply({"params": params}, batch["x"])
+            return M.cross_entropy_loss(logits, batch["y"]), model_state
+
+    elif args.model in ("resnet18", "resnet50"):
+        from tf_operator_tpu.models import mnist as M  # loss helpers
+        from tf_operator_tpu.models.resnet import ResNet18, ResNet50, init_resnet
+
+        classes = 1000
+        model = (ResNet50 if args.model == "resnet50" else ResNet18)(
+            num_classes=classes
+        )
+        params, batch_stats = init_resnet(
+            model, jax.random.key(0), image_size=args.image_size, batch=2
+        )
+        model_state = {"batch_stats": batch_stats}
+
+        def make_batch(rng):
+            kx, ky = jax.random.split(rng)
+            return {
+                "x": jax.random.normal(
+                    kx, (args.batch, args.image_size, args.image_size, 3)
+                ),
+                "y": jax.random.randint(ky, (args.batch,), 0, classes),
+            }
+
+        def loss_fn(params, model_state, batch, rng):
+            logits, mut = model.apply(
+                {"params": params, **model_state}, batch["x"], train=True,
+                mutable=["batch_stats"],
+            )
+            return M.cross_entropy_loss(logits, batch["y"]), dict(mut)
+
+    else:  # transformer-lm
+        from tf_operator_tpu.models import transformer as tfm
+
+        cfg = tfm.TransformerConfig(
+            vocab_size=32000, num_layers=4, hidden=512, num_heads=8,
+            max_len=args.seq, causal=True,
+        )
+        attn = make_attention_fn(mesh, causal=True)
+        model = tfm.TransformerLM(cfg, attn_fn=attn)
+        params = tfm.TransformerLM(cfg).init(
+            jax.random.key(0), jnp.zeros((1, args.seq), jnp.int32)
+        )["params"]
+        rules = sharding_rules.TRANSFORMER_TP_RULES
+
+        def make_batch(rng):
+            return {
+                "tokens": jax.random.randint(
+                    rng, (args.batch, args.seq), 0, cfg.vocab_size
+                )
+            }
+
+        def loss_fn(params, model_state, batch, rng):
+            logits = model.apply({"params": params}, batch["tokens"])
+            return tfm.lm_loss(logits, batch["tokens"]), model_state
+
+    tx = optax.adamw(args.lr)
+    state = shard_state(create_train_state(params, tx, model_state), mesh, rules)
+    batch = make_batch(jax.random.key(1))
+    _, compile_step = make_train_step(loss_fn, tx, mesh, rules=rules)
+    step = compile_step(state, batch)
+
+    state, metrics = step(state, batch, jax.random.key(2))
+    jax.block_until_ready(metrics["loss"])
+    t_first = time.time()
+    _emit(
+        {
+            "event": "first_step",
+            "t": t_first,
+            "startup_s": round(t_first - t_start, 3),
+            "loss": float(metrics["loss"]),
+            "mesh": dict(mesh.shape),
+            "backend": jax.default_backend(),
+            "n_devices": len(jax.devices()),
+        }
+    )
+
+    t0 = time.time()
+    for i in range(1, args.steps):
+        batch = make_batch(jax.random.key(2 + i))
+        state, metrics = step(state, batch, jax.random.key(1000 + i))
+        if i % args.log_every == 0:
+            _emit({"event": "progress", "step": i, "loss": float(metrics["loss"])})
+    jax.block_until_ready(metrics["loss"])
+    dt = time.time() - t0
+    steady = args.steps - 1
+    # With --steps 1 there is no steady-state window (only the compile step
+    # ran); report null throughput rather than a microseconds-denominator lie.
+    sps = round(steady / dt, 4) if steady > 0 else None
+    _emit(
+        {
+            "event": "done",
+            "steps": args.steps,
+            "steady_steps_per_sec": sps,
+            "examples_per_sec": round(steady * args.batch / dt, 2) if steady > 0 else None,
+            "final_loss": float(metrics["loss"]),
+            "total_s": round(time.time() - t_start, 3),
+        }
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,178 @@
+"""Transformer family: BERT-style encoder and causal LM, TPU-first.
+
+Parity target: BASELINE.md workload 4 (Chief+Worker+Evaluator BERT-base) —
+plus the long-context capability the reference lacked: the attention function
+is injectable, so the same module runs single-device reference attention or
+ring attention over the `sp` mesh axis (parallel/ring_attention.py).
+
+Module names are the contract for the tensor-parallel sharding rules
+(parallel/sharding_rules.TRANSFORMER_TP_RULES): query/key/value, attn_out,
+mlp_in, mlp_out, embed, lm_head.
+
+TPU notes: bf16 compute / f32 params; head_dim kept >=128-friendly shapes;
+no dropout by default (bench determinism) but supported via `dropout_rate`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.parallel.ring_attention import attention_reference
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 30522  # BERT-base vocabulary
+    num_layers: int = 12
+    hidden: int = 768
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    max_len: int = 512
+    causal: bool = False
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.num_heads
+
+
+BERT_BASE = TransformerConfig()
+BERT_LARGE = TransformerConfig(num_layers=24, hidden=1024, num_heads=16)
+
+
+def _tiny(causal: bool = False, **kw) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=1024, num_layers=2, hidden=128, num_heads=4, max_len=256,
+        causal=causal, **kw,
+    )
+
+
+TINY = _tiny()
+TINY_LM = _tiny(causal=True)
+
+AttnFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
+
+
+class SelfAttention(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: AttnFn | None = None
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.cfg
+        b, t, _ = x.shape
+        dense = lambda name: nn.Dense(  # noqa: E731
+            cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
+        )
+        q = dense("query")(x)
+        k = dense("key")(x)
+        v = dense("value")(x)
+
+        def split(a):  # [B, T, H*D] -> [B, H, T, D]
+            return a.reshape(b, t, cfg.num_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+
+        attn = self.attn_fn
+        if attn is None:
+            attn = lambda q, k, v: attention_reference(q, k, v, causal=cfg.causal)  # noqa: E731
+        o = attn(split(q), split(k), split(v))
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, cfg.hidden)
+        return nn.Dense(
+            cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32, name="attn_out"
+        )(o)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: AttnFn | None = None
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32, name=name)  # noqa: E731
+        # Pre-LN: stabler for deep stacks, standard on TPU training.
+        h = SelfAttention(cfg, self.attn_fn, name="attn")(ln("ln1")(x), deterministic)
+        if cfg.dropout_rate:
+            h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
+        x = x + h
+        h = nn.Dense(
+            cfg.hidden * cfg.mlp_ratio, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="mlp_in",
+        )(ln("ln2")(x))
+        h = nn.gelu(h)
+        h = nn.Dense(
+            cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32, name="mlp_out"
+        )(h)
+        if cfg.dropout_rate:
+            h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
+        return x + h
+
+
+class Transformer(nn.Module):
+    """Token encoder/decoder trunk; returns final hidden states."""
+
+    cfg: TransformerConfig
+    attn_fn: AttnFn | None = None
+
+    @nn.compact
+    def __call__(self, tokens, deterministic=True):
+        cfg = self.cfg
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="embed",
+        )(tokens)
+        pos = nn.Embed(
+            cfg.max_len, cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="pos_embed",
+        )(jnp.arange(tokens.shape[1]))
+        x = x + pos[None]
+        for i in range(cfg.num_layers):
+            x = Block(cfg, self.attn_fn, name=f"layer_{i}")(x, deterministic)
+        return nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32, name="ln_f")(x)
+
+
+class TransformerLM(nn.Module):
+    """Causal language model head over the trunk (flagship long-context model)."""
+
+    cfg: TransformerConfig
+    attn_fn: AttnFn | None = None
+
+    @nn.compact
+    def __call__(self, tokens, deterministic=True):
+        h = Transformer(self.cfg, self.attn_fn, name="trunk")(tokens, deterministic)
+        logits = nn.Dense(
+            self.cfg.vocab_size, dtype=self.cfg.dtype, param_dtype=jnp.float32,
+            use_bias=False, name="lm_head",
+        )(h)
+        return logits.astype(jnp.float32)
+
+
+class TransformerClassifier(nn.Module):
+    """Sequence classifier (BERT-style [CLS]-pooled) for the evaluator path."""
+
+    cfg: TransformerConfig
+    num_classes: int = 2
+    attn_fn: AttnFn | None = None
+
+    @nn.compact
+    def __call__(self, tokens, deterministic=True):
+        h = Transformer(self.cfg, self.attn_fn, name="trunk")(tokens, deterministic)
+        pooled = jnp.tanh(
+            nn.Dense(self.cfg.hidden, dtype=self.cfg.dtype, param_dtype=jnp.float32,
+                     name="pooler")(h[:, 0])
+        )
+        return nn.Dense(self.num_classes, dtype=self.cfg.dtype,
+                        param_dtype=jnp.float32, name="cls")(pooled).astype(jnp.float32)
+
+
+def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token cross entropy (shifted)."""
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

@@ -1,0 +1,6 @@
+"""TPU kernels (pallas) with portable fallbacks.
+
+Hot ops the MXU/VMEM path owns: fused flash attention (ops.flash_attention).
+Every kernel has a pure-JAX reference twin used (a) as the non-TPU fallback,
+(b) to pin numerics in tests (pallas interpret mode on CPU).
+"""

@@ -1,0 +1,37 @@
+"""Attention dispatcher: pallas flash kernel on TPU, reference elsewhere.
+
+Selection order for `flash_attention(q, k, v, causal)`:
+  1. pallas fused kernel — default backend is TPU, pallas importable, and
+     T divisible into MXU-friendly blocks
+  2. pure-JAX reference (XLA still fuses well; correct everywhere)
+
+Model code should not import this directly — use
+parallel.ring_attention.make_attention_fn, which additionally routes to ring
+attention when the mesh has a sequence-parallel axis.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from tf_operator_tpu.parallel.ring_attention import attention_reference
+
+
+def _pallas_eligible(q: jax.Array) -> bool:
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    t, d = q.shape[-2], q.shape[-1]
+    return t >= 128 and t % 128 == 0 and d % 128 == 0
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False,
+    force_pallas: bool | None = None, interpret: bool = False,
+) -> jax.Array:
+    """[B, H, T, D] attention with automatic kernel selection."""
+    use_pallas = force_pallas if force_pallas is not None else _pallas_eligible(q)
+    if use_pallas:
+        from tf_operator_tpu.ops.flash_attention import flash_attention_pallas
+
+        return flash_attention_pallas(q, k, v, causal, 128, 128, interpret)
+    return attention_reference(q, k, v, causal)

@@ -1,0 +1,8 @@
+"""SPMD parallelism: meshes, shardings, train steps, ring attention.
+
+This package is the data-plane counterpart of the operator's cluster
+contract: where the reference's user containers consumed TF_CONFIG and formed
+an NCCL/gRPC fabric (SURVEY.md §2 parallelism table), workloads here consume
+the TPUJOB_* / JAX_* env the operator injects, build a jax.sharding.Mesh over
+the slice (axes dp/fsdp/tp/sp/ep/pp), and let XLA insert ICI/DCN collectives.
+"""

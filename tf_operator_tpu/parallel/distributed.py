@@ -1,0 +1,50 @@
+"""Multi-process initialization from the operator's injected contract.
+
+A workload calls `initialize_from_env()` first thing: it reads the env the
+operator injected (JAX_COORDINATOR_ADDRESS / JAX_PROCESS_ID /
+JAX_NUM_PROCESSES — see cluster_spec/tpu_env.py) and brings up
+jax.distributed so all processes form one JAX runtime; collectives then ride
+ICI within a slice and DCN across hosts. This replaces the reference's
+TF_CONFIG -> tf.train.ClusterSpec -> gRPC-server bootstrap (SURVEY.md §3.4)
+with the JAX-native equivalent, transparently to the manifest author.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tf_operator_tpu.cluster_spec import tpu_env
+from tf_operator_tpu.utils.logging import FieldLogger
+
+
+def distributed_env() -> tuple[str | None, int, int]:
+    """(coordinator_address, process_id, num_processes) from the injected env.
+    The local runtime rewrites the coordinator DNS name to 127.0.0.1:port."""
+    coord = os.environ.get(tpu_env.ENV_COORDINATOR_ADDRESS) or None
+    pid = int(os.environ.get(tpu_env.ENV_PROCESS_ID, "0"))
+    nprocs = int(os.environ.get(tpu_env.ENV_NUM_PROCESSES, "1"))
+    return coord, pid, nprocs
+
+
+def initialize_from_env(force: bool = False) -> bool:
+    """Initialize jax.distributed when the operator wired a multi-process
+    job; no-op (returns False) for single-process jobs."""
+    coord, pid, nprocs = distributed_env()
+    log = FieldLogger({"component": "jax-distributed", "process": pid})
+    if nprocs <= 1 and not force:
+        return False
+    # The coordinator binds its own listen port, which the local runtime maps
+    # via TPUJOB_COORD_LISTEN_PORT; in a real cluster the DNS name is its own.
+    if pid == 0:
+        listen = os.environ.get("TPUJOB_COORD_LISTEN_PORT")
+        if listen and coord:
+            coord = f"127.0.0.1:{listen}"
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    log.info("initialized: %d/%d via %s", pid, nprocs, coord)
+    return True

@@ -1,0 +1,102 @@
+"""Mesh construction + sharding vocabulary.
+
+The operator injects TPUJOB_MESH (logical axes, e.g. {"dp":8,"tp":4}) and
+TPUJOB_TOPOLOGY; this module turns them into a jax.sharding.Mesh and the
+standard shardings the training library uses. Axis semantics:
+
+  dp    pure data parallel (params replicated)
+  fsdp  data parallel with fully-sharded params (zero-3 style)
+  tp    tensor parallel (megatron-style within attention/mlp)
+  sp    sequence/context parallel (ring attention over this axis)
+  ep    expert parallel (MoE experts spread over this axis)
+  pp    pipeline parallel (stage-indexed)
+
+Batches shard over (dp, fsdp, sp...); params shard over (fsdp, tp); XLA
+lowers the implied collectives onto ICI within a slice and DCN across
+processes (scaling-book recipe: pick a mesh, annotate shardings, let XLA
+insert collectives).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tf_operator_tpu.cluster_spec.tpu_env import ENV_MESH
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+# tp innermost: tensor-parallel collectives are latency-bound and must ride
+# the fastest ICI links; dp outermost so gradient all-reduce crosses DCN only
+# at the slowest level.
+
+
+def normalize_axes(axes: dict[str, int]) -> dict[str, int]:
+    """Drop size-1 axes? No — keep explicit sizes, ordered canonically."""
+    out: dict[str, int] = {}
+    for name in AXIS_ORDER:
+        if name in axes:
+            out[name] = int(axes[name])
+    for name, size in axes.items():
+        if name not in out:
+            out[name] = int(size)
+    return out
+
+
+def make_mesh(axes: dict[str, int] | None = None, devices=None) -> Mesh:
+    """Build a Mesh with the canonical axis order. With axes=None, a pure-dp
+    mesh over every visible device."""
+    if devices is None:
+        devices = jax.devices()
+    if not axes:
+        axes = {"dp": len(devices)}
+    axes = normalize_axes(axes)
+    n = int(np.prod(list(axes.values())))
+    if n != len(devices):
+        raise ValueError(
+            f"mesh axes {axes} need {n} devices, have {len(devices)} "
+            f"({[str(d) for d in devices[:4]]}...)"
+        )
+    grid = np.asarray(devices).reshape(tuple(axes.values()))
+    return Mesh(grid, tuple(axes.keys()))
+
+
+def mesh_from_env(devices=None) -> Mesh:
+    """Mesh from the operator-injected TPUJOB_MESH (defaults to pure dp)."""
+    raw = os.environ.get(ENV_MESH, "")
+    axes = json.loads(raw) if raw else None
+    return make_mesh(axes, devices)
+
+
+def data_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Axes the global batch is split over."""
+    return tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+
+
+def batch_sharding(mesh: Mesh, extra_seq_axis: bool = False) -> NamedSharding:
+    """[batch, seq, ...] sharding: batch over dp/fsdp, seq over sp if asked."""
+    da = data_axes(mesh)
+    batch_spec = da if len(da) > 1 else (da[0] if da else None)
+    if extra_seq_axis and "sp" in mesh.axis_names:
+        return NamedSharding(mesh, P(batch_spec, "sp"))
+    return NamedSharding(mesh, P(batch_spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape[name] if name in mesh.axis_names else 1
+
+
+def local_batch_size(mesh: Mesh, global_batch: int) -> int:
+    denom = 1
+    for a in data_axes(mesh):
+        denom *= axis_size(mesh, a)
+    if global_batch % denom:
+        raise ValueError(f"global batch {global_batch} not divisible by dp size {denom}")
+    return global_batch // denom

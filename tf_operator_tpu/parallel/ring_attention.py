@@ -1,0 +1,150 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Long-context is first-class here (the reference predates it entirely,
+SURVEY.md §5): sequences are sharded over the `sp` mesh axis, each device
+holds a [*, T/n, *] block of Q/K/V, and K/V blocks rotate around the ring via
+`ppermute` (ICI neighbor exchange) while each device folds incoming blocks
+into an online-softmax accumulator (the blockwise log-sum-exp recurrence of
+Rabe & Staats '21 / FlashAttention, arranged around a device ring as in Liu
+et al. '23). Compute of block i overlaps the transfer of block i+1 — XLA
+schedules the ppermute concurrently with the matmuls since neither depends
+on the other within a scan step.
+
+Communication cost per step: 2 * B*H*(T/n)*D halves around the ring; total
+bytes equal one full K/V all-gather, but peak memory stays O(T/n) and the
+compute is perfectly overlapped — the property that makes million-token
+contexts feasible on a slice.
+
+Used inside shard_map (see `ring_attention`), with a pure single-device
+reference (`attention_reference`) for numerics tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def attention_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+) -> jax.Array:
+    """Plain softmax(QK^T/sqrt(d))V on one device. [B, H, T, D]."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(jnp.float32(d)).astype(q.dtype)
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _block_attn(q, k, v, q_off, k_off, causal, sm_scale):
+    """Unnormalized block attention with running-max stats.
+    Returns (o_block [B,H,Tq,D] f32, m [B,H,Tq] f32, l [B,H,Tq] f32)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        q_pos = q_off + jnp.arange(q.shape[-2])
+        k_pos = k_off + jnp.arange(k.shape[-2])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    # Fully-masked rows: exp(NEG_INF - NEG_INF)=1 would poison l; zero them.
+    p = jnp.where((m == NEG_INF)[..., None], 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o, m, l
+
+
+def _ring_attention_sharded(
+    q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str, causal: bool
+) -> jax.Array:
+    """Per-device body (runs under shard_map): q,k,v are the local
+    [B, H, T_local, D] shards."""
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    t_local = q.shape[-2]
+    sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    q_off = my * t_local
+
+    perm = [(i, (i + 1) % n) for i in range(n)]  # ring: send to next rank
+
+    def step(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        src = (my - i) % n  # who produced the K/V block we hold at step i
+        k_off = src * t_local
+        bo, bm, bl = _block_attn(q, k_cur, v_cur, q_off, k_off, causal, sm_scale)
+        m_new = jnp.maximum(m, bm)
+        c_old = jnp.exp(m - m_new)
+        c_new = jnp.exp(bm - m_new)
+        o = o * c_old[..., None] + bo * c_new[..., None]
+        l = l * c_old + bl * c_new
+        # Rotate K/V to the next rank; overlaps with the matmuls above. The
+        # last step's rotation result is never read — skip the send (all
+        # devices agree on i, so the cond is uniform and collective-safe).
+        k_nxt, v_nxt = jax.lax.cond(
+            i < n - 1,
+            lambda kv: (
+                jax.lax.ppermute(kv[0], axis_name, perm),
+                jax.lax.ppermute(kv[1], axis_name, perm),
+            ),
+            lambda kv: kv,
+            (k_cur, v_cur),
+        )
+        return (o, m_new, l, k_nxt, v_nxt), None
+
+    # Accumulators must carry the same varying-axes type as the values they
+    # mix with inside the scan (JAX vma typing under shard_map); deriving
+    # them from q inherits its full varying set on any mesh.
+    qf = q.astype(jnp.float32)
+    o0 = qf * 0.0
+    m0 = qf[..., 0] * 0.0 + NEG_INF
+    l0 = qf[..., 0] * 0.0
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(n)
+    )
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (strict causal edge)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = False,
+    batch_axes: tuple[str, ...] = ("dp", "fsdp"),
+    head_axis: str = "tp",
+) -> jax.Array:
+    """Exact attention with [B, H, T, D] inputs sequence-sharded over
+    `axis_name`; batch over dp/fsdp and heads over tp when present."""
+    if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        return attention_reference(q, k, v, causal)
+    b_spec = tuple(a for a in batch_axes if a in mesh.axis_names) or None
+    h_spec = head_axis if head_axis in mesh.axis_names else None
+    spec = P(b_spec, h_spec, axis_name, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_sharded, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def make_attention_fn(
+    mesh: Mesh | None, causal: bool = False, axis_name: str = "sp"
+) -> Callable:
+    """Attention callable for model code: ring when the mesh has a >1 sp
+    axis, plain reference otherwise."""
+    if mesh is not None and axis_name in mesh.axis_names and mesh.shape[axis_name] > 1:
+        return functools.partial(ring_attention, mesh=mesh, causal=causal, axis_name=axis_name)
+    return functools.partial(attention_reference, causal=causal)

@@ -1,0 +1,122 @@
+"""Parameter sharding rules: map param-tree paths to PartitionSpecs.
+
+Megatron-style tensor parallelism + fsdp composition, expressed as ordered
+(regex, PartitionSpec) rules over flattened parameter paths. The first match
+wins; unmatched params are replicated (then optionally fsdp-sharded on their
+largest divisible dimension).
+
+Rule sets are data, not code: models ship a default rule set
+(e.g. models.transformer.TP_RULES) and users can override per job.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = list[tuple[str, P]]
+
+# Megatron TP for the transformer family (models/transformer.py naming):
+#   qkv / mlp-in kernels: split output dim over tp (column parallel)
+#   attn-out / mlp-out kernels: split input dim over tp (row parallel)
+#   embeddings: split vocab over tp
+TRANSFORMER_TP_RULES: Rules = [
+    (r".*(query|key|value|qkv)/kernel$", P(None, "tp")),
+    (r".*attn_out/kernel$", P("tp", None)),
+    (r".*mlp_in/kernel$", P(None, "tp")),
+    (r".*mlp_out/kernel$", P("tp", None)),
+    (r".*embed/embedding$", P("tp", None)),
+    (r".*lm_head/kernel$", P(None, "tp")),
+    (r".*(bias|scale)$", P()),
+]
+
+
+def path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _mesh_axes(mesh: Mesh, spec: P) -> P:
+    """Drop axes the mesh doesn't have (rules are mesh-agnostic)."""
+    cleaned = []
+    for entry in spec:
+        if entry is None:
+            cleaned.append(None)
+        elif isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in mesh.axis_names)
+            cleaned.append(kept if kept else None)
+        else:
+            cleaned.append(entry if entry in mesh.axis_names else None)
+    return P(*cleaned)
+
+
+def _apply_fsdp(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
+    """Compose fsdp onto the largest dimension not already sharded, when it
+    divides evenly (zero-3 parameter sharding)."""
+    if "fsdp" not in mesh.axis_names or mesh.shape["fsdp"] == 1:
+        return spec
+    fsdp = mesh.shape["fsdp"]
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if entries[i] is None and shape[i] % fsdp == 0 and shape[i] >= fsdp:
+            entries[i] = "fsdp"
+            break
+    return P(*entries)
+
+
+def sharding_for(
+    path: str, shape: tuple[int, ...], mesh: Mesh, rules: Rules | None
+) -> NamedSharding:
+    spec = P()
+    for pattern, candidate in rules or []:
+        if re.match(pattern, path):
+            spec = _mesh_axes(mesh, candidate)
+            break
+    spec = _apply_fsdp(spec, shape, mesh)
+    # Drop shardings that don't divide the dim evenly (small models on big tp).
+    entries = list(spec)
+    for i, entry in enumerate(entries):
+        if entry is None or i >= len(shape):
+            continue
+        size = mesh.shape[entry] if isinstance(entry, str) else int(
+            np.prod([mesh.shape[a] for a in entry])
+        )
+        if shape[i] % size:
+            entries[i] = None
+    return NamedSharding(mesh, P(*entries))
+
+
+def tree_shardings(params, mesh: Mesh, rules: Rules | None = None):
+    """PyTree of NamedShardings matching `params`' structure."""
+
+    def per_leaf(path, leaf):
+        return sharding_for(path_str(path), getattr(leaf, "shape", ()), mesh, rules)
+
+    return jax.tree_util.tree_map_with_path(per_leaf, params)
+
+
+def shard_tree(params, mesh: Mesh, rules: Rules | None = None):
+    """Device-put a param tree with its computed shardings."""
+    shardings = tree_shardings(params, mesh, rules)
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+def describe(params, mesh: Mesh, rules: Rules | None = None) -> Iterable[str]:
+    shardings = tree_shardings(params, mesh, rules)
+    flat, _ = jax.tree_util.tree_flatten_with_path(shardings)
+    for path, s in flat:
+        yield f"{path_str(path)}: {s.spec}"

@@ -1,0 +1,173 @@
+"""Generic SPMD train-step factory.
+
+One jit-compiled step covering the framework's parallelism modes: data
+parallel (dp), fully-sharded dp (fsdp), tensor parallel (tp, via param
+sharding rules), and sequence parallel (sp, via ring attention inside the
+model). XLA derives every collective from the sharding annotations — there
+is no explicit pmean/psum here (scaling-book recipe), which is what lets the
+same step compile for any mesh shape.
+
+Design choices for TPU:
+  - params live in f32, compute casts to bf16 inside the model (MXU-native)
+  - donate the train state: buffers update in place, halving peak HBM
+  - optional jax.checkpoint (remat) on the loss for long-sequence memory
+  - static shapes only; the step is traced once per (mesh, shapes)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tf_operator_tpu.parallel import mesh as mesh_lib
+from tf_operator_tpu.parallel import sharding_rules
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    """Minimal train state (params + optimizer + step + optional mutable
+    model state such as batch-norm statistics)."""
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    model_state: Any  # e.g. flax "batch_stats"; {} when unused
+
+
+def create_train_state(
+    params: Any,
+    tx: optax.GradientTransformation,
+    model_state: Any = None,
+) -> TrainState:
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        model_state=model_state if model_state is not None else {},
+    )
+
+
+def state_shardings(
+    state: TrainState, mesh: Mesh, rules: sharding_rules.Rules | None
+) -> TrainState:
+    """Shardings for every leaf of the state: params/opt-state follow the
+    param rules (momentum shards like its param), the rest replicated."""
+    param_sh = sharding_rules.tree_shardings(state.params, mesh, rules)
+
+    # Optimizer subtrees (adam mu/nu, trace, …) mirror the param tree
+    # structure, so an opt leaf's path *ends with* its param's path (e.g.
+    # "0/mu/layer_0/attn/query/kernel"). Match by path suffix — matching by
+    # shape would collide query/key/value with attn_out (both hidden×hidden)
+    # and hand momenta a transposed sharding.
+    flat_params = {
+        sharding_rules.path_str(p): leaf
+        for p, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]
+    }
+    by_path = {
+        sharding_rules.path_str(p): (s, getattr(flat_params.get(sharding_rules.path_str(p)), "shape", None))
+        for p, s in jax.tree_util.tree_flatten_with_path(param_sh)[0]
+    }
+    max_depth = max((p.count("/") + 1 for p in by_path), default=0)
+    repl = NamedSharding(mesh, P())
+
+    def opt_leaf(path, leaf):
+        parts = sharding_rules.path_str(path).split("/")
+        for k in range(min(max_depth, len(parts)), 0, -1):
+            hit = by_path.get("/".join(parts[-k:]))
+            if hit is not None and hit[1] == getattr(leaf, "shape", None):
+                return hit[0]
+        return repl
+
+    return TrainState(
+        step=repl,
+        params=param_sh,
+        opt_state=jax.tree_util.tree_map_with_path(opt_leaf, state.opt_state),
+        model_state=jax.tree.map(lambda _: repl, state.model_state),
+    )
+
+
+def shard_state(state: TrainState, mesh: Mesh, rules=None) -> TrainState:
+    sh = state_shardings(state, mesh, rules)
+    return jax.tree.map(jax.device_put, state, sh)
+
+
+LossFn = Callable[..., tuple[jax.Array, Any]]
+# signature: loss_fn(params, model_state, batch, rng) -> (loss, new_model_state)
+
+
+def make_train_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    rules: sharding_rules.Rules | None = None,
+    remat: bool = False,
+    seq_sharded_batch: bool = False,
+):
+    """Build the jitted SPMD train step.
+
+    Returns step(state, batch, rng) -> (state, metrics) with donated state.
+    """
+    if remat:
+        loss_fn = jax.checkpoint(loss_fn)
+
+    def _step(state: TrainState, batch, rng):
+        (loss, new_model_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, state.model_state, batch, rng
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt,
+            model_state=new_model_state if new_model_state is not None else {},
+        )
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    batch_sh = mesh_lib.batch_sharding(mesh, extra_seq_axis=seq_sharded_batch)
+    repl = mesh_lib.replicated(mesh)
+
+    def batch_shardings_for(batch):
+        return jax.tree.map(lambda _: batch_sh, batch)
+
+    def compile_step(example_state: TrainState, example_batch):
+        st_sh = state_shardings(example_state, mesh, rules)
+        return jax.jit(
+            _step,
+            in_shardings=(st_sh, batch_shardings_for(example_batch), repl),
+            out_shardings=(st_sh, repl),
+            donate_argnums=(0,),
+        )
+
+    return _step, compile_step
+
+
+def make_eval_step(
+    metric_fn: Callable, mesh: Mesh, rules: sharding_rules.Rules | None = None
+):
+    """Eval-step factory: metric_fn(params, model_state, batch) -> metrics.
+    Returns compile_eval(example_params, example_model_state, example_batch)
+    -> jitted step with the same param/batch shardings as training."""
+    batch_sh = mesh_lib.batch_sharding(mesh)
+    repl = mesh_lib.replicated(mesh)
+
+    def compile_eval(params, model_state, batch):
+        param_sh = sharding_rules.tree_shardings(params, mesh, rules)
+        return jax.jit(
+            metric_fn,
+            in_shardings=(
+                param_sh,
+                jax.tree.map(lambda _: repl, model_state),
+                jax.tree.map(lambda _: batch_sh, batch),
+            ),
+            out_shardings=repl,
+        )
+
+    return compile_eval

@@ -246,12 +246,24 @@ class LocalProcessRuntime:
                 restart_count += 1
                 self._set_status(pod, PodPhase.RUNNING, code, restart_count)
                 time.sleep(min(0.1 * restart_count, 2.0))
+                # The pod may have been deleted during the backoff sleep —
+                # respawning then would orphan a process forever (Always
+                # policy) with no pod object tracking it.
+                if entry.stopping or self._stopped:
+                    return
+                cur = self.cluster.try_get_pod(pod.namespace, pod.name)
+                if cur is None or cur.metadata.uid != pod.metadata.uid:
+                    return
                 continue
 
             phase = PodPhase.SUCCEEDED if code == 0 else PodPhase.FAILED
             self._set_status(pod, phase, code, restart_count)
             with self._lock:
-                self._procs.pop((pod.namespace, pod.name), None)
+                # Only pop our own entry: an ExitCode re-creation may have
+                # already registered a successor under the same (ns, name).
+                cur_entry = self._procs.get((pod.namespace, pod.name))
+                if cur_entry is entry:
+                    self._procs.pop((pod.namespace, pod.name), None)
             return
 
     def _set_status(
