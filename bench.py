@@ -66,7 +66,8 @@ def run_job_e2e(model: str, steps: int, batch: int, extra: list[str],
     )
     from tf_operator_tpu.runtime.session import LocalSession
 
-    metrics_file = tempfile.mktemp(prefix=f"tpujob-bench-{model}-")
+    fd, metrics_file = tempfile.mkstemp(prefix=f"tpujob-bench-{model}-")
+    os.close(fd)
     name = f"bench-{model.replace('/', '-')}"
     cmd = [
         sys.executable, "-m", "tf_operator_tpu.models.train",
@@ -132,6 +133,22 @@ def run_job_e2e(model: str, steps: int, batch: int, extra: list[str],
 
 
 def main() -> int:
+    # The one-JSON-line stdout contract must survive any failure mode.
+    try:
+        return _main()
+    except BaseException as exc:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "dist_mnist_e2e_wallclock_s", "value": -1.0, "unit": "s",
+            "vs_baseline": 0.0,
+            "details": {"error": f"{type(exc).__name__}: {exc}"},
+        }))
+        return 1
+
+
+def _main() -> int:
     t_total = time.time()
 
     # --- Workload 1 (north star): dist-MNIST through the operator ---

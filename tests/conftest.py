@@ -18,7 +18,10 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 # at interpreter startup and pins JAX_PLATFORMS to the TPU plugin, so setting
 # the env var here is too late — go through jax.config instead, before any
 # backend is initialized. Set TPUJOB_TEST_TPU=1 to run against real hardware.
-if not os.environ.get("TPUJOB_TEST_TPU"):
+# An explicitly user-set JAX_PLATFORMS is honored; "axon" is the value the
+# sandbox sitecustomize setdefaults, i.e. "the user didn't choose".
+if (not os.environ.get("TPUJOB_TEST_TPU")
+        and os.environ.get("JAX_PLATFORMS", "axon") == "axon"):
     os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses we spawn
     try:
         import jax

@@ -1,0 +1,158 @@
+"""Pipeline parallelism: GPipe schedule numerics, grads, and training.
+
+Covers parallel/pipeline.py on the virtual 8-device CPU mesh (conftest).
+Reference parity note: the reference operator has no pipeline data plane
+(SURVEY.md §2); these tests pin the new capability's correctness against a
+sequential single-device execution of the same stages.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tf_operator_tpu.models import transformer as tfm
+from tf_operator_tpu.parallel import mesh as mesh_lib
+from tf_operator_tpu.parallel.pipeline import (
+    make_pipelined_lm,
+    pipeline_apply,
+    pipeline_rules,
+    stack_stage_params,
+    stacked_shardings,
+)
+from tf_operator_tpu.parallel.train_step import (
+    create_train_state,
+    make_train_step,
+    shard_state,
+)
+
+
+def mlp_stage(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def init_mlp(key, width=16):
+    kw, kb = jax.random.split(key)
+    return {
+        "w": jax.random.normal(kw, (width, width)) * 0.3,
+        "b": jax.random.normal(kb, (width,)) * 0.1,
+    }
+
+
+def sequential_reference(stacked, x):
+    n = jax.tree.leaves(stacked)[0].shape[0]
+    for i in range(n):
+        x = mlp_stage(jax.tree.map(lambda a: a[i], stacked), x)
+    return x
+
+
+class TestPipelineApply:
+    @pytest.mark.parametrize("axes,m", [({"pp": 4}, 4), ({"pp": 4}, 8),
+                                        ({"pp": 2, "dp": 4}, 4)])
+    def test_matches_sequential(self, axes, m):
+        import math
+        n = math.prod(axes.values())
+        mesh = mesh_lib.make_mesh(axes, devices=jax.devices()[:n])
+        stacked = stack_stage_params(init_mlp, jax.random.key(0), axes["pp"])
+        batch = m * 4 * axes.get("dp", 1)
+        x = jax.random.normal(jax.random.key(1), (batch, 16))
+        got = pipeline_apply(mlp_stage, stacked, x, mesh, num_microbatches=m)
+        want = sequential_reference(stacked, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_degenerate_no_pp_axis(self):
+        mesh = mesh_lib.make_mesh({"dp": 8})
+        stacked = stack_stage_params(init_mlp, jax.random.key(0), 3)
+        x = jax.random.normal(jax.random.key(1), (4, 16))
+        got = pipeline_apply(mlp_stage, stacked, x, mesh, num_microbatches=2)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(sequential_reference(stacked, x)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grads_match_sequential(self):
+        mesh = mesh_lib.make_mesh({"pp": 4, "dp": 2})
+        stacked = stack_stage_params(init_mlp, jax.random.key(0), 4)
+        x = jax.random.normal(jax.random.key(1), (8, 16))
+
+        def loss_pipe(p):
+            return jnp.mean(pipeline_apply(mlp_stage, p, x, mesh,
+                                           num_microbatches=4) ** 2)
+
+        def loss_seq(p):
+            return jnp.mean(sequential_reference(p, x) ** 2)
+
+        g_pipe = jax.grad(loss_pipe)(stacked)
+        g_seq = jax.grad(loss_seq)(stacked)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            g_pipe, g_seq,
+        )
+
+    def test_remat_same_numerics(self):
+        mesh = mesh_lib.make_mesh({"pp": 4}, devices=jax.devices()[:4])
+        stacked = stack_stage_params(init_mlp, jax.random.key(0), 4)
+        x = jax.random.normal(jax.random.key(1), (4, 16))
+        base = pipeline_apply(mlp_stage, stacked, x, mesh, 4)
+        remat = pipeline_apply(mlp_stage, stacked, x, mesh, 4, remat=True)
+        np.testing.assert_allclose(np.asarray(base), np.asarray(remat),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_bad_microbatch_count(self):
+        mesh = mesh_lib.make_mesh({"pp": 4}, devices=jax.devices()[:4])
+        stacked = stack_stage_params(init_mlp, jax.random.key(0), 4)
+        x = jnp.zeros((6, 16))
+        with pytest.raises(ValueError, match="not divisible"):
+            pipeline_apply(mlp_stage, stacked, x, mesh, num_microbatches=4)
+
+
+class TestPipelinedLM:
+    def test_forward_matches_plain_transformer_shapes(self):
+        cfg = tfm.TINY_LM
+        mesh = mesh_lib.make_mesh({"pp": 2, "dp": 4})
+        init, loss_fn, apply_fn = make_pipelined_lm(cfg, mesh,
+                                                    num_microbatches=2)
+        params = init(jax.random.key(0))
+        # stage stack carries [S, ...] leading dim
+        lead = jax.tree.leaves(params["stages"])[0].shape[0]
+        assert lead == 2
+        toks = jnp.zeros((8, 64), jnp.int32)
+        logits = apply_fn(params, toks)
+        assert logits.shape == (8, 64, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_layer_count_must_divide(self):
+        cfg = tfm.TransformerConfig(vocab_size=64, num_layers=3, hidden=32,
+                                    num_heads=2, max_len=32, causal=True)
+        mesh = mesh_lib.make_mesh({"pp": 2, "dp": 4})
+        with pytest.raises(ValueError, match="not divisible"):
+            make_pipelined_lm(cfg, mesh, num_microbatches=2)
+
+    def test_trains_loss_decreases(self):
+        cfg = tfm.TransformerConfig(vocab_size=128, num_layers=2, hidden=64,
+                                    num_heads=2, max_len=32, causal=True)
+        mesh = mesh_lib.make_mesh({"pp": 2, "dp": 4})
+        init, loss_fn, _ = make_pipelined_lm(cfg, mesh, num_microbatches=2)
+        params = init(jax.random.key(0))
+        tx = optax.adam(1e-3)
+        state = create_train_state(params, tx)
+        rules = pipeline_rules()
+        state = shard_state(state, mesh, rules)
+        _, compile_step = make_train_step(loss_fn, tx, mesh, rules=rules)
+
+        toks = jax.random.randint(jax.random.key(1), (8, 32), 0, 128)
+        batch = {"tokens": toks}
+        step = compile_step(state, batch)
+        losses = []
+        rng = jax.random.key(2)
+        for _ in range(8):
+            state, metrics = step(state, batch, rng)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0], losses
+        # params on the pp axis stayed stage-sharded
+        sh = stacked_shardings(state.params["stages"], mesh)
+        leaf = jax.tree.leaves(state.params["stages"])[0]
+        want = jax.tree.leaves(sh)[0]
+        assert leaf.sharding.spec == want.spec

@@ -1,0 +1,234 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the `pp` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2 parallelism table);
+its operator-side contribution is only stable stage-indexed addressing
+(`pkg/common/jobcontroller/util.go:24` `{job}-{type}-{index}` names). The
+TPU-native build supplies the data plane itself: layers are partitioned into
+S stages whose parameters are *stacked* on a leading axis sharded over `pp`,
+and a `shard_map` body runs the classic GPipe schedule — M microbatches flow
+through S stages over M+S-1 ticks, activations hopping stage→stage+1 via
+`ppermute` (nearest-neighbor ICI traffic, the cheapest collective on a TPU
+torus).
+
+SPMD shape of the schedule: every device runs the *same* program every tick
+(XLA requirement — one traced program), so idle ticks (the pipeline bubble,
+(S-1)/(M+S-1) of the work) execute the stage on garbage and mask the result.
+Efficiency therefore grows with M; pick M >= 4*S in practice.
+
+Composition: the batch dimension shards over dp/fsdp as usual (each
+data-parallel group runs an independent pipeline replica); tp/sp axes are
+left unmentioned in the specs, i.e. stage bodies see them replicated. The
+backward pass needs no code: AD transposes `ppermute` into the reverse hop
+and the scan into the reverse schedule. `remat=True` recomputes each stage
+in backward, the standard memory/compute trade for deep pipelines.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+StageFn = Callable[[Any, jax.Array], jax.Array]
+# stage_fn(stage_params, h) -> h, same activation shape in and out.
+
+
+def stack_stage_params(init_fn: Callable[[jax.Array], Any], rng: jax.Array,
+                       num_stages: int) -> Any:
+    """Init S independent stage param trees and stack them on a leading axis
+    (the axis the `pp` mesh dimension shards)."""
+    return jax.vmap(init_fn)(jax.random.split(rng, num_stages))
+
+
+def stacked_shardings(stacked: Any, mesh: Mesh) -> Any:
+    """NamedShardings putting every stacked leaf's leading dim on `pp`."""
+    sh = NamedSharding(mesh, P("pp"))
+    return jax.tree.map(lambda _: sh, stacked)
+
+
+def pipeline_apply(
+    stage_fn: StageFn,
+    stacked_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    num_microbatches: int,
+    pp_axis: str = "pp",
+    batch_axes: tuple[str, ...] = ("dp", "fsdp"),
+    remat: bool = False,
+) -> jax.Array:
+    """Run x [B, ...] through S pipelined stages; returns same-shape output.
+
+    stacked_params: pytree with leading dim S == mesh.shape[pp_axis], sharded
+    over pp. B must divide by num_microbatches (and its dp shard too).
+    """
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    if pp_axis not in mesh.axis_names or mesh.shape[pp_axis] == 1:
+        # Degenerate single-stage mesh: just run the stages sequentially.
+        def seq(x):
+            s = stacked_params
+            n = jax.tree.leaves(s)[0].shape[0]
+            for i in range(n):
+                x = stage_fn(jax.tree.map(lambda a: a[i], s), x)
+            return x
+        return seq(x)
+
+    m = num_microbatches
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by microbatches {m}")
+
+    b_spec = tuple(a for a in batch_axes if a in mesh.axis_names) or None
+    dp_size = 1
+    for a in b_spec or ():
+        dp_size *= mesh.shape[a]
+    if (b // m) % dp_size:
+        raise ValueError(
+            f"microbatch size {b // m} not divisible by data-parallel "
+            f"size {dp_size} (batch {b}, {m} microbatches)"
+        )
+    # [M, mb, ...]: microbatch dim replicated over pp (every stage holds the
+    # full local schedule), per-microbatch batch dim sharded over dp.
+    x_spec = P(None, b_spec, *([None] * (x.ndim - 1)))
+    p_spec = jax.tree.map(lambda _: P(pp_axis), stacked_params)
+
+    def body(params, xs):
+        params = jax.tree.map(lambda a: a[0], params)  # local [1,...] shard
+        stage = jax.lax.axis_index(pp_axis)
+        n = jax.lax.psum(1, pp_axis)
+        perm = [(i, i + 1) for i in range(mesh.shape[pp_axis] - 1)]
+
+        def tick(carry, t):
+            incoming, outputs = carry
+            # Stage 0 feeds microbatch t; others consume the activation that
+            # hopped in last tick. Clamp keeps the gather in bounds during
+            # the drain ticks (whose stage-0 output never reaches collection).
+            feed = xs[jnp.minimum(t, m - 1)]
+            h = jnp.where(stage == 0, feed, incoming)
+            out = stage_fn(params, h)
+            # The last stage emits microbatch t-(S-1) once the fill ends.
+            idx = t - (n - 1)
+            done = jax.lax.dynamic_update_slice(
+                outputs, out[None].astype(outputs.dtype),
+                (jnp.maximum(idx, 0),) + (0,) * out.ndim,
+            )
+            outputs = jnp.where((stage == n - 1) & (idx >= 0), done, outputs)
+            # Hop to the next stage; ranks with no sender (stage 0) get zeros.
+            shifted = jax.lax.ppermute(out, pp_axis, perm)
+            return (shifted, outputs), None
+
+        # The carry mixes with axis_index-dependent values, so it is
+        # pp-varying inside the scan; the initial value must carry the same
+        # varying-axes type (shard_map vma typing).
+        o0 = jax.lax.pcast(jnp.zeros_like(xs[0]), (pp_axis,), to="varying")
+        outs0 = jax.lax.pcast(jnp.zeros_like(xs), (pp_axis,), to="varying")
+        (_, outputs), _ = jax.lax.scan(
+            tick, (o0, outs0), jnp.arange(m + mesh.shape[pp_axis] - 1)
+        )
+        # Only the last stage holds real outputs; psum replicates them across
+        # pp so the result leaves the shard_map pp-invariant.
+        outputs = jnp.where(stage == n - 1, outputs, jnp.zeros_like(outputs))
+        return jax.lax.psum(outputs, pp_axis)
+
+    xs = x.reshape((m, b // m) + x.shape[1:])
+    fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(p_spec, x_spec), out_specs=x_spec
+    )
+    return fn(stacked_params, xs).reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# Pipelined transformer LM: embed/head outside the pipeline (auto-sharded),
+# the homogeneous block stack inside it.
+# ---------------------------------------------------------------------------
+
+
+def make_pipelined_lm(cfg, mesh: Mesh, num_microbatches: int,
+                      remat: bool = False):
+    """Pipelined causal LM over `cfg` (models.transformer.TransformerConfig).
+
+    Returns (init, loss_fn):
+      init(rng) -> params {"embed": .., "stages": stacked, "head": ..}
+      loss_fn(params, model_state, batch, rng) -> (loss, model_state)
+    compatible with parallel.train_step.make_train_step. Use
+    pipeline_rules() for the matching sharding rules.
+    """
+    import flax.linen as nn
+
+    from tf_operator_tpu.models.transformer import Block, lm_loss
+
+    n_stages = mesh.shape["pp"] if "pp" in mesh.axis_names else 1
+    if cfg.num_layers % n_stages:
+        raise ValueError(
+            f"{cfg.num_layers} layers not divisible into {n_stages} stages"
+        )
+    per_stage = cfg.num_layers // n_stages
+
+    class EmbedIn(nn.Module):
+        @nn.compact
+        def __call__(self, tokens):
+            x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="embed")(tokens)
+            pos = nn.Embed(cfg.max_len, cfg.hidden, dtype=cfg.dtype,
+                           param_dtype=jnp.float32, name="pos_embed")(
+                jnp.arange(tokens.shape[1]))
+            return x + pos[None]
+
+    class StageBlocks(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            for i in range(per_stage):
+                x = Block(cfg, name=f"block_{i}")(x)
+            return x
+
+    class HeadOut(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
+                             name="ln_f")(x)
+            logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
+                              param_dtype=jnp.float32, use_bias=False,
+                              name="lm_head")(x)
+            return logits.astype(jnp.float32)
+
+    embed_mod, stage_mod, head_mod = EmbedIn(), StageBlocks(), HeadOut()
+    tok0 = jnp.zeros((1, cfg.max_len), jnp.int32)
+    act0 = jnp.zeros((1, cfg.max_len, cfg.hidden), cfg.dtype)
+
+    def init(rng):
+        r_e, r_s, r_h = jax.random.split(rng, 3)
+        return {
+            "embed": embed_mod.init(r_e, tok0)["params"],
+            "stages": stack_stage_params(
+                lambda k: stage_mod.init(k, act0)["params"], r_s, n_stages),
+            "head": head_mod.init(r_h, act0)["params"],
+        }
+
+    def stage_fn(p, h):
+        return stage_mod.apply({"params": p}, h)
+
+    def apply_fn(params, tokens):
+        h = embed_mod.apply({"params": params["embed"]}, tokens)
+        h = pipeline_apply(stage_fn, params["stages"], h, mesh,
+                           num_microbatches, remat=remat)
+        return head_mod.apply({"params": params["head"]}, h)
+
+    def loss_fn(params, model_state, batch, rng):
+        del rng
+        logits = apply_fn(params, batch["tokens"])
+        return lm_loss(logits, batch["tokens"]), model_state
+
+    return init, loss_fn, apply_fn
+
+
+def pipeline_rules():
+    """Sharding rules for make_pipelined_lm params: stage stacks on pp,
+    embed/head replicated (rules compose with fsdp as usual)."""
+    return [
+        (r".*stages/.*", P("pp")),
+        (r".*", P()),
+    ]
