@@ -1,0 +1,593 @@
+// tpujob native runtime: the operator's hot-loop primitives and the local
+// executor's process supervisor, in C++.
+//
+// The reference's native tier is the operator binary itself (Go —
+// SURVEY.md §0: pkg/common/jobcontroller workqueue/expectations hot loop,
+// and kubelet doing process supervision below it). This library is the
+// TPU build's equivalent: the per-reconcile data structures the controller
+// hammers (client-go-style rate-limited workqueue, expectations cache,
+// exit-code policy — ref jobcontroller.go:110-133, train_util.go:18-55)
+// and a kubelet-stand-in process supervisor (setsid process groups,
+// pidfd-based waits, whole-tree kills) behind a plain C ABI consumed from
+// Python via ctypes (tf_operator_tpu/native). Pure-Python fallbacks with
+// identical semantics live in core/workqueue.py, core/expectations.py,
+// utils/exit_codes.py and runtime/local.py.
+//
+// Build: make -C native   ->  native/build/libtpujob_native.so
+
+#include <algorithm>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <stdint.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+extern char** environ;
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+double now_s() {
+  return std::chrono::duration<double>(Clock::now().time_since_epoch()).count();
+}
+
+// ---------------------------------------------------------------------------
+// Rate limiters (client-go DefaultControllerRateLimiter shape)
+// ---------------------------------------------------------------------------
+
+class ItemExponentialRateLimiter {
+ public:
+  ItemExponentialRateLimiter(double base_delay, double max_delay)
+      : base_(base_delay), max_(max_delay) {}
+
+  double when(const std::string& item) {
+    std::lock_guard<std::mutex> g(mu_);
+    int n = 0;
+    auto it = failures_.find(item);
+    if (it != failures_.end()) n = it->second;
+    failures_[item] = n + 1;
+    // base * 2^n, saturating.
+    double d = base_;
+    for (int i = 0; i < n && d < max_; i++) d *= 2.0;
+    return std::min(d, max_);
+  }
+
+  void forget(const std::string& item) {
+    std::lock_guard<std::mutex> g(mu_);
+    failures_.erase(item);
+  }
+
+  int num_requeues(const std::string& item) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = failures_.find(item);
+    return it == failures_.end() ? 0 : it->second;
+  }
+
+ private:
+  double base_, max_;
+  std::mutex mu_;
+  std::unordered_map<std::string, int> failures_;
+};
+
+class BucketRateLimiter {
+ public:
+  BucketRateLimiter(double qps, int burst)
+      : qps_(qps), burst_(burst), tokens_(burst), last_(now_s()) {}
+
+  double when() {
+    std::lock_guard<std::mutex> g(mu_);
+    double now = now_s();
+    tokens_ = std::min(static_cast<double>(burst_), tokens_ + (now - last_) * qps_);
+    last_ = now;
+    if (tokens_ >= 1.0) {
+      tokens_ -= 1.0;
+      return 0.0;
+    }
+    double need = 1.0 - tokens_;
+    tokens_ -= 1.0;
+    return need / qps_;
+  }
+
+ private:
+  double qps_;
+  int burst_;
+  double tokens_, last_;
+  std::mutex mu_;
+};
+
+// ---------------------------------------------------------------------------
+// Rate-limited deduplicating workqueue (client-go workqueue.Type +
+// DelayingQueue + RateLimitingQueue semantics; see core/workqueue.py).
+// ---------------------------------------------------------------------------
+
+class WorkQueue {
+ public:
+  WorkQueue(double qps, int burst, double base_delay, double max_delay)
+      : item_rl_(base_delay, max_delay), bucket_(qps, burst) {}
+
+  void add(const std::string& item) {
+    std::lock_guard<std::mutex> g(mu_);
+    add_locked(item);
+    cv_.notify_one();
+  }
+
+  void add_after(const std::string& item, double delay) {
+    if (delay <= 0) {
+      add(item);
+      return;
+    }
+    std::lock_guard<std::mutex> g(mu_);
+    if (shutdown_) return;
+    waiting_.push({now_s() + delay, ++seq_, item});
+    cv_.notify_one();
+  }
+
+  void add_rate_limited(const std::string& item) {
+    double d = std::max(item_rl_.when(item), bucket_.when());
+    add_after(item, d);
+  }
+
+  void forget(const std::string& item) { item_rl_.forget(item); }
+  int num_requeues(const std::string& item) { return item_rl_.num_requeues(item); }
+
+  // Returns 1 with *out set, 0 on timeout, -1 on shutdown-and-drained.
+  int get(double timeout_s, bool block_forever, std::string* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    double deadline = block_forever ? 0.0 : now_s() + timeout_s;
+    for (;;) {
+      drain_ready_locked();
+      if (!queue_.empty()) {
+        *out = queue_.front();
+        queue_.pop_front();
+        dirty_.erase(*out);
+        processing_.insert(*out);
+        return 1;
+      }
+      if (shutdown_) return -1;
+      double wait = -1.0;  // forever
+      if (!waiting_.empty()) wait = std::max(0.0, waiting_.top().ready_at - now_s());
+      if (!block_forever) {
+        double rem = deadline - now_s();
+        if (rem <= 0) return 0;
+        wait = (wait < 0) ? rem : std::min(wait, rem);
+      }
+      if (wait < 0) {
+        cv_.wait(lk);
+      } else {
+        cv_.wait_for(lk, std::chrono::duration<double>(wait));
+      }
+    }
+  }
+
+  void done(const std::string& item) {
+    std::lock_guard<std::mutex> g(mu_);
+    processing_.erase(item);
+    if (dirty_.count(item)) {
+      queue_.push_back(item);
+      cv_.notify_one();
+    }
+  }
+
+  void shut_down() {
+    std::lock_guard<std::mutex> g(mu_);
+    shutdown_ = true;
+    cv_.notify_all();
+  }
+
+  int size() {
+    std::lock_guard<std::mutex> g(mu_);
+    return static_cast<int>(queue_.size());
+  }
+
+ private:
+  struct Waiting {
+    double ready_at;
+    uint64_t seq;
+    std::string item;
+    bool operator>(const Waiting& o) const {
+      return ready_at != o.ready_at ? ready_at > o.ready_at : seq > o.seq;
+    }
+  };
+
+  void add_locked(const std::string& item) {
+    if (shutdown_ || dirty_.count(item)) return;
+    dirty_.insert(item);
+    if (!processing_.count(item)) queue_.push_back(item);
+  }
+
+  void drain_ready_locked() {
+    double now = now_s();
+    while (!waiting_.empty() && waiting_.top().ready_at <= now) {
+      std::string item = waiting_.top().item;
+      waiting_.pop();
+      if (!dirty_.count(item)) {
+        dirty_.insert(item);
+        if (!processing_.count(item)) queue_.push_back(item);
+      }
+    }
+  }
+
+  ItemExponentialRateLimiter item_rl_;
+  BucketRateLimiter bucket_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::string> queue_;
+  std::unordered_set<std::string> dirty_, processing_;
+  std::priority_queue<Waiting, std::vector<Waiting>, std::greater<Waiting>> waiting_;
+  uint64_t seq_ = 0;
+  bool shutdown_ = false;
+};
+
+// ---------------------------------------------------------------------------
+// Expectations cache (k8s ControllerExpectations; see core/expectations.py)
+// ---------------------------------------------------------------------------
+
+constexpr double kExpectationsTimeoutS = 5 * 60.0;
+
+class Expectations {
+ public:
+  void expect(const std::string& key, int adds, int dels) {
+    std::lock_guard<std::mutex> g(mu_);
+    entries_[key] = {adds, dels, now_s()};
+  }
+
+  void raise_exp(const std::string& key, int adds, int dels) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = entries_.find(key);
+    if (it == entries_.end()) {
+      entries_[key] = {adds, dels, now_s()};
+    } else {
+      it->second.adds += adds;
+      it->second.dels += dels;
+    }
+  }
+
+  void observe(const std::string& key, int adds, int dels) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = entries_.find(key);
+    if (it != entries_.end()) {
+      it->second.adds -= adds;
+      it->second.dels -= dels;
+    }
+  }
+
+  bool satisfied(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = entries_.find(key);
+    if (it == entries_.end()) return true;
+    const Entry& e = it->second;
+    if (e.adds <= 0 && e.dels <= 0) return true;
+    return now_s() - e.ts > kExpectationsTimeoutS;
+  }
+
+  void erase(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    entries_.erase(key);
+  }
+
+ private:
+  struct Entry {
+    int adds, dels;
+    double ts;
+  };
+  std::mutex mu_;
+  std::unordered_map<std::string, Entry> entries_;
+};
+
+// ---------------------------------------------------------------------------
+// Process supervisor (kubelet stand-in for the local-process runtime)
+// ---------------------------------------------------------------------------
+
+class Supervisor {
+ public:
+  ~Supervisor() {
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& kv : procs_) {
+      if (kv.second.pidfd >= 0) close(kv.second.pidfd);
+    }
+  }
+
+  // Returns pid > 0 on success, -errno on failure.
+  long spawn(char* const argv[], char* const envp[], const char* cwd,
+             const char* logfile) {
+    int err_pipe[2];
+    if (pipe2(err_pipe, O_CLOEXEC) != 0) return -errno;
+
+    pid_t pid = fork();
+    if (pid < 0) {
+      int e = errno;
+      close(err_pipe[0]);
+      close(err_pipe[1]);
+      return -e;
+    }
+    if (pid == 0) {
+      // Child: own session+process group so terminate/kill reach the whole
+      // tree; stdio to the log file (or /dev/null); report exec errno up the
+      // CLOEXEC pipe so the parent sees spawn failures synchronously.
+      close(err_pipe[0]);
+      setsid();
+      int fd = -1;
+      if (logfile && logfile[0]) {
+        fd = open(logfile, O_WRONLY | O_CREAT | O_APPEND, 0644);
+      }
+      if (fd < 0) fd = open("/dev/null", O_WRONLY);
+      if (fd >= 0) {
+        dup2(fd, 1);
+        dup2(fd, 2);
+        if (fd > 2) close(fd);
+      }
+      int devnull = open("/dev/null", O_RDONLY);
+      if (devnull >= 0) {
+        dup2(devnull, 0);
+        if (devnull > 2) close(devnull);
+      }
+      if (cwd && cwd[0] && chdir(cwd) != 0) {
+        int e = errno;
+        ssize_t n = write(err_pipe[1], &e, sizeof(e));
+        (void)n;
+        _exit(127);
+      }
+      // The child owns a private copy of the address space: installing envp
+      // here (not in the parent) keeps concurrent spawns race-free.
+      if (envp) environ = const_cast<char**>(envp);
+      execvp(argv[0], argv);
+      int e = errno;
+      ssize_t n = write(err_pipe[1], &e, sizeof(e));
+      (void)n;
+      _exit(127);
+    }
+
+    close(err_pipe[1]);
+    int child_errno = 0;
+    ssize_t n = read(err_pipe[0], &child_errno, sizeof(child_errno));
+    close(err_pipe[0]);
+    if (n > 0) {  // exec failed
+      int status;
+      waitpid(pid, &status, 0);
+      return -(child_errno ? child_errno : ECHILD);
+    }
+
+    int pidfd = static_cast<int>(syscall(SYS_pidfd_open, pid, 0));
+    std::lock_guard<std::mutex> g(mu_);
+    procs_[pid] = {pidfd, false, 0};
+    return pid;
+  }
+
+  // 1 = exited (*code set), 0 = still running, -1 = unknown pid.
+  int poll_proc(long pid) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = procs_.find(static_cast<pid_t>(pid));
+    if (it == procs_.end()) return -1;
+    if (it->second.reaped) return 1;
+    return try_reap_locked(it) ? 1 : 0;
+  }
+
+  // 1 = exited within timeout (*code set), 0 = timeout, -1 = unknown pid.
+  // timeout_s < 0 means block forever.
+  int wait_proc(long pid, double timeout_s, int* code) {
+    // Poll a dup of the pidfd: a concurrent release() may close the original
+    // while we sleep, and the dup keeps the open description alive.
+    int pidfd = -1;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      auto it = procs_.find(static_cast<pid_t>(pid));
+      if (it == procs_.end()) return -1;
+      if (it->second.reaped) {
+        *code = it->second.exit_code;
+        return 1;
+      }
+      if (it->second.pidfd >= 0) pidfd = dup(it->second.pidfd);
+    }
+    double deadline = timeout_s < 0 ? -1 : now_s() + timeout_s;
+    int result;
+    for (;;) {
+      if (pidfd >= 0) {
+        struct pollfd pfd = {pidfd, POLLIN, 0};
+        int ms = -1;
+        if (deadline >= 0) {
+          double rem = deadline - now_s();
+          if (rem < 0) rem = 0;
+          ms = static_cast<int>(rem * 1000);
+        }
+        int r = poll(&pfd, 1, ms);
+        if (r < 0 && errno != EINTR) {
+          result = -1;
+          break;
+        }
+        if (r == 0) {
+          result = 0;  // timeout
+          break;
+        }
+      } else {
+        // No pidfd (old kernel): poll with sleeps.
+        usleep(20000);
+      }
+      std::lock_guard<std::mutex> g(mu_);
+      auto it = procs_.find(static_cast<pid_t>(pid));
+      if (it == procs_.end()) {
+        result = -1;  // released concurrently
+        break;
+      }
+      if (it->second.reaped || try_reap_locked(it)) {
+        *code = it->second.exit_code;
+        result = 1;
+        break;
+      }
+      if (deadline >= 0 && now_s() >= deadline) {
+        result = 0;
+        break;
+      }
+    }
+    if (pidfd >= 0) close(pidfd);
+    return result;
+  }
+
+  int exit_code(long pid) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = procs_.find(static_cast<pid_t>(pid));
+    if (it == procs_.end() || !it->second.reaped) return -1;
+    return it->second.exit_code;
+  }
+
+  // Signal the whole process group.
+  void signal_group(long pid, int sig) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = procs_.find(static_cast<pid_t>(pid));
+    if (it == procs_.end() || it->second.reaped) return;
+    kill(-static_cast<pid_t>(pid), sig);
+  }
+
+  void release(long pid) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = procs_.find(static_cast<pid_t>(pid));
+    if (it == procs_.end()) return;
+    if (!it->second.reaped) {
+      // Last resort: don't leak a zombie; kill and reap synchronously.
+      kill(-static_cast<pid_t>(pid), SIGKILL);
+      int status;
+      waitpid(static_cast<pid_t>(pid), &status, 0);
+    }
+    if (it->second.pidfd >= 0) close(it->second.pidfd);
+    procs_.erase(it);
+  }
+
+ private:
+  struct Proc {
+    int pidfd;
+    bool reaped;
+    int exit_code;
+  };
+
+  bool try_reap_locked(std::unordered_map<pid_t, Proc>::iterator it) {
+    int status = 0;
+    pid_t r = waitpid(it->first, &status, WNOHANG);
+    if (r != it->first) return false;
+    int code = 0;
+    if (WIFEXITED(status)) {
+      code = WEXITSTATUS(status);
+    } else if (WIFSIGNALED(status)) {
+      code = 128 + WTERMSIG(status);
+    }
+    it->second.reaped = true;
+    it->second.exit_code = code;
+    return true;
+  }
+
+  std::mutex mu_;
+  std::unordered_map<pid_t, Proc> procs_;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// --- workqueue ---
+void* tq_new(double qps, int burst, double base_delay, double max_delay) {
+  return new WorkQueue(qps, burst, base_delay, max_delay);
+}
+void tq_free(void* q) { delete static_cast<WorkQueue*>(q); }
+void tq_add(void* q, const char* item) { static_cast<WorkQueue*>(q)->add(item); }
+void tq_add_after(void* q, const char* item, double delay) {
+  static_cast<WorkQueue*>(q)->add_after(item, delay);
+}
+void tq_add_rate_limited(void* q, const char* item) {
+  static_cast<WorkQueue*>(q)->add_rate_limited(item);
+}
+void tq_forget(void* q, const char* item) { static_cast<WorkQueue*>(q)->forget(item); }
+int tq_num_requeues(void* q, const char* item) {
+  return static_cast<WorkQueue*>(q)->num_requeues(item);
+}
+int tq_get(void* q, double timeout_s, int block_forever, char* buf, int buflen) {
+  std::string out;
+  int r = static_cast<WorkQueue*>(q)->get(timeout_s, block_forever != 0, &out);
+  if (r == 1) {
+    size_t n = std::min(out.size(), static_cast<size_t>(buflen - 1));
+    memcpy(buf, out.data(), n);
+    buf[n] = '\0';
+  }
+  return r;
+}
+void tq_done(void* q, const char* item) { static_cast<WorkQueue*>(q)->done(item); }
+void tq_shutdown(void* q) { static_cast<WorkQueue*>(q)->shut_down(); }
+int tq_len(void* q) { return static_cast<WorkQueue*>(q)->size(); }
+
+// --- expectations ---
+void* te_new() { return new Expectations(); }
+void te_free(void* e) { delete static_cast<Expectations*>(e); }
+void te_expect(void* e, const char* key, int adds, int dels) {
+  static_cast<Expectations*>(e)->expect(key, adds, dels);
+}
+void te_raise(void* e, const char* key, int adds, int dels) {
+  static_cast<Expectations*>(e)->raise_exp(key, adds, dels);
+}
+void te_observe(void* e, const char* key, int adds, int dels) {
+  static_cast<Expectations*>(e)->observe(key, adds, dels);
+}
+int te_satisfied(void* e, const char* key) {
+  return static_cast<Expectations*>(e)->satisfied(key) ? 1 : 0;
+}
+void te_delete(void* e, const char* key) { static_cast<Expectations*>(e)->erase(key); }
+
+// --- exit-code policy (train_util.go:18-55 semantics; see utils/exit_codes.py)
+int tx_is_retryable(int code) {
+  switch (code) {
+    case 130:  // SIGINT
+    case 137:  // SIGKILL
+    case 138:  // SIGUSR1: user-declared retryable
+    case 143:  // SIGTERM
+      return 1;
+    case 1:
+    case 2:
+    case 126:
+    case 127:
+    case 128:
+    case 139:  // SIGSEGV
+      return 0;
+    default:
+      return code > 128 ? 1 : 0;
+  }
+}
+
+// --- supervisor ---
+void* ts_new() { return new Supervisor(); }
+void ts_free(void* s) { delete static_cast<Supervisor*>(s); }
+long ts_spawn(void* s, char* const argv[], char* const envp[], const char* cwd,
+              const char* logfile) {
+  return static_cast<Supervisor*>(s)->spawn(argv, envp, cwd, logfile);
+}
+int ts_poll(void* s, long pid) { return static_cast<Supervisor*>(s)->poll_proc(pid); }
+int ts_wait(void* s, long pid, double timeout_s, int* code) {
+  return static_cast<Supervisor*>(s)->wait_proc(pid, timeout_s, code);
+}
+int ts_exit_code(void* s, long pid) {
+  return static_cast<Supervisor*>(s)->exit_code(pid);
+}
+void ts_signal(void* s, long pid, int sig) {
+  static_cast<Supervisor*>(s)->signal_group(pid, sig);
+}
+void ts_release(void* s, long pid) { static_cast<Supervisor*>(s)->release(pid); }
+
+const char* tpujob_native_version() { return "1"; }
+
+}  // extern "C"

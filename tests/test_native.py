@@ -1,0 +1,245 @@
+"""Native (C++) runtime tier: differential tests against the pure-Python
+reference implementations (core/workqueue.py, core/expectations.py,
+utils/exit_codes.py) plus supervisor process-tree behavior.
+
+The native library is required in CI (the build toolchain is part of the
+environment); tests skip only if the source tree was shipped without native/.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+import pytest
+
+from tf_operator_tpu import native
+from tf_operator_tpu.core.expectations import ControllerExpectations
+from tf_operator_tpu.core.workqueue import RateLimitingQueue
+from tf_operator_tpu.utils.exit_codes import is_retryable_exit_code
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no toolchain)"
+)
+
+
+def queue_impls():
+    return [RateLimitingQueue(), native.NativeRateLimitingQueue()]
+
+
+def exp_impls():
+    return [ControllerExpectations(), native.NativeControllerExpectations()]
+
+
+class TestWorkqueueParity:
+    @pytest.mark.parametrize("q", queue_impls(), ids=["python", "native"])
+    def test_dedup_and_fifo(self, q):
+        q.add("a")
+        q.add("b")
+        q.add("a")  # coalesces
+        assert len(q) == 2
+        assert q.get(0.1) == "a"
+        assert q.get(0.1) == "b"
+        assert q.get(0.05) is None  # empty -> timeout
+
+    @pytest.mark.parametrize("q", queue_impls(), ids=["python", "native"])
+    def test_inflight_exclusivity(self, q):
+        q.add("k")
+        assert q.get(0.1) == "k"
+        q.add("k")  # re-added while processing: not handed out again
+        assert q.get(0.05) is None
+        q.done("k")  # re-queues the dirty item
+        assert q.get(0.5) == "k"
+        q.done("k")
+
+    @pytest.mark.parametrize("q", queue_impls(), ids=["python", "native"])
+    def test_add_after_delay(self, q):
+        t0 = time.monotonic()
+        q.add_after("late", 0.15)
+        assert q.get(2.0) == "late"
+        assert time.monotonic() - t0 >= 0.14
+
+    @pytest.mark.parametrize("q", queue_impls(), ids=["python", "native"])
+    def test_rate_limited_backoff_and_forget(self, q):
+        for _ in range(4):
+            q.add_rate_limited("j")
+        assert q.num_requeues("j") == 4
+        q.forget("j")
+        assert q.num_requeues("j") == 0
+
+    @pytest.mark.parametrize("q", queue_impls(), ids=["python", "native"])
+    def test_shutdown_unblocks_get(self, q):
+        import threading
+
+        got = []
+        t = threading.Thread(target=lambda: got.append(q.get(None)))
+        t.start()
+        time.sleep(0.1)
+        q.shut_down()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert got == [None]
+
+    def test_native_concurrent_workers(self):
+        """Many producers/consumers over the native queue: every distinct key
+        is processed, none twice-in-parallel."""
+        import threading
+
+        q = native.NativeRateLimitingQueue()
+        seen: dict[str, int] = {}
+        active: set[str] = set()
+        lock = threading.Lock()
+        violations = []
+
+        def worker():
+            while True:
+                item = q.get(timeout=None)
+                if item is None:
+                    return
+                with lock:
+                    if item in active:
+                        violations.append(item)
+                    active.add(item)
+                    seen[item] = seen.get(item, 0) + 1
+                time.sleep(0.001)
+                with lock:
+                    active.discard(item)
+                q.done(item)
+
+        workers = [threading.Thread(target=worker) for _ in range(4)]
+        for w in workers:
+            w.start()
+        for i in range(200):
+            q.add(f"job-{i % 50}")
+        time.sleep(0.5)
+        q.shut_down()
+        for w in workers:
+            w.join(timeout=5)
+        assert not violations
+        assert len(seen) == 50
+
+
+class TestExpectationsParity:
+    @pytest.mark.parametrize("e", exp_impls(), ids=["python", "native"])
+    def test_create_cycle(self, e):
+        key = "ns/job/Worker/pods"
+        assert e.satisfied(key)  # never set
+        e.expect_creations(key, 3)
+        assert not e.satisfied(key)
+        for _ in range(3):
+            e.creation_observed(key)
+        assert e.satisfied(key)
+
+    @pytest.mark.parametrize("e", exp_impls(), ids=["python", "native"])
+    def test_delete_cycle_and_raise(self, e):
+        key = "k"
+        e.expect_deletions(key, 1)
+        e.raise_expectations(key, 0, 1)
+        assert not e.satisfied(key)
+        e.deletion_observed(key)
+        assert not e.satisfied(key)
+        e.deletion_observed(key)
+        assert e.satisfied(key)
+        e.delete_expectations(key)
+        assert e.satisfied(key)
+
+
+class TestExitCodeParity:
+    def test_differential_0_to_300(self):
+        for code in range(0, 300):
+            assert native.native_is_retryable_exit_code(code) == bool(
+                is_retryable_exit_code(code)
+            ), f"exit code {code} disagrees"
+
+
+class TestSupervisor:
+    @pytest.fixture
+    def sup(self):
+        return native.NativeSupervisor()
+
+    def test_exit_code_and_logfile(self, sup):
+        with tempfile.TemporaryDirectory() as d:
+            log = os.path.join(d, "out.log")
+            p = sup.spawn(
+                [sys.executable, "-c", "print('native-out'); raise SystemExit(9)"],
+                env=dict(os.environ),
+                logfile=log,
+            )
+            assert p.wait(15) == 9
+            assert "native-out" in open(log).read()
+            p.release()
+
+    def test_env_is_exactly_what_was_passed(self, sup):
+        with tempfile.TemporaryDirectory() as d:
+            log = os.path.join(d, "env.log")
+            env = {"PATH": os.environ["PATH"], "TPUJOB_MARKER": "xyzzy"}
+            p = sup.spawn(
+                [sys.executable, "-c",
+                 "import os; print(os.environ.get('TPUJOB_MARKER'), "
+                 "'HOME' in os.environ)"],
+                env=env,
+                logfile=log,
+            )
+            assert p.wait(15) == 0
+            p.release()
+            out = open(log).read().split()
+            assert out[0] == "xyzzy"
+            assert out[1] == "False"  # inherited env NOT leaked through
+
+    def test_terminate_kills_whole_tree(self, sup):
+        # sh spawns a grandchild; SIGTERM on the group must reach both.
+        p = sup.spawn(["/bin/sh", "-c", "sleep 60 & wait"], env=dict(os.environ))
+        time.sleep(0.3)
+        p.terminate()
+        assert p.wait(10) == 128 + 15
+        p.release()
+
+    def test_wait_timeout(self, sup):
+        p = sup.spawn([sys.executable, "-c", "import time; time.sleep(30)"],
+                      env=dict(os.environ))
+        with pytest.raises(TimeoutError):
+            p.wait(0.2)
+        p.kill()
+        assert p.wait(10) == 128 + 9
+        p.release()
+
+    def test_spawn_failure_raises_oserror(self, sup):
+        with pytest.raises(OSError):
+            sup.spawn(["/no/such/binary"], env={})
+
+    def test_poll(self, sup):
+        p = sup.spawn([sys.executable, "-c", "pass"], env=dict(os.environ))
+        deadline = time.monotonic() + 10
+        while p.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert p.poll() == 0
+        p.release()
+
+    def test_cwd(self, sup):
+        with tempfile.TemporaryDirectory() as d:
+            log = os.path.join(d, "cwd.log")
+            p = sup.spawn(
+                [sys.executable, "-c", "import os; print(os.getcwd())"],
+                env=dict(os.environ),
+                cwd=d,
+                logfile=log,
+            )
+            assert p.wait(15) == 0
+            p.release()
+            assert open(log).read().strip() == os.path.realpath(d)
+
+
+class TestRuntimeUsesNative:
+    def test_make_supervisor_prefers_native(self):
+        from tf_operator_tpu.runtime.local import make_supervisor
+
+        assert isinstance(make_supervisor(), native.NativeSupervisor)
+
+    def test_controller_uses_native_queue(self):
+        from tf_operator_tpu.core.expectations import make_expectations
+        from tf_operator_tpu.core.workqueue import make_queue
+
+        assert isinstance(make_queue(), native.NativeRateLimitingQueue)
+        assert isinstance(make_expectations(), native.NativeControllerExpectations)

@@ -30,8 +30,8 @@ from tf_operator_tpu.core.cluster import (
     Service,
 )
 from tf_operator_tpu.core.control import PodControl, ServiceControl
-from tf_operator_tpu.core.expectations import ControllerExpectations
-from tf_operator_tpu.core.workqueue import RateLimitingQueue
+from tf_operator_tpu.core.expectations import make_expectations
+from tf_operator_tpu.core.workqueue import make_queue
 from tf_operator_tpu.utils import naming
 from tf_operator_tpu.utils.logging import logger_for_key
 
@@ -55,8 +55,8 @@ class JobControllerBase:
 
     def __init__(self, cluster: InMemoryCluster):
         self.cluster = cluster
-        self.queue = RateLimitingQueue()
-        self.expectations = ControllerExpectations()
+        self.queue = make_queue()
+        self.expectations = make_expectations()
         self.pod_control = PodControl(cluster)
         self.service_control = ServiceControl(cluster)
         self._stop = threading.Event()

@@ -19,6 +19,16 @@ import time
 EXPECTATIONS_TIMEOUT_S = 5 * 60.0
 
 
+def make_expectations() -> "ControllerExpectations":
+    """Native (C++) expectations cache when available, else pure Python."""
+    try:
+        from tf_operator_tpu.native import NativeControllerExpectations
+
+        return NativeControllerExpectations()  # type: ignore[return-value]
+    except (ImportError, RuntimeError):
+        return ControllerExpectations()
+
+
 class _Entry:
     __slots__ = ("adds", "dels", "timestamp")
 

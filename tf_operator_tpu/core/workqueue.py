@@ -100,6 +100,20 @@ def default_rate_limiter() -> MaxOfRateLimiter:
     )
 
 
+def make_queue(rate_limiter: Any | None = None) -> "RateLimitingQueue":
+    """Preferred queue for string-keyed controllers: the native (C++)
+    implementation when the library is available, else this module's
+    pure-Python one. A custom rate_limiter forces the Python path."""
+    if rate_limiter is None:
+        try:
+            from tf_operator_tpu.native import NativeRateLimitingQueue
+
+            return NativeRateLimitingQueue()  # type: ignore[return-value]
+        except (ImportError, RuntimeError):
+            pass
+    return RateLimitingQueue(rate_limiter)
+
+
 class RateLimitingQueue:
     def __init__(self, rate_limiter: Any | None = None):
         self._rl = rate_limiter or default_rate_limiter()

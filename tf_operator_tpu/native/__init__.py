@@ -1,0 +1,337 @@
+"""ctypes bindings for the native (C++) runtime library.
+
+The native tier implements the operator's hot-loop primitives — rate-limited
+workqueue, expectations cache, exit-code policy (semantics of the reference's
+jobcontroller.go:110-133 / train_util.go:18-55) — and the local executor's
+process supervisor (setsid process groups, pidfd waits, whole-tree kills).
+Source: native/tpujob_native.cc, built by native/Makefile.
+
+Loading policy:
+  - First import tries `native/build/libtpujob_native.so`; if missing/stale
+    and a C++ toolchain is present, it is built on the fly (one `make`
+    invocation, cached thereafter).
+  - Failure is non-fatal: `load()` returns None and callers fall back to the
+    pure-Python implementations with identical semantics.
+  - TPUJOB_NATIVE=0 disables the native path; TPUJOB_NATIVE=require makes a
+    load failure raise (used in CI to prove the native path is exercised).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "build" / "libtpujob_native.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_attempted = False
+_load_error: str | None = None
+
+
+def _build() -> bool:
+    src = _NATIVE_DIR / "tpujob_native.cc"
+    if not src.exists():
+        return False
+    if _LIB_PATH.exists() and _LIB_PATH.stat().st_mtime >= src.stat().st_mtime:
+        return True
+    try:
+        r = subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if r.returncode != 0:
+        global _load_error
+        _load_error = f"native build failed:\n{r.stdout}\n{r.stderr}"
+        return False
+    return _LIB_PATH.exists()
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.tq_new.restype = c.c_void_p
+    lib.tq_new.argtypes = [c.c_double, c.c_int, c.c_double, c.c_double]
+    lib.tq_free.argtypes = [c.c_void_p]
+    lib.tq_add.argtypes = [c.c_void_p, c.c_char_p]
+    lib.tq_add_after.argtypes = [c.c_void_p, c.c_char_p, c.c_double]
+    lib.tq_add_rate_limited.argtypes = [c.c_void_p, c.c_char_p]
+    lib.tq_forget.argtypes = [c.c_void_p, c.c_char_p]
+    lib.tq_num_requeues.restype = c.c_int
+    lib.tq_num_requeues.argtypes = [c.c_void_p, c.c_char_p]
+    lib.tq_get.restype = c.c_int
+    lib.tq_get.argtypes = [c.c_void_p, c.c_double, c.c_int, c.c_char_p, c.c_int]
+    lib.tq_done.argtypes = [c.c_void_p, c.c_char_p]
+    lib.tq_shutdown.argtypes = [c.c_void_p]
+    lib.tq_len.restype = c.c_int
+    lib.tq_len.argtypes = [c.c_void_p]
+
+    lib.te_new.restype = c.c_void_p
+    lib.te_free.argtypes = [c.c_void_p]
+    lib.te_expect.argtypes = [c.c_void_p, c.c_char_p, c.c_int, c.c_int]
+    lib.te_raise.argtypes = [c.c_void_p, c.c_char_p, c.c_int, c.c_int]
+    lib.te_observe.argtypes = [c.c_void_p, c.c_char_p, c.c_int, c.c_int]
+    lib.te_satisfied.restype = c.c_int
+    lib.te_satisfied.argtypes = [c.c_void_p, c.c_char_p]
+    lib.te_delete.argtypes = [c.c_void_p, c.c_char_p]
+
+    lib.tx_is_retryable.restype = c.c_int
+    lib.tx_is_retryable.argtypes = [c.c_int]
+
+    lib.ts_new.restype = c.c_void_p
+    lib.ts_free.argtypes = [c.c_void_p]
+    lib.ts_spawn.restype = c.c_long
+    lib.ts_spawn.argtypes = [
+        c.c_void_p,
+        c.POINTER(c.c_char_p),
+        c.POINTER(c.c_char_p),
+        c.c_char_p,
+        c.c_char_p,
+    ]
+    lib.ts_poll.restype = c.c_int
+    lib.ts_poll.argtypes = [c.c_void_p, c.c_long]
+    lib.ts_wait.restype = c.c_int
+    lib.ts_wait.argtypes = [c.c_void_p, c.c_long, c.c_double, c.POINTER(c.c_int)]
+    lib.ts_exit_code.restype = c.c_int
+    lib.ts_exit_code.argtypes = [c.c_void_p, c.c_long]
+    lib.ts_signal.argtypes = [c.c_void_p, c.c_long, c.c_int]
+    lib.ts_release.argtypes = [c.c_void_p, c.c_long]
+
+
+def load() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_attempted, _load_error
+    mode = os.environ.get("TPUJOB_NATIVE", "1").lower()
+    if mode in ("0", "off", "false"):
+        return None
+    with _lock:
+        if _load_attempted:
+            if _lib is None and mode == "require":
+                raise RuntimeError(f"TPUJOB_NATIVE=require: {_load_error}")
+            return _lib
+        _load_attempted = True
+        try:
+            if _build():
+                lib = ctypes.CDLL(str(_LIB_PATH))
+                _declare(lib)
+                _lib = lib
+        except OSError as e:
+            _load_error = str(e)
+        if _lib is None and mode == "require":
+            raise RuntimeError(
+                f"TPUJOB_NATIVE=require but native library unavailable: {_load_error}"
+            )
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# ---------------------------------------------------------------------------
+# Wrappers with the exact interfaces of the pure-Python implementations
+# ---------------------------------------------------------------------------
+
+
+class NativeRateLimitingQueue:
+    """Drop-in for core.workqueue.RateLimitingQueue (string items)."""
+
+    def __init__(self, qps: float = 10.0, burst: int = 100,
+                 base_delay: float = 0.005, max_delay: float = 1000.0):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._q = self._lib.tq_new(qps, burst, base_delay, max_delay)
+        self._buf = ctypes.create_string_buffer(4096)
+
+    def add(self, item: str) -> None:
+        self._lib.tq_add(self._q, item.encode())
+
+    def add_after(self, item: str, delay: float) -> None:
+        self._lib.tq_add_after(self._q, item.encode(), delay)
+
+    def add_rate_limited(self, item: str) -> None:
+        self._lib.tq_add_rate_limited(self._q, item.encode())
+
+    def forget(self, item: str) -> None:
+        self._lib.tq_forget(self._q, item.encode())
+
+    def num_requeues(self, item: str) -> int:
+        return self._lib.tq_num_requeues(self._q, item.encode())
+
+    def get(self, timeout: float | None = None) -> str | None:
+        # tq_get needs a per-call buffer: concurrent workers share the queue.
+        buf = ctypes.create_string_buffer(4096)
+        r = self._lib.tq_get(
+            self._q,
+            -1.0 if timeout is None else timeout,
+            1 if timeout is None else 0,
+            buf,
+            len(buf),
+        )
+        return buf.value.decode() if r == 1 else None
+
+    def done(self, item: str) -> None:
+        self._lib.tq_done(self._q, item.encode())
+
+    def shut_down(self) -> None:
+        self._lib.tq_shutdown(self._q)
+
+    def __len__(self) -> int:
+        return self._lib.tq_len(self._q)
+
+    def __del__(self):
+        lib, q = getattr(self, "_lib", None), getattr(self, "_q", None)
+        if lib is not None and q:
+            lib.tq_free(q)
+            self._q = None
+
+
+class NativeControllerExpectations:
+    """Drop-in for core.expectations.ControllerExpectations."""
+
+    def __init__(self) -> None:
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._e = self._lib.te_new()
+
+    def expect_creations(self, key: str, n: int) -> None:
+        self._lib.te_expect(self._e, key.encode(), n, 0)
+
+    def expect_deletions(self, key: str, n: int) -> None:
+        self._lib.te_expect(self._e, key.encode(), 0, n)
+
+    def raise_expectations(self, key: str, adds: int, dels: int) -> None:
+        self._lib.te_raise(self._e, key.encode(), adds, dels)
+
+    def creation_observed(self, key: str) -> None:
+        self._lib.te_observe(self._e, key.encode(), 1, 0)
+
+    def deletion_observed(self, key: str) -> None:
+        self._lib.te_observe(self._e, key.encode(), 0, 1)
+
+    def satisfied(self, key: str) -> bool:
+        return bool(self._lib.te_satisfied(self._e, key.encode()))
+
+    def delete_expectations(self, key: str) -> None:
+        self._lib.te_delete(self._e, key.encode())
+
+    def __del__(self):
+        lib, e = getattr(self, "_lib", None), getattr(self, "_e", None)
+        if lib is not None and e:
+            lib.te_free(e)
+            self._e = None
+
+
+def native_is_retryable_exit_code(code: int) -> bool:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return bool(lib.tx_is_retryable(code))
+
+
+class NativeProcess:
+    """Handle for one supervised process (whole process group)."""
+
+    def __init__(self, supervisor: "NativeSupervisor", pid: int):
+        self._sup = supervisor
+        self.pid = pid
+        self._exit_code: int | None = None
+
+    def poll(self) -> int | None:
+        if self._exit_code is not None:
+            return self._exit_code
+        r = self._sup._lib.ts_poll(self._sup._s, self.pid)
+        if r == 1:
+            self._exit_code = self._sup._lib.ts_exit_code(self._sup._s, self.pid)
+        return self._exit_code
+
+    def wait(self, timeout: float | None = None) -> int:
+        if self._exit_code is not None:
+            return self._exit_code
+        code = ctypes.c_int(0)
+        r = self._sup._lib.ts_wait(
+            self._sup._s, self.pid, -1.0 if timeout is None else timeout,
+            ctypes.byref(code),
+        )
+        if r == 1:
+            self._exit_code = code.value
+            return self._exit_code
+        if r == 0:
+            raise TimeoutError(f"pid {self.pid} still running after {timeout}s")
+        # Released concurrently (e.g. the owning thread reaped + released
+        # while we waited): the cached code is the truth if we have it.
+        if self._exit_code is not None:
+            return self._exit_code
+        raise ProcessLookupError(f"pid {self.pid} not supervised")
+
+    def terminate(self) -> None:
+        import signal as _sig
+
+        self._sup._lib.ts_signal(self._sup._s, self.pid, int(_sig.SIGTERM))
+
+    def kill(self) -> None:
+        import signal as _sig
+
+        self._sup._lib.ts_signal(self._sup._s, self.pid, int(_sig.SIGKILL))
+
+    def send_signal(self, sig: int) -> None:
+        self._sup._lib.ts_signal(self._sup._s, self.pid, int(sig))
+
+    def release(self) -> None:
+        self._sup._lib.ts_release(self._sup._s, self.pid)
+
+
+class NativeSupervisor:
+    """Process supervisor over the native library: children run in their own
+    session/process group (signals reach the whole tree), stdio redirected to
+    a log file, exits collected via pidfd."""
+
+    def __init__(self) -> None:
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._s = self._lib.ts_new()
+
+    @staticmethod
+    def _carray(items: list[bytes]) -> "ctypes.Array":
+        arr = (ctypes.c_char_p * (len(items) + 1))()
+        arr[:-1] = items
+        arr[-1] = None
+        return arr
+
+    def spawn(
+        self,
+        cmd: list[str],
+        env: dict[str, str] | None = None,
+        cwd: str | None = None,
+        logfile: str | None = None,
+    ) -> NativeProcess:
+        argv = self._carray([c.encode() for c in cmd])
+        envp = None
+        if env is not None:
+            envp = self._carray([f"{k}={v}".encode() for k, v in env.items()])
+        pid = self._lib.ts_spawn(
+            self._s,
+            argv,
+            envp,
+            cwd.encode() if cwd else None,
+            logfile.encode() if logfile else None,
+        )
+        if pid < 0:
+            raise OSError(-pid, os.strerror(-pid), cmd[0])
+        return NativeProcess(self, int(pid))
+
+    def __del__(self):
+        lib, s = getattr(self, "_lib", None), getattr(self, "_s", None)
+        if lib is not None and s:
+            lib.ts_free(s)
+            self._s = None

@@ -82,6 +82,14 @@ def pipeline_apply(
     if b % m:
         raise ValueError(f"batch {b} not divisible by microbatches {m}")
 
+    n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_stages != mesh.shape[pp_axis]:
+        raise ValueError(
+            f"stacked_params has {n_stages} stages but mesh axis "
+            f"'{pp_axis}' has {mesh.shape[pp_axis]} devices; they must match "
+            f"(each pp rank runs exactly one stage)"
+        )
+
     b_spec = tuple(a for a in batch_axes if a in mesh.axis_names) or None
     dp_size = 1
     for a in b_spec or ():
@@ -151,10 +159,11 @@ def make_pipelined_lm(cfg, mesh: Mesh, num_microbatches: int,
                       remat: bool = False):
     """Pipelined causal LM over `cfg` (models.transformer.TransformerConfig).
 
-    Returns (init, loss_fn):
+    Returns (init, loss_fn, apply_fn):
       init(rng) -> params {"embed": .., "stages": stacked, "head": ..}
       loss_fn(params, model_state, batch, rng) -> (loss, model_state)
-    compatible with parallel.train_step.make_train_step. Use
+      apply_fn(params, tokens) -> logits
+    loss_fn is compatible with parallel.train_step.make_train_step. Use
     pipeline_rules() for the matching sharding rules.
     """
     import flax.linen as nn

@@ -46,10 +46,80 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+class _PopenProcess:
+    """Popen-backed handle with the NativeProcess interface: process-group
+    signals, signal deaths normalized to 128+sig exit codes."""
+
+    def __init__(self, popen: subprocess.Popen):
+        self._p = popen
+        self.pid = popen.pid
+
+    @staticmethod
+    def _norm(code: int | None) -> int | None:
+        return 128 - code if code is not None and code < 0 else code
+
+    def poll(self) -> int | None:
+        return self._norm(self._p.poll())
+
+    def wait(self, timeout: float | None = None) -> int:
+        try:
+            return self._norm(self._p.wait(timeout))
+        except subprocess.TimeoutExpired as e:
+            raise TimeoutError(str(e)) from None
+
+    def _signal(self, sig: int) -> None:
+        try:
+            os.killpg(self.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            try:
+                self._p.send_signal(sig)
+            except ProcessLookupError:
+                pass
+
+    def terminate(self) -> None:
+        self._signal(signal.SIGTERM)
+
+    def kill(self) -> None:
+        self._signal(signal.SIGKILL)
+
+    def release(self) -> None:
+        pass
+
+
+class _PopenSupervisor:
+    def spawn(self, cmd, env=None, cwd=None, logfile=None) -> _PopenProcess:
+        stdout = subprocess.DEVNULL
+        if logfile:
+            stdout = open(logfile, "ab")
+        try:
+            p = subprocess.Popen(
+                cmd,
+                env=env,
+                stdout=stdout,
+                stderr=subprocess.STDOUT,
+                cwd=cwd or None,
+                start_new_session=True,
+            )
+        finally:
+            if stdout is not subprocess.DEVNULL:
+                stdout.close()
+        return _PopenProcess(p)
+
+
+def make_supervisor():
+    """Native (C++) supervisor when the library is available, else Popen."""
+    try:
+        from tf_operator_tpu.native import NativeSupervisor
+
+        return NativeSupervisor()
+    except (ImportError, RuntimeError):
+        return _PopenSupervisor()
+
+
 @dataclass
 class _Proc:
     pod_uid: str
-    process: subprocess.Popen
+    process: object  # NativeProcess | _PopenProcess
     restart_count: int = 0
     stopping: bool = False
 
@@ -93,6 +163,7 @@ class LocalProcessRuntime:
         self.inherit_env = inherit_env
         self.log_dir = log_dir
         self._procs: dict[tuple[str, str], _Proc] = {}
+        self._supervisor = make_supervisor()
         self._port_maps: dict[str, PortMap] = {}  # job label -> map
         self._lock = threading.Lock()
         self._threads: list[threading.Thread] = []
@@ -159,12 +230,9 @@ class LocalProcessRuntime:
             self._terminate(proc.process)
 
     @staticmethod
-    def _terminate(process: subprocess.Popen) -> None:
+    def _terminate(process) -> None:
         if process.poll() is None:
-            try:
-                process.send_signal(signal.SIGTERM)
-            except ProcessLookupError:
-                pass
+            process.terminate()
 
     def _build_env(self, pod: Pod, pm: PortMap) -> dict[str, str]:
         env = dict(os.environ) if self.inherit_env else {}
@@ -207,23 +275,17 @@ class LocalProcessRuntime:
         restart_count = 0
 
         while True:
-            stdout = subprocess.DEVNULL
+            logfile = None
             if self.log_dir:
                 os.makedirs(self.log_dir, exist_ok=True)
-                stdout = open(
-                    os.path.join(self.log_dir, f"{pod.namespace}_{pod.name}.log"), "ab"
+                logfile = os.path.join(
+                    self.log_dir, f"{pod.namespace}_{pod.name}.log"
                 )
             try:
-                process = subprocess.Popen(
-                    cmd,
-                    env=env,
-                    stdout=stdout,
-                    stderr=subprocess.STDOUT,
-                    cwd=container.working_dir or None,
+                process = self._supervisor.spawn(
+                    cmd, env=env, cwd=container.working_dir or None, logfile=logfile
                 )
             except OSError as e:
-                if stdout is not subprocess.DEVNULL:
-                    stdout.close()
                 log.error("spawn failed: %s", e)
                 self._set_status(pod, PodPhase.FAILED, 127, restart_count, reason="SpawnError")
                 return
@@ -234,8 +296,7 @@ class LocalProcessRuntime:
             self._set_status(pod, PodPhase.RUNNING, None, restart_count)
 
             code = process.wait()
-            if stdout is not subprocess.DEVNULL:
-                stdout.close()
+            process.release()
             if entry.stopping or self._stopped:
                 return  # deleted: pod object is already gone
 
@@ -312,8 +373,10 @@ class LocalProcessRuntime:
             remaining = max(0.1, deadline - time.time())
             try:
                 p.process.wait(timeout=remaining)
-            except subprocess.TimeoutExpired:
+            except TimeoutError:
                 p.process.kill()
+            except ProcessLookupError:
+                pass  # already reaped+released by its pod thread
 
     def port_map(self, job_name: str) -> PortMap | None:
         with self._lock:
