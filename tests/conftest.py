@@ -23,6 +23,11 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 if (not os.environ.get("TPUJOB_TEST_TPU")
         and os.environ.get("JAX_PLATFORMS", "axon") == "axon"):
     os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses we spawn
+    # The sitecustomize only registers (and re-pins) the TPU plugin when
+    # PALLAS_AXON_POOL_IPS is set; dropping it here makes pods we spawn in
+    # tests honor JAX_PLATFORMS=cpu. Without this, every test pod grabs the
+    # single-process TPU tunnel and multi-pod jobs deadlock on the chip.
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     try:
         import jax
 

@@ -157,6 +157,79 @@ class TestRestApi:
             text = r.read().decode()
         assert "tpujob_operator_jobs_created_total" in text
 
+    def test_dashboard_ui_served(self, served):
+        _, _, server = served
+        for path in ("/", "/ui"):
+            with urllib.request.urlopen(f"http://{server}{path}", timeout=5) as r:
+                body = r.read().decode()
+                assert r.headers["Content-Type"].startswith("text/html")
+                assert "TrainJob Operator" in body
+                assert "/api/trainjobs" in body  # the SPA drives the REST API
+
+    def test_yaml_submit(self, served):
+        cluster, controller, server = served
+        yaml_manifest = (
+            "apiVersion: kubeflow.org/v1\n"
+            "kind: TFJob\n"
+            "metadata: {name: yaml-job, namespace: default}\n"
+            "spec:\n"
+            "  tfReplicaSpecs:\n"
+            "    Worker:\n"
+            "      replicas: 1\n"
+            "      template:\n"
+            "        spec:\n"
+            "          containers:\n"
+            "            - {name: tensorflow, image: x}\n"
+        )
+        req = urllib.request.Request(
+            f"http://{server}/api/trainjobs",
+            data=yaml_manifest.encode(),
+            headers={"Content-Type": "application/yaml"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 201
+        assert self._get(server, "/api/trainjobs/default/yaml-job")
+
+    def test_admission_rejects_invalid_spec(self, served):
+        _, _, server = served
+        manifest = {
+            "kind": "TrainJob",
+            "metadata": {"name": "bad-job"},
+            "spec": {
+                "replicaSpecs": {
+                    "Worker": {
+                        "replicas": 1,
+                        "template": {
+                            "spec": {
+                                "containers": [{"name": "wrong-name", "image": "x"}]
+                            }
+                        },
+                    }
+                }
+            },
+        }
+        req = urllib.request.Request(
+            f"http://{server}/api/trainjobs",
+            data=json.dumps(manifest).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 400
+        body = json.loads(e.value.read())
+        assert any("training container" in p for p in body["problems"])
+        # Rejected at admission: nothing was created.
+        with pytest.raises(urllib.error.HTTPError):
+            self._get(server, "/api/trainjobs/default/bad-job")
+
+    def test_endpoints_without_runtime_404(self, served):
+        _, _, server = served
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._get(server, "/api/endpoints/default/nope")
+        assert e.value.code == 404
+
 
 class TestLeaderElection:
     def test_single_leader(self, tmp_path):

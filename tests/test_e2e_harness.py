@@ -1,0 +1,117 @@
+"""The E2E harness itself: operator process fixture + REST client + runner.
+
+The full eight-suite sweep runs via `python -m tf_operator_tpu.e2e.test_runner`
+(the CI entry point, mirroring the reference's Argo workflow step); here we
+pin the harness machinery with a fast subset against one shared operator
+process: REST CRUD round-trip, fault injection over /api/endpoints, admission
+rejection, retries/trials accounting, and JUnit XML artifacts.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from tf_operator_tpu.e2e import suites
+from tf_operator_tpu.e2e.operator_fixture import OperatorProcess
+from tf_operator_tpu.e2e.test_runner import TestCase, run_case, run_suite
+from tf_operator_tpu.e2e.trainjob_client import ApiError, TrainJobClient
+
+
+@pytest.fixture(scope="module")
+def operator(tmp_path_factory):
+    with OperatorProcess(str(tmp_path_factory.mktemp("op-logs"))) as op:
+        yield op
+
+
+@pytest.fixture(scope="module")
+def client(operator):
+    return TrainJobClient(operator.server)
+
+
+class TestClient:
+    def test_crud_roundtrip(self, client):
+        m = suites.manifest("h-crud", {"Worker": (1, suites.sleep_cmd(60))})
+        created = client.create(m)
+        assert created["manifest"]["metadata"]["name"] == "h-crud"
+        assert client.get("default", "h-crud") is not None
+        assert any(
+            j["manifest"]["metadata"]["name"] == "h-crud"
+            for j in client.list("default")
+        )
+        assert "default" in client.namespaces()
+        client.delete("default", "h-crud")
+        client.wait_for_delete("default", "h-crud")
+        assert client.get("default", "h-crud") is None
+
+    def test_duplicate_create_conflicts(self, client):
+        m = suites.manifest("h-dup", {"Worker": (1, suites.sleep_cmd(60))})
+        client.create(m)
+        try:
+            with pytest.raises(ApiError):
+                client.create(m)
+        finally:
+            client.delete("default", "h-dup")
+            client.wait_for_delete("default", "h-dup")
+
+    def test_metrics_exposed(self, client):
+        text = client.metrics()
+        assert "trainjob_operator" in text or "jobs_created" in text
+
+    def test_invalid_suite(self, client):
+        suites.invalid_rejected_at_admission(client)
+
+    def test_fault_injection_endpoints(self, client):
+        suites.shutdown_worker0_completes(client)
+
+
+class TestRunner:
+    def test_retry_then_pass(self, client):
+        attempts = []
+
+        def flaky(_client):
+            attempts.append(1)
+            if len(attempts) < 2:
+                raise RuntimeError("transient")
+
+        r = run_case(TestCase("flaky", flaky), client, retries=3)
+        assert r.ok and r.attempts == 2
+
+    def test_trials_rerun_pass(self, client):
+        runs = []
+
+        def counted(_client):
+            runs.append(1)
+
+        r = run_case(TestCase("trials", counted, trials=3), client, retries=2)
+        assert r.ok and len(runs) == 3
+
+    def test_failure_recorded_with_traceback(self, client):
+        def broken(_client):
+            raise AssertionError("expected-marker")
+
+        r = run_case(TestCase("broken", broken), client, retries=2)
+        assert not r.ok
+        assert "expected-marker" in r.failure
+        assert r.attempts == 2
+
+    def test_junit_xml(self, client, tmp_path):
+        def ok(_client):
+            pass
+
+        def bad(_client):
+            raise RuntimeError("boom & <xml-unsafe>")
+
+        result = run_suite(
+            "unit", [TestCase("ok", ok), TestCase("bad", bad)], client,
+            retries=1, junit_dir=str(tmp_path),
+        )
+        assert not result.ok
+        path = os.path.join(str(tmp_path), "junit_unit.xml")
+        xml = open(path).read()
+        assert 'tests="2"' in xml and 'failures="1"' in xml
+        assert "boom &amp; &lt;xml-unsafe&gt;" in xml
+        import xml.dom.minidom as minidom
+
+        minidom.parseString(xml)  # well-formed

@@ -1,0 +1,188 @@
+"""Examples + BERT/evaluator flow (BASELINE workload 4) + checkpoint protocol.
+
+- every manifest under examples/ parses and validates (the examples are the
+  BASELINE workload configs — they must stay submittable);
+- BertMLM/mlm_loss semantics;
+- checkpoint save/restore roundtrip and the trainer->evaluator FINAL protocol;
+- chief+evaluator TrainJob end-to-end on the local runtime: chief trains and
+  writes checkpoints, evaluator follows them and exits with the job.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import pytest
+
+from tf_operator_tpu.api import compat, validation
+
+REPO = Path(__file__).resolve().parent.parent
+EXAMPLES = sorted((REPO / "examples").rglob("*.yaml"))
+
+
+class TestManifests:
+    @pytest.mark.parametrize("path", EXAMPLES, ids=[p.name for p in EXAMPLES])
+    def test_example_validates(self, path):
+        job = compat.job_from_yaml(path.read_text())
+        assert validation.validate_job(job) == []
+
+    def test_baseline_workloads_present(self):
+        names = {p.name for p in EXAMPLES}
+        assert {
+            "mnist-single.yaml", "dist-mnist-ps.yaml",
+            "resnet50-collective.yaml", "bert-gang.yaml",
+            "resnet-preemptible.yaml", "tf_job_mnist.yaml",
+        } <= names
+
+    def test_bert_gang_topology(self):
+        job = compat.job_from_yaml((REPO / "examples/bert-gang.yaml").read_text())
+        assert job.spec.tpu.topology == "v5e-8"
+        assert job.spec.mesh.axes == {"dp": 2, "tp": 4}
+        assert job.spec.run_policy.scheduling.gang
+
+
+class TestBertModel:
+    def test_forward_shapes(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tf_operator_tpu.models import transformer as tfm
+
+        cfg = tfm.TINY
+        model = tfm.BertMLM(cfg)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        params = model.init(jax.random.key(0), tokens)["params"]
+        logits = model.apply({"params": params}, tokens)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_mlm_loss_only_masked_positions(self):
+        import jax.numpy as jnp
+
+        from tf_operator_tpu.models.transformer import mlm_loss
+
+        # Perfect prediction at the masked position, garbage elsewhere:
+        # loss must be ~0 because unmasked positions don't count.
+        v = 8
+        logits = jnp.full((1, 2, v), -30.0)
+        logits = logits.at[0, 0, 3].set(30.0)   # masked pos: correct
+        logits = logits.at[0, 1, 0].set(30.0)   # unmasked pos: wrong
+        targets = jnp.array([[3, 5]])
+        mask = jnp.array([[1.0, 0.0]])
+        assert float(mlm_loss(logits, targets, mask)) < 1e-3
+        # Flip the mask: now the wrong position counts and loss is large.
+        assert float(mlm_loss(logits, targets, 1.0 - mask)) > 10.0
+
+    def test_mlm_batch(self):
+        import jax
+
+        from tf_operator_tpu.models.transformer import make_mlm_batch
+
+        b = make_mlm_batch(jax.random.key(0), 4, 64, vocab_size=1000)
+        assert b["tokens"].shape == (4, 64)
+        masked = b["mask"].astype(bool)
+        assert bool(masked.any())
+        # Masked positions show [MASK]; unmasked keep their targets.
+        assert bool((b["tokens"][masked] == 103).all())
+        assert bool((b["tokens"][~masked] == b["targets"][~masked]).all())
+
+
+class TestCheckpointProtocol:
+    def test_save_restore_roundtrip(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from tf_operator_tpu.models import checkpoint as ckpt
+
+        tree = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones((3,))}
+        ckpt.save(str(tmp_path), 5, tree)
+        assert ckpt.list_steps(str(tmp_path)) == [5]
+        back = ckpt.restore(str(tmp_path), 5, template=tree)
+        assert jax.tree.all(jax.tree.map(lambda a, b: bool((a == b).all()), tree, back))
+
+    def test_final_marker_and_wait(self, tmp_path):
+        import jax.numpy as jnp
+
+        from tf_operator_tpu.models import checkpoint as ckpt
+
+        d = str(tmp_path)
+        assert ckpt.final_step(d) is None
+        ckpt.save(d, 1, {"x": jnp.zeros(2)})
+        ckpt.save(d, 2, {"x": jnp.zeros(2)})
+        seen: set[int] = set()
+        assert ckpt.wait_for_new_step(d, seen, timeout=5) == 1
+        seen.add(1)
+        assert ckpt.wait_for_new_step(d, seen, timeout=5) == 2
+        seen.add(2)
+        ckpt.mark_final(d, 2)
+        assert ckpt.final_step(d) == 2
+        # All consumed + FINAL -> stream complete (None, quickly).
+        assert ckpt.wait_for_new_step(d, seen, timeout=30) is None
+
+
+@pytest.mark.slow
+class TestChiefEvaluatorE2E:
+    def test_bert_chief_evaluator_job(self, tmp_path):
+        """BASELINE workload 4 shape end-to-end on the local runtime."""
+        from tf_operator_tpu.api import defaults
+        from tf_operator_tpu.api.types import (
+            ContainerSpec,
+            JobConditionType,
+            ObjectMeta,
+            PodTemplateSpec,
+            ReplicaSpec,
+            TrainJob,
+            TrainJobSpec,
+            is_succeeded,
+        )
+        from tf_operator_tpu.runtime.session import LocalSession
+
+        ckpt_dir = str(tmp_path / "ckpt")
+        # batch divisible by the 8 virtual CPU devices (pods inherit the
+        # test env's XLA_FLAGS and shard dp over all of them).
+        common = ["--model", "bert-tiny", "--batch", "8", "--seq", "16",
+                  "--checkpoint-dir", ckpt_dir]
+        train_cmd = [sys.executable, "-m", "tf_operator_tpu.models.train",
+                     "--steps", "2", *common]
+        eval_cmd = [sys.executable, "-m", "tf_operator_tpu.models.train",
+                    "--eval", "--steps", "2", "--eval-timeout", "240", *common]
+
+        def spec(cmd):
+            return ReplicaSpec(
+                replicas=1,
+                template=PodTemplateSpec(
+                    containers=[ContainerSpec(name="jax", image="local",
+                                              command=cmd)]
+                ),
+            )
+
+        job = TrainJob(
+            metadata=ObjectMeta(name="bert-e2e"),
+            spec=TrainJobSpec(
+                replica_specs={
+                    defaults.canonical_replica_type("chief"): spec(train_cmd),
+                    defaults.canonical_replica_type("evaluator"): spec(eval_cmd),
+                }
+            ),
+        )
+        defaults.set_defaults(job)
+        job.spec.run_policy.scheduling.gang = False
+
+        import os
+
+        pythonpath = str(REPO)
+        if os.environ.get("PYTHONPATH"):
+            pythonpath += os.pathsep + os.environ["PYTHONPATH"]
+        with LocalSession(env_overrides={"PYTHONPATH": pythonpath}) as s:
+            s.submit(job)
+            final = s.wait_for_condition(
+                "default", "bert-e2e",
+                (JobConditionType.SUCCEEDED, JobConditionType.FAILED),
+                timeout=420,
+            )
+            assert is_succeeded(final.status), final.status
+            # The evaluator consumed the FINAL checkpoint stream.
+            from tf_operator_tpu.models import checkpoint as ckpt
+
+            assert ckpt.final_step(ckpt_dir) == 2

@@ -121,11 +121,6 @@ def cmd_operator(args) -> int:
     signal.signal(signal.SIGINT, lambda *a: stop.set())
 
     def lead() -> None:
-        # The API binds only on the leader: a hot standby must not collide on
-        # the monitoring port while waiting for the lock.
-        api = ApiServer(cluster, port=args.monitoring_port, log_dir=args.log_dir)
-        api.start()
-        log.info("REST/metrics API on 127.0.0.1:%d", api.port)
         controller = TrainJobController(
             cluster,
             enable_gang=args.enable_gang_scheduling,
@@ -133,6 +128,12 @@ def cmd_operator(args) -> int:
             slice_allocator=allocator,
         )
         runtime = LocalProcessRuntime(cluster, log_dir=args.log_dir)
+        # The API binds only on the leader: a hot standby must not collide on
+        # the monitoring port while waiting for the lock.
+        api = ApiServer(cluster, port=args.monitoring_port, log_dir=args.log_dir,
+                        runtime=runtime)
+        api.start()
+        log.info("REST/metrics API on 127.0.0.1:%d", api.port)
         controller.run(workers=args.threadiness)
         log.info("controllers running (threadiness=%d)", args.threadiness)
         stop.wait()
@@ -178,7 +179,9 @@ def cmd_submit(args) -> int:
 
 
 def cmd_version(args) -> int:
-    print(f"tpujob {__version__} (python {sys.version.split()[0]})")
+    from tf_operator_tpu.version import version_string
+
+    print(version_string())
     return 0
 
 

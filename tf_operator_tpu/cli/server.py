@@ -9,6 +9,8 @@ Dashboard-backend parity (dashboard/backend/handler/api_handler.go:42-267):
   GET    /api/namespaces                     namespaces in use
   GET    /api/pods/{ns}                      pods in a namespace
   GET    /api/logs/{ns}/{pod}                pod logs (local runtime log files)
+  GET    /api/endpoints/{ns}/{name}          replica HTTP addresses (port-map
+                                             view; E2E fault-injection path)
 
 Operator-ops parity (main.go:38-46, options.go:74):
   GET    /metrics                            Prometheus text format
@@ -22,7 +24,7 @@ import threading
 from dataclasses import asdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from tf_operator_tpu.api import compat
+from tf_operator_tpu.api import compat, defaults, validation
 from tf_operator_tpu.api.types import TrainJob
 from tf_operator_tpu.core.cluster import InMemoryCluster
 from tf_operator_tpu.status import metrics
@@ -56,9 +58,10 @@ def _job_payload(cluster: InMemoryCluster, job: TrainJob) -> dict:
 
 class ApiServer:
     def __init__(self, cluster: InMemoryCluster, port: int = 8443,
-                 log_dir: str | None = None):
+                 log_dir: str | None = None, runtime=None):
         self.cluster = cluster
         self.log_dir = log_dir
+        self.runtime = runtime  # LocalProcessRuntime, for the endpoints view
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -80,7 +83,19 @@ class ApiServer:
             def do_GET(self):
                 parts = [p for p in self.path.split("?")[0].split("/") if p]
                 try:
-                    if parts == ["metrics"]:
+                    if not parts or parts[0] == "ui":
+                        # Dashboard SPA (reference Aux-A: /tfjobs/ui/).
+                        import os
+
+                        page = os.path.join(
+                            os.path.dirname(os.path.dirname(
+                                os.path.abspath(__file__))),
+                            "dashboard", "index.html",
+                        )
+                        with open(page, "rb") as f:
+                            self._send(f.read().decode(),
+                                       content_type="text/html; charset=utf-8")
+                    elif parts == ["metrics"]:
                         self._send(metrics.DEFAULT.expose(), content_type="text/plain")
                     elif parts == ["healthz"]:
                         self._send({"ok": True})
@@ -129,6 +144,27 @@ class ApiServer:
                                 ]
                             }
                         )
+                    elif parts[:2] == ["api", "endpoints"] and len(parts) == 4:
+                        if outer.runtime is None:
+                            self._send({"error": "no runtime attached"}, 404)
+                            return
+                        ns, name = parts[2], parts[3]
+                        pm = outer.runtime.port_map(name, ns)
+                        if pm is None:
+                            self._send({"endpoints": {}})
+                            return
+                        eps = {}
+                        for pod in outer.cluster.list_pods(ns):
+                            if pod.metadata.labels.get("job-name") != name:
+                                continue
+                            host = f"{pod.name}.{ns}.svc"
+                            for h, mapping in pm.ports.items():
+                                if h.startswith(host) and mapping:
+                                    port_no = mapping.get(2222) or sorted(
+                                        mapping.values()
+                                    )[0]
+                                    eps[pod.name] = f"127.0.0.1:{port_no}"
+                        self._send({"endpoints": eps})
                     elif parts[:2] == ["api", "logs"] and len(parts) == 4:
                         if outer.log_dir is None:
                             self._send({"error": "log collection disabled"}, 404)
@@ -154,8 +190,21 @@ class ApiServer:
                     return
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
-                    manifest = json.loads(self.rfile.read(length))
-                    job = compat.job_from_dict(manifest)
+                    raw = self.rfile.read(length)
+                    ctype = self.headers.get("Content-Type", "application/json")
+                    if "yaml" in ctype:
+                        job = compat.job_from_yaml(raw.decode())
+                    else:
+                        job = compat.job_from_dict(json.loads(raw))
+                    # Admission-time validation (SURVEY.md §7: validate at the
+                    # API edge instead of the reference's in-controller
+                    # invalid-spec status write-back, informer.go:82).
+                    defaults.set_defaults(job)
+                    problems = validation.validate_job(job)
+                    if problems:
+                        self._send({"error": "invalid TrainJob",
+                                    "problems": problems}, 400)
+                        return
                     created = outer.cluster.create_job(job)
                     self._send(_job_payload(outer.cluster, created), 201)
                 except Exception as e:

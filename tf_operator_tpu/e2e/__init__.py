@@ -1,0 +1,11 @@
+"""E2E test harness: run behavior suites against a live operator over REST.
+
+Parity with the reference's Python harness (py/kubeflow/tf_operator/):
+  trainjob_client   <- tf_job_client.py   (CRUD, wait, fault injection)
+  test_runner       <- test_runner.py     (retries, trials, JUnit XML)
+  suites            <- the eight E2E behavior suites (SURVEY.md §4 Tier 3)
+  operator_fixture  <- k8s_util.py-ish: bring up/tear down a real operator
+                       process for the suites to target
+"""
+
+from tf_operator_tpu.e2e.trainjob_client import TrainJobClient  # noqa: F401

@@ -1,0 +1,87 @@
+"""Bring up / tear down a real operator process for E2E suites.
+
+The reference harness assumed a live cluster with the operator deployed
+(setup-cluster / setup-kubeflow steps of the Argo workflow,
+workflows.libsonnet:216-298); this module is that step for the local
+substrate: it spawns `tpujob operator` as a separate OS process and waits for
+its REST API to answer, so suites exercise the system across a true process
+boundary like the reference's harness did over the K8s API.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent.parent)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class OperatorProcess:
+    def __init__(self, log_dir: str, port: int | None = None,
+                 extra_args: list[str] | None = None):
+        self.port = port or _free_port()
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        self._logfile = open(os.path.join(log_dir, "operator.log"), "ab")
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "tf_operator_tpu.cli.main", "operator",
+                "--monitoring-port", str(self.port),
+                "--log-dir", log_dir,
+                *(extra_args or []),
+            ],
+            env=env,
+            stdout=self._logfile,
+            stderr=subprocess.STDOUT,
+        )
+
+    @property
+    def server(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def wait_ready(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"operator exited early ({self.proc.returncode}); see "
+                    f"{self.log_dir}/operator.log"
+                )
+            try:
+                with urllib.request.urlopen(
+                    f"http://{self.server}/healthz", timeout=1.0
+                ):
+                    return
+            except OSError:
+                time.sleep(0.1)
+        raise TimeoutError(f"operator API not ready on {self.server}")
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        self._logfile.close()
+
+    def __enter__(self) -> "OperatorProcess":
+        self.wait_ready()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

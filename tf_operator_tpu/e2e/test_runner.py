@@ -1,0 +1,162 @@
+"""E2E suite runner: retries, multi-trial idempotency, JUnit XML artifacts.
+
+Parity with py/kubeflow/tf_operator/test_runner.py: each test case runs with
+up to `retries` attempts (run_test:24, retries at test_runner.py:22-23),
+optionally repeated `trials` times to prove delete/recreate idempotency
+(test_runner.py:46-53), and every case's outcome lands in a JUnit XML file
+the CI layer archives (test_runner.py:79-83).
+
+CLI:
+  python -m tf_operator_tpu.e2e.test_runner --suites simple shutdown \
+      --junit-dir /tmp/artifacts [--server HOST:PORT]
+
+Without --server, a fresh operator process is spawned per run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import tempfile
+import time
+import traceback
+from dataclasses import dataclass, field
+from xml.sax.saxutils import escape
+
+from tf_operator_tpu.e2e.trainjob_client import TrainJobClient
+
+
+@dataclass
+class TestCase:
+    name: str
+    fn: object  # Callable[[TrainJobClient], None]
+    trials: int = 1
+
+
+@dataclass
+class CaseResult:
+    name: str
+    time_s: float
+    failure: str | None = None
+    attempts: int = 1
+
+    @property
+    def ok(self) -> bool:
+        return self.failure is None
+
+
+@dataclass
+class SuiteResult:
+    suite: str
+    cases: list[CaseResult] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return all(c.ok for c in self.cases)
+
+    def to_junit_xml(self) -> str:
+        failures = sum(1 for c in self.cases if not c.ok)
+        total_t = sum(c.time_s for c in self.cases)
+        out = [
+            '<?xml version="1.0" encoding="utf-8"?>',
+            f'<testsuite name="{escape(self.suite)}" tests="{len(self.cases)}" '
+            f'failures="{failures}" errors="0" time="{total_t:.3f}">',
+        ]
+        for c in self.cases:
+            out.append(
+                f'  <testcase classname="{escape(self.suite)}" '
+                f'name="{escape(c.name)}" time="{c.time_s:.3f}">'
+            )
+            if c.failure is not None:
+                out.append(
+                    f'    <failure message="failed after {c.attempts} '
+                    f'attempts">{escape(c.failure)}</failure>'
+                )
+            out.append("  </testcase>")
+        out.append("</testsuite>")
+        return "\n".join(out)
+
+
+def run_case(case: TestCase, client: TrainJobClient, retries: int = 2) -> CaseResult:
+    t0 = time.monotonic()
+    failure = None
+    attempts = 0
+    for trial in range(case.trials):
+        for attempt in range(retries):
+            attempts += 1
+            try:
+                case.fn(client)
+                failure = None
+                break
+            except Exception:
+                failure = (
+                    f"trial {trial + 1}/{case.trials} attempt "
+                    f"{attempt + 1}/{retries}:\n{traceback.format_exc()}"
+                )
+        if failure is not None:
+            break  # a trial exhausted its retries: the case failed
+    return CaseResult(
+        name=case.name,
+        time_s=time.monotonic() - t0,
+        failure=failure,
+        attempts=attempts,
+    )
+
+
+def run_suite(
+    suite_name: str,
+    cases: list[TestCase],
+    client: TrainJobClient,
+    retries: int = 2,
+    junit_dir: str | None = None,
+) -> SuiteResult:
+    result = SuiteResult(suite=suite_name)
+    for case in cases:
+        print(f"[{suite_name}] {case.name} ...", file=sys.stderr, flush=True)
+        cr = run_case(case, client, retries=retries)
+        status = "PASS" if cr.ok else "FAIL"
+        print(f"[{suite_name}] {case.name}: {status} ({cr.time_s:.1f}s)",
+              file=sys.stderr, flush=True)
+        result.cases.append(cr)
+    if junit_dir:
+        import os
+
+        os.makedirs(junit_dir, exist_ok=True)
+        path = os.path.join(junit_dir, f"junit_{suite_name}.xml")
+        with open(path, "w") as f:
+            f.write(result.to_junit_xml())
+        print(f"[{suite_name}] junit -> {path}", file=sys.stderr)
+    return result
+
+
+def main(argv: list[str] | None = None) -> int:
+    from tf_operator_tpu.e2e import suites as suites_mod
+    from tf_operator_tpu.e2e.operator_fixture import OperatorProcess
+
+    ap = argparse.ArgumentParser(prog="tpujob-e2e")
+    ap.add_argument("--suites", nargs="*", default=sorted(suites_mod.SUITES),
+                    choices=sorted(suites_mod.SUITES))
+    ap.add_argument("--junit-dir", default=None)
+    ap.add_argument("--server", default=None,
+                    help="target a running operator instead of spawning one")
+    ap.add_argument("--retries", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    def run_all(client: TrainJobClient) -> int:
+        ok = True
+        for name in args.suites:
+            cases = suites_mod.SUITES[name]()
+            r = run_suite(name, cases, client, retries=args.retries,
+                          junit_dir=args.junit_dir)
+            ok = ok and r.ok
+        return 0 if ok else 1
+
+    if args.server:
+        return run_all(TrainJobClient(args.server))
+    with tempfile.TemporaryDirectory(prefix="tpujob-e2e-") as log_dir:
+        with OperatorProcess(log_dir) as op:
+            return run_all(TrainJobClient(op.server))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

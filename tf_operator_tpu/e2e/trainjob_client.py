@@ -1,0 +1,207 @@
+"""REST client for a running operator — the harness's view of the system.
+
+Mirrors py/kubeflow/tf_operator/tf_job_client.py: create/get/delete TrainJobs,
+wait_for_condition / wait_for_delete, terminate_replicas via the fake
+workload's /exit endpoint (tf_job_client.py:302-352), and creation-failure
+scanning over the job's event stream
+(tf_job_client.get_creation_failures_from_tfjob:364).
+
+Everything goes through the operator's HTTP API — the client holds no
+in-process handle to the cluster, exactly like the reference harness talking
+to the K8s API server.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+TERMINAL = ("Succeeded", "Failed")
+
+
+class E2ETimeoutError(TimeoutError):
+    pass
+
+
+class ApiError(RuntimeError):
+    def __init__(self, status: int, body: str):
+        super().__init__(f"HTTP {status}: {body[:500]}")
+        self.status = status
+        self.body = body
+
+
+class TrainJobClient:
+    def __init__(self, server: str = "127.0.0.1:8443", timeout: float = 10.0):
+        self.server = server
+        self.timeout = timeout
+
+    # ------------------------------------------------------------------ http
+
+    def _request(self, method: str, path: str, body: dict | None = None) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"http://{self.server}{path}",
+            data=data,
+            headers={"Content-Type": "application/json"} if data else {},
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, e.read().decode(errors="replace")) from None
+
+    # ------------------------------------------------------------------ crud
+
+    def create(self, manifest: dict) -> dict:
+        return self._request("POST", "/api/trainjobs", manifest)
+
+    def get(self, namespace: str, name: str) -> dict | None:
+        try:
+            return self._request("GET", f"/api/trainjobs/{namespace}/{name}")
+        except ApiError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    def list(self, namespace: str | None = None) -> list[dict]:
+        path = "/api/trainjobs" + (f"/{namespace}" if namespace else "")
+        return self._request("GET", path)["items"]
+
+    def delete(self, namespace: str, name: str) -> None:
+        self._request("DELETE", f"/api/trainjobs/{namespace}/{name}")
+
+    def list_pods(self, namespace: str) -> list[dict]:
+        return self._request("GET", f"/api/pods/{namespace}")["items"]
+
+    def namespaces(self) -> list[str]:
+        return self._request("GET", "/api/namespaces")["namespaces"]
+
+    def logs(self, namespace: str, pod: str) -> str:
+        req = urllib.request.Request(
+            f"http://{self.server}/api/logs/{namespace}/{pod}"
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return r.read().decode(errors="replace")
+
+    def metrics(self) -> str:
+        req = urllib.request.Request(f"http://{self.server}/metrics")
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return r.read().decode()
+
+    # ----------------------------------------------------------------- waits
+
+    def wait_for_condition(
+        self,
+        namespace: str,
+        name: str,
+        conditions: tuple[str, ...],
+        timeout: float = 120.0,
+        poll: float = 0.1,
+    ) -> dict:
+        """Block until the job has any of `conditions` with status True
+        (tf_job_client.wait_for_condition:117)."""
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            job = self.get(namespace, name)
+            if job is not None:
+                last = job
+                for c in job["status"]["conditions"]:
+                    if c["status"] and c["type"] in conditions:
+                        return job
+            time.sleep(poll)
+        raise E2ETimeoutError(
+            f"{namespace}/{name} never reached {conditions}; last={last}"
+        )
+
+    def wait_for_phase(self, namespace: str, name: str) -> dict:
+        return self.wait_for_condition(namespace, name, TERMINAL)
+
+    def wait_for_delete(self, namespace: str, name: str,
+                        timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.get(namespace, name) is None:
+                return
+            time.sleep(0.1)
+        raise E2ETimeoutError(f"{namespace}/{name} not deleted in {timeout}s")
+
+    def wait_for_replicas_serving(
+        self, namespace: str, name: str, count: int, timeout: float = 60.0
+    ) -> dict[str, str]:
+        """Wait until `count` replicas of the job answer /health; returns
+        {pod_name: address}."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            eps = self.endpoints(namespace, name)
+            serving = {}
+            for pod, addr in eps.items():
+                try:
+                    self.replica_http(addr, "/health", timeout=1.0)
+                    serving[pod] = addr
+                except OSError:
+                    pass
+            if len(serving) >= count:
+                return serving
+            time.sleep(0.2)
+        raise E2ETimeoutError(
+            f"{namespace}/{name}: fewer than {count} replicas serving"
+        )
+
+    # ------------------------------------------------- fault injection / HTTP
+
+    def endpoints(self, namespace: str, name: str) -> dict[str, str]:
+        return self._request("GET", f"/api/endpoints/{namespace}/{name}")[
+            "endpoints"
+        ]
+
+    @staticmethod
+    def replica_http(addr: str, path: str, timeout: float = 5.0) -> dict:
+        with urllib.request.urlopen(f"http://{addr}{path}", timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def terminate_replicas(
+        self,
+        namespace: str,
+        name: str,
+        replica_type: str,
+        indices: list[int] | None = None,
+        exit_code: int = 0,
+    ) -> list[str]:
+        """Drive replicas to exit with `exit_code` through the workload's
+        /exit endpoint (tf_job_client.terminate_replicas:317). Returns the pod
+        names terminated."""
+        eps = self.endpoints(namespace, name)
+        prefix = f"{name}-{replica_type.lower()}-"
+        hit = []
+        for pod, addr in sorted(eps.items()):
+            if not pod.startswith(prefix):
+                continue
+            idx = int(pod.rsplit("-", 1)[1])
+            if indices is not None and idx not in indices:
+                continue
+            try:
+                self.replica_http(addr, f"/exit?exitCode={exit_code}")
+            except OSError:
+                pass  # the exit handler kills the server mid-response
+            hit.append(pod)
+        return hit
+
+    # ------------------------------------------------------------- assertions
+
+    def get_events(self, namespace: str, name: str) -> list[dict]:
+        job = self.get(namespace, name)
+        return job["events"] if job else []
+
+    def get_creation_failures(self, namespace: str, name: str) -> list[str]:
+        """Warning events about pod/service creation — the reference harness's
+        crash-loop detector (tf_job_client.get_creation_failures_from_tfjob)."""
+        return [
+            f"{e['reason']}: {e['message']}"
+            for e in self.get_events(namespace, name)
+            if e["type"] == "Warning"
+            and ("Create" in e["reason"] or "Failed" in e["reason"])
+        ]

@@ -1,0 +1,97 @@
+"""Checkpoint save/restore + the trainer->evaluator handoff protocol.
+
+The reference operator never managed checkpoints itself: users mounted PVs
+and TensorFlow checkpointed; the evaluator replica followed the checkpoint
+stream (SURVEY.md §5 "Checkpoint / resume", §2 Evaluator row). Same contract
+here, TPU-native: the chief (or worker-0) writes orbax checkpoints under
+--checkpoint-dir, the Evaluator replica polls the directory, restores each
+new step and evaluates. A FINAL marker file tells the evaluator the stream
+is complete so it can exit cleanly.
+
+Layout:  <dir>/step_<N>/...   (orbax PyTree checkpoint, atomic rename)
+         <dir>/FINAL          (text: last step number)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from typing import Any
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save(ckpt_dir: str, step: int, tree: Any) -> str:
+    """Atomically persist `tree` as step `step`; returns the checkpoint path."""
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+    _checkpointer().save(path, tree, force=True)
+    return path
+
+
+def restore(ckpt_dir: str, step: int, template: Any | None = None) -> Any:
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+    if template is not None:
+        import orbax.checkpoint as ocp
+
+        return _checkpointer().restore(
+            path, restore_args=ocp.checkpoint_utils.construct_restore_args(template)
+        )
+    return _checkpointer().restore(path)
+
+
+def list_steps(ckpt_dir: str) -> list[int]:
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for name in os.listdir(ckpt_dir):
+        m = _STEP_RE.match(name)
+        # Orbax writes to a tmp dir then renames: only finished checkpoints
+        # carry the final name and a metadata file.
+        if m and os.path.isdir(os.path.join(ckpt_dir, name)):
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def latest_step(ckpt_dir: str) -> int | None:
+    steps = list_steps(ckpt_dir)
+    return steps[-1] if steps else None
+
+
+def mark_final(ckpt_dir: str, step: int) -> None:
+    tmp = os.path.join(ckpt_dir, ".FINAL.tmp")
+    with open(tmp, "w") as f:
+        f.write(str(step))
+    os.replace(tmp, os.path.join(ckpt_dir, "FINAL"))
+
+
+def final_step(ckpt_dir: str) -> int | None:
+    path = os.path.join(ckpt_dir, "FINAL")
+    try:
+        with open(path) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def wait_for_new_step(
+    ckpt_dir: str, seen: set[int], timeout: float, poll: float = 0.2
+) -> int | None:
+    """Block until a checkpoint not in `seen` appears; None on timeout or when
+    the FINAL marker is set and every step has been consumed."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for s in list_steps(ckpt_dir):
+            if s not in seen:
+                return s
+        fs = final_step(ckpt_dir)
+        if fs is not None and fs in seen:
+            return None  # stream complete
+        time.sleep(poll)
+    return None

@@ -32,12 +32,93 @@ def _emit(event: dict) -> None:
             f.write(line + "\n")
 
 
+def _is_checkpoint_writer() -> bool:
+    """Chief (or worker-0 when no chief exists) writes checkpoints — the same
+    role the reference gave worker-0/chief for summaries (SURVEY.md §3.4).
+    A standalone run (no operator env) always writes."""
+    rtype = os.environ.get("TPUJOB_REPLICA_TYPE", "").lower()
+    if not rtype:
+        return True
+    if rtype in ("chief", "master"):
+        return True
+    if rtype != "worker" or os.environ.get("TPUJOB_REPLICA_INDEX", "0") != "0":
+        return False
+    # Worker-0 writes only when the job has no chief/master (one writer per
+    # checkpoint dir); the injected ClusterSpec says whether one exists.
+    try:
+        cluster = json.loads(os.environ.get("TF_CONFIG", "{}")).get("cluster", {})
+    except ValueError:
+        cluster = {}
+    return not ("chief" in cluster or "master" in cluster)
+
+
+def _save_checkpoint(ckpt_dir: str, step: int, state, final: bool = False) -> None:
+    import jax
+
+    from tf_operator_tpu.models import checkpoint as ckpt
+
+    params = jax.device_get(state.params)
+    path = ckpt.save(ckpt_dir, step, params)
+    if final:
+        ckpt.mark_final(ckpt_dir, step)
+    _emit({"event": "checkpoint", "step": step, "path": path, "final": final})
+
+
+def _run_evaluator(args, model, params_template, make_batch, loss_fn) -> int:
+    """Evaluator replica: follow the checkpoint stream until FINAL
+    (the reference's Evaluator role, excluded from the ClusterSpec)."""
+    import jax
+
+    from tf_operator_tpu.models import checkpoint as ckpt
+
+    if not args.checkpoint_dir:
+        print("--eval requires --checkpoint-dir", file=sys.stderr)
+        return 2
+
+    @jax.jit
+    def eval_loss(params, batch):
+        loss, _ = loss_fn(params, {}, batch, jax.random.key(0))
+        return loss
+
+    seen: set[int] = set()
+    evaluated = 0
+    while True:
+        step = ckpt.wait_for_new_step(
+            args.checkpoint_dir, seen, timeout=args.eval_timeout
+        )
+        if step is None:
+            final = ckpt.final_step(args.checkpoint_dir)
+            if final is not None and final in seen:
+                break  # stream complete
+            print(f"evaluator: no new checkpoint in {args.eval_timeout}s",
+                  file=sys.stderr)
+            return 1 if evaluated == 0 else 0
+        seen.add(step)
+        params = ckpt.restore(args.checkpoint_dir, step, template=params_template)
+        # Fixed keys -> the same eval batches every round, generated lazily
+        # (materializing all of them up front would hold steps×batch arrays).
+        losses = [
+            float(eval_loss(params, make_batch(jax.random.key(10_000 + i))))
+            for i in range(args.steps)
+        ]
+        evaluated += 1
+        _emit({
+            "event": "eval",
+            "checkpoint_step": step,
+            "eval_loss": round(sum(losses) / len(losses), 6),
+            "n_batches": args.steps,
+        })
+    _emit({"event": "eval_done", "checkpoints_evaluated": evaluated})
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--model",
         default="mnist-mlp",
-        choices=["mnist-mlp", "mnist-conv", "resnet18", "resnet50", "transformer-lm"],
+        choices=["mnist-mlp", "mnist-conv", "resnet18", "resnet50",
+                 "transformer-lm", "bert-base", "bert-tiny"],
     )
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--batch", type=int, default=128)
@@ -45,6 +126,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--log-every", type=int, default=20)
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="chief/worker-0 writes orbax checkpoints here; the "
+                         "Evaluator replica follows them (--eval)")
+    ap.add_argument("--checkpoint-every", type=int, default=0,
+                    help="save every N steps (default: once at the end)")
+    ap.add_argument("--eval", action="store_true",
+                    help="evaluator mode: poll --checkpoint-dir, restore and "
+                         "evaluate each new checkpoint until FINAL")
+    ap.add_argument("--eval-timeout", type=float, default=600.0)
     args = ap.parse_args(argv)
 
     t_start = time.time()
@@ -118,6 +208,32 @@ def main(argv: list[str] | None = None) -> int:
             )
             return M.cross_entropy_loss(logits, batch["y"]), dict(mut)
 
+    elif args.model in ("bert-base", "bert-tiny"):
+        from tf_operator_tpu.models import transformer as tfm
+
+        base = tfm.BERT_BASE if args.model == "bert-base" else tfm.TINY
+        cfg = tfm.TransformerConfig(
+            vocab_size=base.vocab_size, num_layers=base.num_layers,
+            hidden=base.hidden, num_heads=base.num_heads,
+            max_len=max(args.seq, 8), causal=False,
+        )
+        attn = make_attention_fn(mesh, causal=False)
+        model = tfm.BertMLM(cfg, attn_fn=attn)
+        params = tfm.BertMLM(cfg).init(
+            jax.random.key(0), jnp.zeros((1, args.seq), jnp.int32)
+        )["params"]
+        rules = sharding_rules.TRANSFORMER_TP_RULES
+
+        def make_batch(rng):
+            return tfm.make_mlm_batch(rng, args.batch, args.seq, cfg.vocab_size)
+
+        def loss_fn(params, model_state, batch, rng):
+            logits = model.apply({"params": params}, batch["tokens"])
+            return (
+                tfm.mlm_loss(logits, batch["targets"], batch["mask"]),
+                model_state,
+            )
+
     else:  # transformer-lm
         from tf_operator_tpu.models import transformer as tfm
 
@@ -142,6 +258,11 @@ def main(argv: list[str] | None = None) -> int:
         def loss_fn(params, model_state, batch, rng):
             logits = model.apply({"params": params}, batch["tokens"])
             return tfm.lm_loss(logits, batch["tokens"]), model_state
+
+    if args.eval:
+        return _run_evaluator(args, model, params, make_batch, loss_fn)
+
+    saver = _is_checkpoint_writer() and args.checkpoint_dir
 
     tx = optax.adamw(args.lr)
     state = shard_state(create_train_state(params, tx, model_state), mesh, rules)
@@ -170,8 +291,12 @@ def main(argv: list[str] | None = None) -> int:
         state, metrics = step(state, batch, jax.random.key(1000 + i))
         if i % args.log_every == 0:
             _emit({"event": "progress", "step": i, "loss": float(metrics["loss"])})
+        if saver and args.checkpoint_every and i % args.checkpoint_every == 0:
+            _save_checkpoint(args.checkpoint_dir, i, state)
     jax.block_until_ready(metrics["loss"])
     dt = time.time() - t0
+    if saver:
+        _save_checkpoint(args.checkpoint_dir, args.steps, state, final=True)
     steady = args.steps - 1
     # With --steps 1 there is no steady-state window (only the compile step
     # ran); report null throughput rather than a microseconds-denominator lie.

@@ -170,6 +170,51 @@ class TransformerClassifier(nn.Module):
                         param_dtype=jnp.float32, name="cls")(pooled).astype(jnp.float32)
 
 
+class BertMLM(nn.Module):
+    """Masked-LM head over the trunk — the BERT-base pretraining objective
+    (BASELINE.md workload 4's model)."""
+
+    cfg: TransformerConfig
+    attn_fn: AttnFn | None = None
+
+    @nn.compact
+    def __call__(self, tokens, deterministic=True):
+        cfg = self.cfg
+        h = Transformer(cfg, self.attn_fn, name="trunk")(tokens, deterministic)
+        # BERT's MLM transform: dense + gelu + LN, then decode to vocab.
+        h = nn.Dense(cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="mlm_transform")(h)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="mlm_ln")(h)
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
+                          param_dtype=jnp.float32, use_bias=False,
+                          name="lm_head")(h)
+        return logits.astype(jnp.float32)
+
+
+def mlm_loss(logits: jax.Array, targets: jax.Array, mask: jax.Array) -> jax.Array:
+    """Cross entropy over masked positions only. mask: [B, T] 1.0 where the
+    token was masked out (the 15% BERT selects)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return (nll * mask).sum() / denom
+
+
+def make_mlm_batch(
+    rng: jax.Array, batch: int, seq: int, vocab_size: int,
+    mask_rate: float = 0.15, mask_token: int = 103,  # BERT's [MASK]
+) -> dict[str, jax.Array]:
+    """Synthetic MLM batch: random tokens, `mask_rate` of them replaced by
+    [MASK]; targets are the originals."""
+    kt, km = jax.random.split(rng)
+    targets = jax.random.randint(kt, (batch, seq), 0, vocab_size)
+    mask = (jax.random.uniform(km, (batch, seq)) < mask_rate).astype(jnp.float32)
+    tokens = jnp.where(mask.astype(bool), mask_token, targets)
+    return {"tokens": tokens, "targets": targets, "mask": mask}
+
+
 def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     """Next-token cross entropy (shifted)."""
     logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))

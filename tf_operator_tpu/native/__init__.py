@@ -135,6 +135,14 @@ def available() -> bool:
     return load() is not None
 
 
+def loaded_or_built() -> bool:
+    """True if the library is loaded or its .so already exists on disk.
+    Never triggers a build — safe for fast paths like `tpujob version`."""
+    if _lib is not None:
+        return True
+    return _LIB_PATH.exists()
+
+
 # ---------------------------------------------------------------------------
 # Wrappers with the exact interfaces of the pure-Python implementations
 # ---------------------------------------------------------------------------
@@ -149,7 +157,6 @@ class NativeRateLimitingQueue:
         if self._lib is None:
             raise RuntimeError("native library unavailable")
         self._q = self._lib.tq_new(qps, burst, base_delay, max_delay)
-        self._buf = ctypes.create_string_buffer(4096)
 
     def add(self, item: str) -> None:
         self._lib.tq_add(self._q, item.encode())

@@ -164,7 +164,7 @@ class LocalProcessRuntime:
         self.log_dir = log_dir
         self._procs: dict[tuple[str, str], _Proc] = {}
         self._supervisor = make_supervisor()
-        self._port_maps: dict[str, PortMap] = {}  # job label -> map
+        self._port_maps: dict[tuple[str, str], PortMap] = {}  # (ns, job) -> map
         self._lock = threading.Lock()
         self._threads: list[threading.Thread] = []
         self._stopped = False
@@ -181,12 +181,12 @@ class LocalProcessRuntime:
         """Build (incrementally, per job) the DNS->localhost port map from
         every `host.svc[:port]` endpoint the pod's env mentions (TF_CONFIG
         JSON, coordinator address, TPU endpoints, worker hostnames)."""
-        job_name = pod.metadata.labels.get("job-name", "")
+        job_key = (pod.namespace, pod.metadata.labels.get("job-name", ""))
         with self._lock:
-            pm = self._port_maps.get(job_name)
+            pm = self._port_maps.get(job_key)
             if pm is None:
                 pm = PortMap()
-                self._port_maps[job_name] = pm
+                self._port_maps[job_key] = pm
             endpoints: set[tuple[str, int]] = set()
             bare_hosts: set[str] = set()
             for c in pod.spec.containers:
@@ -378,6 +378,6 @@ class LocalProcessRuntime:
             except ProcessLookupError:
                 pass  # already reaped+released by its pod thread
 
-    def port_map(self, job_name: str) -> PortMap | None:
+    def port_map(self, job_name: str, namespace: str = "default") -> PortMap | None:
         with self._lock:
-            return self._port_maps.get(job_name)
+            return self._port_maps.get((namespace, job_name))

@@ -97,7 +97,7 @@ class LocalSession:
     ) -> str | None:
         """127.0.0.1:port HTTP address of a replica's workload server
         (`port` is the declared containerPort, default tfjob-port 2222)."""
-        pm = self.runtime.port_map(job_name)
+        pm = self.runtime.port_map(job_name, namespace)
         if pm is None:
             return None
         host = f"{gen_general_name(job_name, rtype, index)}.{namespace}.svc"

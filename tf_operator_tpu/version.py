@@ -1,0 +1,55 @@
+"""Version info (parity with pkg/version/version.go:22-43: version, git SHA,
+runtime) surfaced by `tpujob version` and the REST /healthz payload."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+from tf_operator_tpu import __version__
+
+
+def git_sha() -> str:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        r = subprocess.run(
+            ["git", "-C", repo, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+        )
+        if r.returncode == 0:
+            return r.stdout.strip()
+    except OSError:
+        pass
+    return "unknown"
+
+
+def version_info() -> dict[str, str]:
+    info = {
+        "version": __version__,
+        "gitSHA": git_sha(),
+        "python": sys.version.split()[0],
+    }
+    try:
+        import jax
+
+        info["jax"] = jax.__version__
+    except ImportError:
+        pass
+    try:
+        from tf_operator_tpu import native
+
+        # loaded_or_built never compiles: `tpujob version` must stay instant.
+        info["native"] = "loaded" if native.loaded_or_built() else "fallback"
+    except Exception:
+        info["native"] = "fallback"
+    return info
+
+
+def version_string() -> str:
+    i = version_info()
+    parts = [f"tpujob {i['version']} (git {i['gitSHA']}, python {i['python']}"]
+    if "jax" in i:
+        parts.append(f", jax {i['jax']}")
+    parts.append(f", native {i.get('native', 'fallback')})")
+    return "".join(parts)

@@ -1,0 +1,102 @@
+#!/usr/bin/env python3
+"""Release tooling (parity with py/kubeflow/tf_operator/release.py:122-462 —
+build artifacts, build/push the operator image, changelog), local-first:
+
+  python tools/release.py build      native lib + versioned source tarball
+  python tools/release.py test       the release gate (pytest -x)
+  python tools/release.py image      docker build (uses build/Dockerfile);
+                                     prints the command if docker is absent
+  python tools/release.py changelog  commits since the last release tag
+
+Artifacts land in dist/: tf_operator_tpu-<version>+<sha>.tar.gz (git archive,
+reproducible) and libtpujob_native.so.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DIST = os.path.join(REPO, "dist")
+
+
+def sh(cmd: list[str], **kw) -> subprocess.CompletedProcess:
+    print("+", " ".join(cmd), file=sys.stderr)
+    return subprocess.run(cmd, cwd=REPO, check=True, **kw)
+
+
+def _version_tag() -> str:
+    sys.path.insert(0, REPO)
+    from tf_operator_tpu.version import git_sha, version_info
+
+    return f"{version_info()['version']}+{git_sha()}"
+
+
+def cmd_build(args) -> int:
+    os.makedirs(DIST, exist_ok=True)
+    tag = _version_tag()
+    # 1. Native library.
+    sh(["make", "-C", os.path.join(REPO, "native")])
+    shutil.copy2(
+        os.path.join(REPO, "native", "build", "libtpujob_native.so"),
+        os.path.join(DIST, "libtpujob_native.so"),
+    )
+    # 2. Reproducible source tarball of the committed tree.
+    tarball = os.path.join(DIST, f"tf_operator_tpu-{tag}.tar.gz")
+    sh(["git", "archive", "--format=tar.gz",
+        f"--prefix=tf_operator_tpu-{tag}/", "-o", tarball, "HEAD"])
+    print(f"built: {tarball}")
+    print(f"built: {DIST}/libtpujob_native.so")
+    return 0
+
+
+def cmd_test(args) -> int:
+    return subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-x", "-q"], cwd=REPO
+    ).returncode
+
+
+def cmd_image(args) -> int:
+    tag = args.tag or f"tpujob-operator:{_version_tag()}"
+    cmd = ["docker", "build", "-f", "build/Dockerfile", "-t", tag, "."]
+    if shutil.which("docker") is None:
+        print("docker not available here; on a build host run:")
+        print("  " + " ".join(cmd))
+        return 0
+    sh(cmd)
+    if args.push:
+        sh(["docker", "push", tag])
+    return 0
+
+
+def cmd_changelog(args) -> int:
+    r = subprocess.run(
+        ["git", "-C", REPO, "describe", "--tags", "--abbrev=0"],
+        capture_output=True, text=True,
+    )
+    since = r.stdout.strip() if r.returncode == 0 else None
+    rev = f"{since}..HEAD" if since else "HEAD"
+    sh(["git", "log", "--oneline", "--no-decorate", rev])
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="release.py")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("build").set_defaults(fn=cmd_build)
+    sub.add_parser("test").set_defaults(fn=cmd_test)
+    p = sub.add_parser("image")
+    p.add_argument("--tag", default=None)
+    p.add_argument("--push", action="store_true")
+    p.set_defaults(fn=cmd_image)
+    sub.add_parser("changelog").set_defaults(fn=cmd_changelog)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
