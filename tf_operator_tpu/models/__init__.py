@@ -5,6 +5,8 @@
   transformer  BERT-base encoder +
                causal LM w/ ring attention (Chief+Worker+Evaluator BERT parity,
                                             long-context first-class)
+  moe          Mixture-of-Experts LM, expert-parallel over the `ep` mesh axis
+               (GShard dense dispatch; SURVEY.md §2 parallelism table EP row)
 
 All models compute in bfloat16 by default (MXU-native) with f32 params, and
 take an injectable attention function so sequence parallelism composes.

@@ -1,0 +1,295 @@
+"""Mixture-of-Experts transformer with expert parallelism (`ep` mesh axis).
+
+SURVEY.md §2's parallelism table lists EP as a strategy the reference has no
+operator-side machinery for ("same: mesh axis") — the TPU build realizes it
+in the data plane: expert weights are stacked `[E, ...]` tensors sharded over
+the `ep` axis (parallel/mesh.py), and token routing is the GShard/Switch
+dense-dispatch formulation — one-hot dispatch/combine einsums with a static
+per-expert capacity — so every shape is static, the routing math lowers to
+MXU-friendly batched matmuls, and XLA inserts the dp<->ep all-to-alls from
+the sharding annotations alone (scaling-book recipe; no hand-written
+collectives).
+
+Naming contract for sharding rules (parallel/sharding_rules.MOE_RULES):
+router/kernel, experts_in, experts_out (stacked expert FFN weights).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models.transformer import AttnFn, SelfAttention
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    num_layers: int = 4
+    hidden: int = 512
+    num_heads: int = 8
+    mlp_ratio: int = 4
+    max_len: int = 1024
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 2          # every Nth block uses the MoE MLP
+    balance_coef: float = 1e-2  # Switch load-balancing aux loss weight
+    zloss_coef: float = 1e-3    # router logit z-loss weight
+    causal: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.num_heads
+
+    @property
+    def ffn(self) -> int:
+        return self.hidden * self.mlp_ratio
+
+    def capacity(self, seq_len: int) -> int:
+        """Static per-expert token capacity C for a [B, T] batch row."""
+        c = int(self.top_k * seq_len * self.capacity_factor / self.num_experts)
+        return max(c, 1)
+
+
+TINY_MOE = MoEConfig(
+    vocab_size=1024, num_layers=2, hidden=128, num_heads=4, max_len=256,
+    num_experts=4, top_k=2, moe_every=1,
+)
+
+
+def topk_routing(
+    router_logits: jax.Array, top_k: int, capacity: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """GShard top-k routing with static capacity.
+
+    router_logits: [B, T, E] (float32). Returns:
+      combine  [B, T, E, C] f32 — gate weight of token t in expert e, slot c
+      dispatch [B, T, E, C] bool — combine > 0
+      aux      dict arrays for the load-balance loss (f_e counts, p_e probs)
+
+    Priority is choice-major (all 1st choices claim slots before any 2nd
+    choice) then token-major — the GShard order, so earlier tokens win slots
+    deterministically. Everything is one-hot einsums: no gather/scatter, no
+    dynamic shapes.
+    """
+    b, t, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    remaining = probs
+    masks, gates = [], []
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)                   # [B, T]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)     # [B, T, E]
+        masks.append(onehot)
+        gates.append((remaining * onehot).sum(-1))             # [B, T]
+        remaining = remaining * (1.0 - onehot)
+
+    mask_k = jnp.stack(masks, axis=1)                          # [B, K, T, E]
+    gate_k = jnp.stack(gates, axis=1)                          # [B, K, T]
+    if top_k > 1:
+        # Normalize the K selected gates to sum to 1 (top-2 convention).
+        gate_k = gate_k / jnp.maximum(gate_k.sum(axis=1, keepdims=True), 1e-9)
+    # top_k == 1 keeps the raw softmax prob (Switch eq. 2) — normalizing would
+    # make every combine weight exactly 1.0 and cut the router out of the LM
+    # loss's gradient path entirely.
+
+    # Slot assignment: cumulative count over the flattened (K, T) order.
+    flat = mask_k.reshape(b, top_k * t, e)
+    pos_in_expert = jnp.cumsum(flat, axis=1) - flat            # [B, KT, E]
+    pos = (pos_in_expert * flat).sum(-1)                       # [B, KT]
+    fits = (pos < capacity)[..., None] * flat                  # [B, KT, E]
+    slot_onehot = jax.nn.one_hot(
+        pos.astype(jnp.int32), capacity, dtype=jnp.float32
+    )                                                          # [B, KT, C]
+
+    combine_flat = (
+        gate_k.reshape(b, top_k * t)[..., None, None]
+        * fits[..., None]
+        * slot_onehot[:, :, None, :]
+    )                                                          # [B, KT, E, C]
+    combine = combine_flat.reshape(b, top_k, t, e, capacity).sum(axis=1)
+    dispatch = combine > 0.0
+
+    aux = {
+        # fraction of tokens whose FIRST choice is expert e (Switch f_e)
+        "fraction": mask_k[:, 0].mean(axis=(0, 1)),            # [E]
+        "prob": probs.mean(axis=(0, 1)),                       # [E]
+        "logits": router_logits,
+    }
+    return combine, dispatch, aux
+
+
+def load_balance_loss(aux: dict, num_experts: int) -> jax.Array:
+    """Switch-transformer load-balancing loss: E * sum_e f_e * p_e (== 1.0 at
+    perfect uniformity)."""
+    return num_experts * (aux["fraction"] * aux["prob"]).sum()
+
+
+def router_z_loss(aux: dict) -> jax.Array:
+    """Penalize large router logits (numerical stability, ST-MoE eq. 5)."""
+    z = jax.nn.logsumexp(aux["logits"].astype(jnp.float32), axis=-1)
+    return (z**2).mean()
+
+
+class MoEMlp(nn.Module):
+    """Expert-parallel FFN. Expert weights are stacked [E, ...] params sharded
+    over `ep`; dispatch/combine are einsums so the tokens<->experts shuffle is
+    an XLA all-to-all, not host code."""
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        b, t, h = x.shape
+        capacity = cfg.capacity(t)
+
+        w_router = self.param(
+            "router", nn.initializers.lecun_normal(), (h, cfg.num_experts),
+            jnp.float32,
+        )
+        experts_in = self.param(
+            "experts_in", nn.initializers.lecun_normal(),
+            (cfg.num_experts, h, cfg.ffn), jnp.float32,
+        )
+        experts_out = self.param(
+            "experts_out", nn.initializers.lecun_normal(),
+            (cfg.num_experts, cfg.ffn, h), jnp.float32,
+        )
+
+        # Router math in f32 (bf16 softmax over experts is too coarse).
+        logits = jnp.einsum("bth,he->bte", x.astype(jnp.float32), w_router)
+        combine, dispatch, aux = topk_routing(logits, cfg.top_k, capacity)
+
+        self.sow("moe_losses", "balance",
+                 load_balance_loss(aux, cfg.num_experts))
+        self.sow("moe_losses", "zloss", router_z_loss(aux))
+
+        # Dispatch: [B,T,E,C] x [B,T,H] -> [E,B,C,H]; with batch dp-sharded
+        # and experts ep-sharded, XLA lowers this to the ep all-to-all.
+        expert_in = jnp.einsum(
+            "btec,bth->ebch", dispatch.astype(cfg.dtype), x.astype(cfg.dtype)
+        )
+        hmid = jnp.einsum(
+            "ebch,ehf->ebcf", expert_in, experts_in.astype(cfg.dtype)
+        )
+        hmid = nn.gelu(hmid)
+        expert_out = jnp.einsum(
+            "ebcf,efh->ebch", hmid, experts_out.astype(cfg.dtype)
+        )
+        # Combine back (weighted by gates); dropped tokens (over capacity)
+        # contribute 0 — the residual connection carries them through.
+        return jnp.einsum(
+            "btec,ebch->bth", combine.astype(cfg.dtype), expert_out
+        )
+
+
+class MoEBlock(nn.Module):
+    cfg: MoEConfig
+    use_moe: bool
+    attn_fn: AttnFn | None = None
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.cfg
+        from tf_operator_tpu.models.transformer import TransformerConfig
+
+        attn_cfg = TransformerConfig(
+            vocab_size=cfg.vocab_size, num_layers=cfg.num_layers,
+            hidden=cfg.hidden, num_heads=cfg.num_heads, max_len=cfg.max_len,
+            causal=cfg.causal, dtype=cfg.dtype,
+        )
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            dtype=cfg.dtype, param_dtype=jnp.float32, name=name
+        )
+        x = x + SelfAttention(attn_cfg, self.attn_fn, name="attn")(
+            ln("ln1")(x), deterministic
+        )
+        h = ln("ln2")(x)
+        if self.use_moe:
+            h = MoEMlp(cfg, name="moe")(h)
+        else:
+            h = nn.Dense(cfg.ffn, dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="mlp_in")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="mlp_out")(h)
+        return x + h
+
+
+class MoETransformerLM(nn.Module):
+    """Causal LM with MoE FFNs every `moe_every` blocks (Mixtral/Switch
+    layout: interleaved dense + expert layers)."""
+
+    cfg: MoEConfig
+    attn_fn: AttnFn | None = None
+
+    @nn.compact
+    def __call__(self, tokens, deterministic=True):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="embed")(tokens)
+        pos = nn.Embed(cfg.max_len, cfg.hidden, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="pos_embed")(
+            jnp.arange(tokens.shape[1])
+        )
+        x = x + pos[None]
+        for i in range(cfg.num_layers):
+            use_moe = (i % cfg.moe_every) == (cfg.moe_every - 1)
+            x = MoEBlock(cfg, use_moe, self.attn_fn, name=f"layer_{i}")(
+                x, deterministic
+            )
+        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
+                          param_dtype=jnp.float32, use_bias=False,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def moe_lm_loss(
+    model: MoETransformerLM, params, tokens: jax.Array
+) -> jax.Array:
+    """Next-token loss + the sown MoE aux losses (balance + z-loss)."""
+    from tf_operator_tpu.models.transformer import lm_loss
+
+    cfg = model.cfg
+    logits, mut = model.apply(
+        {"params": params}, tokens, mutable=["moe_losses"]
+    )
+    loss = lm_loss(logits, tokens)
+    flat, _ = jax.tree_util.tree_flatten_with_path(mut.get("moe_losses", {}))
+    balance = [leaf for path, leaf in flat if "balance" in str(path)]
+    zloss = [leaf for path, leaf in flat if "zloss" in str(path)]
+    if balance:
+        loss = loss + cfg.balance_coef * sum(balance) / len(balance)
+    if zloss:
+        loss = loss + cfg.zloss_coef * sum(zloss) / len(zloss)
+    return loss
+
+
+def moe_reference_forward(
+    params: dict, cfg: MoEConfig, x: jax.Array
+) -> jax.Array:
+    """Per-token loop reference for MoEMlp (test oracle, no capacity limit):
+    y[t] = sum over the top-k experts of gate * FFN_e(x[t])."""
+    w_router = params["router"]
+    wi, wo = params["experts_in"], params["experts_out"]
+    logits = x.astype(jnp.float32) @ w_router
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, cfg.top_k)
+    topv = topv / topv.sum(-1, keepdims=True)
+    out = jnp.zeros_like(x, dtype=jnp.float32)
+    for k in range(cfg.top_k):
+        e = topi[..., k]  # [B, T]
+        gate = topv[..., k]
+        h = jnp.einsum("bth,bthf->btf", x.astype(jnp.float32), wi[e])
+        h = nn.gelu(h)
+        y = jnp.einsum("btf,btfh->bth", h, wo[e])
+        out = out + gate[..., None] * y
+    return out

@@ -118,7 +118,7 @@ def main(argv: list[str] | None = None) -> int:
         "--model",
         default="mnist-mlp",
         choices=["mnist-mlp", "mnist-conv", "resnet18", "resnet50",
-                 "transformer-lm", "bert-base", "bert-tiny"],
+                 "transformer-lm", "bert-base", "bert-tiny", "moe-lm"],
     )
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--batch", type=int, default=128)
@@ -231,6 +231,33 @@ def main(argv: list[str] | None = None) -> int:
             logits = model.apply({"params": params}, batch["tokens"])
             return (
                 tfm.mlm_loss(logits, batch["targets"], batch["mask"]),
+                model_state,
+            )
+
+    elif args.model == "moe-lm":
+        from tf_operator_tpu.models import moe as moe_lib
+
+        cfg = moe_lib.MoEConfig(
+            vocab_size=32000, num_layers=4, hidden=512, num_heads=8,
+            max_len=args.seq, num_experts=8, top_k=2, moe_every=2,
+        )
+        attn = make_attention_fn(mesh, causal=True)
+        model = moe_lib.MoETransformerLM(cfg, attn_fn=attn)
+        params = moe_lib.MoETransformerLM(cfg).init(
+            jax.random.key(0), jnp.zeros((1, args.seq), jnp.int32)
+        )["params"]
+        rules = sharding_rules.MOE_RULES
+
+        def make_batch(rng):
+            return {
+                "tokens": jax.random.randint(
+                    rng, (args.batch, args.seq), 0, cfg.vocab_size
+                )
+            }
+
+        def loss_fn(params, model_state, batch, rng):
+            return (
+                moe_lib.moe_lm_loss(model, params, batch["tokens"]),
                 model_state,
             )
 

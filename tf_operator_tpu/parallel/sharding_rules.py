@@ -34,6 +34,15 @@ TRANSFORMER_TP_RULES: Rules = [
     (r".*(bias|scale)$", P()),
 ]
 
+# MoE (models/moe.py naming): stacked expert FFN weights [E, in, out] shard
+# experts over ep and the matmul dims over tp; the router stays replicated so
+# every dp shard routes identically-cheaply.
+MOE_RULES: Rules = [
+    (r".*experts_in$", P("ep", None, "tp")),
+    (r".*experts_out$", P("ep", "tp", None)),
+    (r".*/router$", P()),
+] + TRANSFORMER_TP_RULES
+
 
 def path_str(path) -> str:
     parts = []

@@ -19,7 +19,7 @@ def git_sha() -> str:
         )
         if r.returncode == 0:
             return r.stdout.strip()
-    except OSError:
+    except (OSError, subprocess.TimeoutExpired):
         pass
     return "unknown"
 
