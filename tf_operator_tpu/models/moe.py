@@ -64,7 +64,7 @@ TINY_MOE = MoEConfig(
 
 def topk_routing(
     router_logits: jax.Array, top_k: int, capacity: int
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+) -> tuple[jax.Array, jax.Array, dict[str, jax.Array]]:
     """GShard top-k routing with static capacity.
 
     router_logits: [B, T, E] (float32). Returns:
@@ -153,12 +153,16 @@ class MoEMlp(nn.Module):
             "router", nn.initializers.lecun_normal(), (h, cfg.num_experts),
             jnp.float32,
         )
+        # batch_axis=0 excludes the stacked expert dim from fan-in: each
+        # expert must init like a standalone [h, ffn] dense layer, not with
+        # variance shrunk by E.
+        expert_init = nn.initializers.lecun_normal(batch_axis=0)
         experts_in = self.param(
-            "experts_in", nn.initializers.lecun_normal(),
+            "experts_in", expert_init,
             (cfg.num_experts, h, cfg.ffn), jnp.float32,
         )
         experts_out = self.param(
-            "experts_out", nn.initializers.lecun_normal(),
+            "experts_out", expert_init,
             (cfg.num_experts, cfg.ffn, h), jnp.float32,
         )
 
@@ -283,7 +287,8 @@ def moe_reference_forward(
     logits = x.astype(jnp.float32) @ w_router
     probs = jax.nn.softmax(logits, axis=-1)
     topv, topi = jax.lax.top_k(probs, cfg.top_k)
-    topv = topv / topv.sum(-1, keepdims=True)
+    if cfg.top_k > 1:  # top-1 keeps the raw softmax prob (Switch eq. 2)
+        topv = topv / topv.sum(-1, keepdims=True)
     out = jnp.zeros_like(x, dtype=jnp.float32)
     for k in range(cfg.top_k):
         e = topi[..., k]  # [B, T]
