@@ -170,12 +170,20 @@ def _main() -> int:
 
     # --- Workload 2: ResNet-50 training throughput on the chip ---
     log("bench: ResNet-50 throughput through operator...")
-    rn_batch = 64 if backend in ("tpu", "axon") else 8
-    rn_steps = 30 if backend in ("tpu", "axon") else 5
-    rn_size = 224 if backend in ("tpu", "axon") else 64
+    # batch 256 feeds the MXU ~30% better than 64 (measured on v5e) and
+    # fits HBM with bf16 activations; 60 steps leaves a 40-step steady
+    # window after the 20-step first compile call. The CPU fallback needs
+    # --log-every <= steps/2 so a steady window exists past the first chunk
+    # (the trainer reports null throughput without one).
+    on_tpu = backend in ("tpu", "axon")
+    rn_batch = 256 if on_tpu else 8
+    rn_steps = 60 if on_tpu else 15
+    rn_size = 224 if on_tpu else 64
+    rn_extra = ["--image-size", str(rn_size)]
+    if not on_tpu:
+        rn_extra += ["--log-every", "5"]
     resnet = run_job_e2e(
-        "resnet50", steps=rn_steps, batch=rn_batch,
-        extra=["--image-size", str(rn_size)], timeout=1800,
+        "resnet50", steps=rn_steps, batch=rn_batch, extra=rn_extra, timeout=1800,
     )
     rev = {e["event"]: e for e in resnet["events"]}
     rn_ips = rev.get("done", {}).get("examples_per_sec")

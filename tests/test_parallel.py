@@ -19,6 +19,7 @@ from tf_operator_tpu.parallel.ring_attention import (
 )
 from tf_operator_tpu.parallel.train_step import (
     create_train_state,
+    make_scanned_train_step,
     make_train_step,
     shard_state,
 )
@@ -248,3 +249,72 @@ class TestModels:
         params = model.init(jax.random.key(0), jnp.zeros((2, 16), jnp.int32))["params"]
         out = model.apply({"params": params}, jnp.zeros((2, 16), jnp.int32))
         assert out.shape == (2, 3)
+
+
+class TestScannedTrainStep:
+    """make_scanned_train_step: the on-device chunked loop the trainer uses."""
+
+    def _setup(self, mesh, fixed_batch=False):
+        model = mnist_models.MLP()
+        tx = optax.adamw(1e-3)
+
+        def make_batch(rng):
+            if fixed_batch:
+                # Same batch every step: memorizable, so loss must descend.
+                rng = jax.random.key(7)
+            kx, ky = jax.random.split(rng)
+            return {
+                "x": jax.random.normal(kx, (16, 28, 28)),
+                "y": jax.random.randint(ky, (16,), 0, 10),
+            }
+
+        def loss_fn(p, model_state, batch, rng):
+            logits = model.apply({"params": p}, batch["x"])
+            return (
+                mnist_models.cross_entropy_loss(logits, batch["y"]),
+                model_state,
+            )
+
+        def fresh_state():
+            # Re-init per state: donation deletes the previous state's
+            # buffers, so states must not share param arrays.
+            params = model.init(
+                jax.random.key(0), jnp.zeros((1, 28, 28), jnp.float32)
+            )["params"]
+            return shard_state(create_train_state(params, tx), mesh, None)
+
+        return make_scanned_train_step(loss_fn, tx, mesh, make_batch), fresh_state
+
+    def test_chunking_invariant(self):
+        """One unroll=4 call must equal two unroll=2 calls exactly: the RNG
+        stream derives from the GLOBAL step (fold_in(key, state.step + i)),
+        not the scan-local index — the invariant the trainer's tail-chunk
+        handling relies on (models/train.py)."""
+        mesh = mesh_lib.make_mesh({"dp": 8})
+        compile_scanned, fresh_state = self._setup(mesh)
+
+        s4, m4 = compile_scanned(fresh_state(), 4)(fresh_state())
+        step2 = compile_scanned(fresh_state(), 2)
+        s2 = fresh_state()
+        s2, _ = step2(s2)
+        s2, m2 = step2(s2)
+
+        assert int(s4.step) == int(s2.step) == 4
+        np.testing.assert_allclose(
+            float(m4["loss"]), float(m2["loss"]), rtol=1e-6
+        )
+        for a, b in zip(jax.tree.leaves(s4.params), jax.tree.leaves(s2.params)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+            )
+
+    def test_loss_decreases(self):
+        mesh = mesh_lib.make_mesh({"dp": 8})
+        compile_scanned, fresh_state = self._setup(mesh, fixed_batch=True)
+        state = fresh_state()
+        step = compile_scanned(state, 8)
+        state, m_first = step(state)
+        for _ in range(3):
+            state, m = step(state)
+        assert int(state.step) == 32
+        assert float(m["loss"]) < float(m_first["loss"])

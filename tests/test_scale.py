@@ -1,0 +1,99 @@
+"""Design-scale target: O(100) simultaneous TrainJobs per cluster.
+
+The reference's only quantitative scale claim (tf_job_design_doc.md:24-26,
+SURVEY.md §6): the operator must handle on the order of 100 concurrent jobs.
+These tests drive the full stack — reconcile engine, expectations, pod
+creation, local-process runtime, status machine, cleanup — at that scale
+with trivial workloads (no jax import), and check both correctness (every
+job reaches the right terminal state) and liveness (the controller's
+workqueue keeps up; nothing deadlocks or cross-talks between jobs).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from tf_operator_tpu.api import defaults
+from tf_operator_tpu.api.types import (
+    ContainerSpec,
+    JobConditionType,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    ReplicaType,
+    TrainJob,
+    TrainJobSpec,
+    is_failed,
+    is_succeeded,
+)
+from tf_operator_tpu.runtime.session import LocalSession
+
+N_JOBS = 100
+
+
+def _job(name: str, command: list[str], replicas: int = 1) -> TrainJob:
+    job = TrainJob(
+        metadata=ObjectMeta(name=name),
+        spec=TrainJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=replicas,
+                    template=PodTemplateSpec(
+                        containers=[
+                            ContainerSpec(
+                                name="tensorflow", image="local", command=command
+                            )
+                        ]
+                    ),
+                )
+            }
+        ),
+    )
+    defaults.set_defaults(job)
+    job.spec.run_policy.scheduling.gang = False
+    return job
+
+
+class TestHundredConcurrentJobs:
+    def test_100_jobs_all_succeed(self):
+        """Submit 100 jobs at once; every one must reach Succeeded."""
+        ok = [sys.executable, "-c", "import time; time.sleep(0.2)"]
+        t0 = time.monotonic()
+        with LocalSession(workers=4) as s:
+            for i in range(N_JOBS):
+                s.submit(_job(f"scale-{i}", ok))
+            for i in range(N_JOBS):
+                final = s.wait_for_condition(
+                    "default", f"scale-{i}",
+                    (JobConditionType.SUCCEEDED, JobConditionType.FAILED),
+                    timeout=180,
+                )
+                assert is_succeeded(final.status), (
+                    f"scale-{i}: {final.status.conditions}"
+                )
+        wall = time.monotonic() - t0
+        # Liveness bound, generous for CI: 100 jobs x (reconcile + spawn +
+        # exit + status) must not serialize into minutes.
+        assert wall < 150, f"100 concurrent jobs took {wall:.1f}s"
+
+    def test_mixed_outcomes_no_crosstalk(self):
+        """Interleave succeeding and failing jobs: each must get ITS OWN
+        terminal state (status cross-talk at scale was the class of bug the
+        reference's expectations cache existed to stop)."""
+        ok = [sys.executable, "-c", "pass"]
+        bad = [sys.executable, "-c", "raise SystemExit(1)"]
+        n = 40
+        with LocalSession(workers=4) as s:
+            for i in range(n):
+                s.submit(_job(f"mix-{i}", ok if i % 2 == 0 else bad))
+            for i in range(n):
+                final = s.wait_for_condition(
+                    "default", f"mix-{i}",
+                    (JobConditionType.SUCCEEDED, JobConditionType.FAILED),
+                    timeout=120,
+                )
+                if i % 2 == 0:
+                    assert is_succeeded(final.status), f"mix-{i}"
+                else:
+                    assert is_failed(final.status), f"mix-{i}"

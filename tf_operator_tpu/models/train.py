@@ -153,9 +153,12 @@ def main(argv: list[str] | None = None) -> int:
     from tf_operator_tpu.parallel.ring_attention import make_attention_fn
     from tf_operator_tpu.parallel.train_step import (
         create_train_state,
-        make_train_step,
+        make_scanned_train_step,
         shard_state,
     )
+    from tf_operator_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
 
     mesh = mesh_lib.mesh_from_env()
     rules = None
@@ -293,40 +296,72 @@ def main(argv: list[str] | None = None) -> int:
 
     tx = optax.adamw(args.lr)
     state = shard_state(create_train_state(params, tx, model_state), mesh, rules)
-    batch = make_batch(jax.random.key(1))
-    _, compile_step = make_train_step(loss_fn, tx, mesh, rules=rules)
-    step = compile_step(state, batch)
+    compile_scanned = make_scanned_train_step(
+        loss_fn, tx, mesh, make_batch, rules=rules
+    )
+    # Chunked on-device loop: one dispatch per `chunk` steps (batches are
+    # generated inside the compiled program) — per-step host round-trips to
+    # a tunneled chip otherwise dominate small-model step time. The chunk
+    # honors the checkpoint cadence so no save point is skipped.
+    chunk = max(1, min(args.log_every, args.checkpoint_every or args.steps,
+                       args.steps))
+    step_chunk = compile_scanned(state, chunk)
+    ckpt_marks = 0
 
-    state, metrics = step(state, batch, jax.random.key(2))
+    def maybe_checkpoint(done: int) -> None:
+        nonlocal ckpt_marks
+        if not (saver and args.checkpoint_every) or done >= args.steps:
+            return  # the final save (marked FINAL) happens after the loop
+        marks = done // args.checkpoint_every
+        if marks > ckpt_marks:
+            ckpt_marks = marks
+            _save_checkpoint(args.checkpoint_dir, done, state)
+
+    state, metrics = step_chunk(state)
     jax.block_until_ready(metrics["loss"])
     t_first = time.time()
+    done = chunk
     _emit(
         {
             "event": "first_step",
             "t": t_first,
             "startup_s": round(t_first - t_start, 3),
+            "steps_in_first_call": chunk,
             "loss": float(metrics["loss"]),
             "mesh": dict(mesh.shape),
             "backend": jax.default_backend(),
             "n_devices": len(jax.devices()),
         }
     )
+    maybe_checkpoint(done)
 
+    # Steady-state window: full chunks only (every dispatch reuses the one
+    # compiled program). The tail chunk, if any, needs its own compile and
+    # runs AFTER dt is captured so compilation never pollutes throughput.
+    full_chunks = (args.steps - done) // chunk
+    tail = (args.steps - done) % chunk
     t0 = time.time()
-    for i in range(1, args.steps):
-        batch = make_batch(jax.random.key(2 + i))
-        state, metrics = step(state, batch, jax.random.key(1000 + i))
-        if i % args.log_every == 0:
-            _emit({"event": "progress", "step": i, "loss": float(metrics["loss"])})
-        if saver and args.checkpoint_every and i % args.checkpoint_every == 0:
-            _save_checkpoint(args.checkpoint_dir, i, state)
+    for _ in range(full_chunks):
+        state, metrics = step_chunk(state)
+        done += chunk
+        if done < args.steps or done % args.log_every == 0:
+            _emit({"event": "progress", "step": done,
+                   "loss": float(metrics["loss"])})
+        maybe_checkpoint(done)
     jax.block_until_ready(metrics["loss"])
     dt = time.time() - t0
+    steady = full_chunks * chunk
+
+    if tail:
+        state, metrics = compile_scanned(state, tail)(state)
+        done += tail
+        _emit({"event": "progress", "step": done,
+               "loss": float(metrics["loss"])})
     if saver:
         _save_checkpoint(args.checkpoint_dir, args.steps, state, final=True)
-    steady = args.steps - 1
-    # With --steps 1 there is no steady-state window (only the compile step
-    # ran); report null throughput rather than a microseconds-denominator lie.
+    # With steps <= one chunk there is no steady-state window (only the
+    # compile call ran); report null throughput rather than a
+    # microseconds-denominator lie.
     sps = round(steady / dt, 4) if steady > 0 else None
     _emit(
         {

@@ -149,6 +149,62 @@ def make_train_step(
     return _step, compile_step
 
 
+def make_scanned_train_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    make_batch: Callable[[jax.Array], Any],
+    rules: sharding_rules.Rules | None = None,
+    remat: bool = False,
+    seq_sharded_batch: bool = False,
+    seed: int = 0,
+):
+    """On-device training loop: one jit call runs `unroll` optimizer steps.
+
+    Batches are generated INSIDE the compiled program (make_batch(rng) must
+    be traceable — synthetic data or an on-device pipeline) and sharded like
+    make_train_step's host batches via with_sharding_constraint. The scan
+    turns per-step host work into one dispatch per chunk — on a tunneled or
+    remote chip the per-step dispatch round-trip otherwise dominates
+    small-model step time. RNG streams derive from fold_in(key(seed),
+    global_step), so results are reproducible across chunkings.
+
+    Returns compile(example_state, unroll) -> step(state) -> (state,
+    metrics) with donated state; metrics are the last step's.
+    """
+    _step, _ = make_train_step(loss_fn, tx, mesh, rules=rules, remat=remat)
+    batch_sh = mesh_lib.batch_sharding(mesh, extra_seq_axis=seq_sharded_batch)
+    repl = mesh_lib.replicated(mesh)
+
+    def compile_scanned(example_state: TrainState, unroll: int):
+        st_sh = state_shardings(example_state, mesh, rules)
+
+        def _many(state: TrainState):
+            base = jax.random.key(seed)
+
+            def body(st, i):
+                rng = jax.random.fold_in(base, i)
+                batch = jax.tree.map(
+                    lambda x: jax.lax.with_sharding_constraint(x, batch_sh),
+                    make_batch(jax.random.fold_in(rng, 0)),
+                )
+                return _step(st, batch, jax.random.fold_in(rng, 1))
+
+            state, ms = jax.lax.scan(
+                body, state, state.step + jnp.arange(unroll)
+            )
+            return state, jax.tree.map(lambda a: a[-1], ms)
+
+        return jax.jit(
+            _many,
+            in_shardings=(st_sh,),
+            out_shardings=(st_sh, repl),
+            donate_argnums=(0,),
+        )
+
+    return compile_scanned
+
+
 def make_eval_step(
     metric_fn: Callable, mesh: Mesh, rules: sharding_rules.Rules | None = None
 ):

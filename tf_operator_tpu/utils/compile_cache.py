@@ -1,0 +1,53 @@
+"""Persistent XLA compilation cache shared by every pod process.
+
+The reference operator compiles nothing (all math lives in user containers,
+SURVEY.md §0); a TPU-native data plane, by contrast, pays XLA's first
+compile (~20-40s on a v5e chip) in EVERY pod process unless compiled
+programs persist. Pointing `jax_compilation_cache_dir` at one on-disk
+directory makes an N-replica job compile each program once per machine
+instead of once per pod, and drops pod-startup->first-step latency from
+tens of seconds to seconds on every subsequent run of the same program
+shape (the north-star latency metric, BASELINE.md).
+
+Set TPUJOB_COMPILE_CACHE to a directory to relocate the cache, or to
+"off" to disable; unset uses ~/.cache/tpujob/xla.
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_COMPILE_CACHE = "TPUJOB_COMPILE_CACHE"
+_DISABLED = ("off", "0", "none", "disabled")
+
+
+def default_cache_dir() -> str:
+    return os.path.join(os.path.expanduser("~"), ".cache", "tpujob", "xla")
+
+
+def enable_compile_cache(path: str | None = None) -> str | None:
+    """Enable the persistent compilation cache; returns the directory in
+    use, or None when disabled (TPUJOB_COMPILE_CACHE=off) or unavailable.
+    Call after `import jax` and before the first jit compilation."""
+    resolved = path if path is not None else os.environ.get(ENV_COMPILE_CACHE)
+    if resolved is None:
+        resolved = default_cache_dir()
+    if not resolved or resolved.lower() in _DISABLED:
+        return None
+    try:
+        os.makedirs(resolved, exist_ok=True)
+    except OSError:
+        return None
+    import jax
+
+    try:
+        # Cache everything: even sub-second compiles cost a round-trip to a
+        # tunneled chip's compiler far exceeding a local disk read. The
+        # thresholds go first and the dir last, so a partial failure leaves
+        # the cache fully off (no dir == disabled), matching the None return.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_compilation_cache_dir", resolved)
+    except (AttributeError, ValueError):
+        return None  # older jax without these knobs: run uncached
+    return resolved
