@@ -190,6 +190,22 @@ def _main() -> int:
     log(f"  ok={resnet['ok']} wallclock={resnet.get('wallclock_s')}s "
         f"images/s={rn_ips}")
 
+    # --- Workload 3: long-context LM (pallas flash attention path) ---
+    # seq 8192 is past the point where plain XLA attention fails to compile
+    # on v5e — this measures the fused-kernel long-context capability the
+    # reference stack (NCCL/GPU TF) gated on model code.
+    log("bench: long-context transformer-lm throughput...")
+    lm_seq = 8192 if on_tpu else 256
+    lm_batch = 4 if on_tpu else 2
+    lm = run_job_e2e(
+        "transformer-lm", steps=25 if on_tpu else 10, batch=lm_batch,
+        extra=["--seq", str(lm_seq), "--log-every", "5"], timeout=900,
+    )
+    lev = {e["event"]: e for e in lm["events"]}
+    lm_eps = lev.get("done", {}).get("examples_per_sec")
+    lm_tps = round(lm_eps * lm_seq, 1) if lm_eps else None
+    log(f"  ok={lm['ok']} seq={lm_seq} tokens/s={lm_tps}")
+
     details = {
         "backend": backend,
         "mnist_wallclock_s": mnist["wallclock_s"],
@@ -200,6 +216,9 @@ def _main() -> int:
         "resnet50_images_per_sec": rn_ips,
         "resnet50_batch": rn_batch,
         "resnet50_image_size": rn_size,
+        "longctx_ok": lm["ok"],
+        "longctx_seq": lm_seq,
+        "longctx_tokens_per_sec": lm_tps,
         "bench_total_s": round(time.time() - t_total, 1),
     }
     # No published reference numbers exist (BASELINE.md): anchor at 1.0 =

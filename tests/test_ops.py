@@ -34,11 +34,35 @@ class TestFlashAttentionKernel:
         got = flash_attention_pallas(q, k, v, False, 128, 128, True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
 
-    def test_grad_matches_reference(self):
-        q, k, v = _qkv(2, (1, 2, 128, 128))
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("shape", [(1, 2, 128, 128), (2, 2, 256, 64)])
+    def test_grad_matches_reference(self, causal, shape):
+        """Fused pallas backward (dq/dk/dv kernels) vs autodiff of the
+        reference, multi-block and single-block grids."""
+        q, k, v = _qkv(2, shape)
 
         def loss_flash(q, k, v):
-            return jnp.sum(flash_attention_pallas(q, k, v, True, 128, 128, True) ** 2)
+            return jnp.sum(
+                flash_attention_pallas(q, k, v, causal, 128, 128, True) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+    def test_grad_non_divisible_blocks(self):
+        """Padded tail blocks must not leak garbage into dk/dv (the
+        accumulating pass reads padded q rows)."""
+        q, k, v = _qkv(5, (1, 1, 192, 128))
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention_pallas(q, k, v, True, 128, 128, True) ** 2
+            )
 
         def loss_ref(q, k, v):
             return jnp.sum(attention_reference(q, k, v, True) ** 2)
@@ -47,6 +71,30 @@ class TestFlashAttentionKernel:
         gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+    def test_grad_bf16(self):
+        q, k, v = _qkv(6, (1, 2, 256, 64), jnp.bfloat16)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention_pallas(q, k, v, True, 128, 128, True)
+                .astype(jnp.float32) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                attention_reference(q, k, v, True).astype(jnp.float32) ** 2
+            )
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            # bf16 mantissa is 8 bits: different contraction orders give a
+            # few ulp on isolated elements; bound the worst element loosely.
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=1e-1, rtol=1e-1,
+            )
 
     def test_dispatcher_falls_back_on_cpu(self):
         q, k, v = _qkv(3, (1, 1, 64, 32))

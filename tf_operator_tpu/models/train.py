@@ -302,9 +302,14 @@ def main(argv: list[str] | None = None) -> int:
     # Chunked on-device loop: one dispatch per `chunk` steps (batches are
     # generated inside the compiled program) — per-step host round-trips to
     # a tunneled chip otherwise dominate small-model step time. The chunk
-    # honors the checkpoint cadence so no save point is skipped.
-    chunk = max(1, min(args.log_every, args.checkpoint_every or args.steps,
-                       args.steps))
+    # honors the checkpoint cadence EXACTLY (gcd, so chunk boundaries land
+    # on every multiple of checkpoint_every even when log_every doesn't
+    # divide it).
+    import math
+
+    chunk = max(1, min(args.log_every, args.steps))
+    if saver and args.checkpoint_every:
+        chunk = max(1, math.gcd(chunk, args.checkpoint_every))
     step_chunk = compile_scanned(state, chunk)
     ckpt_marks = 0
 
@@ -344,7 +349,10 @@ def main(argv: list[str] | None = None) -> int:
     for _ in range(full_chunks):
         state, metrics = step_chunk(state)
         done += chunk
-        if done < args.steps or done % args.log_every == 0:
+        # Throttle to the requested cadence: float() is a device sync, and
+        # emitting every sub-log_every chunk would reintroduce the per-step
+        # host round-trips this loop exists to avoid.
+        if done % args.log_every == 0 or done == args.steps:
             _emit({"event": "progress", "step": done,
                    "loss": float(metrics["loss"])})
         maybe_checkpoint(done)

@@ -1,9 +1,14 @@
 """Attention dispatcher: pallas flash kernel on TPU, reference elsewhere.
 
 Selection order for `flash_attention(q, k, v, causal)`:
-  1. pallas fused kernel — default backend is TPU, pallas importable, and
-     T divisible into MXU-friendly blocks
-  2. pure-JAX reference (XLA still fuses well; correct everywhere)
+  1. pallas fused kernel (fwd + fused bwd) — default backend is TPU, pallas
+     importable, T >= 1024 and divisible into MXU-friendly blocks
+  2. pure-JAX reference (XLA fuses it well at short T; correct everywhere)
+
+The T >= 1024 threshold and the 1024 default block size are measured on
+v5e (transformer-lm train step, 32k tokens/batch): XLA wins at T=256
+(1141 vs 1046 ex/s), the kernel wins from T=1024 up (+10% at 1024, +13%
+at 2048, +55% at 4096) and is the only path that compiles at T >= 8192.
 
 Model code should not import this directly — use
 parallel.ring_attention.make_attention_fn, which additionally routes to ring
@@ -12,18 +17,29 @@ attention when the mesh has a sequence-parallel axis.
 
 from __future__ import annotations
 
+import os
+
 import jax
 
 from tf_operator_tpu.parallel.ring_attention import attention_reference
 
+# Debug/bench override: "flash" forces the pallas kernel, "reference" forces
+# the pure-JAX path, unset/"auto" selects by backend and shape.
+ENV_ATTENTION = "TPUJOB_ATTENTION"
+
 
 def _pallas_eligible(q: jax.Array) -> bool:
+    forced = os.environ.get(ENV_ATTENTION, "").lower()
+    if forced == "flash":
+        return True
+    if forced == "reference":
+        return False
     if jax.default_backend() not in ("tpu", "axon"):
         return False
     t, d = q.shape[-2], q.shape[-1]
     # d%64: Mosaic pads the lane dim, so BERT-family head_dim 64 runs the
     # fused kernel (verified bit-level vs reference on v5e at d=64/128/192).
-    return t >= 128 and t % 128 == 0 and d >= 64 and d % 64 == 0
+    return t >= 1024 and t % 128 == 0 and d >= 64 and d % 64 == 0
 
 
 def flash_attention(
@@ -35,5 +51,6 @@ def flash_attention(
     if use_pallas:
         from tf_operator_tpu.ops.flash_attention import flash_attention_pallas
 
-        return flash_attention_pallas(q, k, v, causal, 128, 128, interpret)
+        block = int(os.environ.get("TPUJOB_FLASH_BLOCK", "1024"))
+        return flash_attention_pallas(q, k, v, causal, block, block, interpret)
     return attention_reference(q, k, v, causal)

@@ -12,12 +12,17 @@ back (FlashAttention recurrence; kernel structure per the pallas TPU guide:
 Block sizes default to 128x128 (MXU-native); causal masking prunes whole
 K-blocks above the diagonal with pl.when, halving work for causal LMs.
 
-Backward pass: flash_attention is wrapped in jax.custom_vjp whose backward
-recomputes attention blockwise in plain JAX (O(T) memory via jax.checkpoint-
-style recompute); a fused pallas backward is future work.
+Backward pass: fused pallas kernels (FlashAttention-2 recurrence). The
+forward additionally emits the per-row logsumexp L = m + log(l); the
+backward recomputes P = exp(S - L) blockwise from the saved Q/K/V and runs
+two kernels — one accumulating dQ over K-blocks, one accumulating dK/dV
+over Q-blocks — so backward HBM traffic is O(T*D) like the forward and all
+four matmuls per block pair hit the MXU. delta = rowsum(dO * O) is
+recomputed in-block from the O/dO tiles each kernel already holds (cheaper
+than materializing a second lane-broadcast residual array).
 
 Use ops.attention.flash_attention — it dispatches pallas-on-TPU / reference
-elsewhere. `interpret=True` runs the same kernel on CPU for tests.
+elsewhere. `interpret=True` runs the same kernels on CPU for tests.
 """
 
 from __future__ import annotations
@@ -40,9 +45,15 @@ NEG_INF = -1e30
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-    *, sm_scale: float, causal: bool, block_q: int, block_k: int, seq_k: int,
+    q_ref, k_ref, v_ref, o_ref, *rest,
+    sm_scale: float, causal: bool, block_q: int, block_k: int, seq_k: int,
+    save_lse: bool,
 ):
+    if save_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        m_scr, l_scr, acc_scr = rest
+        lse_ref = None
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     last_k = pl.num_programs(2) - 1
@@ -96,16 +107,38 @@ def _fwd_kernel(
 
     @pl.when(kj == last_k)
     def _finalize():
+        m = m_scr[:, 0]
         l = l_scr[:, 0]
+        if lse_ref is not None:
+            # lse is the backward's residual: P = exp(S - lse) reconstructs
+            # normalized probabilities blockwise. NEG_INF marks fully-masked
+            # rows. Lane-broadcast to 128 because Mosaic requires the last
+            # block dim be 128-divisible (the official TPU kernel does the
+            # same).
+            lse = jnp.where(
+                l == 0.0, NEG_INF, m + jnp.log(jnp.where(l == 0.0, 1.0, l))
+            )
+            lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _check_pltpu() -> None:
+    if not _HAS_PLTPU:
+        raise RuntimeError(
+            "pallas TPU backend unavailable; use ops.attention.flash_attention "
+            "which falls back to the reference implementation"
+        )
 
 
 def _flash_fwd(
     q: jax.Array, k: jax.Array, v: jax.Array,
     causal: bool, block_q: int, block_k: int, interpret: bool,
-) -> jax.Array:
-    """q,k,v: [BH, T, D] (batch*heads flattened)."""
+    save_residuals: bool = True,
+):
+    """q,k,v: [BH, T, D] (batch*heads flattened). Returns (o, lse) with
+    lse [BH, T, 128] lane-replicated f32, or (o, None) when
+    save_residuals=False (eval/inference: skips the lse HBM writes)."""
     bh, t, d = q.shape
     tk = k.shape[1]
     sm_scale = 1.0 / (d**0.5)
@@ -115,37 +148,243 @@ def _flash_fwd(
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_k=tk,
+        block_q=block_q, block_k=block_k, seq_k=tk, save_lse=save_residuals,
     )
+    _check_pltpu()
     kwargs = {}
-    if _HAS_PLTPU and not interpret:
+    if not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
-        )
-    if not _HAS_PLTPU:
-        raise RuntimeError(
-            "pallas TPU backend unavailable; use ops.attention.flash_attention "
-            "which falls back to the reference implementation"
         )
     scratch = [
         pltpu.VMEM((block_q, 128), jnp.float32),
         pltpu.VMEM((block_q, 128), jnp.float32),
         pltpu.VMEM((block_q, d), jnp.float32),
     ]
-    return pl.pallas_call(
+    o_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    out_specs = [o_spec]
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    if save_residuals:
+        out_specs.append(
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+        )
+        out_shape.append(jax.ShapeDtypeStruct((bh, t, 128), jnp.float32))
+    out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            o_spec,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=scratch,
         interpret=interpret,
         **kwargs,
     )(q, k, v)
+    return (out[0], out[1]) if save_residuals else (out[0], None)
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_scr,
+    *, sm_scale: float, causal: bool, block_q: int, block_k: int, seq_k: int,
+):
+    """dQ pass: grid (BH, q-blocks, k-blocks), k sequential.
+    dQ_i = scale * sum_j [P_ij ∘ (dO_i V_j^T - delta_i)] K_j  (FA-2 eq. 13),
+    delta_i = rowsum(dO_i ∘ O_i) computed in-block (cheaper than a second
+    lane-broadcast residual array)."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    last_k = pl.num_programs(2) - 1
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]  # (BQ,) f32, lane-replicated residual
+        delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1)  # (BQ,)
+
+        # Zero padded tail rows of K/V: p and ds are 0 at those columns, but
+        # the 0 * <pad garbage> inside dp and ds@K would still poison the
+        # accumulator (0*NaN=NaN).
+        kv_row = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+        k = jnp.where(kv_row < seq_k, k, 0)
+        v = jnp.where(kv_row < seq_k, v, 0)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = k_pos < seq_k
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
+
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where((lse <= NEG_INF)[:, None], 0.0, p)  # fully-masked rows
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(kj * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kj == last_k)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, sm_scale: float, causal: bool, block_q: int, block_k: int,
+    seq_q: int, seq_k: int,
+):
+    """dK/dV pass: grid (BH, k-blocks, q-blocks), q sequential.
+    dV_j = sum_i P_ij^T dO_i;  dK_j = scale * sum_i dS_ij^T Q_i."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    last_q = pl.num_programs(2) - 1
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1)
+
+        # Padded tail rows accumulate into dk/dv through the contractions
+        # below; zero the garbage at the source (0*NaN=NaN otherwise).
+        q_row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, q.shape, 0)
+        q = jnp.where(q_row < seq_q, q, 0)
+        v_row = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+        v = jnp.where(v_row < seq_k, v, 0)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # Unlike the fwd (whose padded-tail q rows fall outside the output),
+        # garbage q rows here would ACCUMULATE into dk/dv — mask them too.
+        valid = (k_pos < seq_k) & (q_pos < seq_q)
+        if causal:
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
+
+        row_ok = (lse > NEG_INF) & (
+            qi * block_q + jax.lax.broadcasted_iota(jnp.int32, lse.shape, 0) < seq_q
+        )
+        p = jnp.exp(s - jnp.where(row_ok, lse, 0.0)[:, None])
+        p = jnp.where(valid & row_ok[:, None], p, 0.0)
+        do = jnp.where(row_ok[:, None], do, 0.0)  # padded reads may be junk
+
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - jnp.where(row_ok, delta, 0.0)[:, None]) * sm_scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # Skip Q-blocks entirely before this K-block (no q >= k pairs).
+        pl.when(qi * block_q + block_q - 1 >= kj * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == last_q)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(
+    q: jax.Array, k: jax.Array, v: jax.Array, o: jax.Array, lse: jax.Array,
+    do: jax.Array, causal: bool, block_q: int, block_k: int, interpret: bool,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused backward on [BH, T, D] operands; returns (dq, dk, dv)."""
+    bh, t, d = q.shape
+    tk = k.shape[1]
+    sm_scale = 1.0 / (d**0.5)
+    block_q = min(block_q, t)
+    block_k = min(block_k, tk)
+    _check_pltpu()
+
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kv_spec_q = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    lse_spec_q = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_k=tk,
+        ),
+        grid=(bh, pl.cdiv(t, block_q), pl.cdiv(tk, block_k)),
+        in_specs=[q_spec, kv_spec_q, kv_spec_q, q_spec, q_spec, lse_spec_q],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v, o, do, lse)
+
+    # dK/dV: k-blocks parallel, q-blocks sequential (block index roles swap).
+    q_spec_k = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kv_spec_k = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    lse_spec_k = pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_q=t, seq_k=tk,
+        ),
+        grid=(bh, pl.cdiv(tk, block_k), pl.cdiv(t, block_q)),
+        in_specs=[q_spec_k, kv_spec_k, kv_spec_k, q_spec_k, q_spec_k, lse_spec_k],
+        out_specs=[kv_spec_k, kv_spec_k],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v, o, do, lse)
+    return dq, dk, dv
 
 
 @functools.partial(
@@ -156,27 +395,36 @@ def flash_attention_pallas(
     causal: bool = False, block_q: int = 128, block_k: int = 128,
     interpret: bool = False,
 ) -> jax.Array:
-    """[B, H, T, D] fused attention; differentiable (recompute backward)."""
+    """[B, H, T, D] fused attention; differentiable (fused pallas backward).
+    The primal (eval/inference) skips the lse residual entirely."""
     b, h, t, d = q.shape
     flat = lambda x: x.reshape(b * h, x.shape[2], d)  # noqa: E731
-    o = _flash_fwd(flat(q), flat(k), flat(v), causal, block_q, block_k, interpret)
+    o, _ = _flash_fwd(
+        flat(q), flat(k), flat(v), causal, block_q, block_k, interpret,
+        save_residuals=False,
+    )
     return o.reshape(b, h, t, d)
 
 
 def _fwd_rule(q, k, v, causal, block_q, block_k, interpret):
-    o = flash_attention_pallas(q, k, v, causal, block_q, block_k, interpret)
-    return o, (q, k, v)
+    b, h, t, d = q.shape
+    flat = lambda x: x.reshape(b * h, x.shape[2], d)  # noqa: E731
+    o, lse = _flash_fwd(
+        flat(q), flat(k), flat(v), causal, block_q, block_k, interpret
+    )
+    return o.reshape(b, h, t, d), (q, k, v, o, lse)
 
 
 def _bwd_rule(causal, block_q, block_k, interpret, res, g):
-    """Recompute-based backward: differentiate the reference implementation
-    (memory O(T^2) only for the local shard; a fused pallas bwd is future
-    work — numerics are exact either way)."""
-    from tf_operator_tpu.parallel.ring_attention import attention_reference
-
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: attention_reference(q, k, v, causal), q, k, v)
-    return vjp(g)
+    q, k, v, o_flat, lse = res
+    b, h, t, d = q.shape
+    flat = lambda x: x.reshape(b * h, x.shape[2], d)  # noqa: E731
+    dq, dk, dv = _flash_bwd(
+        flat(q), flat(k), flat(v), o_flat, lse, flat(g),
+        causal, block_q, block_k, interpret,
+    )
+    unflat = lambda x: x.reshape(b, h, x.shape[1], d)  # noqa: E731
+    return unflat(dq), unflat(dk), unflat(dv)
 
 
 flash_attention_pallas.defvjp(_fwd_rule, _bwd_rule)

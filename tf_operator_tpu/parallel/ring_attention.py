@@ -144,7 +144,11 @@ def make_attention_fn(
     mesh: Mesh | None, causal: bool = False, axis_name: str = "sp"
 ) -> Callable:
     """Attention callable for model code: ring when the mesh has a >1 sp
-    axis, plain reference otherwise."""
+    axis, otherwise the ops.attention dispatcher (pallas flash kernel on
+    TPU when shapes qualify, reference elsewhere)."""
     if mesh is not None and axis_name in mesh.axis_names and mesh.shape[axis_name] > 1:
         return functools.partial(ring_attention, mesh=mesh, causal=causal, axis_name=axis_name)
-    return functools.partial(attention_reference, causal=causal)
+    # Lazy import: ops.attention imports this module for the reference impl.
+    from tf_operator_tpu.ops.attention import flash_attention
+
+    return functools.partial(flash_attention, causal=causal)
