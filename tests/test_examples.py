@@ -121,6 +121,70 @@ class TestCheckpointProtocol:
         assert ckpt.wait_for_new_step(d, seen, timeout=30) is None
 
 
+class TestResume:
+    """Crash/restart recovery: a restarted trainer must CONTINUE the
+    trajectory from the latest full-state checkpoint, and the resumed run
+    must match the uninterrupted one (RNG streams key off the global step)."""
+
+    def _run(self, d: str, metrics_file: str, steps: int, monkeypatch):
+        from tf_operator_tpu.models import train as train_mod
+
+        monkeypatch.setenv("TPUJOB_METRICS_FILE", metrics_file)
+        rc = train_mod.main([
+            "--model", "mnist-mlp", "--steps", str(steps), "--batch", "8",
+            "--checkpoint-dir", d, "--checkpoint-every", "2",
+            "--log-every", "2",
+        ])
+        assert rc == 0
+
+    @staticmethod
+    def _events(metrics_file: str) -> list[dict]:
+        import json
+
+        with open(metrics_file) as f:
+            return [json.loads(ln) for ln in f if ln.strip()]
+
+    def test_resume_continues_and_matches(self, tmp_path, monkeypatch):
+        from tf_operator_tpu.models import checkpoint as ckpt
+
+        # Uninterrupted 8-step run.
+        d_full = str(tmp_path / "full")
+        m_full = str(tmp_path / "full.jsonl")
+        self._run(d_full, m_full, 8, monkeypatch)
+        assert ckpt.final_step(d_full) == 8
+
+        # 4 steps, "crash", then re-run asking for 8: must resume from 4.
+        d_res = str(tmp_path / "resumed")
+        m_res = str(tmp_path / "res.jsonl")
+        self._run(d_res, m_res, 4, monkeypatch)
+        assert ckpt.latest_step(d_res) == 4
+        self._run(d_res, m_res, 8, monkeypatch)
+
+        ev = self._events(m_res)
+        resumed = [e for e in ev if e["event"] == "resumed"]
+        assert resumed and resumed[0]["from_step"] == 4
+        assert ckpt.final_step(d_res) == 8
+
+        # Same final loss as the uninterrupted trajectory.
+        loss_full = [e for e in self._events(m_full) if e["event"] == "done"][-1]
+        loss_res = [e for e in ev if e["event"] == "done"][-1]
+        assert loss_full["final_loss"] == pytest.approx(
+            loss_res["final_loss"], rel=1e-5
+        )
+
+    def test_resume_past_target_is_idempotent(self, tmp_path, monkeypatch):
+        from tf_operator_tpu.models import checkpoint as ckpt
+
+        d = str(tmp_path / "idem")
+        m = str(tmp_path / "idem.jsonl")
+        self._run(d, m, 4, monkeypatch)
+        # Operator restarts the pod with the same command: no retraining.
+        self._run(d, m, 4, monkeypatch)
+        ev = self._events(m)
+        assert any(e.get("resumed_complete") for e in ev if e["event"] == "done")
+        assert ckpt.final_step(d) == 4
+
+
 @pytest.mark.slow
 class TestChiefEvaluatorE2E:
     def test_bert_chief_evaluator_job(self, tmp_path):

@@ -28,15 +28,17 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
-def save(ckpt_dir: str, step: int, tree: Any) -> str:
-    """Atomically persist `tree` as step `step`; returns the checkpoint path."""
-    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+def save_named(ckpt_dir: str, name: str, tree: Any) -> str:
+    """Atomically persist `tree` under <dir>/<name>; returns the path."""
+    path = os.path.join(os.path.abspath(ckpt_dir), name)
     _checkpointer().save(path, tree, force=True)
     return path
 
 
-def restore(ckpt_dir: str, step: int, template: Any | None = None) -> Any:
-    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+def restore_named(ckpt_dir: str, name: str, template: Any | None = None) -> Any:
+    path = os.path.join(os.path.abspath(ckpt_dir), name)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(path)
     if template is not None:
         import orbax.checkpoint as ocp
 
@@ -44,6 +46,15 @@ def restore(ckpt_dir: str, step: int, template: Any | None = None) -> Any:
             path, restore_args=ocp.checkpoint_utils.construct_restore_args(template)
         )
     return _checkpointer().restore(path)
+
+
+def save(ckpt_dir: str, step: int, tree: Any) -> str:
+    """Atomically persist `tree` as step `step`; returns the checkpoint path."""
+    return save_named(ckpt_dir, f"step_{step}", tree)
+
+
+def restore(ckpt_dir: str, step: int, template: Any | None = None) -> Any:
+    return restore_named(ckpt_dir, f"step_{step}", template)
 
 
 def list_steps(ckpt_dir: str) -> list[int]:

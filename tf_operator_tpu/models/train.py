@@ -52,16 +52,80 @@ def _is_checkpoint_writer() -> bool:
     return not ("chief" in cluster or "master" in cluster)
 
 
+def _aux_tree(state) -> dict:
+    """Resume payload beyond params (optimizer moments, step counter,
+    mutable model state). The optax state is stored as a flat leaf list —
+    orbax does not round-trip namedtuple structure (tuples come back as
+    lists) — and the resume side rebuilds it with the freshly-initialized
+    state's treedef."""
+    import jax
+
+    tree = {
+        "step": state.step,
+        "opt_leaves": list(jax.tree.leaves(state.opt_state)),
+    }
+    if state.model_state:
+        tree["model_state"] = state.model_state
+    return tree
+
+
 def _save_checkpoint(ckpt_dir: str, step: int, state, final: bool = False) -> None:
+    """step_<N> holds params ONLY (the evaluator/external contract — cheap
+    to restore, format-compatible with hand-written checkpoints);
+    trainstate_<N> holds the resume payload. The aux dir is written first
+    so any visible step_<N> has its trainstate beside it."""
     import jax
 
     from tf_operator_tpu.models import checkpoint as ckpt
 
-    params = jax.device_get(state.params)
-    path = ckpt.save(ckpt_dir, step, params)
+    ckpt.save_named(ckpt_dir, f"trainstate_{step}", jax.device_get(_aux_tree(state)))
+    path = ckpt.save(ckpt_dir, step, jax.device_get(state.params))
     if final:
         ckpt.mark_final(ckpt_dir, step)
     _emit({"event": "checkpoint", "step": step, "path": path, "final": final})
+
+
+def _try_resume(ckpt_dir: str | None, state):
+    """Restore the latest checkpoint, if any. Returns (state, start_step).
+    The reference's contract was 'stable pod identity + restart semantics so
+    TF can resume from its own checkpoints' (SURVEY.md §5); here the trainer
+    itself resumes, so a pod restarted by the operator's restart policy
+    continues the trajectory instead of starting over. A step_<N> without a
+    trainstate_<N> (external/hand-written checkpoint) resumes params-only
+    with a fresh optimizer."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models import checkpoint as ckpt
+    from tf_operator_tpu.parallel.train_step import TrainState
+
+    if not ckpt_dir:
+        return state, 0
+    last = ckpt.latest_step(ckpt_dir)
+    if not last:
+        return state, 0
+    params = ckpt.restore(ckpt_dir, last, template=jax.device_get(state.params))
+    step_arr = jnp.asarray(last, jnp.int32)
+    opt_state, model_state, partial = state.opt_state, state.model_state, True
+    try:
+        aux = ckpt.restore_named(
+            ckpt_dir, f"trainstate_{last}", template=jax.device_get(_aux_tree(state))
+        )
+    except (FileNotFoundError, ValueError):
+        pass  # params-only checkpoint: fresh optimizer, step from the dir name
+    else:
+        step_arr = jnp.asarray(aux["step"], jnp.int32)
+        opt_state = jax.tree.unflatten(
+            jax.tree.structure(state.opt_state), aux["opt_leaves"]
+        )
+        model_state = aux.get("model_state", state.model_state)
+        partial = False
+    state = TrainState(
+        step=step_arr, params=params, opt_state=opt_state, model_state=model_state
+    )
+    start = int(step_arr)
+    _emit({"event": "resumed", "from_step": start, "params_only": partial})
+    return state, start
 
 
 def _run_evaluator(args, model, params_template, make_batch, loss_fn) -> int:
@@ -295,7 +359,20 @@ def main(argv: list[str] | None = None) -> int:
     saver = _is_checkpoint_writer() and args.checkpoint_dir
 
     tx = optax.adamw(args.lr)
-    state = shard_state(create_train_state(params, tx, model_state), mesh, rules)
+    state = create_train_state(params, tx, model_state)
+    state, start_step = _try_resume(args.checkpoint_dir, state)
+    state = shard_state(state, mesh, rules)
+    if start_step >= args.steps:
+        # Already trained to (or past) the target: restart policies must be
+        # idempotent, not retrain.
+        from tf_operator_tpu.models import checkpoint as ckpt_lib
+
+        if ckpt_lib.final_step(args.checkpoint_dir) is None and saver:
+            ckpt_lib.mark_final(args.checkpoint_dir, start_step)
+        _emit({"event": "done", "steps": start_step, "steady_steps_per_sec": None,
+               "examples_per_sec": None, "final_loss": None,
+               "total_s": round(time.time() - t_start, 3), "resumed_complete": True})
+        return 0
     compile_scanned = make_scanned_train_step(
         loss_fn, tx, mesh, make_batch, rules=rules
     )
@@ -304,14 +381,19 @@ def main(argv: list[str] | None = None) -> int:
     # a tunneled chip otherwise dominate small-model step time. The chunk
     # honors the checkpoint cadence EXACTLY (gcd, so chunk boundaries land
     # on every multiple of checkpoint_every even when log_every doesn't
-    # divide it).
+    # divide it). RNG streams key off the GLOBAL step, so a resumed run
+    # reproduces the uninterrupted trajectory.
     import math
 
-    chunk = max(1, min(args.log_every, args.steps))
-    if saver and args.checkpoint_every:
+    # Chunk derives from flags only (identical on every replica): gating on
+    # the local checkpoint-writer role would give chief and workers
+    # different scan unrolls — divergent SPMD programs across one
+    # jax.distributed job.
+    chunk = max(1, min(args.log_every, args.steps - start_step))
+    if args.checkpoint_dir and args.checkpoint_every:
         chunk = max(1, math.gcd(chunk, args.checkpoint_every))
     step_chunk = compile_scanned(state, chunk)
-    ckpt_marks = 0
+    ckpt_marks = (start_step // args.checkpoint_every) if args.checkpoint_every else 0
 
     def maybe_checkpoint(done: int) -> None:
         nonlocal ckpt_marks
@@ -325,7 +407,7 @@ def main(argv: list[str] | None = None) -> int:
     state, metrics = step_chunk(state)
     jax.block_until_ready(metrics["loss"])
     t_first = time.time()
-    done = chunk
+    done = start_step + chunk
     _emit(
         {
             "event": "first_step",
