@@ -1,0 +1,103 @@
+"""Real multi-process jax.distributed training through the operator.
+
+The reference's distributed contract was TF_CONFIG -> TensorFlow gRPC mesh ->
+NCCL collectives inside user containers (SURVEY.md §2 "Distributed
+communication backend"); its E2E suites only verified the INJECTED config,
+never a live collective fabric. This suite goes further: two worker pods
+form ONE jax.distributed runtime from the operator-injected env
+(JAX_COORDINATOR_ADDRESS / PROCESS_ID / NUM_PROCESSES, DNS rewritten to
+localhost ports by the runtime), build a global dp=2 mesh spanning both
+processes, and run real cross-process gradient all-reduces for every
+optimizer step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+from tf_operator_tpu.api import defaults
+from tf_operator_tpu.api.types import (
+    ContainerSpec,
+    JobConditionType,
+    MeshSpec,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    ReplicaType,
+    TrainJob,
+    TrainJobSpec,
+    is_succeeded,
+)
+from tf_operator_tpu.runtime.session import LocalSession
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class TestJaxDistributedE2E:
+    def test_two_process_dp_training(self, tmp_path):
+        """2 worker pods -> one 2-device global mesh -> dp training to
+        completion. n_devices==2 in the trainer's telemetry proves the
+        processes actually joined one runtime (each pod is pinned to a
+        single local CPU device)."""
+        metrics_file = str(tmp_path / "events.jsonl")
+        cmd = [
+            sys.executable, "-m", "tf_operator_tpu.models.train",
+            "--model", "mnist-mlp", "--steps", "4", "--batch", "8",
+            "--log-every", "2",
+        ]
+        job = TrainJob(
+            metadata=ObjectMeta(name="dist-dp2"),
+            spec=TrainJobSpec(
+                replica_specs={
+                    ReplicaType.WORKER: ReplicaSpec(
+                        replicas=2,
+                        template=PodTemplateSpec(
+                            containers=[
+                                ContainerSpec(
+                                    name="tensorflow", image="local", command=cmd
+                                )
+                            ]
+                        ),
+                    )
+                },
+                mesh=MeshSpec(axes={"dp": 2}),
+            ),
+        )
+        defaults.set_defaults(job)
+        job.spec.run_policy.scheduling.gang = False
+
+        pythonpath = str(REPO)
+        if os.environ.get("PYTHONPATH"):
+            pythonpath += os.pathsep + os.environ["PYTHONPATH"]
+        with LocalSession(
+            env_overrides={
+                "PYTHONPATH": pythonpath,
+                "TPUJOB_METRICS_FILE": metrics_file,
+                # One local CPU device per process: the dp=2 mesh must span
+                # BOTH processes, not 8 virtual devices inside one.
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "JAX_PLATFORMS": "cpu",
+            },
+            log_dir=str(tmp_path / "logs"),
+        ) as s:
+            s.submit(job)
+            final = s.wait_for_condition(
+                "default", "dist-dp2",
+                (JobConditionType.SUCCEEDED, JobConditionType.FAILED),
+                timeout=420,
+            )
+            assert is_succeeded(final.status), final.status.conditions
+
+        with open(metrics_file) as f:
+            events = [json.loads(ln) for ln in f if ln.strip()]
+        first_steps = [e for e in events if e["event"] == "first_step"]
+        assert first_steps, events
+        # Both processes see the GLOBAL runtime: 2 devices, a dp=2 mesh.
+        for e in first_steps:
+            assert e["n_devices"] == 2, e
+            assert e["mesh"] == {"dp": 2}, e
+        dones = [e for e in events if e["event"] == "done"]
+        assert dones and all(e["steps"] == 4 for e in dones)

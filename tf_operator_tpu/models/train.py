@@ -102,7 +102,7 @@ def _try_resume(ckpt_dir: str | None, state):
     if not ckpt_dir:
         return state, 0
     last = ckpt.latest_step(ckpt_dir)
-    if not last:
+    if last is None:  # step_0 is a valid (externally seeded) checkpoint
         return state, 0
     params = ckpt.restore(ckpt_dir, last, template=jax.device_get(state.params))
     step_arr = jnp.asarray(last, jnp.int32)
@@ -124,6 +124,21 @@ def _try_resume(ckpt_dir: str | None, state):
         step=step_arr, params=params, opt_state=opt_state, model_state=model_state
     )
     start = int(step_arr)
+    if jax.process_count() > 1:
+        # Every replica independently reads the checkpoint dir; if visibility
+        # differs (non-shared volume, storage lag) the replicas would resume
+        # divergent states AND compile different scan unrolls — mismatched
+        # collectives hang the job. Fail loudly instead.
+        from jax.experimental import multihost_utils
+        import numpy as np
+
+        agreed = int(multihost_utils.broadcast_one_to_all(np.int32(start)))
+        if agreed != start:
+            raise RuntimeError(
+                f"checkpoint visibility differs across replicas (this process "
+                f"sees step {start}, process 0 sees {agreed}) — mount a "
+                f"shared --checkpoint-dir volume"
+            )
     _emit({"event": "resumed", "from_step": start, "params_only": partial})
     return state, start
 
@@ -199,6 +214,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="evaluator mode: poll --checkpoint-dir, restore and "
                          "evaluate each new checkpoint until FINAL")
     ap.add_argument("--eval-timeout", type=float, default=600.0)
+    ap.add_argument("--profile-dir", default=None,
+                    help="write a jax.profiler (XProf/TensorBoard) trace of "
+                         "the steady-state window to this directory")
     args = ap.parse_args(argv)
 
     t_start = time.time()
@@ -367,7 +385,8 @@ def main(argv: list[str] | None = None) -> int:
         # idempotent, not retrain.
         from tf_operator_tpu.models import checkpoint as ckpt_lib
 
-        if ckpt_lib.final_step(args.checkpoint_dir) is None and saver:
+        if (saver and start_step > 0
+                and ckpt_lib.final_step(args.checkpoint_dir) is None):
             ckpt_lib.mark_final(args.checkpoint_dir, start_step)
         _emit({"event": "done", "steps": start_step, "steady_steps_per_sec": None,
                "examples_per_sec": None, "final_loss": None,
@@ -427,6 +446,19 @@ def main(argv: list[str] | None = None) -> int:
     # runs AFTER dt is captured so compilation never pollutes throughput.
     full_chunks = (args.steps - done) // chunk
     tail = (args.steps - done) % chunk
+    profiling = bool(args.profile_dir) and full_chunks > 0
+    if profiling:
+        # Device-level trace of the steady window (the reference delegated
+        # all profiling to cAdvisor/Prometheus node metrics — SURVEY.md §5;
+        # this is the TPU-native equivalent: per-op XProf timelines).
+        # Replica type+index is unique per pod in every regime (chief-0 and
+        # worker-0 differ by type; non-distributed local pods have no
+        # distinct jax.process_index()).
+        rank = (f"{os.environ.get('TPUJOB_REPLICA_TYPE') or 'local'}-"
+                f"{os.environ.get('TPUJOB_REPLICA_INDEX', '0')}")
+        trace_dir = os.path.join(args.profile_dir, rank)
+        jax.profiler.start_trace(trace_dir)
+        _emit({"event": "profile_start", "dir": trace_dir})
     t0 = time.time()
     for _ in range(full_chunks):
         state, metrics = step_chunk(state)
@@ -441,6 +473,10 @@ def main(argv: list[str] | None = None) -> int:
     jax.block_until_ready(metrics["loss"])
     dt = time.time() - t0
     steady = full_chunks * chunk
+    if profiling:
+        jax.profiler.stop_trace()
+        _emit({"event": "profile_done", "dir": args.profile_dir,
+               "steps_traced": steady})
 
     if tail:
         state, metrics = compile_scanned(state, tail)(state)
