@@ -36,63 +36,67 @@ from tf_operator_tpu.runtime.session import LocalSession
 REPO = Path(__file__).resolve().parent.parent
 
 
+def run_distributed_job(tmp_path, name: str, cmd: list[str]) -> list[dict]:
+    """Submit a 2-worker dp=2 TrainJob running `cmd`, wait for success, and
+    return the parsed trainer events. Shared scaffolding for every scenario
+    in this suite (one local CPU device per process so the mesh must span
+    both)."""
+    metrics_file = str(tmp_path / f"{name}-events.jsonl")
+    job = TrainJob(
+        metadata=ObjectMeta(name=name),
+        spec=TrainJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=2,
+                    template=PodTemplateSpec(
+                        containers=[
+                            ContainerSpec(
+                                name="tensorflow", image="local", command=cmd
+                            )
+                        ]
+                    ),
+                )
+            },
+            mesh=MeshSpec(axes={"dp": 2}),
+        ),
+    )
+    defaults.set_defaults(job)
+    job.spec.run_policy.scheduling.gang = False
+
+    pythonpath = str(REPO)
+    if os.environ.get("PYTHONPATH"):
+        pythonpath += os.pathsep + os.environ["PYTHONPATH"]
+    with LocalSession(
+        env_overrides={
+            "PYTHONPATH": pythonpath,
+            "TPUJOB_METRICS_FILE": metrics_file,
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "JAX_PLATFORMS": "cpu",
+        },
+        log_dir=str(tmp_path / "logs"),
+    ) as s:
+        s.submit(job)
+        final = s.wait_for_condition(
+            "default", name,
+            (JobConditionType.SUCCEEDED, JobConditionType.FAILED),
+            timeout=420,
+        )
+        assert is_succeeded(final.status), final.status.conditions
+
+    with open(metrics_file) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
 class TestJaxDistributedE2E:
     def test_two_process_dp_training(self, tmp_path):
         """2 worker pods -> one 2-device global mesh -> dp training to
         completion. n_devices==2 in the trainer's telemetry proves the
-        processes actually joined one runtime (each pod is pinned to a
-        single local CPU device)."""
-        metrics_file = str(tmp_path / "events.jsonl")
-        cmd = [
+        processes actually joined one runtime."""
+        events = run_distributed_job(tmp_path, "dist-dp2", [
             sys.executable, "-m", "tf_operator_tpu.models.train",
             "--model", "mnist-mlp", "--steps", "4", "--batch", "8",
             "--log-every", "2",
-        ]
-        job = TrainJob(
-            metadata=ObjectMeta(name="dist-dp2"),
-            spec=TrainJobSpec(
-                replica_specs={
-                    ReplicaType.WORKER: ReplicaSpec(
-                        replicas=2,
-                        template=PodTemplateSpec(
-                            containers=[
-                                ContainerSpec(
-                                    name="tensorflow", image="local", command=cmd
-                                )
-                            ]
-                        ),
-                    )
-                },
-                mesh=MeshSpec(axes={"dp": 2}),
-            ),
-        )
-        defaults.set_defaults(job)
-        job.spec.run_policy.scheduling.gang = False
-
-        pythonpath = str(REPO)
-        if os.environ.get("PYTHONPATH"):
-            pythonpath += os.pathsep + os.environ["PYTHONPATH"]
-        with LocalSession(
-            env_overrides={
-                "PYTHONPATH": pythonpath,
-                "TPUJOB_METRICS_FILE": metrics_file,
-                # One local CPU device per process: the dp=2 mesh must span
-                # BOTH processes, not 8 virtual devices inside one.
-                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
-                "JAX_PLATFORMS": "cpu",
-            },
-            log_dir=str(tmp_path / "logs"),
-        ) as s:
-            s.submit(job)
-            final = s.wait_for_condition(
-                "default", "dist-dp2",
-                (JobConditionType.SUCCEEDED, JobConditionType.FAILED),
-                timeout=420,
-            )
-            assert is_succeeded(final.status), final.status.conditions
-
-        with open(metrics_file) as f:
-            events = [json.loads(ln) for ln in f if ln.strip()]
+        ])
         first_steps = [e for e in events if e["event"] == "first_step"]
         assert first_steps, events
         # Both processes see the GLOBAL runtime: 2 devices, a dp=2 mesh.
@@ -101,3 +105,31 @@ class TestJaxDistributedE2E:
             assert e["mesh"] == {"dp": 2}, e
         dones = [e for e in events if e["event"] == "done"]
         assert dones and all(e["steps"] == 4 for e in dones)
+
+    def test_two_process_real_data(self, tmp_path):
+        """Distributed training on a REAL sharded dataset: each pod reads
+        its own disjoint shards (shard_from_env) and contributes its slice
+        of the global batch via make_array_from_process_local_data."""
+        import numpy as np
+
+        from tf_operator_tpu.data import write_array_shards
+
+        rng = np.random.default_rng(0)
+        data_dir = str(tmp_path / "ds")
+        write_array_shards(
+            data_dir,
+            {
+                "x": rng.normal(size=(64, 28, 28)).astype(np.float32),
+                "y": rng.integers(0, 10, size=(64,)).astype(np.int32),
+            },
+            num_shards=4,
+        )
+        events = run_distributed_job(tmp_path, "dist-data", [
+            sys.executable, "-m", "tf_operator_tpu.models.train",
+            "--model", "mnist-mlp", "--steps", "4", "--batch", "16",
+            "--data-dir", data_dir, "--log-every", "2",
+        ])
+        firsts = [e for e in events if e["event"] == "first_step"]
+        # Each process reads half the dataset (2 of 4 shards = 32 samples).
+        assert firsts and all(e["local_samples"] == 32 for e in firsts)
+        assert all(e["n_devices"] == 2 for e in firsts)

@@ -1,0 +1,6 @@
+from tf_operator_tpu.data.dataset import (  # noqa: F401
+    ShardedDataset,
+    shard_from_env,
+    write_array_shards,
+)
+from tf_operator_tpu.data.prefetch import prefetch_to_device  # noqa: F401

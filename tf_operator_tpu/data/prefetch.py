@@ -1,0 +1,98 @@
+"""Host->device prefetch: overlap input transfer with the training step.
+
+HBM-feeding is the classic TPU input bottleneck: if device_put happens on
+the same thread that dispatches the step, the chip idles for the transfer
+every step. A small background thread keeps `depth` batches already resident
+on device (optionally sharded over the mesh's data axes), so the train loop
+dequeues device arrays and the transfer of batch i+depth rides under the
+compute of batch i.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator
+
+
+class _Stop:
+    pass
+
+
+def prefetch_to_device(
+    it: Iterator[Any], depth: int = 2, sharding=None
+) -> Iterator[Any]:
+    """Wrap a host-batch iterator; yields batches already on device.
+
+    sharding: optional jax.sharding.Sharding applied via device_put (e.g.
+    mesh_lib.batch_sharding(mesh)); None leaves placement to jax.
+    """
+    import jax
+
+    if depth < 1:
+        raise ValueError("depth must be >= 1")
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    err: list[BaseException] = []
+
+    multiproc = jax.process_count() > 1
+
+    def to_device(batch):
+        if sharding is not None and multiproc:
+            # Each process contributes its local slice of the global batch.
+            return jax.tree.map(
+                lambda x: jax.make_array_from_process_local_data(sharding, x),
+                batch,
+            )
+        if sharding is not None:
+            return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+        return jax.tree.map(jax.device_put, batch)
+
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for batch in it:
+                if stop.is_set():
+                    return
+                batch = to_device(batch)
+                while not stop.is_set():
+                    try:
+                        q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — surfaced to the consumer
+            err.append(e)
+        finally:
+            # The sentinel must be DELIVERED on normal completion (a full
+            # queue would otherwise drop it and strand the consumer in
+            # q.get); bail only when the consumer signalled abandonment.
+            while not stop.is_set():
+                try:
+                    q.put(_Stop, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    t = threading.Thread(target=worker, daemon=True, name="prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _Stop:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        # Consumer abandoned the iterator (e.g. the trainer pulled exactly
+        # `steps` batches from an endless dataset): unblock and end the
+        # worker so it doesn't pin device buffers forever.
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass

@@ -102,6 +102,24 @@ def _try_resume(ckpt_dir: str | None, state):
     if not ckpt_dir:
         return state, 0
     last = ckpt.latest_step(ckpt_dir)
+    if jax.process_count() > 1:
+        # Every replica independently reads the checkpoint dir; if visibility
+        # differs (non-shared volume, storage lag) the replicas would resume
+        # divergent states AND compile different scan unrolls — mismatched
+        # collectives hang the job. The agreement collective must run on
+        # EVERY process (sentinel -1 = sees nothing) BEFORE any early
+        # return, else the check itself deadlocks.
+        from jax.experimental import multihost_utils
+        import numpy as np
+
+        observed = -1 if last is None else last
+        agreed = int(multihost_utils.broadcast_one_to_all(np.int32(observed)))
+        if agreed != observed:
+            raise RuntimeError(
+                f"checkpoint visibility differs across replicas (this process "
+                f"sees step {observed}, process 0 sees {agreed}) — mount a "
+                f"shared --checkpoint-dir volume"
+            )
     if last is None:  # step_0 is a valid (externally seeded) checkpoint
         return state, 0
     params = ckpt.restore(ckpt_dir, last, template=jax.device_get(state.params))
@@ -124,21 +142,6 @@ def _try_resume(ckpt_dir: str | None, state):
         step=step_arr, params=params, opt_state=opt_state, model_state=model_state
     )
     start = int(step_arr)
-    if jax.process_count() > 1:
-        # Every replica independently reads the checkpoint dir; if visibility
-        # differs (non-shared volume, storage lag) the replicas would resume
-        # divergent states AND compile different scan unrolls — mismatched
-        # collectives hang the job. Fail loudly instead.
-        from jax.experimental import multihost_utils
-        import numpy as np
-
-        agreed = int(multihost_utils.broadcast_one_to_all(np.int32(start)))
-        if agreed != start:
-            raise RuntimeError(
-                f"checkpoint visibility differs across replicas (this process "
-                f"sees step {start}, process 0 sees {agreed}) — mount a "
-                f"shared --checkpoint-dir volume"
-            )
     _emit({"event": "resumed", "from_step": start, "params_only": partial})
     return state, start
 
@@ -191,6 +194,96 @@ def _run_evaluator(args, model, params_template, make_batch, loss_fn) -> int:
     return 0
 
 
+def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
+                      saver, t_start) -> int:
+    """Real-data loop: host batches from the sharded dataset, double-buffered
+    onto the device so the transfer of batch i+2 rides under the compute of
+    batch i. Each process reads its own shards (shard_from_env) and feeds
+    its slice of the GLOBAL batch."""
+    import jax
+
+    from tf_operator_tpu.data import (
+        ShardedDataset,
+        prefetch_to_device,
+        shard_from_env,
+    )
+    from tf_operator_tpu.parallel import mesh as mesh_lib
+    from tf_operator_tpu.parallel.train_step import make_train_step
+
+    nprocs = jax.process_count()
+    if args.batch % nprocs:
+        raise SystemExit(f"--batch {args.batch} not divisible by {nprocs} processes")
+    reader, readers = shard_from_env()
+    ds = ShardedDataset(args.data_dir, reader, readers)
+    # start_batch keeps a resumed run on the uninterrupted batch sequence
+    # (one local batch per global step).
+    it = prefetch_to_device(
+        ds.batches(args.batch // nprocs, seed=0, start_batch=start_step),
+        depth=2,
+        sharding=mesh_lib.batch_sharding(mesh),
+    )
+    _, compile_step = make_train_step(loss_fn, tx, mesh, rules=rules)
+
+    batch = next(it)
+    step = compile_step(state, batch)
+    state, metrics = step(state, batch, jax.random.key(start_step))
+    jax.block_until_ready(metrics["loss"])
+    t_first = time.time()
+    done = start_step + 1
+    _emit(
+        {
+            "event": "first_step",
+            "t": t_first,
+            "startup_s": round(t_first - t_start, 3),
+            "steps_in_first_call": 1,
+            "loss": float(metrics["loss"]),
+            "mesh": dict(mesh.shape),
+            "backend": jax.default_backend(),
+            "n_devices": len(jax.devices()),
+            "data_dir": args.data_dir,
+            "local_samples": ds.num_samples,
+        }
+    )
+    profiling = bool(args.profile_dir) and done < args.steps
+    if profiling:
+        rank = (f"{os.environ.get('TPUJOB_REPLICA_TYPE') or 'local'}-"
+                f"{os.environ.get('TPUJOB_REPLICA_INDEX', '0')}")
+        trace_dir = os.path.join(args.profile_dir, rank)
+        jax.profiler.start_trace(trace_dir)
+        _emit({"event": "profile_start", "dir": trace_dir})
+    t0 = time.time()
+    while done < args.steps:
+        state, metrics = step(state, next(it), jax.random.key(done))
+        done += 1
+        if done % args.log_every == 0 or done == args.steps:
+            _emit({"event": "progress", "step": done,
+                   "loss": float(metrics["loss"])})
+        if (saver and args.checkpoint_every and done < args.steps
+                and done % args.checkpoint_every == 0):
+            _save_checkpoint(args.checkpoint_dir, done, state)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.time() - t0
+    if profiling:
+        jax.profiler.stop_trace()
+        _emit({"event": "profile_done", "dir": args.profile_dir,
+               "steps_traced": args.steps - start_step - 1})
+    if saver:
+        _save_checkpoint(args.checkpoint_dir, args.steps, state, final=True)
+    steady = args.steps - start_step - 1
+    sps = round(steady / dt, 4) if steady > 0 else None
+    _emit(
+        {
+            "event": "done",
+            "steps": args.steps,
+            "steady_steps_per_sec": sps,
+            "examples_per_sec": round(steady * args.batch / dt, 2) if steady > 0 else None,
+            "final_loss": float(metrics["loss"]),
+            "total_s": round(time.time() - t_start, 3),
+        }
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -217,6 +310,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler (XProf/TensorBoard) trace of "
                          "the steady-state window to this directory")
+    ap.add_argument("--data-dir", default=None,
+                    help="train on a sharded on-disk dataset (data/dataset.py "
+                         "layout; keys must match the model's batch keys) "
+                         "instead of synthetic data; --batch is the GLOBAL "
+                         "batch, sharded across processes")
     args = ap.parse_args(argv)
 
     t_start = time.time()
@@ -392,6 +490,10 @@ def main(argv: list[str] | None = None) -> int:
                "examples_per_sec": None, "final_loss": None,
                "total_s": round(time.time() - t_start, 3), "resumed_complete": True})
         return 0
+    if args.data_dir:
+        return _train_on_dataset(args, state, start_step, loss_fn, tx, mesh,
+                                 rules, saver, t_start)
+
     compile_scanned = make_scanned_train_step(
         loss_fn, tx, mesh, make_batch, rules=rules
     )
