@@ -318,3 +318,105 @@ class TestScannedTrainStep:
             state, m = step(state)
         assert int(state.step) == 32
         assert float(m["loss"]) < float(m_first["loss"])
+
+
+class TestUlyssesAttention:
+    """All-to-all sequence parallelism (the second SP scheme next to ring)."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        from tf_operator_tpu.parallel.ulysses import ulysses_attention
+
+        m = mesh_lib.make_mesh({"sp": 8})
+        k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+        shape = (2, 8, 64, 32)  # H=8 divisible by sp=8
+        q, k, v = (jax.random.normal(kk, shape, jnp.float32) for kk in (k1, k2, k3))
+        expected = attention_reference(q, k, v, causal=causal)
+        got = ulysses_attention(q, k, v, mesh=m, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+    def test_mixed_mesh_axes(self):
+        from tf_operator_tpu.parallel.ulysses import ulysses_attention
+
+        m = mesh_lib.make_mesh({"dp": 2, "sp": 2, "tp": 2})
+        k1, k2, k3 = jax.random.split(jax.random.key(2), 3)
+        shape = (2, 4, 32, 16)  # local heads 4/tp2 = 2, divisible by sp=2
+        q, k, v = (jax.random.normal(kk, shape) for kk in (k1, k2, k3))
+        expected = attention_reference(q, k, v, causal=True)
+        got = ulysses_attention(q, k, v, mesh=m, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+    def test_grad_flows(self):
+        from tf_operator_tpu.parallel.ulysses import ulysses_attention
+
+        m = mesh_lib.make_mesh({"sp": 8})
+        q = jax.random.normal(jax.random.key(1), (1, 8, 32, 16))
+
+        def loss(q):
+            return jnp.sum(ulysses_attention(q, q, q, mesh=m, causal=True) ** 2)
+
+        def loss_ref(q):
+            return jnp.sum(attention_reference(q, q, q, causal=True) ** 2)
+
+        g = jax.grad(loss)(q)
+        gr = jax.grad(loss_ref)(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=2e-4)
+
+    def test_indivisible_heads_rejected(self):
+        from tf_operator_tpu.parallel.ulysses import ulysses_attention
+
+        m = mesh_lib.make_mesh({"sp": 8})
+        q = jnp.zeros((1, 4, 32, 16))  # 4 heads, sp=8
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention(q, q, q, mesh=m)
+
+    def test_mode_selection(self, monkeypatch):
+        from tf_operator_tpu.parallel.ulysses import sp_mode
+
+        m = mesh_lib.make_mesh({"dp": 2, "sp": 2, "tp": 2})
+        assert sp_mode(m, num_heads=8) == "ulysses"   # 8/tp2=4, 4%2==0
+        assert sp_mode(m, num_heads=2) == "ring"      # 2/tp2=1, 1%2!=0
+        assert sp_mode(None) == "ring"
+        monkeypatch.setenv("TPUJOB_SP_MODE", "ring")
+        assert sp_mode(m, num_heads=8) == "ring"
+
+    def test_train_step_via_make_attention_fn(self):
+        """TransformerLM train step over dp x sp with Ulysses selected
+        (TINY_LM heads divide by sp): loss must descend."""
+        from tf_operator_tpu.parallel.ring_attention import make_attention_fn
+        from tf_operator_tpu.parallel.ulysses import sp_mode
+
+        mesh = mesh_lib.make_mesh({"dp": 2, "sp": 4})
+        cfg = tfm.TINY_LM
+        assert sp_mode(mesh, cfg.num_heads) == "ulysses"
+        model = tfm.TransformerLM(cfg, attn_fn=make_attention_fn(mesh, causal=True))
+        params = tfm.TransformerLM(cfg).init(
+            jax.random.key(0), jnp.zeros((1, 32), jnp.int32)
+        )["params"]
+
+        def loss_fn(params, model_state, batch, rng):
+            logits = model.apply({"params": params}, batch["tokens"])
+            return tfm.lm_loss(logits, batch["tokens"]), model_state
+
+        tx = optax.adam(1e-3)
+        state = shard_state(create_train_state(params, tx), mesh,
+                            sharding_rules.TRANSFORMER_TP_RULES)
+        _, compile_step = make_train_step(
+            loss_fn, tx, mesh, rules=sharding_rules.TRANSFORMER_TP_RULES
+        )
+        batch = {"tokens": jax.random.randint(jax.random.key(1), (4, 32), 0,
+                                              cfg.vocab_size)}
+        step = compile_step(state, batch)
+        state, m0 = step(state, batch, jax.random.key(0))
+        for _ in range(8):
+            state, metrics = step(state, batch, jax.random.key(0))
+        assert float(metrics["loss"]) < float(m0["loss"])
+
+    def test_long_seq_prefers_ring(self, monkeypatch):
+        from tf_operator_tpu.parallel.ulysses import sp_mode
+
+        m = mesh_lib.make_mesh({"sp": 8})
+        assert sp_mode(m, num_heads=8, seq_len=4096) == "ulysses"
+        assert sp_mode(m, num_heads=8, seq_len=1 << 20) == "ring"
+        monkeypatch.setenv("TPUJOB_ULYSSES_MAX_SEQ", "2048")
+        assert sp_mode(m, num_heads=8, seq_len=4096) == "ring"

@@ -11,8 +11,9 @@ v5e (transformer-lm train step, 32k tokens/batch): XLA wins at T=256
 at 2048, +55% at 4096) and is the only path that compiles at T >= 8192.
 
 Model code should not import this directly — use
-parallel.ring_attention.make_attention_fn, which additionally routes to ring
-attention when the mesh has a sequence-parallel axis.
+parallel.ring_attention.make_attention_fn, which on meshes with a
+sequence-parallel axis auto-selects between ring attention and Ulysses
+all-to-all (parallel/ulysses.sp_mode) instead of calling this dispatcher.
 """
 
 from __future__ import annotations

@@ -114,6 +114,23 @@ def _ring_attention_sharded(
     return (o / l[..., None]).astype(q.dtype)
 
 
+def sp_shard_map(
+    body: Callable,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    batch_axes: tuple[str, ...] = ("dp", "fsdp"),
+    head_axis: str = "tp",
+):
+    """shard_map wrapper shared by every sequence-parallel attention scheme:
+    [B, H, T, D] with batch over dp/fsdp, heads over tp, sequence over sp."""
+    b_spec = tuple(a for a in batch_axes if a in mesh.axis_names) or None
+    h_spec = head_axis if head_axis in mesh.axis_names else None
+    spec = P(b_spec, h_spec, axis_name, None)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -128,14 +145,9 @@ def ring_attention(
     `axis_name`; batch over dp/fsdp and heads over tp when present."""
     if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
         return attention_reference(q, k, v, causal)
-    b_spec = tuple(a for a in batch_axes if a in mesh.axis_names) or None
-    h_spec = head_axis if head_axis in mesh.axis_names else None
-    spec = P(b_spec, h_spec, axis_name, None)
-    fn = jax.shard_map(
+    fn = sp_shard_map(
         functools.partial(_ring_attention_sharded, axis_name=axis_name, causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+        mesh, axis_name, batch_axes, head_axis,
     )
     return fn(q, k, v)
 
@@ -143,11 +155,25 @@ def ring_attention(
 def make_attention_fn(
     mesh: Mesh | None, causal: bool = False, axis_name: str = "sp"
 ) -> Callable:
-    """Attention callable for model code: ring when the mesh has a >1 sp
-    axis, otherwise the ops.attention dispatcher (pallas flash kernel on
-    TPU when shapes qualify, reference elsewhere)."""
+    """Attention callable for model code. With a >1 sp axis the scheme is
+    picked per head count: Ulysses all-to-all (full sequences through the
+    fused kernel) when heads divide by sp, ring otherwise — see
+    parallel/ulysses.sp_mode (TPUJOB_SP_MODE overrides). Without sp, the
+    ops.attention dispatcher (pallas flash kernel on TPU when shapes
+    qualify, reference elsewhere)."""
     if mesh is not None and axis_name in mesh.axis_names and mesh.shape[axis_name] > 1:
-        return functools.partial(ring_attention, mesh=mesh, causal=causal, axis_name=axis_name)
+        from tf_operator_tpu.parallel.ulysses import sp_mode, ulysses_attention
+
+        def sp_attn(q, k, v):
+            if sp_mode(mesh, q.shape[1], axis_name, seq_len=q.shape[2]) == "ulysses":
+                return ulysses_attention(
+                    q, k, v, mesh=mesh, causal=causal, axis_name=axis_name
+                )
+            return ring_attention(
+                q, k, v, mesh=mesh, causal=causal, axis_name=axis_name
+            )
+
+        return sp_attn
     # Lazy import: ops.attention imports this module for the reference impl.
     from tf_operator_tpu.ops.attention import flash_attention
 

@@ -1,0 +1,118 @@
+"""Ulysses-style sequence parallelism: all-to-all heads<->sequence resharding.
+
+The second canonical long-context scheme next to ring attention
+(parallel/ring_attention.py). Ring keeps K/V moving and computes blockwise;
+Ulysses (DeepSpeed-Ulysses, Jacobs et al. '23) instead RESHARDS: sequences
+arrive sharded over `sp` ([B, H, T/n, D] per device), one all-to-all turns
+them into full sequences for a head subset ([B, H/n, T, D]), attention runs
+UNSHARDED per local head — which is exactly where the fused pallas flash
+kernel (ops/attention.py) is strongest — and a second all-to-all restores
+sequence sharding.
+
+Trade-offs vs ring (why both exist):
+  - Ulysses moves Q, K, V and O once each (4 tensors, one shot over ICI);
+    ring moves K/V n-1 times but overlaps transfer under compute.
+  - Ulysses needs num_heads % sp == 0; ring has no head constraint.
+  - Ulysses computes attention on the FULL [T, T] extent per head locally —
+    perfect for the fused kernel; ring's blockwise math stays O(T/n) memory
+    per device. For very long T with few heads, ring; otherwise Ulysses.
+
+make_attention_fn picks per mesh/shape (TPUJOB_SP_MODE=ring|ulysses|auto
+overrides). Gradients need no code: jax.lax.all_to_all is linear, so AD
+transposes it into the reverse all-to-all.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ulysses_sharded(q, k, v, axis_name: str, causal: bool):
+    """Per-device body (under shard_map): q,k,v are [B, H, T/n, D] local
+    shards; returns the same-shape local output shard."""
+    from tf_operator_tpu.ops.attention import flash_attention
+
+    # heads -> devices, gathering the full sequence locally: [B, H/n, T, D].
+    def a2a_in(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    # and back: sequence -> devices, regathering all heads: [B, H, T/n, D].
+    def a2a_out(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    o = flash_attention(a2a_in(q), a2a_in(k), a2a_in(v), causal=causal)
+    return a2a_out(o)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = False,
+    batch_axes: tuple[str, ...] = ("dp", "fsdp"),
+    head_axis: str = "tp",
+) -> jax.Array:
+    """Exact attention with [B, H, T, D] inputs sequence-sharded over
+    `axis_name` (same contract as ring_attention). num_heads must divide by
+    the sp size (after any tp head sharding)."""
+    from tf_operator_tpu.parallel.ring_attention import (
+        attention_reference,
+        sp_shard_map,
+    )
+
+    if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        return attention_reference(q, k, v, causal)
+    sp = mesh.shape[axis_name]
+    heads_local = q.shape[1] // (
+        mesh.shape[head_axis] if head_axis in mesh.axis_names else 1
+    )
+    if heads_local % sp:
+        raise ValueError(
+            f"ulysses needs local heads ({heads_local}) divisible by "
+            f"sp={sp}; use ring attention for this shape"
+        )
+    fn = sp_shard_map(
+        functools.partial(_ulysses_sharded, axis_name=axis_name, causal=causal),
+        mesh, axis_name, batch_axes, head_axis,
+    )
+    return fn(q, k, v)
+
+
+# Past this GLOBAL sequence length, auto-selection prefers ring even when
+# the head count allows Ulysses: Ulysses holds full-T Q/K/V/O per device
+# (sp x the activation bytes of ring's O(T/sp) blocks), which is what makes
+# ring the million-token scheme. Override via env or TPUJOB_SP_MODE.
+ENV_ULYSSES_MAX_SEQ = "TPUJOB_ULYSSES_MAX_SEQ"
+DEFAULT_ULYSSES_MAX_SEQ = 131072
+
+
+def sp_mode(mesh: Mesh | None, num_heads: int | None = None,
+            axis_name: str = "sp", head_axis: str = "tp",
+            seq_len: int | None = None) -> str:
+    """Which SP scheme to use: 'ulysses' when the head count divides by sp
+    (the all-to-all form feeds full sequences to the fused kernel) AND the
+    sequence is short enough to hold full-T activations per device; 'ring'
+    otherwise. TPUJOB_SP_MODE=ring|ulysses forces."""
+    forced = os.environ.get("TPUJOB_SP_MODE", "").lower()
+    if forced in ("ring", "ulysses"):
+        return forced
+    if mesh is None or num_heads is None:
+        return "ring"
+    max_seq = int(os.environ.get(ENV_ULYSSES_MAX_SEQ, DEFAULT_ULYSSES_MAX_SEQ))
+    if seq_len is not None and seq_len > max_seq:
+        return "ring"
+    sp = mesh.shape[axis_name] if axis_name in mesh.axis_names else 1
+    tp = mesh.shape[head_axis] if head_axis in mesh.axis_names else 1
+    if sp > 1 and (num_heads // tp) % sp == 0:
+        return "ulysses"
+    return "ring"
