@@ -41,7 +41,9 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-NEG_INF = -1e30
+# Shared fully-masked sentinel: merge_partials (ring attention) compares
+# flash-produced lse values against the SAME constant — one definition only.
+from tf_operator_tpu.parallel.ring_attention import NEG_INF  # noqa: E402
 
 
 def _fwd_kernel(
@@ -54,6 +56,8 @@ def _fwd_kernel(
     else:
         m_scr, l_scr, acc_scr = rest
         lse_ref = None
+    # lse_ref block is (block_q, 128) lane-broadcast (see the layout note
+    # above _lse_out).
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     last_k = pl.num_programs(2) - 1
@@ -112,9 +116,7 @@ def _fwd_kernel(
         if lse_ref is not None:
             # lse is the backward's residual: P = exp(S - lse) reconstructs
             # normalized probabilities blockwise. NEG_INF marks fully-masked
-            # rows. Lane-broadcast to 128 because Mosaic requires the last
-            # block dim be 128-divisible (the official TPU kernel does the
-            # same).
+            # rows.
             lse = jnp.where(
                 l == 0.0, NEG_INF, m + jnp.log(jnp.where(l == 0.0, 1.0, l))
             )
@@ -129,6 +131,36 @@ def _check_pltpu() -> None:
             "pallas TPU backend unavailable; use ops.attention.flash_attention "
             "which falls back to the reference implementation"
         )
+
+
+# lse/g_lse storage layout: [BH, T, 128] f32, lane-broadcast — each row
+# value replicated across the 128 lanes (the official TPU kernel stores its
+# l/m residuals the same way). A compact [BH, T/128, 128] reshape layout
+# would cut the bytes 128x, but Mosaic cannot lower the required in-kernel
+# (block_q,) -> (block_q/128, 128) shape cast ("infer-vector-layout:
+# unsupported shape cast" on v5e), so the broadcast stands.
+
+
+def _lse_out(bh: int, t: int, block_q: int, index_fn):
+    """(BlockSpec, ShapeDtypeStruct) for an lse-layout operand/output."""
+    spec = pl.BlockSpec((1, block_q, 128), index_fn)
+    shape = jax.ShapeDtypeStruct((bh, t, 128), jnp.float32)
+    return spec, shape
+
+
+def _lse_rows(ref) -> jax.Array:
+    """Read the (block_q,) row values back from the lane-broadcast block."""
+    return ref[0][:, 0]
+
+
+def _lse_flat(x3) -> jax.Array:
+    """[BH, T] view of a stored lse array."""
+    return x3[:, :, 0]
+
+
+def _lse_store(x, t: int) -> jax.Array:
+    """Pack a [BH, T] f32 array into the stored lse layout."""
+    return jnp.broadcast_to(x[:, :, None], (x.shape[0], t, 128))
 
 
 def _flash_fwd(
@@ -165,10 +197,9 @@ def _flash_fwd(
     out_specs = [o_spec]
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
     if save_residuals:
-        out_specs.append(
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
-        )
-        out_shape.append(jax.ShapeDtypeStruct((bh, t, 128), jnp.float32))
+        lse_spec, lse_shape = _lse_out(bh, t, block_q, lambda b, i, j: (b, i, 0))
+        out_specs.append(lse_spec)
+        out_shape.append(lse_shape)
     out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -187,13 +218,21 @@ def _flash_fwd(
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_scr,
-    *, sm_scale: float, causal: bool, block_q: int, block_k: int, seq_k: int,
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
+    sm_scale: float, causal: bool, block_q: int, block_k: int, seq_k: int,
+    has_glse: bool,
 ):
     """dQ pass: grid (BH, q-blocks, k-blocks), k sequential.
     dQ_i = scale * sum_j [P_ij ∘ (dO_i V_j^T - delta_i)] K_j  (FA-2 eq. 13),
     delta_i = rowsum(dO_i ∘ O_i) computed in-block (cheaper than a second
-    lane-broadcast residual array)."""
+    lane-broadcast residual array). With has_glse, an lse cotangent (from a
+    downstream logsumexp-merge combiner, e.g. ring attention) adds the
+    dlse/dS = P term: ds = p*(dp - delta + g_lse)."""
+    if has_glse:
+        glse_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
+        glse_ref = None
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     last_k = pl.num_programs(2) - 1
@@ -207,8 +246,10 @@ def _bwd_dq_kernel(
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, 0]  # (BQ,) f32, lane-replicated residual
+        lse = _lse_rows(lse_ref)  # (BQ,) f32
         delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1)  # (BQ,)
+        if glse_ref is not None:
+            delta = delta - _lse_rows(glse_ref)
 
         # Zero padded tail rows of K/V: p and ds are 0 at those columns, but
         # the 0 * <pad garbage> inside dp and ds@K would still poison the
@@ -250,13 +291,19 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr,
-    *, sm_scale: float, causal: bool, block_q: int, block_k: int,
-    seq_q: int, seq_k: int,
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
+    sm_scale: float, causal: bool, block_q: int, block_k: int,
+    seq_q: int, seq_k: int, has_glse: bool,
 ):
     """dK/dV pass: grid (BH, k-blocks, q-blocks), q sequential.
-    dV_j = sum_i P_ij^T dO_i;  dK_j = scale * sum_i dS_ij^T Q_i."""
+    dV_j = sum_i P_ij^T dO_i;  dK_j = scale * sum_i dS_ij^T Q_i.
+    has_glse as in _bwd_dq_kernel (dK takes the p*g_lse term through dS;
+    dV is unaffected — lse does not depend on V)."""
+    if has_glse:
+        glse_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
+        glse_ref = None
     kj = pl.program_id(1)
     qi = pl.program_id(2)
     last_q = pl.num_programs(2) - 1
@@ -271,8 +318,10 @@ def _bwd_dkv_kernel(
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, 0]
+        lse = _lse_rows(lse_ref)
         delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1)
+        if glse_ref is not None:
+            delta = delta - _lse_rows(glse_ref)
 
         # Padded tail rows accumulate into dk/dv through the contractions
         # below; zero the garbage at the source (0*NaN=NaN otherwise).
@@ -329,8 +378,11 @@ def _bwd_dkv_kernel(
 def _flash_bwd(
     q: jax.Array, k: jax.Array, v: jax.Array, o: jax.Array, lse: jax.Array,
     do: jax.Array, causal: bool, block_q: int, block_k: int, interpret: bool,
+    g_lse: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Fused backward on [BH, T, D] operands; returns (dq, dk, dv)."""
+    """Fused backward on [BH, T, D] operands; returns (dq, dk, dv).
+    g_lse: optional lane-broadcast [BH, T, 128] cotangent of the lse output
+    (only flash_attention_with_lse callers have one)."""
     bh, t, d = q.shape
     tk = k.shape[1]
     sm_scale = 1.0 / (d**0.5)
@@ -338,6 +390,7 @@ def _flash_bwd(
     block_k = min(block_k, tk)
     _check_pltpu()
 
+    has_glse = g_lse is not None
     kwargs = {}
     if not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
@@ -345,33 +398,44 @@ def _flash_bwd(
         )
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     kv_spec_q = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
-    lse_spec_q = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+    lse_spec_q, _ = _lse_out(bh, t, block_q, lambda b, i, j: (b, i, 0))
 
+    dq_in_specs = [q_spec, kv_spec_q, kv_spec_q, q_spec, q_spec, lse_spec_q]
+    dq_operands = [q, k, v, o, do, lse]
+    if has_glse:
+        dq_in_specs.append(lse_spec_q)
+        dq_operands.append(g_lse)
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, seq_k=tk,
+            block_q=block_q, block_k=block_k, seq_k=tk, has_glse=has_glse,
         ),
         grid=(bh, pl.cdiv(t, block_q), pl.cdiv(tk, block_k)),
-        in_specs=[q_spec, kv_spec_q, kv_spec_q, q_spec, q_spec, lse_spec_q],
+        in_specs=dq_in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
         **kwargs,
-    )(q, k, v, o, do, lse)
+    )(*dq_operands)
 
     # dK/dV: k-blocks parallel, q-blocks sequential (block index roles swap).
     q_spec_k = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
     kv_spec_k = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
-    lse_spec_k = pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0))
+    lse_spec_k, _ = _lse_out(bh, t, block_q, lambda b, j, i: (b, i, 0))
+    dkv_in_specs = [q_spec_k, kv_spec_k, kv_spec_k, q_spec_k, q_spec_k, lse_spec_k]
+    dkv_operands = [q, k, v, o, do, lse]
+    if has_glse:
+        dkv_in_specs.append(lse_spec_k)
+        dkv_operands.append(g_lse)
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, seq_q=t, seq_k=tk,
+            has_glse=has_glse,
         ),
         grid=(bh, pl.cdiv(tk, block_k), pl.cdiv(t, block_q)),
-        in_specs=[q_spec_k, kv_spec_k, kv_spec_k, q_spec_k, q_spec_k, lse_spec_k],
+        in_specs=dkv_in_specs,
         out_specs=[kv_spec_k, kv_spec_k],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -383,7 +447,7 @@ def _flash_bwd(
         ],
         interpret=interpret,
         **kwargs,
-    )(q, k, v, o, do, lse)
+    )(*dkv_operands)
     return dq, dk, dv
 
 
@@ -428,3 +492,46 @@ def _bwd_rule(causal, block_q, block_k, interpret, res, g):
 
 
 flash_attention_pallas.defvjp(_fwd_rule, _bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool = False, block_q: int = 1024, block_k: int = 1024,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """[B, H, T, D] fused attention returning (o, lse [B, H, T] f32).
+
+    For combiners that merge partial attention results by logsumexp weights
+    (ring attention's per-device blocks): the lse output is differentiable —
+    its cotangent enters the backward kernels as the dlse/dS = P term."""
+    return _fwd_lse_rule(q, k, v, causal, block_q, block_k, interpret)[0]
+
+
+def _fwd_lse_rule(q, k, v, causal, block_q, block_k, interpret):
+    b, h, t, d = q.shape
+    flat = lambda x: x.reshape(b * h, x.shape[2], d)  # noqa: E731
+    o, lse3 = _flash_fwd(
+        flat(q), flat(k), flat(v), causal, block_q, block_k, interpret,
+        save_residuals=True,
+    )
+    lse_flat = _lse_flat(lse3)
+    out = (o.reshape(b, h, t, d), lse_flat.reshape(b, h, t))
+    return out, (q, k, v, o, lse3)
+
+
+def _bwd_lse_rule(causal, block_q, block_k, interpret, res, g):
+    q, k, v, o_flat, lse3 = res
+    g_o, g_lse = g
+    b, h, t, d = q.shape
+    flat = lambda x: x.reshape(b * h, x.shape[2], d)  # noqa: E731
+    g_lse3 = _lse_store(g_lse.reshape(b * h, t).astype(jnp.float32), t)
+    dq, dk, dv = _flash_bwd(
+        flat(q), flat(k), flat(v), o_flat, lse3, flat(g_o),
+        causal, block_q, block_k, interpret, g_lse=g_lse3,
+    )
+    unflat = lambda x: x.reshape(b, h, x.shape[1], d)  # noqa: E731
+    return unflat(dq), unflat(dk), unflat(dv)
+
+
+flash_attention_with_lse.defvjp(_fwd_lse_rule, _bwd_lse_rule)

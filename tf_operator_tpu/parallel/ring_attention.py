@@ -22,6 +22,7 @@ reference (`attention_reference`) for numerics tests.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Callable
 
 import jax
@@ -45,13 +46,15 @@ def attention_reference(
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def _block_attn(q, k, v, q_off, k_off, causal, sm_scale):
-    """Unnormalized block attention with running-max stats.
+def _block_attn(q, k, v, causal, sm_scale):
+    """Unnormalized block attention with running-max stats (local indices —
+    cross-block causal visibility is whole-slab and handled by the ring
+    combiner, so no position offsets are needed).
     Returns (o_block [B,H,Tq,D] f32, m [B,H,Tq] f32, l [B,H,Tq] f32)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
     if causal:
-        q_pos = q_off + jnp.arange(q.shape[-2])
-        k_pos = k_off + jnp.arange(k.shape[-2])
+        q_pos = jnp.arange(q.shape[-2])
+        k_pos = jnp.arange(k.shape[-2])
         mask = q_pos[:, None] >= k_pos[None, :]
         s = jnp.where(mask[None, None], s, NEG_INF)
     m = jnp.max(s, axis=-1)
@@ -63,55 +66,99 @@ def _block_attn(q, k, v, q_off, k_off, causal, sm_scale):
     return o, m, l
 
 
+def _block_norm_naive(q, k, v, causal: bool, sm_scale: float):
+    """Normalized (o f32, lse f32) for one whole block pair (pure JAX)."""
+    o, m, l = _block_attn(q, k, v, causal, sm_scale)
+    lse = jnp.where(
+        l == 0.0, NEG_INF, m + jnp.log(jnp.where(l == 0.0, 1.0, l))
+    )
+    return o / jnp.where(l == 0.0, 1.0, l)[..., None], lse
+
+
+def merge_partials(o1, lse1, o2, lse2):
+    """Exact logsumexp merge of two normalized partial attention results.
+    lse == NEG_INF marks an empty (fully-masked) partial."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(m <= NEG_INF, 0.0, m)
+    w1 = jnp.where(lse1 <= NEG_INF, 0.0, jnp.exp(lse1 - m_safe))
+    w2 = jnp.where(lse2 <= NEG_INF, 0.0, jnp.exp(lse2 - m_safe))
+    denom = w1 + w2
+    denom_safe = jnp.where(denom == 0.0, 1.0, denom)
+    o = (o1 * w1[..., None] + o2 * w2[..., None]) / denom_safe[..., None]
+    lse = jnp.where(denom == 0.0, NEG_INF, m_safe + jnp.log(denom_safe))
+    return o, lse
+
+
 def _ring_attention_sharded(
-    q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str, causal: bool
+    q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str, causal: bool,
+    block_impl: str = "naive", interpret: bool = False,
 ) -> jax.Array:
     """Per-device body (runs under shard_map): q,k,v are the local
-    [B, H, T_local, D] shards."""
-    n = jax.lax.psum(1, axis_name)
-    my = jax.lax.axis_index(axis_name)
-    t_local = q.shape[-2]
-    sm_scale = 1.0 / (q.shape[-1] ** 0.5)
-    q_off = my * t_local
+    [B, H, T_local, D] shards.
 
+    Each device folds n partial results (one per K/V block rotating around
+    the ring) with merge_partials. Because blocks are whole T_local slabs,
+    causal masking reduces to three cases: the diagonal (src == my, the
+    only partially-masked block — computed first, outside the scan, with
+    causal=True), fully-visible (src < my) and fully-masked (src > my)
+    blocks. So the block primitive never needs position offsets — which is
+    what lets the fused pallas kernel (block_impl='flash', via
+    flash_attention_with_lse and its differentiable lse output) drop in for
+    long local shards at O(T_local * D) memory per ring step."""
+    n = jax.lax.psum(1, axis_name)  # static: axis size is known at trace time
+    my = jax.lax.axis_index(axis_name)
+    sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     perm = [(i, (i + 1) % n) for i in range(n)]  # ring: send to next rank
 
+    def block_fn(q_blk, k_blk, v_blk, blk_causal: bool):
+        if block_impl == "flash":
+            from tf_operator_tpu.ops.flash_attention import (
+                flash_attention_with_lse,
+            )
+
+            blk = min(1024, q_blk.shape[-2], k_blk.shape[-2])
+            o, lse = flash_attention_with_lse(
+                q_blk, k_blk, v_blk, blk_causal, blk, blk, interpret
+            )
+            return o.astype(jnp.float32), lse
+        return _block_norm_naive(q_blk, k_blk, v_blk, blk_causal, sm_scale)
+
+    def rotate(x):
+        return jax.lax.ppermute(x, axis_name, perm)
+
+    # Diagonal block (the only one needing intra-block causal masking);
+    # the first K/V hop's transfer overlaps it (no data dependency).
+    o, lse = block_fn(q, k, v, causal)
+    if n == 1:
+        return o.astype(q.dtype)
+    k_cur, v_cur = rotate(k), rotate(v)
+
     def step(carry, i):
-        o, m, l, k_cur, v_cur = carry
+        o, lse, k_cur, v_cur = carry
         src = (my - i) % n  # who produced the K/V block we hold at step i
-        k_off = src * t_local
-        bo, bm, bl = _block_attn(q, k_cur, v_cur, q_off, k_off, causal, sm_scale)
-        m_new = jnp.maximum(m, bm)
-        c_old = jnp.exp(m - m_new)
-        c_new = jnp.exp(bm - m_new)
-        o = o * c_old[..., None] + bo * c_new[..., None]
-        l = l * c_old + bl * c_new
+        ob, lseb = block_fn(q, k_cur, v_cur, False)
+        if causal:
+            # Whole-block visibility: src < my fully visible, src > my
+            # fully masked (equality is the diagonal, handled above).
+            visible = src < my
+            lseb = jnp.where(visible, lseb, NEG_INF)
+            ob = jnp.where(visible, ob, 0.0)
+        o, lse = merge_partials(o, lse, ob, lseb)
         # Rotate K/V to the next rank; overlaps with the matmuls above. The
         # last step's rotation result is never read — skip the send (all
         # devices agree on i, so the cond is uniform and collective-safe).
         k_nxt, v_nxt = jax.lax.cond(
             i < n - 1,
-            lambda kv: (
-                jax.lax.ppermute(kv[0], axis_name, perm),
-                jax.lax.ppermute(kv[1], axis_name, perm),
-            ),
+            lambda kv: (rotate(kv[0]), rotate(kv[1])),
             lambda kv: kv,
             (k_cur, v_cur),
         )
-        return (o, m_new, l, k_nxt, v_nxt), None
+        return (o, lse, k_nxt, v_nxt), None
 
-    # Accumulators must carry the same varying-axes type as the values they
-    # mix with inside the scan (JAX vma typing under shard_map); deriving
-    # them from q inherits its full varying set on any mesh.
-    qf = q.astype(jnp.float32)
-    o0 = qf * 0.0
-    m0 = qf[..., 0] * 0.0 + NEG_INF
-    l0 = qf[..., 0] * 0.0
-    (o, m, l, _, _), _ = jax.lax.scan(
-        step, (o0, m0, l0, k, v), jnp.arange(n)
+    (o, lse, _, _), _ = jax.lax.scan(
+        step, (o, lse, k_cur, v_cur), jnp.arange(1, n)
     )
-    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (strict causal edge)
-    return (o / l[..., None]).astype(q.dtype)
+    return o.astype(q.dtype)
 
 
 def sp_shard_map(
@@ -120,15 +167,42 @@ def sp_shard_map(
     axis_name: str = "sp",
     batch_axes: tuple[str, ...] = ("dp", "fsdp"),
     head_axis: str = "tp",
+    check_vma: bool = True,
 ):
     """shard_map wrapper shared by every sequence-parallel attention scheme:
-    [B, H, T, D] with batch over dp/fsdp, heads over tp, sequence over sp."""
+    [B, H, T, D] with batch over dp/fsdp, heads over tp, sequence over sp.
+    check_vma=False is required when the body contains pallas_call (its
+    out-shapes carry no varying-axes annotation)."""
     b_spec = tuple(a for a in batch_axes if a in mesh.axis_names) or None
     h_spec = head_axis if head_axis in mesh.axis_names else None
     spec = P(b_spec, h_spec, axis_name, None)
     return jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=check_vma,
     )
+
+
+def resolve_block_impl(block_impl: str | None, t_local: int, d: int) -> str:
+    """Resolve the per-device block primitive: explicit arg beats
+    TPUJOB_RING_BLOCK beats 'auto' (fused kernel on TPU when the local
+    shard meets its shape constraints). Unknown values raise — a silent
+    naive fallback would cost O(T_local^2) memory on long-context jobs."""
+    impl = block_impl or os.environ.get("TPUJOB_RING_BLOCK", "auto") or "auto"
+    impl = impl.strip().lower()
+    if impl == "auto":
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+        return (
+            "flash"
+            if on_tpu and t_local >= 1024 and t_local % 128 == 0
+            and d >= 64 and d % 64 == 0
+            else "naive"
+        )
+    if impl not in ("naive", "flash"):
+        raise ValueError(
+            f"unknown ring block impl {impl!r} (TPUJOB_RING_BLOCK / "
+            f"block_impl): expected 'auto', 'naive' or 'flash'"
+        )
+    return impl
 
 
 def ring_attention(
@@ -140,14 +214,28 @@ def ring_attention(
     causal: bool = False,
     batch_axes: tuple[str, ...] = ("dp", "fsdp"),
     head_axis: str = "tp",
+    block_impl: str | None = None,
+    interpret: bool = False,
 ) -> jax.Array:
     """Exact attention with [B, H, T, D] inputs sequence-sharded over
-    `axis_name`; batch over dp/fsdp and heads over tp when present."""
+    `axis_name`; batch over dp/fsdp and heads over tp when present.
+
+    block_impl: per-device block primitive — 'naive' (pure JAX), 'flash'
+    (fused pallas kernel, O(T_local * D) memory per ring step), or None =
+    TPUJOB_RING_BLOCK env / auto (flash on TPU when the local shard meets
+    the kernel's shape constraints)."""
     if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
         return attention_reference(q, k, v, causal)
+    impl = resolve_block_impl(
+        block_impl, q.shape[2] // mesh.shape[axis_name], q.shape[3]
+    )
     fn = sp_shard_map(
-        functools.partial(_ring_attention_sharded, axis_name=axis_name, causal=causal),
+        functools.partial(
+            _ring_attention_sharded, axis_name=axis_name, causal=causal,
+            block_impl=impl, interpret=interpret,
+        ),
         mesh, axis_name, batch_axes, head_axis,
+        check_vma=(impl != "flash"),
     )
     return fn(q, k, v)
 

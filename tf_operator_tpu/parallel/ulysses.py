@@ -81,9 +81,10 @@ def ulysses_attention(
             f"ulysses needs local heads ({heads_local}) divisible by "
             f"sp={sp}; use ring attention for this shape"
         )
+    # check_vma off: the body may lower to a pallas flash kernel on TPU.
     fn = sp_shard_map(
         functools.partial(_ulysses_sharded, axis_name=axis_name, causal=causal),
-        mesh, axis_name, batch_axes, head_axis,
+        mesh, axis_name, batch_axes, head_axis, check_vma=False,
     )
     return fn(q, k, v)
 
