@@ -41,9 +41,11 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-# Shared fully-masked sentinel: merge_partials (ring attention) compares
-# flash-produced lse values against the SAME constant — one definition only.
-from tf_operator_tpu.parallel.ring_attention import NEG_INF  # noqa: E402
+# Fully-masked sentinel. Defined HERE (the lowest layer); ring attention's
+# merge_partials imports it so flash-produced lse values compare against the
+# same constant — one definition only, and the dependency points ops <-
+# parallel, matching the existing layering.
+NEG_INF = -1e30
 
 
 def _fwd_kernel(

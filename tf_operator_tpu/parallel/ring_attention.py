@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-NEG_INF = -1e30
+# Shared fully-masked sentinel (single definition in the kernel layer).
+from tf_operator_tpu.ops.flash_attention import NEG_INF  # noqa: E402
 
 
 def attention_reference(
