@@ -113,12 +113,27 @@ def cmd_operator(args) -> int:
     from tf_operator_tpu.utils.leader import LeaderElector
 
     log = FieldLogger({"component": "operator"})
-    cluster = InMemoryCluster()
+    # Substrate: a K8s API server (real cluster deployment — pods run as
+    # real cluster pods, kubelet feeds status back) or the in-memory
+    # substrate with the local-process runtime (one-host deployment).
+    on_k8s = bool(args.kube_api or args.in_cluster)
+    if on_k8s:
+        from tf_operator_tpu.core.k8s import K8sApi, K8sCluster
+
+        api_client = (
+            K8sApi.in_cluster() if args.in_cluster
+            else K8sApi(args.kube_api, token=args.kube_token,
+                        insecure=args.kube_insecure)
+        )
+        cluster = K8sCluster(api_client, namespace=args.namespace or None)
+    else:
+        cluster = InMemoryCluster()
     allocator = SliceAllocator.of(*args.tpu_slices) if args.tpu_slices else None
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
+    failed = threading.Event()  # startup failures must exit non-zero
 
     def lead() -> None:
         controller = TrainJobController(
@@ -127,7 +142,16 @@ def cmd_operator(args) -> int:
             gang_scheduler_name=args.gang_scheduler_name,
             slice_allocator=allocator,
         )
-        runtime = LocalProcessRuntime(cluster, log_dir=args.log_dir)
+        runtime = None
+        if on_k8s:
+            cluster.start()
+            if not cluster.wait_synced(60):
+                log.error("informer caches never synced; exiting")
+                failed.set()
+                return
+            log.info("K8s informers synced (%s)", args.kube_api or "in-cluster")
+        else:
+            runtime = LocalProcessRuntime(cluster, log_dir=args.log_dir)
         # The API binds only on the leader: a hot standby must not collide on
         # the monitoring port while waiting for the lock.
         api = ApiServer(cluster, port=args.monitoring_port, log_dir=args.log_dir,
@@ -137,15 +161,18 @@ def cmd_operator(args) -> int:
         controller.run(workers=args.threadiness)
         log.info("controllers running (threadiness=%d)", args.threadiness)
         stop.wait()
-        runtime.stop()
+        if runtime is not None:
+            runtime.stop()
         controller.stop()
+        if on_k8s:
+            cluster.stop()
         api.stop()
 
     if args.enable_leader_election:
         LeaderElector(args.lock_file).run_or_die(lead, stop)
     else:
         lead()
-    return 0
+    return 1 if failed.is_set() else 0
 
 
 def _api_get(server: str, path: str) -> dict:
@@ -210,6 +237,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lock-file", default="/tmp/tpujob-operator.lock")
     p.add_argument("--log-dir", default=None)
     p.add_argument("--tpu-slices", nargs="*", default=None)
+    p.add_argument("--kube-api", default=None,
+                   help="K8s API server URL: run against a real cluster "
+                        "(pods become cluster pods) instead of the "
+                        "local-process runtime")
+    p.add_argument("--in-cluster", action="store_true",
+                   help="use the pod service-account config (deployment "
+                        "inside the cluster, ref server.go:99)")
+    p.add_argument("--kube-token", default=None)
+    p.add_argument("--kube-insecure", action="store_true")
+    p.add_argument("--namespace", default=None,
+                   help="restrict the operator to one namespace "
+                        "(options.go namespace scope)")
     p.set_defaults(fn=cmd_operator)
 
     p = sub.add_parser("get")

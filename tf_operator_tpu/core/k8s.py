@@ -1,0 +1,806 @@
+"""Kubernetes API-server substrate adapter.
+
+The reference operator IS a K8s API client (generated clientsets + shared
+informers, SURVEY.md §1 L2/L3). This adapter gives the same core that runs
+on the in-memory substrate a real-cluster deployment: the identical
+`Cluster` method surface (core/cluster.py) implemented over the API
+server's REST protocol with plain stdlib HTTP — no client library — plus
+list+watch informer threads that replay the server's event stream into the
+substrate's synchronous add/update/delete handlers.
+
+Wire mapping:
+  TrainJob  <-> CR   apis/tpujob.dev/v1/.../trainjobs (+ /status subresource)
+  Pod       <-> core v1 Pod          (api/v1/.../pods)
+  Service   <-> core v1 Service      (api/v1/.../services, headless)
+  PodGroup  <-> scheduling.volcano.sh/v1beta1 podgroups (gang admission)
+  Event     <-> core v1 Event        (involvedObject-keyed, best-effort)
+
+Auth: bearer token + CA (in-cluster service account files, or explicit
+arguments); `insecure=True` skips TLS verification for dev clusters. The
+fake API server in testing/fake_apiserver.py speaks the same subset for
+Tier-2 wire-protocol tests without a cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Callable
+
+from tf_operator_tpu.api import compat
+from tf_operator_tpu.api.types import (
+    ContainerPort,
+    ContainerSpec,
+    EnvVar,
+    JobCondition,
+    JobConditionType,
+    JobStatus,
+    ObjectMeta,
+    OwnerReference,
+    PodTemplateSpec,
+    ReplicaStatus,
+    TrainJob,
+    VolumeMount,
+)
+from tf_operator_tpu.core.cluster import (
+    KIND_JOB,
+    KIND_POD,
+    KIND_PODGROUP,
+    KIND_SERVICE,
+    AlreadyExistsError,
+    ApiError,
+    ConflictError,
+    ContainerStatus,
+    Event,
+    NotFoundError,
+    Pod,
+    PodGroup,
+    PodPhase,
+    PodStatus,
+    Service,
+    ServicePort,
+)
+from tf_operator_tpu.utils.logging import FieldLogger
+
+PODGROUP_API = "scheduling.volcano.sh/v1beta1"
+
+# ---------------------------------------------------------------------------
+# Serialization: substrate dataclasses <-> K8s JSON
+# ---------------------------------------------------------------------------
+
+
+def _meta_to_dict(meta: ObjectMeta) -> dict:
+    out: dict[str, Any] = {
+        "name": meta.name,
+        "namespace": meta.namespace,
+        "labels": meta.labels,
+        "annotations": meta.annotations,
+    }
+    if meta.uid:
+        out["uid"] = meta.uid
+    if meta.resource_version:
+        out["resourceVersion"] = str(meta.resource_version)
+    if meta.owner_references:
+        out["ownerReferences"] = [
+            {
+                "apiVersion": r.api_version,
+                "kind": r.kind,
+                "name": r.name,
+                "uid": r.uid,
+                "controller": r.controller,
+                "blockOwnerDeletion": r.block_owner_deletion,
+            }
+            for r in meta.owner_references
+        ]
+    return out
+
+
+def _meta_from_dict(d: dict) -> ObjectMeta:
+    rv = d.get("resourceVersion", 0)
+    try:
+        rv = int(rv)
+    except (TypeError, ValueError):
+        rv = 0
+    return ObjectMeta(
+        name=d.get("name", ""),
+        namespace=d.get("namespace", "default"),
+        uid=d.get("uid", ""),
+        labels=dict(d.get("labels") or {}),
+        annotations=dict(d.get("annotations") or {}),
+        resource_version=rv,
+        owner_references=[
+            OwnerReference(
+                api_version=r.get("apiVersion", ""),
+                kind=r.get("kind", ""),
+                name=r.get("name", ""),
+                uid=r.get("uid", ""),
+                controller=bool(r.get("controller", False)),
+                block_owner_deletion=bool(r.get("blockOwnerDeletion", False)),
+            )
+            for r in d.get("ownerReferences") or []
+        ],
+    )
+
+
+def job_status_to_dict(status: JobStatus) -> dict:
+    return {
+        "conditions": [
+            {
+                "type": str(c.type),
+                "status": "True" if c.status else "False",
+                "reason": c.reason,
+                "message": c.message,
+                "lastUpdateTime": c.last_update_time,
+                "lastTransitionTime": c.last_transition_time,
+            }
+            for c in status.conditions
+        ],
+        "replicaStatuses": {
+            str(rt): {"active": rs.active, "succeeded": rs.succeeded,
+                      "failed": rs.failed}
+            for rt, rs in status.replica_statuses.items()
+        },
+        "startTime": status.start_time,
+        "completionTime": status.completion_time,
+    }
+
+
+def job_status_from_dict(d: dict) -> JobStatus:
+    from tf_operator_tpu.api.defaults import canonical_replica_type
+
+    status = JobStatus(
+        start_time=d.get("startTime"),
+        completion_time=d.get("completionTime"),
+    )
+    for c in d.get("conditions") or []:
+        status.conditions.append(
+            JobCondition(
+                type=JobConditionType(c["type"]),
+                status=str(c.get("status")) == "True",
+                reason=c.get("reason", ""),
+                message=c.get("message", ""),
+                last_update_time=c.get("lastUpdateTime") or 0.0,
+                last_transition_time=c.get("lastTransitionTime") or 0.0,
+            )
+        )
+    for rt, rs in (d.get("replicaStatuses") or {}).items():
+        status.replica_statuses[canonical_replica_type(rt)] = ReplicaStatus(
+            active=rs.get("active", 0),
+            succeeded=rs.get("succeeded", 0),
+            failed=rs.get("failed", 0),
+        )
+    return status
+
+
+def job_to_k8s(job: TrainJob) -> dict:
+    out = compat.job_to_dict(job)
+    out["metadata"] = _meta_to_dict(job.metadata)
+    out["status"] = job_status_to_dict(job.status)
+    return out
+
+
+def job_from_k8s(d: dict) -> TrainJob:
+    job = compat.job_from_dict(d, apply_defaults=False)
+    job.metadata = _meta_from_dict(d.get("metadata") or {})
+    job.status = job_status_from_dict(d.get("status") or {})
+    return job
+
+
+def _container_to_dict(c: ContainerSpec) -> dict:
+    return {
+        "name": c.name,
+        "image": c.image,
+        "command": list(c.command),
+        "args": list(c.args),
+        "env": [{"name": e.name, "value": e.value} for e in c.env],
+        "ports": [
+            {"name": p.name, "containerPort": p.container_port} for p in c.ports
+        ],
+        "resources": {"limits": c.resources} if c.resources else {},
+        "volumeMounts": [
+            {"name": v.name, "mountPath": v.mount_path, "subPath": v.sub_path,
+             "readOnly": v.read_only}
+            for v in c.volume_mounts
+        ],
+        "workingDir": c.working_dir,
+    }
+
+
+def _container_from_dict(d: dict) -> ContainerSpec:
+    return ContainerSpec(
+        name=d.get("name", ""),
+        image=d.get("image", ""),
+        command=list(d.get("command") or []),
+        args=list(d.get("args") or []),
+        env=[EnvVar(e.get("name", ""), e.get("value", ""))
+             for e in d.get("env") or []],
+        ports=[
+            ContainerPort(p.get("name", ""), p.get("containerPort", 0))
+            for p in d.get("ports") or []
+        ],
+        resources=dict((d.get("resources") or {}).get("limits") or {}),
+        volume_mounts=[
+            VolumeMount(
+                name=v.get("name", ""), mount_path=v.get("mountPath", ""),
+                sub_path=v.get("subPath", ""), read_only=bool(v.get("readOnly")),
+            )
+            for v in d.get("volumeMounts") or []
+        ],
+        working_dir=d.get("workingDir", ""),
+    )
+
+
+def pod_to_k8s(pod: Pod) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": _meta_to_dict(pod.metadata),
+        "spec": {
+            "containers": [_container_to_dict(c) for c in pod.spec.containers],
+            "restartPolicy": pod.spec.restart_policy or "Never",
+            "schedulerName": pod.scheduler_name or pod.spec.scheduler_name,
+            "nodeSelector": pod.spec.node_selector,
+            "volumes": [
+                {
+                    "name": v.name,
+                    **(
+                        {"hostPath": {"path": v.host_path}} if v.host_path
+                        else {"persistentVolumeClaim": {"claimName": v.claim_name}}
+                        if v.claim_name else {"emptyDir": {}}
+                    ),
+                }
+                for v in pod.spec.volumes
+            ],
+        },
+        "status": {
+            "phase": str(pod.status.phase),
+            "containerStatuses": [
+                {
+                    "name": cs.name,
+                    "restartCount": cs.restart_count,
+                    **(
+                        {"state": {"terminated": {"exitCode": cs.exit_code}}}
+                        if cs.exit_code is not None
+                        else {"state": {"running": {}}} if cs.running else {}
+                    ),
+                }
+                for cs in pod.status.container_statuses
+            ],
+            "startTime": pod.status.start_time,
+        },
+    }
+
+
+def pod_from_k8s(d: dict) -> Pod:
+    from tf_operator_tpu.api.types import Volume
+
+    spec_d = d.get("spec") or {}
+    status_d = d.get("status") or {}
+    statuses = []
+    for cs in status_d.get("containerStatuses") or []:
+        state = cs.get("state") or {}
+        term = state.get("terminated") or {}
+        statuses.append(
+            ContainerStatus(
+                name=cs.get("name", ""),
+                running="running" in state,
+                exit_code=term.get("exitCode"),
+                restart_count=cs.get("restartCount", 0),
+                reason=term.get("reason", ""),
+            )
+        )
+    phase = status_d.get("phase") or "Pending"
+    return Pod(
+        metadata=_meta_from_dict(d.get("metadata") or {}),
+        spec=PodTemplateSpec(
+            containers=[
+                _container_from_dict(c) for c in spec_d.get("containers") or []
+            ],
+            volumes=[
+                Volume(
+                    name=v.get("name", ""),
+                    host_path=(v.get("hostPath") or {}).get("path", ""),
+                    claim_name=(v.get("persistentVolumeClaim") or {}).get(
+                        "claimName", ""
+                    ),
+                    empty_dir="emptyDir" in v,
+                )
+                for v in spec_d.get("volumes") or []
+            ],
+            restart_policy=spec_d.get("restartPolicy", ""),
+            scheduler_name=spec_d.get("schedulerName", ""),
+            node_selector=dict(spec_d.get("nodeSelector") or {}),
+        ),
+        status=PodStatus(
+            phase=PodPhase(phase),
+            container_statuses=statuses,
+            start_time=status_d.get("startTime"),
+        ),
+        scheduler_name=spec_d.get("schedulerName", ""),
+    )
+
+
+def service_to_k8s(svc: Service) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta_to_dict(svc.metadata),
+        "spec": {
+            "clusterIP": svc.cluster_ip,
+            "selector": svc.selector,
+            "ports": [{"name": p.name, "port": p.port} for p in svc.ports],
+        },
+    }
+
+
+def service_from_k8s(d: dict) -> Service:
+    spec_d = d.get("spec") or {}
+    return Service(
+        metadata=_meta_from_dict(d.get("metadata") or {}),
+        selector=dict(spec_d.get("selector") or {}),
+        ports=[
+            ServicePort(p.get("name", ""), p.get("port", 0))
+            for p in spec_d.get("ports") or []
+        ],
+        cluster_ip=spec_d.get("clusterIP", "None"),
+    )
+
+
+def podgroup_to_k8s(pg: PodGroup) -> dict:
+    return {
+        "apiVersion": PODGROUP_API,
+        "kind": "PodGroup",
+        "metadata": _meta_to_dict(pg.metadata),
+        "spec": {"minMember": pg.min_member, "queue": pg.queue},
+    }
+
+
+def podgroup_from_k8s(d: dict) -> PodGroup:
+    spec_d = d.get("spec") or {}
+    return PodGroup(
+        metadata=_meta_from_dict(d.get("metadata") or {}),
+        min_member=spec_d.get("minMember", 0),
+        queue=spec_d.get("queue", ""),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Raw API-server client
+# ---------------------------------------------------------------------------
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class K8sApi:
+    """Minimal stdlib HTTP client for the API server."""
+
+    def __init__(
+        self,
+        base_url: str,
+        token: str | None = None,
+        ca_file: str | None = None,
+        insecure: bool = False,
+        timeout: float = 30.0,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+        if base_url.startswith("https"):
+            if insecure:
+                ctx = ssl._create_unverified_context()  # noqa: S323 — opt-in
+            else:
+                ctx = ssl.create_default_context(cafile=ca_file)
+            self._ctx: ssl.SSLContext | None = ctx
+        else:
+            self._ctx = None
+
+    @classmethod
+    def in_cluster(cls) -> "K8sApi":
+        """Service-account config, like rest.InClusterConfig (server.go:99)."""
+        import os
+
+        host = os.environ["KUBERNETES_SERVICE_HOST"]
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        with open(f"{SA_DIR}/token") as f:
+            token = f.read().strip()
+        return cls(f"https://{host}:{port}", token=token,
+                   ca_file=f"{SA_DIR}/ca.crt")
+
+    def _open(self, method: str, path: str, body: dict | None,
+              params: dict | None, timeout: float | None = None):
+        url = self.base_url + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            return urllib.request.urlopen(
+                req, timeout=timeout or self.timeout, context=self._ctx
+            )
+        except urllib.error.HTTPError as e:
+            raise self._map_error(e) from None
+
+    @staticmethod
+    def _map_error(e: urllib.error.HTTPError) -> ApiError:
+        try:
+            payload = json.loads(e.read().decode() or "{}")
+        except ValueError:
+            payload = {}
+        reason = payload.get("reason", "")
+        msg = payload.get("message", str(e))
+        if e.code == 404:
+            return NotFoundError(msg)
+        if e.code == 409:
+            if reason == "AlreadyExists":
+                return AlreadyExistsError(msg)
+            return ConflictError(msg)
+        return ApiError(f"HTTP {e.code}: {msg}")
+
+    def request(self, method: str, path: str, body: dict | None = None,
+                params: dict | None = None) -> dict:
+        with self._open(method, path, body, params) as r:
+            text = r.read().decode()
+        return json.loads(text) if text else {}
+
+    def stream(self, path: str, params: dict | None = None):
+        """Yield JSON objects from a watch stream (one per line)."""
+        r = self._open("GET", path, None, params, timeout=3600.0)
+        try:
+            for line in r:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+        finally:
+            r.close()
+
+
+# ---------------------------------------------------------------------------
+# Informer: list + watch -> substrate handler events
+# ---------------------------------------------------------------------------
+
+
+class _Informer(threading.Thread):
+    def __init__(self, cluster: "K8sCluster", kind: str):
+        super().__init__(daemon=True, name=f"informer-{kind}")
+        self.cluster = cluster
+        self.kind = kind
+        self._stop = threading.Event()
+        self._cache: dict[tuple[str, str], Any] = {}
+        self.synced = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        log = FieldLogger({"component": f"informer-{self.kind}"})
+        while not self._stop.is_set():
+            try:
+                rv = self._relist()
+                self.synced.set()
+                for ev in self.cluster.api.stream(
+                    self.cluster.list_path(self.kind),
+                    {"watch": "true", "resourceVersion": str(rv)},
+                ):
+                    if self._stop.is_set():
+                        return
+                    self._dispatch(ev)
+            except (ApiError, OSError, ValueError) as e:
+                if self._stop.is_set():
+                    return
+                log.info("watch error (will relist): %s", e)
+                time.sleep(0.2)
+
+    def _relist(self) -> int:
+        data = self.cluster.api.request("GET", self.cluster.list_path(self.kind))
+        rv = data.get("metadata", {}).get("resourceVersion", 0)
+        seen: set[tuple[str, str]] = set()
+        for item in data.get("items", []):
+            obj = self.cluster.decode(self.kind, item)
+            key = (obj.namespace, obj.name)
+            seen.add(key)
+            old = self._cache.get(key)
+            self._cache[key] = obj
+            if old is None:
+                self.cluster._fire(self.kind, "add", obj)
+            elif old.metadata.resource_version != obj.metadata.resource_version:
+                self.cluster._fire(self.kind, "update", obj, old=old)
+        for key in list(self._cache):
+            if key not in seen:
+                self.cluster._fire(self.kind, "delete", self._cache.pop(key))
+        try:
+            return int(rv)
+        except (TypeError, ValueError):
+            return 0
+
+    def _dispatch(self, ev: dict) -> None:
+        etype = ev.get("type")
+        obj = self.cluster.decode(self.kind, ev.get("object") or {})
+        key = (obj.namespace, obj.name)
+        if etype == "ADDED":
+            self._cache[key] = obj
+            self.cluster._fire(self.kind, "add", obj)
+        elif etype == "MODIFIED":
+            old = self._cache.get(key)
+            self._cache[key] = obj
+            self.cluster._fire(self.kind, "update", obj, old=old)
+        elif etype == "DELETED":
+            self._cache.pop(key, None)
+            self.cluster._fire(self.kind, "delete", obj)
+
+
+# ---------------------------------------------------------------------------
+# The adapter
+# ---------------------------------------------------------------------------
+
+
+class K8sCluster:
+    """Cluster-substrate implementation over a K8s API server.
+
+    Same method surface as InMemoryCluster (the controller cannot tell them
+    apart); reads go to the API server directly (the informer cache backs
+    only handler delivery), writes are plain REST calls.
+    """
+
+    _CODECS = {
+        KIND_JOB: (job_to_k8s, job_from_k8s),
+        KIND_POD: (pod_to_k8s, pod_from_k8s),
+        KIND_SERVICE: (service_to_k8s, service_from_k8s),
+        KIND_PODGROUP: (podgroup_to_k8s, podgroup_from_k8s),
+    }
+
+    def __init__(self, api: K8sApi, namespace: str | None = None):
+        self.api = api
+        self.namespace = namespace  # None = all namespaces
+        self._handlers: dict[tuple[str, str], list[Callable]] = {}
+        self._informers: list[_Informer] = []
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- paths
+
+    _RESOURCES = {KIND_POD: "pods", KIND_SERVICE: "services"}
+
+    def _ns_path(self, kind: str, namespace: str) -> str:
+        if kind == KIND_JOB:
+            return (f"/apis/{TrainJob.API_VERSION}/namespaces/{namespace}/"
+                    f"{TrainJob.PLURAL}")
+        if kind == KIND_PODGROUP:
+            return f"/apis/{PODGROUP_API}/namespaces/{namespace}/podgroups"
+        return f"/api/v1/namespaces/{namespace}/{self._RESOURCES[kind]}"
+
+    def list_path(self, kind: str) -> str:
+        """Cluster- or namespace-scoped list path for informers."""
+        if self.namespace:
+            return self._ns_path(kind, self.namespace)
+        if kind == KIND_JOB:
+            return f"/apis/{TrainJob.API_VERSION}/{TrainJob.PLURAL}"
+        if kind == KIND_PODGROUP:
+            return f"/apis/{PODGROUP_API}/podgroups"
+        return f"/api/v1/{self._RESOURCES[kind]}"
+
+    def decode(self, kind: str, d: dict):
+        return self._CODECS[kind][1](d)
+
+    def _encode(self, kind: str, obj) -> dict:
+        return self._CODECS[kind][0](obj)
+
+    # ---------------------------------------------------------- handlers
+
+    def on_add(self, kind: str, fn: Callable) -> None:
+        self._handlers.setdefault((kind, "add"), []).append(fn)
+
+    def on_update(self, kind: str, fn: Callable) -> None:
+        self._handlers.setdefault((kind, "update"), []).append(fn)
+
+    def on_delete(self, kind: str, fn: Callable) -> None:
+        self._handlers.setdefault((kind, "delete"), []).append(fn)
+
+    def _fire(self, kind: str, event: str, obj, old=None) -> None:
+        for fn in self._handlers.get((kind, event), []):
+            try:
+                if event == "update":
+                    fn(old if old is not None else obj, obj)
+                else:
+                    fn(obj)
+            except Exception as e:  # noqa: BLE001 — handler bugs must not kill informers
+                import traceback
+
+                FieldLogger({"component": "k8s-informer"}).error(
+                    "handler error for %s %s: %s\n%s", kind, event, e,
+                    traceback.format_exc(),
+                )
+
+    # ------------------------------------------------------ informer mgmt
+
+    def start(self, kinds: tuple[str, ...] = (KIND_JOB, KIND_POD, KIND_SERVICE)) -> None:
+        for kind in kinds:
+            inf = _Informer(self, kind)
+            self._informers.append(inf)
+            inf.start()
+
+    def wait_synced(self, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        for inf in self._informers:
+            if not inf.synced.wait(max(0.0, deadline - time.monotonic())):
+                return False
+        return True
+
+    def stop(self) -> None:
+        for inf in self._informers:
+            inf.stop()
+
+    # --------------------------------------------------------- generic CRUD
+
+    def _create(self, kind: str, obj):
+        d = self.api.request(
+            "POST", self._ns_path(kind, obj.namespace), self._encode(kind, obj)
+        )
+        return self.decode(kind, d)
+
+    def _get(self, kind: str, namespace: str, name: str):
+        d = self.api.request("GET", f"{self._ns_path(kind, namespace)}/{name}")
+        return self.decode(kind, d)
+
+    def _try_get(self, kind: str, namespace: str, name: str):
+        try:
+            return self._get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def _update(self, kind: str, obj, subresource: str = ""):
+        path = f"{self._ns_path(kind, obj.namespace)}/{obj.name}"
+        if subresource:
+            path += f"/{subresource}"
+        d = self.api.request("PUT", path, self._encode(kind, obj))
+        return self.decode(kind, d)
+
+    def _delete(self, kind: str, namespace: str, name: str):
+        d = self.api.request(
+            "DELETE", f"{self._ns_path(kind, namespace)}/{name}"
+        )
+        return self.decode(kind, d) if d.get("kind") not in (None, "Status") else None
+
+    def _list(self, kind: str, namespace: str | None, selector: dict | None):
+        if namespace:
+            path = self._ns_path(kind, namespace)
+        else:
+            path = self.list_path(kind)
+        params = {}
+        if selector:
+            params["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in sorted(selector.items())
+            )
+        data = self.api.request("GET", path, params=params or None)
+        return [self.decode(kind, item) for item in data.get("items", [])]
+
+    # ----------------------------------------------------------- jobs
+
+    def create_job(self, job: TrainJob) -> TrainJob:
+        return self._create(KIND_JOB, job)
+
+    def get_job(self, namespace: str, name: str) -> TrainJob:
+        return self._get(KIND_JOB, namespace, name)
+
+    def try_get_job(self, namespace: str, name: str) -> TrainJob | None:
+        return self._try_get(KIND_JOB, namespace, name)
+
+    def update_job(self, job: TrainJob) -> TrainJob:
+        return self._update(KIND_JOB, job)
+
+    def update_job_status(self, job: TrainJob) -> TrainJob:
+        """Status subresource write (ref UpdateStatus, k8sutil/client.go:85)."""
+        return self._update(KIND_JOB, job, subresource="status")
+
+    def delete_job(self, namespace: str, name: str):
+        return self._delete(KIND_JOB, namespace, name)
+
+    def list_jobs(self, namespace: str | None = None) -> list[TrainJob]:
+        return self._list(KIND_JOB, namespace, None)
+
+    # ----------------------------------------------------------- pods
+
+    def create_pod(self, pod: Pod) -> Pod:
+        return self._create(KIND_POD, pod)
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        return self._get(KIND_POD, namespace, name)
+
+    def try_get_pod(self, namespace: str, name: str) -> Pod | None:
+        return self._try_get(KIND_POD, namespace, name)
+
+    def update_pod(self, pod: Pod) -> Pod:
+        return self._update(KIND_POD, pod)
+
+    def delete_pod(self, namespace: str, name: str):
+        return self._delete(KIND_POD, namespace, name)
+
+    def list_pods(self, namespace: str | None = None,
+                  selector: dict | None = None) -> list[Pod]:
+        return self._list(KIND_POD, namespace, selector)
+
+    # -------------------------------------------------------- services
+
+    def create_service(self, svc: Service) -> Service:
+        return self._create(KIND_SERVICE, svc)
+
+    def get_service(self, namespace: str, name: str) -> Service:
+        return self._get(KIND_SERVICE, namespace, name)
+
+    def update_service(self, svc: Service) -> Service:
+        return self._update(KIND_SERVICE, svc)
+
+    def delete_service(self, namespace: str, name: str):
+        return self._delete(KIND_SERVICE, namespace, name)
+
+    def list_services(self, namespace: str | None = None,
+                      selector: dict | None = None) -> list[Service]:
+        return self._list(KIND_SERVICE, namespace, selector)
+
+    # ------------------------------------------------------- pod groups
+
+    def create_podgroup(self, pg: PodGroup) -> PodGroup:
+        return self._create(KIND_PODGROUP, pg)
+
+    def try_get_podgroup(self, namespace: str, name: str) -> PodGroup | None:
+        return self._try_get(KIND_PODGROUP, namespace, name)
+
+    def update_podgroup(self, pg: PodGroup) -> PodGroup:
+        return self._update(KIND_PODGROUP, pg)
+
+    def delete_podgroup(self, namespace: str, name: str):
+        try:
+            return self._delete(KIND_PODGROUP, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list_podgroups(self, namespace: str | None = None) -> list[PodGroup]:
+        return self._list(KIND_PODGROUP, namespace, None)
+
+    # ----------------------------------------------------------- events
+
+    def record_event(self, kind: str, namespace: str, name: str,
+                     etype: str, reason: str, message: str) -> None:
+        body = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"{name}.{int(time.time() * 1e6):x}",
+                "namespace": namespace,
+            },
+            "involvedObject": {"kind": kind, "namespace": namespace, "name": name},
+            "type": etype,
+            "reason": reason,
+            "message": message,
+        }
+        try:
+            self.api.request(
+                "POST", f"/api/v1/namespaces/{namespace}/events", body
+            )
+        except ApiError:
+            pass  # events are best-effort, as in client-go recorders
+
+    def events_for(self, kind: str, namespace: str, name: str) -> list[Event]:
+        try:
+            data = self.api.request(
+                "GET", f"/api/v1/namespaces/{namespace}/events"
+            )
+        except ApiError:
+            return []
+        out = []
+        for item in data.get("items", []):
+            inv = item.get("involvedObject") or {}
+            if inv.get("kind") == kind and inv.get("name") == name:
+                out.append(
+                    Event(kind, namespace, name, item.get("type", ""),
+                          item.get("reason", ""), item.get("message", ""))
+                )
+        return out
