@@ -224,6 +224,26 @@ class TestK8sReconcile:
             what="pod recreated with a new uid",
         )
 
+    def test_invalid_cr_marked_failed(self, k8s):
+        """CRs bypass REST admission (no webhook); an invalid spec arriving
+        via kubectl must be marked Failed on the CR with an event — never
+        crash the controller (the reference's unstructured-informer
+        tolerance, informer.go:34 / invalid_tfjob_tests)."""
+        server, cluster, controller = k8s
+        bad = _mk_job("k8s-bad", workers=1)
+        # Break it: no containers in the worker template.
+        bad.spec.replica_specs[ReplicaType.WORKER].template.containers = []
+        _kubectl_create(server, bad)
+        _wait(lambda: "Failed" in _job_condition(server, "k8s-bad") or None,
+              what="Failed condition on invalid CR")
+        assert not server.list_objects("pods")
+        evs = cluster.events_for("TrainJob", "default", "k8s-bad")
+        assert any("container" in e.message.lower() for e in evs)
+        # The controller survives: a valid job afterwards still reconciles.
+        _kubectl_create(server, _mk_job("k8s-ok", workers=1))
+        _wait(lambda: server.get_object("pods", "default", "k8s-ok-worker-0"),
+              what="valid job still reconciled")
+
     def test_cli_operator_against_apiserver(self, tmp_path):
         """`tpujob operator --kube-api <url>` as a real process: the
         deployment shape a cluster admin runs (ref cmd/tf-operator.v1)."""

@@ -100,6 +100,20 @@ def _meta_to_dict(meta: ObjectMeta) -> dict:
     return out
 
 
+def _parse_time(v) -> float | None:
+    """K8s RFC3339 timestamp (or our fake's float) -> epoch seconds."""
+    if v in (None, ""):
+        return None
+    if isinstance(v, (int, float)):
+        return float(v)
+    from datetime import datetime
+
+    try:
+        return datetime.fromisoformat(str(v).replace("Z", "+00:00")).timestamp()
+    except ValueError:
+        return None
+
+
 def _meta_from_dict(d: dict) -> ObjectMeta:
     rv = d.get("resourceVersion", 0)
     try:
@@ -113,6 +127,10 @@ def _meta_from_dict(d: dict) -> ObjectMeta:
         labels=dict(d.get("labels") or {}),
         annotations=dict(d.get("annotations") or {}),
         resource_version=rv,
+        # A finalizer-held object is served with deletionTimestamp set; the
+        # controller's adopt guard (controller.py "unless being deleted")
+        # depends on seeing it.
+        deletion_timestamp=_parse_time(d.get("deletionTimestamp")),
         owner_references=[
             OwnerReference(
                 api_version=r.get("apiVersion", ""),
@@ -295,6 +313,12 @@ def pod_from_k8s(d: dict) -> Pod:
             )
         )
     phase = status_d.get("phase") or "Pending"
+    try:
+        phase = PodPhase(phase)
+    except ValueError:
+        # K8s has phases we don't model ("Unknown" on NotReady nodes):
+        # treat as not-finished rather than poisoning the informer.
+        phase = PodPhase.PENDING
     return Pod(
         metadata=_meta_from_dict(d.get("metadata") or {}),
         spec=PodTemplateSpec(
@@ -317,7 +341,7 @@ def pod_from_k8s(d: dict) -> Pod:
             node_selector=dict(spec_d.get("nodeSelector") or {}),
         ),
         status=PodStatus(
-            phase=PodPhase(phase),
+            phase=phase,
             container_statuses=statuses,
             start_time=status_d.get("startTime"),
         ),
@@ -452,9 +476,14 @@ class K8sApi:
             text = r.read().decode()
         return json.loads(text) if text else {}
 
-    def stream(self, path: str, params: dict | None = None):
-        """Yield JSON objects from a watch stream (one per line)."""
+    def stream(self, path: str, params: dict | None = None,
+               on_response: Callable | None = None):
+        """Yield JSON objects from a watch stream (one per line).
+        on_response receives the live response object so the caller can
+        close it from another thread (the informer stop path)."""
         r = self._open("GET", path, None, params, timeout=3600.0)
+        if on_response is not None:
+            on_response(r)
         try:
             for line in r:
                 line = line.strip()
@@ -470,16 +499,44 @@ class K8sApi:
 
 
 class _Informer(threading.Thread):
-    def __init__(self, cluster: "K8sCluster", kind: str):
+    def __init__(self, cluster: "K8sCluster", kind: str,
+                 selector: dict[str, str] | None = None):
         super().__init__(daemon=True, name=f"informer-{kind}")
         self.cluster = cluster
         self.kind = kind
+        # Reference parity: pod/service informers are label-filtered to the
+        # operator's own objects — an unfiltered watch on a shared cluster
+        # would list/decode the world on every relist.
+        self.selector = selector
         self._stop = threading.Event()
+        self._resp = None  # live watch response, closed by stop()
         self._cache: dict[tuple[str, str], Any] = {}
         self.synced = threading.Event()
 
     def stop(self) -> None:
         self._stop.set()
+        resp = self._resp
+        if resp is not None:
+            # resp.close() would deadlock on the BufferedReader lock held by
+            # the blocked reader thread; socket.shutdown is thread-safe and
+            # unblocks the read with EOF.
+            try:
+                import socket as _socket
+
+                sock = getattr(getattr(resp, "fp", None), "raw", None)
+                sock = getattr(sock, "_sock", None)
+                if sock is not None:
+                    sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def _params(self, extra: dict | None = None) -> dict | None:
+        params = dict(extra or {})
+        if self.selector:
+            params["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in sorted(self.selector.items())
+            )
+        return params or None
 
     def run(self) -> None:
         log = FieldLogger({"component": f"informer-{self.kind}"})
@@ -489,7 +546,8 @@ class _Informer(threading.Thread):
                 self.synced.set()
                 for ev in self.cluster.api.stream(
                     self.cluster.list_path(self.kind),
-                    {"watch": "true", "resourceVersion": str(rv)},
+                    self._params({"watch": "true", "resourceVersion": str(rv)}),
+                    on_response=lambda r: setattr(self, "_resp", r),
                 ):
                     if self._stop.is_set():
                         return
@@ -499,9 +557,13 @@ class _Informer(threading.Thread):
                     return
                 log.info("watch error (will relist): %s", e)
                 time.sleep(0.2)
+            finally:
+                self._resp = None
 
     def _relist(self) -> int:
-        data = self.cluster.api.request("GET", self.cluster.list_path(self.kind))
+        data = self.cluster.api.request(
+            "GET", self.cluster.list_path(self.kind), params=self._params()
+        )
         rv = data.get("metadata", {}).get("resourceVersion", 0)
         seen: set[tuple[str, str]] = set()
         for item in data.get("items", []):
@@ -622,8 +684,12 @@ class K8sCluster:
     # ------------------------------------------------------ informer mgmt
 
     def start(self, kinds: tuple[str, ...] = (KIND_JOB, KIND_POD, KIND_SERVICE)) -> None:
+        from tf_operator_tpu.core.controller import LABEL_GROUP_NAME
+
+        own = {LABEL_GROUP_NAME: TrainJob.API_GROUP}
         for kind in kinds:
-            inf = _Informer(self, kind)
+            selector = None if kind == KIND_JOB else own
+            inf = _Informer(self, kind, selector=selector)
             self._informers.append(inf)
             inf.start()
 
@@ -697,7 +763,19 @@ class K8sCluster:
         return self._update(KIND_JOB, job)
 
     def update_job_status(self, job: TrainJob) -> TrainJob:
-        """Status subresource write (ref UpdateStatus, k8sutil/client.go:85)."""
+        """Status subresource write (ref UpdateStatus, k8sutil/client.go:85).
+
+        The /status subresource ignores metadata, but the controller's only
+        job-write path also persists bookkeeping annotations (the slice
+        assignment) — when the job carries annotations, write the main
+        resource first so they land on the CR (spec is the informer's copy;
+        a concurrent edit surfaces as a 409 and the sync retries)."""
+        if job.metadata.annotations:
+            try:
+                updated = self._update(KIND_JOB, job)
+                job.metadata.resource_version = updated.metadata.resource_version
+            except NotFoundError:
+                pass  # deleted underneath us: the status write will 404 too
         return self._update(KIND_JOB, job, subresource="status")
 
     def delete_job(self, namespace: str, name: str):

@@ -91,6 +91,14 @@ class FakeApiServer:
                 if m is None:
                     return self._error(404, "NotFound", self.path)
                 res, ns, name = m["resource"], m["ns"], m["name"]
+                if not name and q.get("watch") == "true":
+                    # The watch loop streams indefinitely: it must NOT hold
+                    # the store lock (writers would deadlock behind a slow
+                    # watch client).
+                    return self._watch(
+                        res, ns, int(q.get("resourceVersion") or 0),
+                        q.get("labelSelector"),
+                    )
                 with store.lock:
                     objs = store.objects.setdefault(res, {})
                     if name:
@@ -98,8 +106,6 @@ class FakeApiServer:
                         if obj is None:
                             return self._error(404, "NotFound", f"{res} {ns}/{name}")
                         return self._send_json(obj)
-                    if q.get("watch") == "true":
-                        return self._watch(res, ns, int(q.get("resourceVersion") or 0))
                     items = [
                         o for (ons, _), o in sorted(objs.items())
                         if ns is None or ons == ns
@@ -120,22 +126,41 @@ class FakeApiServer:
                         "items": items,
                     })
 
-            def _watch(self, res: str, ns: str | None, since_rv: int):
+            def _watch(self, res: str, ns: str | None, since_rv: int,
+                       selector: str | None = None):
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
+                want = (
+                    dict(p.split("=", 1) for p in selector.split(","))
+                    if selector else None
+                )
                 sent = since_rv
                 try:
                     while True:
                         with store.lock:
-                            pending = [
+                            fresh = [
                                 (rv, t, o) for rv, t, r, o in store.log
                                 if r == res and rv > sent
                                 and (ns is None or o["metadata"].get("namespace") == ns)
                             ]
+                            pending = [
+                                (rv, t, o) for rv, t, o in fresh
+                                if want is None
+                                or all(
+                                    (o["metadata"].get("labels") or {}).get(k) == v
+                                    for k, v in want.items()
+                                )
+                            ]
+                            # Watermark past selector-filtered events so the
+                            # log isn't rescanned forever.
+                            watermark = max([sent] + [rv for rv, _, _ in fresh])
                             if not pending:
+                                sent = watermark
                                 store.lock.wait(timeout=0.5)
+                        # Socket writes happen OUTSIDE the lock: a stalled
+                        # watch client must not block writers.
                         for rv, etype, obj in pending:
                             line = json.dumps({"type": etype, "object": obj}) + "\n"
                             data = line.encode()
@@ -143,6 +168,8 @@ class FakeApiServer:
                             self.wfile.write(data + b"\r\n")
                             self.wfile.flush()
                             sent = rv
+                        if pending:
+                            sent = max(sent, watermark)
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     return
 
@@ -221,7 +248,13 @@ class FakeApiServer:
                     store.lock.notify_all()
                 return self._send_json(obj)
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        class _Server(ThreadingHTTPServer):
+            # Watch handlers stream until the client hangs up; never block
+            # shutdown on them.
+            daemon_threads = True
+            block_on_close = False
+
+        self._server = _Server(("127.0.0.1", port), Handler)
         self.port = self._server.server_port
         self.url = f"http://127.0.0.1:{self.port}"
         self._thread = threading.Thread(
