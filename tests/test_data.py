@@ -133,3 +133,28 @@ class TestTrainerDataDir:
         assert first["data_dir"] == d and first["local_samples"] == 64
         done = [e for e in ev if e["event"] == "done"][-1]
         assert done["steps"] == 6 and done["final_loss"] is not None
+
+
+def test_misaligned_hand_written_shards_rejected(tmp_path):
+    """Keys with equal totals but different per-shard splits would pair
+    rows across keys wrong; only write_array_shards guarantees alignment,
+    so hand-written shards must be validated at load."""
+    import json
+    import os
+
+    d = tmp_path / "misaligned"
+    os.makedirs(d)
+    # x: shards of 3+1 rows; y: shards of 2+2 rows — totals agree (4).
+    np.save(d / "x_00000.npy", np.zeros((3, 2)))
+    np.save(d / "x_00001.npy", np.zeros((1, 2)))
+    np.save(d / "y_00000.npy", np.zeros((2,)))
+    np.save(d / "y_00001.npy", np.zeros((2,)))
+    with open(d / "dataset.json", "w") as f:
+        json.dump(
+            {"num_shards": 2, "total_samples": 4,
+             "keys": {"x": {"dtype": "float64", "shape": [2]},
+                      "y": {"dtype": "float64", "shape": []}}},
+            f,
+        )
+    with pytest.raises(ValueError, match="per-shard"):
+        ShardedDataset(str(d))

@@ -289,3 +289,80 @@ class TestK8sReconcile:
 
         cluster.delete_job("default", "crud")
         assert cluster.try_get_job("default", "crud") is None
+
+
+class TestInformerHardening:
+    """The daemon informer must outlive anything the wire can throw at it
+    (reference unstructured-informer tolerance, informer.go:34)."""
+
+    def test_undecodable_object_skipped(self, k8s):
+        """An object whose JSON crashes the codec (condition without 'type')
+        is skipped; every other object of the kind keeps flowing."""
+        server, cluster, controller = k8s
+        good = _mk_job("hard-ok", workers=1)
+        bad = job_to_k8s(_mk_job("hard-bad", workers=1))
+        bad["status"] = {"conditions": [{"status": "True"}]}  # no 'type' key
+        body = json.dumps(bad).encode()
+        req = urllib.request.Request(
+            f"{server.url}/apis/{TrainJob.API_VERSION}/namespaces/default/"
+            f"{TrainJob.PLURAL}",
+            data=body, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 201
+        # The undecodable CR arrives on the same watch stream as this one;
+        # reconciliation of the kind must not stall.
+        _kubectl_create(server, good)
+        _wait(lambda: server.get_object("pods", "default", "hard-ok-worker-0"),
+              what="job after undecodable CR still reconciled")
+
+    def test_watch_error_event_relists(self):
+        """A watch ERROR event carries a Status payload (e.g. 410 Gone):
+        it must break to a relist, never reach the codecs."""
+        from tf_operator_tpu.core.cluster import KIND_JOB, ApiError
+        from tf_operator_tpu.core.k8s import _Informer
+
+        cluster = K8sCluster(K8sApi("http://127.0.0.1:1"))  # never dialed
+        inf = _Informer(cluster, KIND_JOB)
+        with pytest.raises(ApiError, match="watch ERROR"):
+            inf._dispatch({
+                "type": "ERROR",
+                "object": {"kind": "Status", "code": 410,
+                           "reason": "Expired", "message": "too old"},
+            })
+
+    def test_put_stale_resource_version_conflicts(self, k8s):
+        """Optimistic concurrency on the wire: a PUT carrying a stale
+        resourceVersion must 409 like a real API server."""
+        from tf_operator_tpu.core.cluster import ConflictError
+
+        server, cluster, controller = k8s
+        created = cluster.create_job(_mk_job("conflict", workers=1))
+        fresh = cluster.update_job(created)  # bumps the stored rv
+        stale = created  # still carries the pre-update rv
+        assert stale.metadata.resource_version != fresh.metadata.resource_version
+        with pytest.raises(ConflictError):
+            cluster.update_job(stale)
+        # A rv-less write (fresh manifest, kubectl-apply style) still lands.
+        stale.metadata.resource_version = 0
+        cluster.update_job(stale)
+
+    def test_undecodable_deleted_tombstone_still_fires_delete(self):
+        """A DELETED event whose payload no longer decodes must still pop
+        the cache and fire the delete handler (else the controller would
+        reconcile a ghost job forever)."""
+        from tf_operator_tpu.core.cluster import KIND_JOB
+        from tf_operator_tpu.core.k8s import _Informer
+
+        cluster = K8sCluster(K8sApi("http://127.0.0.1:1"))  # never dialed
+        deleted = []
+        cluster.on_delete(KIND_JOB, deleted.append)
+        inf = _Informer(cluster, KIND_JOB)
+        good = _mk_job("tomb", workers=1)
+        inf._cache[("default", "tomb")] = good
+        bad_payload = job_to_k8s(good)
+        bad_payload["status"] = {"conditions": [{"status": "True"}]}  # no type
+        inf._dispatch({"type": "DELETED", "object": bad_payload})
+        assert ("default", "tomb") not in inf._cache
+        assert deleted and deleted[0].name == "tomb"

@@ -512,6 +512,7 @@ class _Informer(threading.Thread):
         self._resp = None  # live watch response, closed by stop()
         self._cache: dict[tuple[str, str], Any] = {}
         self.synced = threading.Event()
+        self._log = FieldLogger({"component": f"informer-{kind}"})
 
     def stop(self) -> None:
         self._stop.set()
@@ -539,8 +540,10 @@ class _Informer(threading.Thread):
         return params or None
 
     def run(self) -> None:
-        log = FieldLogger({"component": f"informer-{self.kind}"})
+        log = self._log
+        backoff = 0.2
         while not self._stop.is_set():
+            started = time.monotonic()
             try:
                 rv = self._relist()
                 self.synced.set()
@@ -552,13 +555,36 @@ class _Informer(threading.Thread):
                     if self._stop.is_set():
                         return
                     self._dispatch(ev)
-            except (ApiError, OSError, ValueError) as e:
+            # Broad catch: the daemon informer is the only event source for
+            # its kind — any escaped decode/transport error (KeyError from a
+            # malformed object included) must relist, never kill the thread.
+            except Exception as e:  # noqa: BLE001
                 if self._stop.is_set():
                     return
-                log.info("watch error (will relist): %s", e)
-                time.sleep(0.2)
+                # Reset backoff only after a healthy stretch: a server whose
+                # LIST succeeds but WATCH immediately fails would otherwise
+                # relist the world in a tight loop forever.
+                if time.monotonic() - started > 10.0:
+                    backoff = 0.2
+                log.info("watch error (will relist in %.1fs): %s", backoff, e)
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 5.0)
             finally:
                 self._resp = None
+
+    def _decode_item(self, item: dict):
+        """Decode one object, or skip it (reference parity: the unstructured
+        informer tolerates CRs the typed codec would choke on, informer.go:82;
+        one undecodable object must not stall every object of the kind)."""
+        try:
+            return self.cluster.decode(self.kind, item)
+        except Exception as e:  # noqa: BLE001 — skip, don't poison the stream
+            meta = item.get("metadata") or {}
+            self._log.error(
+                "skipping undecodable %s %s/%s: %r", self.kind,
+                meta.get("namespace", "?"), meta.get("name", "?"), e,
+            )
+            return None
 
     def _relist(self) -> int:
         data = self.cluster.api.request(
@@ -567,7 +593,15 @@ class _Informer(threading.Thread):
         rv = data.get("metadata", {}).get("resourceVersion", 0)
         seen: set[tuple[str, str]] = set()
         for item in data.get("items", []):
-            obj = self.cluster.decode(self.kind, item)
+            obj = self._decode_item(item)
+            if obj is None:
+                # Present-but-undecodable: keep any cached copy and keep its
+                # key in `seen` so the sweep below doesn't fire a spurious
+                # delete for an object that still exists on the server.
+                meta = item.get("metadata") or {}
+                seen.add((meta.get("namespace", "default"),
+                          meta.get("name", "")))
+                continue
             key = (obj.namespace, obj.name)
             seen.add(key)
             old = self._cache.get(key)
@@ -586,7 +620,25 @@ class _Informer(threading.Thread):
 
     def _dispatch(self, ev: dict) -> None:
         etype = ev.get("type")
-        obj = self.cluster.decode(self.kind, ev.get("object") or {})
+        if etype == "ERROR":
+            # The payload is a Status object (e.g. 410 Gone), not a resource:
+            # never feed it through the codecs — break out to relist.
+            raise ApiError(f"watch ERROR event: {ev.get('object')!r}")
+        raw = ev.get("object") or {}
+        if etype == "DELETED":
+            # The tombstone may carry undecodable last state; deletion only
+            # needs the key — fall back to the cached copy so the delete
+            # handler still fires and the cache can't leak the object.
+            meta = raw.get("metadata") or {}
+            key = (meta.get("namespace", "default"), meta.get("name", ""))
+            cached = self._cache.pop(key, None)
+            obj = self._decode_item(raw) or cached
+            if obj is not None:
+                self.cluster._fire(self.kind, "delete", obj)
+            return
+        obj = self._decode_item(raw)
+        if obj is None:
+            return
         key = (obj.namespace, obj.name)
         if etype == "ADDED":
             self._cache[key] = obj
@@ -595,9 +647,6 @@ class _Informer(threading.Thread):
             old = self._cache.get(key)
             self._cache[key] = obj
             self.cluster._fire(self.kind, "update", obj, old=old)
-        elif etype == "DELETED":
-            self._cache.pop(key, None)
-            self.cluster._fire(self.kind, "delete", obj)
 
 
 # ---------------------------------------------------------------------------

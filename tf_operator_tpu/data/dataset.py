@@ -107,13 +107,19 @@ class ShardedDataset:
             # Concatenation of mmaps materializes; keep the shard list and a
             # flat index instead so reads stay lazy.
             self._arrays[key] = parts  # type: ignore[assignment]
-        lens = [sum(p.shape[0] for p in self._arrays[k]) for k in self.keys]
-        if len(set(lens)) != 1:
-            raise ValueError(f"keys disagree on local sample count: {lens}")
-        self.num_samples = lens[0]
-        self._offsets = np.cumsum(
-            [0] + [p.shape[0] for p in self._arrays[self.keys[0]]]
-        )
+        # Per-shard lengths must match across keys, not just totals: _gather
+        # builds shard offsets from the first key only, so misaligned
+        # hand-written shards would silently pair rows across keys wrong.
+        first_lens = [p.shape[0] for p in self._arrays[self.keys[0]]]
+        for k in self.keys[1:]:
+            lens_k = [p.shape[0] for p in self._arrays[k]]
+            if lens_k != first_lens:
+                raise ValueError(
+                    f"per-shard lengths differ between keys: "
+                    f"{self.keys[0]}={first_lens} vs {k}={lens_k}"
+                )
+        self.num_samples = sum(first_lens)
+        self._offsets = np.cumsum([0] + first_lens)
 
     def _gather(self, key: str, idx: np.ndarray) -> np.ndarray:
         """Gather rows by flat local index across the shard list."""

@@ -207,6 +207,15 @@ class FakeApiServer:
                     cur = objs.get((ns, name))
                     if cur is None:
                         return self._error(404, "NotFound", f"{res} {ns}/{name}")
+                    # Optimistic concurrency, like the real apiserver: a PUT
+                    # carrying a stale resourceVersion conflicts.
+                    body_rv = (body.get("metadata") or {}).get("resourceVersion")
+                    if body_rv and body_rv != cur["metadata"].get("resourceVersion"):
+                        return self._error(
+                            409, "Conflict",
+                            f"{res} {ns}/{name}: resourceVersion {body_rv} "
+                            f"!= {cur['metadata'].get('resourceVersion')}",
+                        )
                     if sub == "status":
                         new = dict(cur)
                         new["status"] = body.get("status", {})
