@@ -222,7 +222,9 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
         depth=2,
         sharding=mesh_lib.batch_sharding(mesh),
     )
-    _, compile_step = make_train_step(loss_fn, tx, mesh, rules=rules)
+    _, compile_step = make_train_step(
+        loss_fn, tx, mesh, rules=rules, remat=args.remat
+    )
 
     batch = next(it)
     step = compile_step(state, batch)
@@ -239,6 +241,7 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
             "loss": float(metrics["loss"]),
             "mesh": dict(mesh.shape),
             "backend": jax.default_backend(),
+            "device_kind": jax.devices()[0].device_kind,
             "n_devices": len(jax.devices()),
             "data_dir": args.data_dir,
             "local_samples": ds.num_samples,
@@ -274,6 +277,7 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
     _emit(
         {
             "event": "done",
+            "t": time.time(),
             "steps": args.steps,
             "steady_steps_per_sec": sps,
             "examples_per_sec": round(steady * args.batch / dt, 2) if steady > 0 else None,
@@ -295,6 +299,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=4,
+                    help="transformer-lm/moe-lm depth")
+    ap.add_argument("--hidden", type=int, default=512,
+                    help="transformer-lm/moe-lm width")
+    ap.add_argument("--heads", type=int, default=8,
+                    help="transformer-lm/moe-lm attention heads")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize the loss (activation checkpointing): "
+                         "trade FLOPs for HBM on long-sequence configs")
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--log-every", type=int, default=20)
@@ -325,6 +338,18 @@ def main(argv: list[str] | None = None) -> int:
     initialize_from_env()
 
     import jax
+
+    # Dial the accelerator while the rest of the stack imports: attaching a
+    # (possibly tunneled) TPU backend is network-bound and independent of
+    # the CPU-bound flax/optax import work, so the two overlap. The main
+    # thread re-joins at mesh_from_env()'s jax.devices() call; an attach
+    # error surfaces there, not in this daemon thread.
+    import threading
+
+    threading.Thread(
+        target=lambda: jax.devices(), daemon=True, name="backend-dial"
+    ).start()
+
     import jax.numpy as jnp
     import optax
 
@@ -335,21 +360,32 @@ def main(argv: list[str] | None = None) -> int:
         create_train_state,
         make_scanned_train_step,
         shard_state,
+        state_shardings,
     )
     from tf_operator_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
 
     mesh = mesh_lib.mesh_from_env()
+    # Segment timestamps (bench.py turns these into the startup breakdown
+    # the north-star latency metric is judged on).
+    _emit({"event": "jax_ready", "t": time.time(),
+           "backend": jax.default_backend()})
     rules = None
-    model_state = {}
+    # Each branch defines init_params(rng) -> (params, model_state) as a
+    # TRACEABLE closure: the whole setup (init + optimizer) compiles into
+    # one program with sharded outputs (see build_state below), instead of
+    # dispatching dozens of tiny init ops — each a round-trip on a
+    # tunneled chip — before training starts.
 
     if args.model in ("mnist-mlp", "mnist-conv"):
         from tf_operator_tpu.models import mnist as M
 
         model = M.MLP() if args.model == "mnist-mlp" else M.ConvNet()
-        x = jnp.zeros((args.batch, 28, 28), jnp.float32)
-        params = model.init(jax.random.key(0), x[:1])["params"]
+
+        def init_params(rng):
+            x = jnp.zeros((1, 28, 28), jnp.float32)
+            return model.init(rng, x)["params"], {}
 
         def make_batch(rng):
             kx, ky = jax.random.split(rng)
@@ -370,10 +406,12 @@ def main(argv: list[str] | None = None) -> int:
         model = (ResNet50 if args.model == "resnet50" else ResNet18)(
             num_classes=classes
         )
-        params, batch_stats = init_resnet(
-            model, jax.random.key(0), image_size=args.image_size, batch=2
-        )
-        model_state = {"batch_stats": batch_stats}
+
+        def init_params(rng):
+            params, batch_stats = init_resnet(
+                model, rng, image_size=args.image_size, batch=2
+            )
+            return params, {"batch_stats": batch_stats}
 
         def make_batch(rng):
             kx, ky = jax.random.split(rng)
@@ -402,9 +440,12 @@ def main(argv: list[str] | None = None) -> int:
         )
         attn = make_attention_fn(mesh, causal=False)
         model = tfm.BertMLM(cfg, attn_fn=attn)
-        params = tfm.BertMLM(cfg).init(
-            jax.random.key(0), jnp.zeros((1, args.seq), jnp.int32)
-        )["params"]
+
+        def init_params(rng):
+            return tfm.BertMLM(cfg).init(
+                rng, jnp.zeros((1, args.seq), jnp.int32)
+            )["params"], {}
+
         rules = sharding_rules.TRANSFORMER_TP_RULES
 
         def make_batch(rng):
@@ -421,14 +462,18 @@ def main(argv: list[str] | None = None) -> int:
         from tf_operator_tpu.models import moe as moe_lib
 
         cfg = moe_lib.MoEConfig(
-            vocab_size=32000, num_layers=4, hidden=512, num_heads=8,
-            max_len=args.seq, num_experts=8, top_k=2, moe_every=2,
+            vocab_size=32000, num_layers=args.layers, hidden=args.hidden,
+            num_heads=args.heads, max_len=args.seq, num_experts=8, top_k=2,
+            moe_every=2,
         )
         attn = make_attention_fn(mesh, causal=True)
         model = moe_lib.MoETransformerLM(cfg, attn_fn=attn)
-        params = moe_lib.MoETransformerLM(cfg).init(
-            jax.random.key(0), jnp.zeros((1, args.seq), jnp.int32)
-        )["params"]
+
+        def init_params(rng):
+            return moe_lib.MoETransformerLM(cfg).init(
+                rng, jnp.zeros((1, args.seq), jnp.int32)
+            )["params"], {}
+
         rules = sharding_rules.MOE_RULES
 
         def make_batch(rng):
@@ -448,14 +493,17 @@ def main(argv: list[str] | None = None) -> int:
         from tf_operator_tpu.models import transformer as tfm
 
         cfg = tfm.TransformerConfig(
-            vocab_size=32000, num_layers=4, hidden=512, num_heads=8,
-            max_len=args.seq, causal=True,
+            vocab_size=32000, num_layers=args.layers, hidden=args.hidden,
+            num_heads=args.heads, max_len=args.seq, causal=True,
         )
         attn = make_attention_fn(mesh, causal=True)
         model = tfm.TransformerLM(cfg, attn_fn=attn)
-        params = tfm.TransformerLM(cfg).init(
-            jax.random.key(0), jnp.zeros((1, args.seq), jnp.int32)
-        )["params"]
+
+        def init_params(rng):
+            return tfm.TransformerLM(cfg).init(
+                rng, jnp.zeros((1, args.seq), jnp.int32)
+            )["params"], {}
+
         rules = sharding_rules.TRANSFORMER_TP_RULES
 
         def make_batch(rng):
@@ -470,14 +518,34 @@ def main(argv: list[str] | None = None) -> int:
             return tfm.lm_loss(logits, batch["tokens"]), model_state
 
     if args.eval:
-        return _run_evaluator(args, model, params, make_batch, loss_fn)
+        import numpy as np
+
+        # The evaluator only needs a host-side restore template (shapes +
+        # dtypes) — never pay a device init for it.
+        abstract_p, _ = jax.eval_shape(init_params, jax.random.key(0))
+        template = jax.tree.map(
+            lambda s: np.zeros(s.shape, s.dtype), abstract_p
+        )
+        return _run_evaluator(args, model, template, make_batch, loss_fn)
 
     saver = _is_checkpoint_writer() and args.checkpoint_dir
 
     tx = optax.adamw(args.lr)
-    state = create_train_state(params, tx, model_state)
+
+    def build_state():
+        p, ms = init_params(jax.random.key(0))
+        return create_train_state(p, tx, ms)
+
+    # One compiled program builds the fully-sharded initial state directly
+    # on the mesh: out_shardings come from an eval_shape pass, so setup
+    # costs a single compile+dispatch instead of one round-trip per
+    # init/optimizer primitive (which dominated cold start on a tunneled
+    # chip) — and params materialize already laid out, never replicated.
+    st_sh = state_shardings(jax.eval_shape(build_state), mesh, rules)
+    state = jax.jit(build_state, out_shardings=st_sh)()
     state, start_step = _try_resume(args.checkpoint_dir, state)
     state = shard_state(state, mesh, rules)
+    _emit({"event": "model_ready", "t": time.time()})
     if start_step >= args.steps:
         # Already trained to (or past) the target: restart policies must be
         # idempotent, not retrain.
@@ -486,16 +554,17 @@ def main(argv: list[str] | None = None) -> int:
         if (saver and start_step > 0
                 and ckpt_lib.final_step(args.checkpoint_dir) is None):
             ckpt_lib.mark_final(args.checkpoint_dir, start_step)
-        _emit({"event": "done", "steps": start_step, "steady_steps_per_sec": None,
-               "examples_per_sec": None, "final_loss": None,
-               "total_s": round(time.time() - t_start, 3), "resumed_complete": True})
+        _emit({"event": "done", "t": time.time(), "steps": start_step,
+               "steady_steps_per_sec": None, "examples_per_sec": None,
+               "final_loss": None, "total_s": round(time.time() - t_start, 3),
+               "resumed_complete": True})
         return 0
     if args.data_dir:
         return _train_on_dataset(args, state, start_step, loss_fn, tx, mesh,
                                  rules, saver, t_start)
 
     compile_scanned = make_scanned_train_step(
-        loss_fn, tx, mesh, make_batch, rules=rules
+        loss_fn, tx, mesh, make_batch, rules=rules, remat=args.remat
     )
     # Chunked on-device loop: one dispatch per `chunk` steps (batches are
     # generated inside the compiled program) — per-step host round-trips to
@@ -538,6 +607,7 @@ def main(argv: list[str] | None = None) -> int:
             "loss": float(metrics["loss"]),
             "mesh": dict(mesh.shape),
             "backend": jax.default_backend(),
+            "device_kind": jax.devices()[0].device_kind,
             "n_devices": len(jax.devices()),
         }
     )
@@ -594,6 +664,7 @@ def main(argv: list[str] | None = None) -> int:
     _emit(
         {
             "event": "done",
+            "t": time.time(),
             "steps": args.steps,
             "steady_steps_per_sec": sps,
             "examples_per_sec": round(steady * args.batch / dt, 2) if steady > 0 else None,
