@@ -366,3 +366,125 @@ class TestInformerHardening:
         inf._dispatch({"type": "DELETED", "object": bad_payload})
         assert ("default", "tomb") not in inf._cache
         assert deleted and deleted[0].name == "tomb"
+
+
+class TestLeaseElection:
+    """Cluster-grade leader election on coordination.k8s.io/v1 Leases
+    (reference semantics: app/server.go:157-182, 15s/5s/3s)."""
+
+    def test_acquire_deny_expire_takeover_release(self):
+        import time as _time
+
+        from tf_operator_tpu.utils.leader import LeaseElector
+
+        with FakeApiServer() as server:
+            api = K8sApi(server.url)
+            a = LeaseElector(api, identity="op-a", lease_duration=1.0,
+                             renew_period=0.2, retry_period=0.1)
+            b = LeaseElector(api, identity="op-b", lease_duration=1.0,
+                             renew_period=0.2, retry_period=0.1)
+            assert a.try_acquire_or_renew()       # create -> leader
+            assert not b.try_acquire_or_renew()   # live lease held by a
+            assert a.try_acquire_or_renew()       # renew own lease
+            lease = server.get_object("leases", "default", "tpujob-operator")
+            assert lease["spec"]["holderIdentity"] == "op-a"
+            assert lease["spec"]["leaseTransitions"] == 0
+
+            _time.sleep(1.6)                      # a's lease expires
+            assert b.try_acquire_or_renew()       # takeover
+            lease = server.get_object("leases", "default", "tpujob-operator")
+            assert lease["spec"]["holderIdentity"] == "op-b"
+            assert lease["spec"]["leaseTransitions"] == 1
+
+            # a's comeback attempt with the live b lease is denied, and a
+            # stale-rv write (the race loser's PUT) 409s at the wire.
+            assert not a.try_acquire_or_renew()
+            stale = dict(lease)
+            stale["metadata"] = dict(lease["metadata"],
+                                     resourceVersion="1")
+            from tf_operator_tpu.core.cluster import ConflictError
+
+            with pytest.raises(ConflictError):
+                api.request(
+                    "PUT",
+                    "/apis/coordination.k8s.io/v1/namespaces/default/"
+                    "leases/tpujob-operator",
+                    stale,
+                )
+
+            b.release()                           # clean handoff
+            assert a.try_acquire_or_renew()       # immediate, no lease wait
+
+    def test_two_processes_sigkill_failover(self, tmp_path):
+        """Two `tpujob operator --kube-api` processes: exactly one leads
+        (binds its REST port); SIGKILL the leader and the standby takes
+        over within the lease (VERDICT r1 item 3 done-criterion)."""
+        import signal as sig
+        import socket
+        import subprocess
+        import sys
+        import time as _time
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        def serving(port):
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=0.5
+                ) as r:
+                    return r.status == 200
+            except OSError:
+                return False
+
+        with FakeApiServer() as server:
+            ports = [free_port(), free_port()]
+            procs = []
+            try:
+                for port in ports:
+                    procs.append(subprocess.Popen(
+                        [sys.executable, "-m", "tf_operator_tpu.cli.main",
+                         "operator", "--kube-api", server.url,
+                         "--monitoring-port", str(port),
+                         "--enable-leader-election",
+                         "--lease-duration", "2.0",
+                         "--lease-renew-period", "0.5",
+                         "--lease-retry-period", "0.25"],
+                        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+                    ))
+                leader_idx = _wait(
+                    lambda: next((i + 1 for i, p in enumerate(ports)
+                                  if serving(p)), None),
+                    timeout=20, what="one operator became leader",
+                ) - 1
+                standby_idx = 1 - leader_idx
+                # Exactly one leads: give the standby a beat to (not) bind.
+                _time.sleep(1.0)
+                assert not serving(ports[standby_idx])
+                lease = server.get_object("leases", "default",
+                                          "tpujob-operator")
+                first_holder = lease["spec"]["holderIdentity"]
+                assert first_holder
+
+                procs[leader_idx].send_signal(sig.SIGKILL)
+                procs[leader_idx].wait(timeout=5)
+                t0 = _time.monotonic()
+                _wait(lambda: serving(ports[standby_idx]),
+                      timeout=10, what="standby took over")
+                took = _time.monotonic() - t0
+                assert took < 2.0 + 2.5  # lease + renew/retry grace
+                lease = server.get_object("leases", "default",
+                                          "tpujob-operator")
+                assert lease["spec"]["holderIdentity"] != first_holder
+                assert lease["spec"]["leaseTransitions"] >= 1
+            finally:
+                for p in procs:
+                    if p.poll() is None:
+                        p.send_signal(sig.SIGTERM)
+                for p in procs:
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()

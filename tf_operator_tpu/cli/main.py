@@ -169,7 +169,22 @@ def cmd_operator(args) -> int:
         api.stop()
 
     if args.enable_leader_election:
-        LeaderElector(args.lock_file).run_or_die(lead, stop)
+        if on_k8s:
+            # Cluster-grade: N operator replicas across nodes serialize on a
+            # coordination.k8s.io/v1 Lease (ref server.go:157-182 semantics).
+            from tf_operator_tpu.utils.leader import LeaseElector
+
+            clean = LeaseElector(
+                api_client,
+                namespace=args.namespace or "default",
+                lease_duration=args.lease_duration,
+                renew_period=args.lease_renew_period,
+                retry_period=args.lease_retry_period,
+            ).run_or_die(lead, stop)
+            if not clean:
+                return 1  # lease lost: exit so the pod restarts as a standby
+        else:
+            LeaderElector(args.lock_file).run_or_die(lead, stop)
     else:
         lead()
     return 1 if failed.is_set() else 0
@@ -235,6 +250,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gang-scheduler-name", default="volcano")
     p.add_argument("--enable-leader-election", action="store_true")
     p.add_argument("--lock-file", default="/tmp/tpujob-operator.lock")
+    # Lease-election timing (K8s substrate); defaults match the reference
+    # (server.go:157-182: 15s lease / 5s renew / 3s retry).
+    p.add_argument("--lease-duration", type=float, default=15.0)
+    p.add_argument("--lease-renew-period", type=float, default=5.0)
+    p.add_argument("--lease-retry-period", type=float, default=3.0)
     p.add_argument("--log-dir", default=None)
     p.add_argument("--tpu-slices", nargs="*", default=None)
     p.add_argument("--kube-api", default=None,

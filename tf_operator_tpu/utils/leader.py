@@ -1,11 +1,19 @@
-"""Leader election via an fcntl file lock.
+"""Leader election: single-host file lock + cluster-grade Lease election.
 
-Capability parity with the reference's Endpoints-lock leader election
-(app/server.go:157-182, 15s lease / 5s renew / 3s retry): multiple operator
-processes on one host serialize on a lock file; exactly one runs the
-controllers, the rest block as hot standbys and take over when the leader
-dies (the kernel releases the lock on process exit, so failover is
-immediate — no lease timers needed for the single-host case).
+Two implementations of the reference's Endpoints-lock leader election
+(app/server.go:157-182, 15s lease / 5s renew / 3s retry):
+
+  LeaderElector — fcntl file lock. Multiple operator processes on ONE host
+  serialize on a lock file; the kernel releases it on process exit, so
+  failover is immediate. Used by the local-substrate deployment.
+
+  LeaseElector — a coordination.k8s.io/v1 Lease through the API server.
+  N operator replicas across nodes serialize cluster-wide; the loser waits
+  as a hot standby and takes over once the holder's lease expires. Every
+  write carries the lease's resourceVersion, so two contenders racing for
+  an expired lease produce exactly one winner (the loser sees 409 Conflict
+  and goes back to waiting). Used by the --kube-api / --in-cluster
+  deployment; same 15s/5s/3s timing defaults as the reference.
 """
 
 from __future__ import annotations
@@ -14,12 +22,14 @@ import fcntl
 import os
 import threading
 import time
+from datetime import datetime, timezone
 from typing import Callable
 
 from tf_operator_tpu.status import metrics
 from tf_operator_tpu.utils.logging import FieldLogger
 
 DEFAULT_LOCK_PATH = "/tmp/tpujob-operator.lock"
+LEASE_API = "coordination.k8s.io/v1"
 
 
 class LeaderElector:
@@ -70,3 +80,225 @@ class LeaderElector:
                 pass
             self._fd = None
             metrics.is_leader.set(0)
+
+
+def _rfc3339(t: float) -> str:
+    return (
+        datetime.fromtimestamp(t, tz=timezone.utc)
+        .isoformat(timespec="microseconds")
+        .replace("+00:00", "Z")
+    )
+
+
+def _parse_rfc3339(v) -> float | None:
+    # Same tolerance as the adapter's codec (floats from the fake server,
+    # RFC3339 with Z from a real one).
+    from tf_operator_tpu.core.k8s import _parse_time
+
+    return _parse_time(v)
+
+
+class LeaseElector:
+    """Cluster-grade leader election on a coordination.k8s.io/v1 Lease.
+
+    Semantics match the reference's resource-lock election
+    (app/server.go:157-182): lease_duration 15s, renew every 5s, contenders
+    retry every 3s. A leader that cannot renew for a full lease_duration
+    considers itself deposed and calls on_lost (the RunOrDie contract — the
+    operator process exits and its pod restarts as a standby).
+    """
+
+    def __init__(
+        self,
+        api,  # core.k8s.K8sApi
+        namespace: str = "default",
+        name: str = "tpujob-operator",
+        identity: str | None = None,
+        lease_duration: float = 15.0,
+        renew_period: float = 5.0,
+        retry_period: float = 3.0,
+    ):
+        self.api = api
+        self.namespace = namespace
+        self.name = name
+        self.identity = identity or f"{os.uname().nodename}-pid-{os.getpid()}"
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.retry_period = retry_period
+        self._log = FieldLogger(
+            {"component": "lease-election", "id": self.identity}
+        )
+
+    # ------------------------------------------------------------- wire
+
+    @property
+    def _list_path(self) -> str:
+        return f"/apis/{LEASE_API}/namespaces/{self.namespace}/leases"
+
+    @property
+    def _path(self) -> str:
+        return f"{self._list_path}/{self.name}"
+
+    def _get(self) -> dict | None:
+        from tf_operator_tpu.core.cluster import NotFoundError
+
+        try:
+            return self.api.request("GET", self._path)
+        except NotFoundError:
+            return None
+
+    def _spec(self, acquire_time: float, transitions: int) -> dict:
+        now = time.time()
+        return {
+            "holderIdentity": self.identity,
+            # Integer seconds on the wire (the real Lease schema), never 0:
+            # a 0 would read back falsy and every contender would substitute
+            # its OWN configured duration — expiry must come from the lease.
+            "leaseDurationSeconds": max(1, int(round(self.lease_duration))),
+            "acquireTime": _rfc3339(acquire_time),
+            "renewTime": _rfc3339(now),
+            "leaseTransitions": transitions,
+        }
+
+    # -------------------------------------------------------- election
+
+    def try_acquire_or_renew(self) -> bool:
+        """One election round: create the lease, renew our own, or take
+        over an expired one. resourceVersion-guarded writes make a
+        concurrent race produce exactly one winner. Never raises on API
+        trouble — any error is 'not leader this round', so the callers'
+        timing loops (renewal deposes only after a full lease_duration of
+        failures) handle transient 500s and network blips uniformly."""
+        from tf_operator_tpu.core.cluster import ApiError
+
+        try:
+            return self._acquire_or_renew_round()
+        except (ApiError, OSError) as e:
+            self._log.info("election round failed: %s", e)
+            return False
+
+    def _acquire_or_renew_round(self) -> bool:
+        from tf_operator_tpu.core.cluster import ApiError
+
+        lease = self._get()
+        now = time.time()
+        if lease is None:
+            body = {
+                "apiVersion": LEASE_API,
+                "kind": "Lease",
+                "metadata": {"name": self.name, "namespace": self.namespace},
+                "spec": self._spec(acquire_time=now, transitions=0),
+            }
+            try:
+                self.api.request("POST", self._list_path, body)
+                return True
+            except ApiError:
+                return False  # lost the create race
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity") or ""
+        renew = _parse_rfc3339(spec.get("renewTime")) or 0.0
+        raw_duration = spec.get("leaseDurationSeconds")
+        duration = (float(raw_duration) if raw_duration is not None
+                    else self.lease_duration)
+        ours = holder == self.identity
+        if not ours and holder and now < renew + duration:
+            return False  # someone else holds a live lease
+        transitions = int(spec.get("leaseTransitions") or 0)
+        lease["spec"] = self._spec(
+            acquire_time=now if not ours
+            else _parse_rfc3339(spec.get("acquireTime")) or now,
+            transitions=transitions if ours else transitions + 1,
+        )
+        try:
+            # lease["metadata"]["resourceVersion"] rides along: a stale rv
+            # (concurrent takeover) 409s and we go back to waiting.
+            self.api.request("PUT", self._path, lease)
+            return True
+        except ApiError:
+            return False
+
+    def _renew_loop(self, renew_stop: threading.Event,
+                    lost: threading.Event,
+                    on_lost: Callable[[], None]) -> None:
+        last_renew = time.monotonic()
+        while True:
+            if renew_stop.wait(self.renew_period):
+                return
+            if self.try_acquire_or_renew():
+                last_renew = time.monotonic()
+            elif time.monotonic() - last_renew > self.lease_duration:
+                self._log.error("lost leadership (lease not renewed in %.0fs)",
+                                self.lease_duration)
+                lost.set()
+                metrics.is_leader.set(0)
+                on_lost()
+                return
+
+    def run_or_die(
+        self,
+        on_started_leading: Callable[[], None],
+        stop: threading.Event,
+        on_lost: Callable[[], None] | None = None,
+    ) -> bool:
+        """Block until leadership is acquired, then run the callback while a
+        background thread renews the lease. If the lease is lost mid-flight,
+        on_lost fires (default: set `stop`, so the callback unwinds — the
+        process then exits and restarts as a standby, like the reference
+        operator's leaderelection.RunOrDie). Returns False when leadership
+        was lost, True on clean shutdown."""
+        while not stop.is_set():
+            if self.try_acquire_or_renew():
+                self._log.info("became leader")
+                metrics.is_leader.set(1)
+                lost = threading.Event()
+                renew_stop = threading.Event()
+                renewer = threading.Thread(
+                    target=self._renew_loop,
+                    args=(renew_stop, lost, on_lost or stop.set),
+                    daemon=True, name="lease-renew",
+                )
+                renewer.start()
+                try:
+                    on_started_leading()
+                finally:
+                    metrics.is_leader.set(0)
+                    # Stop the renewer BEFORE releasing: a renew round that
+                    # lands after the release would re-hold the lease under
+                    # this (exiting) identity and force the standby to wait
+                    # out the full lease. If the renewer is wedged in an
+                    # in-flight request past the join timeout, skip the
+                    # release — expiry-based takeover is slow but safe.
+                    renew_stop.set()
+                    renewer.join(timeout=5.0)
+                    self.release(
+                        lost_already=lost.is_set() or renewer.is_alive()
+                    )
+                return not lost.is_set()
+            self._log.info("waiting for leadership")
+            stop.wait(self.retry_period)
+        return True
+
+    def release(self, lost_already: bool = False) -> None:
+        """Give up the lease on clean shutdown so the standby takes over
+        immediately instead of waiting out the lease."""
+        if lost_already:
+            return
+        from tf_operator_tpu.core.cluster import ApiError
+
+        lease = None
+        try:
+            lease = self._get()
+        except (ApiError, OSError):
+            return
+        if lease is None:
+            return
+        spec = lease.get("spec") or {}
+        if spec.get("holderIdentity") != self.identity:
+            return
+        spec["holderIdentity"] = ""
+        spec["renewTime"] = None
+        lease["spec"] = spec
+        try:
+            self.api.request("PUT", self._path, lease)
+        except (ApiError, OSError):
+            pass
