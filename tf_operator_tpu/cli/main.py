@@ -180,6 +180,7 @@ def cmd_operator(args) -> int:
                 lease_duration=args.lease_duration,
                 renew_period=args.lease_renew_period,
                 retry_period=args.lease_retry_period,
+                renew_deadline=args.lease_renew_deadline,
             ).run_or_die(lead, stop)
             if not clean:
                 return 1  # lease lost: exit so the pod restarts as a standby
@@ -255,6 +256,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lease-duration", type=float, default=15.0)
     p.add_argument("--lease-renew-period", type=float, default=5.0)
     p.add_argument("--lease-retry-period", type=float, default=3.0)
+    p.add_argument("--lease-renew-deadline", type=float, default=None,
+                   help="leader deposes itself after this long without a "
+                        "renew (default 2/3 of --lease-duration; must be "
+                        "under it so deposition beats standby takeover)")
     p.add_argument("--log-dir", default=None)
     p.add_argument("--tpu-slices", nargs="*", default=None)
     p.add_argument("--kube-api", default=None,

@@ -166,12 +166,38 @@ class ApiServer:
                                     eps[pod.name] = f"127.0.0.1:{port_no}"
                         self._send({"endpoints": eps})
                     elif parts[:2] == ["api", "logs"] and len(parts) == 4:
+                        ns, pod_name = parts[2], parts[3]
+                        if hasattr(outer.cluster, "pod_logs"):
+                            # K8s substrate: proxy the pod-log subresource
+                            # (ref dashboard api_handler.go:237) — the local
+                            # log_dir is dead in --kube-api mode.
+                            from tf_operator_tpu.core.cluster import (
+                                ApiError,
+                                NotFoundError,
+                            )
+
+                            try:
+                                # tailLines keeps the truncation server-side
+                                # (a long run's full log never crosses the
+                                # wire just to be sliced here).
+                                text = outer.cluster.pod_logs(
+                                    ns, pod_name, tail_lines=1000
+                                )
+                            except NotFoundError:
+                                self._send({"error": "no logs"}, 404)
+                                return
+                            except (ApiError, OSError) as e:
+                                self._send({"error": str(e)}, 502)
+                                return
+                            self._send(text[-65536:],
+                                       content_type="text/plain")
+                            return
                         if outer.log_dir is None:
                             self._send({"error": "log collection disabled"}, 404)
                             return
                         import os
 
-                        path = os.path.join(outer.log_dir, f"{parts[2]}_{parts[3]}.log")
+                        path = os.path.join(outer.log_dir, f"{ns}_{pod_name}.log")
                         if not os.path.exists(path):
                             self._send({"error": "no logs"}, 404)
                             return

@@ -471,10 +471,17 @@ class K8sApi:
         return ApiError(f"HTTP {e.code}: {msg}")
 
     def request(self, method: str, path: str, body: dict | None = None,
-                params: dict | None = None) -> dict:
-        with self._open(method, path, body, params) as r:
+                params: dict | None = None,
+                timeout: float | None = None) -> dict:
+        with self._open(method, path, body, params, timeout=timeout) as r:
             text = r.read().decode()
         return json.loads(text) if text else {}
+
+    def request_text(self, method: str, path: str,
+                     params: dict | None = None) -> str:
+        """Raw-text request for non-JSON subresources (pod logs)."""
+        with self._open(method, path, None, params) as r:
+            return r.read().decode(errors="replace")
 
     def stream(self, path: str, params: dict | None = None,
                on_response: Callable | None = None):
@@ -853,6 +860,21 @@ class K8sCluster:
     def list_pods(self, namespace: str | None = None,
                   selector: dict | None = None) -> list[Pod]:
         return self._list(KIND_POD, namespace, selector)
+
+    def pod_logs(self, namespace: str, name: str,
+                 container: str | None = None,
+                 tail_lines: int | None = None) -> str:
+        """Pod-log subresource — the dashboard's log view in --kube-api
+        mode (ref dashboard/backend/handler/api_handler.go:237)."""
+        params: dict[str, str] = {}
+        if container:
+            params["container"] = container
+        if tail_lines:
+            params["tailLines"] = str(tail_lines)
+        return self.api.request_text(
+            "GET", f"/api/v1/namespaces/{namespace}/pods/{name}/log",
+            params=params or None,
+        )
 
     # -------------------------------------------------------- services
 

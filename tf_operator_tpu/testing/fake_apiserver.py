@@ -28,7 +28,7 @@ _PATH_RE = re.compile(
     r"(?:/namespaces/(?P<ns>[^/]+))?"
     r"/(?P<resource>[^/?]+)"
     r"(?:/(?P<name>[^/?]+))?"
-    r"(?:/(?P<sub>status))?$"
+    r"(?:/(?P<sub>status|log))?$"
 )
 
 
@@ -40,6 +40,8 @@ class _Store:
         self.objects: dict[str, dict[tuple[str, str], dict]] = {}
         # append-only watch log: (rv, type, resource, obj_dict)
         self.log: list[tuple[int, str, str, dict]] = []
+        # kubelet-side pod logs, served by GET .../pods/{name}/log
+        self.pod_logs: dict[tuple[str, str], str] = {}
 
     def bump(self) -> int:
         self.rv += 1
@@ -91,6 +93,19 @@ class FakeApiServer:
                 if m is None:
                     return self._error(404, "NotFound", self.path)
                 res, ns, name = m["resource"], m["ns"], m["name"]
+                if res == "pods" and name and m["sub"] == "log":
+                    with store.lock:
+                        text = store.pod_logs.get((ns, name))
+                        exists = (ns, name) in store.objects.get("pods", {})
+                    if text is None and not exists:
+                        return self._error(404, "NotFound", f"pod {ns}/{name}")
+                    body = (text or "").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if not name and q.get("watch") == "true":
                     # The watch loop streams indefinitely: it must NOT hold
                     # the store lock (writers would deadlock behind a slow
@@ -295,6 +310,11 @@ class FakeApiServer:
     def list_objects(self, resource: str) -> list[dict]:
         with self.store.lock:
             return list(self.store.objects.get(resource, {}).values())
+
+    def set_pod_log(self, namespace: str, name: str, text: str) -> None:
+        """Stand in for kubelet's log collection."""
+        with self.store.lock:
+            self.store.pod_logs[(namespace, name)] = text
 
     def set_pod_status(self, namespace: str, name: str, phase: str,
                        exit_code: int | None = None,

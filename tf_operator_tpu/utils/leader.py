@@ -117,6 +117,7 @@ class LeaseElector:
         lease_duration: float = 15.0,
         renew_period: float = 5.0,
         retry_period: float = 3.0,
+        renew_deadline: float | None = None,
     ):
         self.api = api
         self.namespace = namespace
@@ -125,6 +126,20 @@ class LeaseElector:
         self.lease_duration = lease_duration
         self.renew_period = renew_period
         self.retry_period = retry_period
+        # The leader must depose itself STRICTLY before a standby can seize
+        # the expired lease, or both run controllers concurrently
+        # (client-go: RenewDeadline 10s < LeaseDuration 15s).
+        self.renew_deadline = (
+            renew_deadline if renew_deadline is not None
+            else lease_duration * 2.0 / 3.0
+        )
+        self.renew_deadline = min(self.renew_deadline, lease_duration * 0.9)
+        # Skew tolerance: lease expiry is judged by how long WE have
+        # observed the lease unchanged (local monotonic clock), never by
+        # comparing the holder's wall-clock renewTime with ours
+        # (client-go's observedTime pattern).
+        self._observed: tuple[str, str] | None = None
+        self._observed_at = 0.0
         self._log = FieldLogger(
             {"component": "lease-election", "id": self.identity}
         )
@@ -139,11 +154,13 @@ class LeaseElector:
     def _path(self) -> str:
         return f"{self._list_path}/{self.name}"
 
-    def _get(self) -> dict | None:
+    def _get(self, timeout: float | None = None) -> dict | None:
         from tf_operator_tpu.core.cluster import NotFoundError
 
         try:
-            return self.api.request("GET", self._path)
+            return self.api.request(
+                "GET", self._path, timeout=timeout or self.renew_deadline
+            )
         except NotFoundError:
             return None
 
@@ -162,25 +179,27 @@ class LeaseElector:
 
     # -------------------------------------------------------- election
 
-    def try_acquire_or_renew(self) -> bool:
+    def try_acquire_or_renew(self, timeout: float | None = None) -> bool:
         """One election round: create the lease, renew our own, or take
         over an expired one. resourceVersion-guarded writes make a
         concurrent race produce exactly one winner. Never raises on API
         trouble — any error is 'not leader this round', so the callers'
-        timing loops (renewal deposes only after a full lease_duration of
-        failures) handle transient 500s and network blips uniformly."""
+        timing loops (renewal deposes after renew_deadline of failures)
+        handle transient 500s and network blips uniformly. `timeout`
+        bounds each HTTP request (default renew_deadline)."""
         from tf_operator_tpu.core.cluster import ApiError
 
         try:
-            return self._acquire_or_renew_round()
+            return self._acquire_or_renew_round(timeout)
         except (ApiError, OSError) as e:
             self._log.info("election round failed: %s", e)
             return False
 
-    def _acquire_or_renew_round(self) -> bool:
+    def _acquire_or_renew_round(self, timeout: float | None = None) -> bool:
         from tf_operator_tpu.core.cluster import ApiError
 
-        lease = self._get()
+        timeout = timeout or self.renew_deadline
+        lease = self._get(timeout)
         now = time.time()
         if lease is None:
             body = {
@@ -190,19 +209,28 @@ class LeaseElector:
                 "spec": self._spec(acquire_time=now, transitions=0),
             }
             try:
-                self.api.request("POST", self._list_path, body)
+                self.api.request("POST", self._list_path, body,
+                                 timeout=timeout)
                 return True
             except ApiError:
                 return False  # lost the create race
         spec = lease.get("spec") or {}
         holder = spec.get("holderIdentity") or ""
-        renew = _parse_rfc3339(spec.get("renewTime")) or 0.0
         raw_duration = spec.get("leaseDurationSeconds")
         duration = (float(raw_duration) if raw_duration is not None
                     else self.lease_duration)
         ours = holder == self.identity
-        if not ours and holder and now < renew + duration:
-            return False  # someone else holds a live lease
+        if not ours and holder:
+            # Restart the local observation clock whenever the lease record
+            # changes; it is "expired" only once WE have seen it unchanged
+            # for its full duration. Immune to cross-node wall-clock skew.
+            key = (holder, str(spec.get("renewTime")))
+            mono = time.monotonic()
+            if key != self._observed:
+                self._observed = key
+                self._observed_at = mono
+            if mono - self._observed_at < duration:
+                return False  # someone else holds a live lease
         transitions = int(spec.get("leaseTransitions") or 0)
         lease["spec"] = self._spec(
             acquire_time=now if not ours
@@ -212,7 +240,7 @@ class LeaseElector:
         try:
             # lease["metadata"]["resourceVersion"] rides along: a stale rv
             # (concurrent takeover) 409s and we go back to waiting.
-            self.api.request("PUT", self._path, lease)
+            self.api.request("PUT", self._path, lease, timeout=timeout)
             return True
         except ApiError:
             return False
@@ -224,11 +252,19 @@ class LeaseElector:
         while True:
             if renew_stop.wait(self.renew_period):
                 return
-            if self.try_acquire_or_renew():
+            # Depose at renew_deadline (< lease_duration). Each attempt's
+            # HTTP timeout is capped by the REMAINING deadline budget, so a
+            # hung API connection cannot push deposition past the point
+            # where a partitioned-off standby could seize the lease
+            # (observation-based takeover needs >= lease_duration).
+            budget = self.renew_deadline - (time.monotonic() - last_renew)
+            if budget > 0 and self.try_acquire_or_renew(
+                timeout=max(0.5, budget)
+            ):
                 last_renew = time.monotonic()
-            elif time.monotonic() - last_renew > self.lease_duration:
+            elif time.monotonic() - last_renew > self.renew_deadline:
                 self._log.error("lost leadership (lease not renewed in %.0fs)",
-                                self.lease_duration)
+                                self.renew_deadline)
                 lost.set()
                 metrics.is_leader.set(0)
                 on_lost()
