@@ -224,11 +224,13 @@ class TestRestApi:
         with pytest.raises(urllib.error.HTTPError):
             self._get(server, "/api/trainjobs/default/bad-job")
 
-    def test_endpoints_without_runtime_404(self, served):
+    def test_endpoints_without_runtime_reads_annotations(self, served):
+        """With no local runtime attached, endpoints come from the node
+        agent's pod annotations (the K8s-substrate path) — an unknown job
+        simply has none."""
         _, _, server = served
-        with pytest.raises(urllib.error.HTTPError) as e:
-            self._get(server, "/api/endpoints/default/nope")
-        assert e.value.code == 404
+        body = self._get(server, "/api/endpoints/default/nope")
+        assert body == {"endpoints": {}}
 
 
 class TestLeaderElection:

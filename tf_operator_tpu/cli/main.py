@@ -191,6 +191,42 @@ def cmd_operator(args) -> int:
     return 1 if failed.is_set() else 0
 
 
+def cmd_kubelet(args) -> int:
+    """Node agent: run this node's share of pods from the API server as
+    local processes (the kubelet role in SURVEY.md §3.3's 'kubelet starts
+    the tensorflow container' step). With this running, `--kube-api` mode
+    is a complete single-node cluster: operator reconciles CRs into pods,
+    the agent executes them and feeds status back."""
+    from tf_operator_tpu.core.cluster import KIND_POD
+    from tf_operator_tpu.core.k8s import K8sApi, K8sCluster
+    from tf_operator_tpu.runtime.local import LocalProcessRuntime
+
+    log = FieldLogger({"component": "kubelet"})
+    if not args.kube_api and not args.in_cluster:
+        print("error: kubelet requires --kube-api URL or --in-cluster",
+              file=sys.stderr)
+        return 2
+    api_client = (
+        K8sApi.in_cluster() if args.in_cluster
+        else K8sApi(args.kube_api, token=args.kube_token,
+                    insecure=args.kube_insecure)
+    )
+    cluster = K8sCluster(api_client, namespace=args.namespace or None)
+    runtime = LocalProcessRuntime(cluster, log_dir=args.log_dir)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    cluster.start((KIND_POD,))
+    if not cluster.wait_synced(60):
+        log.error("pod informer never synced; exiting")
+        return 1
+    log.info("node agent running against %s", args.kube_api or "in-cluster")
+    stop.wait()
+    runtime.stop()
+    cluster.stop()
+    return 0
+
+
 def _api_get(server: str, path: str) -> dict:
     with urllib.request.urlopen(f"http://{server}{path}", timeout=10) as r:
         return json.loads(r.read())
@@ -275,6 +311,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="restrict the operator to one namespace "
                         "(options.go namespace scope)")
     p.set_defaults(fn=cmd_operator)
+
+    p = sub.add_parser("kubelet")
+    p.add_argument("--kube-api", default=None)
+    p.add_argument("--in-cluster", action="store_true")
+    p.add_argument("--kube-token", default=None)
+    p.add_argument("--kube-insecure", action="store_true")
+    p.add_argument("--namespace", default=None)
+    p.add_argument("--log-dir", default=None)
+    p.set_defaults(fn=cmd_kubelet)
 
     p = sub.add_parser("get")
     p.add_argument("namespace", nargs="?", default=None)

@@ -146,7 +146,24 @@ class ApiServer:
                         )
                     elif parts[:2] == ["api", "endpoints"] and len(parts) == 4:
                         if outer.runtime is None:
-                            self._send({"error": "no runtime attached"}, 404)
+                            # K8s substrate: the node agent publishes each
+                            # replica's dialable address on the pod (its
+                            # stand-in for status.podIP) — read it back.
+                            from tf_operator_tpu.core.cluster import (
+                                ENDPOINT_ANNOTATION,
+                            )
+
+                            ns, name = parts[2], parts[3]
+                            eps = {}
+                            for pod in outer.cluster.list_pods(
+                                ns, {"job-name": name}
+                            ):
+                                ep = pod.metadata.annotations.get(
+                                    ENDPOINT_ANNOTATION
+                                )
+                                if ep:
+                                    eps[pod.name] = ep
+                            self._send({"endpoints": eps})
                             return
                         ns, name = parts[2], parts[3]
                         pm = outer.runtime.port_map(name, ns)

@@ -178,6 +178,12 @@ KIND_POD = "Pod"
 KIND_SERVICE = "Service"
 KIND_PODGROUP = "PodGroup"
 
+# Published by the node agent on each pod it runs: the replica's dialable
+# local HTTP address (this framework's stand-in for status.podIP). The
+# dashboard's endpoints view reads it back when no in-process runtime is
+# attached.
+ENDPOINT_ANNOTATION = "tpujob.dev/host-endpoint"
+
 
 class InMemoryCluster:
     """Thread-safe in-process cluster state with informer-style handlers.
@@ -339,6 +345,12 @@ class InMemoryCluster:
         return self._try_get(KIND_POD, namespace, name)
 
     def update_pod(self, pod: Pod) -> Pod:
+        return self._update(KIND_POD, pod)
+
+    def update_pod_status(self, pod: Pod) -> Pod:
+        """Kubelet-side write (status + runtime annotations). One store on
+        the in-memory substrate; the K8s adapter splits it across the main
+        resource and the /status subresource."""
         return self._update(KIND_POD, pod)
 
     def delete_pod(self, namespace: str, name: str) -> Pod:

@@ -852,7 +852,18 @@ class K8sCluster:
         return self._try_get(KIND_POD, namespace, name)
 
     def update_pod(self, pod: Pod) -> Pod:
+        """Metadata/spec write (controller adoption etc.) — status is the
+        kubelet's resource; use update_pod_status for phase transitions."""
         return self._update(KIND_POD, pod)
+
+    def update_pod_status(self, pod: Pod) -> Pod:
+        """Kubelet-side write: the runtime's updates carry both metadata
+        (the endpoint annotation) and status (phase transitions), which the
+        API server takes on separate resources — main resource first, then
+        /status with the fresh rv."""
+        updated = self._update(KIND_POD, pod)
+        pod.metadata.resource_version = updated.metadata.resource_version
+        return self._update(KIND_POD, pod, subresource="status")
 
     def delete_pod(self, namespace: str, name: str):
         return self._delete(KIND_POD, namespace, name)

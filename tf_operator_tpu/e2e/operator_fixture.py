@@ -85,3 +85,50 @@ class OperatorProcess:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+class KubeletProcess:
+    """`tpujob kubelet` as a separate OS process: the node agent that turns
+    API-server pods into local processes. With OperatorProcess(--kube-api)
+    this completes the wire-substrate deployment (reference Tier-3's
+    setup-cluster step, workflows.libsonnet:216-298)."""
+
+    def __init__(self, kube_api: str, log_dir: str):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._logfile = open(os.path.join(log_dir, "kubelet.log"), "ab")
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "tf_operator_tpu.cli.main", "kubelet",
+                "--kube-api", kube_api, "--log-dir", log_dir,
+            ],
+            env=env,
+            stdout=self._logfile,
+            stderr=subprocess.STDOUT,
+        )
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        self._logfile.close()
+
+    def __enter__(self) -> "KubeletProcess":
+        # No HTTP surface to probe; an early crash is the only readiness
+        # failure worth catching (suites' own waits absorb informer sync).
+        time.sleep(0.3)
+        if self.proc.poll() is not None:
+            raise RuntimeError(
+                f"kubelet exited early ({self.proc.returncode}); see "
+                f"{self.log_dir}/kubelet.log"
+            )
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -139,6 +139,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--junit-dir", default=None)
     ap.add_argument("--server", default=None,
                     help="target a running operator instead of spawning one")
+    ap.add_argument("--substrate", choices=["local", "kube"], default="local",
+                    help="kube: fake API server + `operator --kube-api` + "
+                         "`kubelet` node agent, so every suite crosses the "
+                         "real K8s wire protocol (reference Tier-3 scope)")
     ap.add_argument("--retries", type=int, default=2)
     args = ap.parse_args(argv)
 
@@ -154,6 +158,15 @@ def main(argv: list[str] | None = None) -> int:
     if args.server:
         return run_all(TrainJobClient(args.server))
     with tempfile.TemporaryDirectory(prefix="tpujob-e2e-") as log_dir:
+        if args.substrate == "kube":
+            from tf_operator_tpu.e2e.operator_fixture import KubeletProcess
+            from tf_operator_tpu.testing.fake_apiserver import FakeApiServer
+
+            with FakeApiServer() as fake:
+                with OperatorProcess(
+                    log_dir, extra_args=["--kube-api", fake.url]
+                ) as op, KubeletProcess(fake.url, log_dir):
+                    return run_all(TrainJobClient(op.server))
         with OperatorProcess(log_dir) as op:
             return run_all(TrainJobClient(op.server))
 

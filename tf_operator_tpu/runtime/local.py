@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass, field
 
 from tf_operator_tpu.core.cluster import (
+    ENDPOINT_ANNOTATION,
     KIND_POD,
     ContainerStatus,
     InMemoryCluster,
@@ -153,7 +154,7 @@ class LocalProcessRuntime:
 
     def __init__(
         self,
-        cluster: InMemoryCluster,
+        cluster,  # InMemoryCluster | core.k8s.K8sCluster (same surface)
         env_overrides: dict[str, str] | None = None,
         inherit_env: bool = True,
         log_dir: str | None = None,
@@ -241,9 +242,8 @@ class LocalProcessRuntime:
             env[e.name] = pm.rewrite(e.value)
         # This replica's own listen ports: the localhost ports its DNS
         # identity was rewritten to, keyed by the container's declared ports.
-        own_host = next((h for h in pm.ports if h.startswith(f"{pod.name}.")), None)
+        own_host, port_by_name = self._own_host(pod, pm)
         if own_host is not None:
-            port_by_name = {p.name: p.container_port for p in container.ports}
             tf_local = pm.local_port(own_host, port_by_name.get("tfjob-port", 2222))
             coord_local = pm.local_port(own_host, port_by_name.get("coord-port", 8476))
             if tf_local is not None:
@@ -253,10 +253,37 @@ class LocalProcessRuntime:
         env.update(self.env_overrides)
         return env
 
+    def _own_host(self, pod: Pod, pm: PortMap) -> tuple[str | None, dict[str, int]]:
+        """This replica's own DNS identity in the port map + its declared
+        container ports by name (shared by env injection and the published
+        endpoint so the listen port and the dialable address cannot drift)."""
+        own = next((h for h in pm.ports if h.startswith(f"{pod.name}.")), None)
+        ports = (
+            {p.name: p.container_port for p in pod.spec.containers[0].ports}
+            if pod.spec.containers else {}
+        )
+        return own, ports
+
+    def _own_endpoint(self, pod: Pod, pm: PortMap) -> str | None:
+        """This replica's tfjob-port as a dialable localhost address."""
+        own_host, port_by_name = self._own_host(pod, pm)
+        if own_host is None:
+            return None
+        local = pm.local_port(own_host, port_by_name.get("tfjob-port", 2222))
+        if local is None:
+            mapping = pm.ports.get(own_host) or {}
+            local = sorted(mapping.values())[0] if mapping else None
+        return f"127.0.0.1:{local}" if local is not None else None
+
     def _run_pod(self, pod: Pod) -> None:
         """Process lifecycle for one pod, including kubelet-style in-place
         restarts for Always/OnFailure pod restart policies."""
         log = logger_for_pod(pod.namespace, pod.name)
+        if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+            # A relist can replay pods that already ran to completion (e.g.
+            # the node agent restarting against a live API server): never
+            # re-execute them.
+            return
         if not pod.spec.containers or not (
             pod.spec.containers[0].command or pod.spec.containers[0].args
         ):
@@ -293,7 +320,8 @@ class LocalProcessRuntime:
             entry = _Proc(pod.metadata.uid, process, restart_count)
             with self._lock:
                 self._procs[(pod.namespace, pod.name)] = entry
-            self._set_status(pod, PodPhase.RUNNING, None, restart_count)
+            self._set_status(pod, PodPhase.RUNNING, None, restart_count,
+                             endpoint=self._own_endpoint(pod, pm))
 
             code = process.wait()
             process.release()
@@ -334,29 +362,47 @@ class LocalProcessRuntime:
         exit_code: int | None,
         restart_count: int,
         reason: str = "",
+        endpoint: str | None = None,
     ) -> None:
-        try:
-            cur = self.cluster.get_pod(pod.namespace, pod.name)
-        except Exception:
-            return
-        if cur.metadata.uid != pod.metadata.uid:
-            return  # replaced by a newer pod with the same name
-        cur.status.phase = phase
-        if cur.status.start_time is None and phase != PodPhase.PENDING:
-            cur.status.start_time = time.time()
-        cname = pod.spec.containers[0].name
-        cs = next((c for c in cur.status.container_statuses if c.name == cname), None)
-        if cs is None:
-            cs = ContainerStatus(name=cname)
-            cur.status.container_statuses.append(cs)
-        cs.running = phase == PodPhase.RUNNING
-        cs.exit_code = exit_code
-        cs.restart_count = restart_count
-        cs.reason = reason
-        try:
-            self.cluster.update_pod(cur)
-        except Exception:
-            pass
+        # Re-read + retry: against a real API server a concurrent write (the
+        # controller patching labels, another status bump) 409s; dropping a
+        # phase transition would wedge the job's state machine, so terminal
+        # phases retry much harder than intermediate ones.
+        terminal = phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+        attempts = 40 if terminal else 5
+        for _ in range(attempts):
+            try:
+                cur = self.cluster.get_pod(pod.namespace, pod.name)
+            except Exception:
+                return
+            if cur.metadata.uid != pod.metadata.uid:
+                return  # replaced by a newer pod with the same name
+            cur.status.phase = phase
+            if cur.status.start_time is None and phase != PodPhase.PENDING:
+                cur.status.start_time = time.time()
+            if endpoint:
+                cur.metadata.annotations[ENDPOINT_ANNOTATION] = endpoint
+            cname = pod.spec.containers[0].name
+            cs = next(
+                (c for c in cur.status.container_statuses if c.name == cname),
+                None,
+            )
+            if cs is None:
+                cs = ContainerStatus(name=cname)
+                cur.status.container_statuses.append(cs)
+            cs.running = phase == PodPhase.RUNNING
+            cs.exit_code = exit_code
+            cs.restart_count = restart_count
+            cs.reason = reason
+            try:
+                self.cluster.update_pod_status(cur)
+                return
+            except Exception:
+                time.sleep(0.05)  # conflict/transient: re-read and retry
+        logger_for_pod(pod.namespace, pod.name).error(
+            "dropping pod status write after %d attempts (phase=%s exit=%s)",
+            attempts, phase, exit_code,
+        )
 
     # ------------------------------------------------------------------ stop
 
