@@ -16,11 +16,16 @@ SPMD shape of the schedule: every device runs the *same* program every tick
 Efficiency therefore grows with M; pick M >= 4*S in practice.
 
 Composition: the batch dimension shards over dp/fsdp as usual (each
-data-parallel group runs an independent pipeline replica); tp/sp axes are
-left unmentioned in the specs, i.e. stage bodies see them replicated. The
-backward pass needs no code: AD transposes `ppermute` into the reverse hop
-and the scan into the reverse schedule. `remat=True` recomputes each stage
-in backward, the standard memory/compute trade for deep pipelines.
+data-parallel group runs an independent pipeline replica). tp composes via
+*partial-manual* shard_map: only pp + the batch axes are manual inside the
+body (`axis_names=`), so any tp sharding on the stage weights' inner dims
+stays visible to GSPMD, which auto-partitions the stage matmuls
+Megatron-style (column/row splits + psum) *inside* the hand-written GPipe
+schedule — manual where the schedule needs it, compiler-driven where it
+doesn't. The backward pass needs no code: AD transposes `ppermute` into the
+reverse hop and the scan into the reverse schedule. `remat=True` recomputes
+each stage in backward, the standard memory/compute trade for deep
+pipelines.
 """
 
 from __future__ import annotations
@@ -143,8 +148,12 @@ def pipeline_apply(
         return jax.lax.psum(outputs, pp_axis)
 
     xs = x.reshape((m, b // m) + x.shape[1:])
+    # Partial-manual: only the schedule axes are manual; tp/sp stay under
+    # GSPMD so tensor-parallel stage internals auto-partition (see header).
+    manual = frozenset({pp_axis}) | frozenset(b_spec or ())
     fn = jax.shard_map(
-        body, mesh=mesh, in_specs=(p_spec, x_spec), out_specs=x_spec
+        body, mesh=mesh, in_specs=(p_spec, x_spec), out_specs=x_spec,
+        axis_names=manual,
     )
     return fn(stacked_params, xs).reshape(x.shape)
 
@@ -234,10 +243,26 @@ def make_pipelined_lm(cfg, mesh: Mesh, num_microbatches: int,
     return init, loss_fn, apply_fn
 
 
-def pipeline_rules():
+def pipeline_rules(tp: bool = False):
     """Sharding rules for make_pipelined_lm params: stage stacks on pp,
-    embed/head replicated (rules compose with fsdp as usual)."""
-    return [
+    embed/head replicated (rules compose with fsdp as usual).
+
+    With tp=True, stage kernels additionally split their matmul dims over
+    the tp axis (stacked-leading-dim variants of TRANSFORMER_TP_RULES);
+    pipeline_apply's partial-manual shard_map leaves tp to GSPMD, so the
+    stage bodies run Megatron column/row-parallel without manual psums.
+    """
+    rules = []
+    if tp:
+        rules += [
+            (r".*stages/.*(query|key|value|qkv)/kernel$", P("pp", None, "tp")),
+            (r".*stages/.*attn_out/kernel$", P("pp", "tp", None)),
+            (r".*stages/.*mlp_in/kernel$", P("pp", None, "tp")),
+            (r".*stages/.*mlp_out/kernel$", P("pp", "tp", None)),
+            (r".*embed/embedding$", P("tp", None)),
+            (r".*lm_head/kernel$", P(None, "tp")),
+        ]
+    return rules + [
         (r".*stages/.*", P("pp")),
         (r".*", P()),
     ]

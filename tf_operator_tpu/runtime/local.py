@@ -35,6 +35,7 @@ from tf_operator_tpu.core.cluster import (
     KIND_POD,
     ContainerStatus,
     InMemoryCluster,
+    NotFoundError,
     Pod,
     PodPhase,
 )
@@ -373,8 +374,11 @@ class LocalProcessRuntime:
         for _ in range(attempts):
             try:
                 cur = self.cluster.get_pod(pod.namespace, pod.name)
+            except NotFoundError:
+                return  # pod deleted; nothing to report status on
             except Exception:
-                return
+                time.sleep(0.05)  # transient read failure: retry like writes
+                continue
             if cur.metadata.uid != pod.metadata.uid:
                 return  # replaced by a newer pod with the same name
             cur.status.phase = phase
