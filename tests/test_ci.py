@@ -1,0 +1,233 @@
+"""CI DAG runner + release publish gate.
+
+Reference parity: the Argo workflow DAG (test/workflows/components/
+workflows.libsonnet:216-298) and the tag-green-postsubmit release flow
+(py/kubeflow/tf_operator/release.py:248, prow.py). These tests pin the
+executable equivalents: ci/pipeline.yaml parses into a valid DAG,
+tools/ci.py honors dependencies / parallel branches / failure propagation,
+and tools/release.py publish refuses to push without a green CI summary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import ci  # noqa: E402  (tools/ci.py)
+
+
+class TestPipelineDefinition:
+    def test_repo_pipeline_parses_and_is_acyclic(self):
+        stages = ci.load_pipeline(str(REPO / "ci" / "pipeline.yaml"))
+        # The reference DAG's load-bearing shape: build+lint gate unit, the
+        # two e2e substrates are independent branches, bench gates release.
+        assert set(stages) >= {
+            "build-native", "py-lint", "unit", "dryrun-multichip",
+            "e2e-local", "e2e-kube", "bench", "release-build",
+        }
+        assert "unit" in stages["e2e-local"]["deps"]
+        assert "unit" in stages["e2e-kube"]["deps"]
+        assert "bench" in stages["release-build"]["deps"]
+        # Topo order: deps come before dependents.
+        order = list(stages)
+        for name, spec in stages.items():
+            for dep in spec.get("deps", []):
+                assert order.index(dep) < order.index(name), (dep, name)
+
+    def test_cycle_rejected(self, tmp_path):
+        p = tmp_path / "cyc.yaml"
+        p.write_text(
+            "stages:\n"
+            "  a: {cmd: 'true', deps: [b]}\n"
+            "  b: {cmd: 'true', deps: [a]}\n"
+        )
+        with pytest.raises(ValueError, match="cycle"):
+            ci.load_pipeline(str(p))
+
+    def test_unknown_dep_rejected(self, tmp_path):
+        p = tmp_path / "bad.yaml"
+        p.write_text("stages:\n  a: {cmd: 'true', deps: [nope]}\n")
+        with pytest.raises(ValueError, match="unknown dep"):
+            ci.load_pipeline(str(p))
+
+
+class TestRunner:
+    def _pipeline(self, tmp_path, text):
+        p = tmp_path / "p.yaml"
+        p.write_text(text)
+        return str(p)
+
+    def test_runs_in_dependency_order(self, tmp_path):
+        marker = tmp_path / "order.txt"
+        path = self._pipeline(
+            tmp_path,
+            "stages:\n"
+            f"  one: {{cmd: 'echo one >> {marker}'}}\n"
+            f"  two: {{cmd: 'echo two >> {marker}', deps: [one]}}\n"
+            f"  three: {{cmd: 'echo three >> {marker}', deps: [two]}}\n",
+        )
+        rc = ci.main(["--pipeline", path, "--artifacts", str(tmp_path / "a")])
+        assert rc == 0
+        assert marker.read_text().split() == ["one", "two", "three"]
+        summary = json.loads((tmp_path / "a" / "summary.json").read_text())
+        assert summary["ok"]
+        assert all(r["status"] == "ok" for r in summary["stages"].values())
+
+    def test_failure_skips_dependents_and_exits_nonzero(self, tmp_path):
+        path = self._pipeline(
+            tmp_path,
+            "stages:\n"
+            "  ok: {cmd: 'true'}\n"
+            "  boom: {cmd: 'exit 3'}\n"
+            "  downstream: {cmd: 'true', deps: [boom]}\n"
+            "  independent: {cmd: 'true', deps: [ok]}\n",
+        )
+        rc = ci.main(["--pipeline", path, "--artifacts", str(tmp_path / "a")])
+        assert rc == 1
+        summary = json.loads((tmp_path / "a" / "summary.json").read_text())
+        st = {n: r["status"] for n, r in summary["stages"].items()}
+        assert st == {"ok": "ok", "boom": "failed",
+                      "downstream": "skipped", "independent": "ok"}
+        assert summary["stages"]["boom"]["returncode"] == 3
+
+    def test_skip_drops_stage_and_dependents(self, tmp_path):
+        path = self._pipeline(
+            tmp_path,
+            "stages:\n"
+            "  a: {cmd: 'true'}\n"
+            "  b: {cmd: 'true', deps: [a]}\n"
+            "  c: {cmd: 'true', deps: [b]}\n",
+        )
+        rc = ci.main(["--pipeline", path, "--artifacts", str(tmp_path / "a"),
+                      "--skip", "b"])
+        assert rc == 0
+        summary = json.loads((tmp_path / "a" / "summary.json").read_text())
+        assert set(summary["stages"]) == {"a"}
+
+    def test_artifacts_placeholder_and_logs(self, tmp_path):
+        art = tmp_path / "art"
+        path = self._pipeline(
+            tmp_path,
+            "stages:\n"
+            "  w: {cmd: 'echo hello > {artifacts}/out.txt'}\n",
+        )
+        rc = ci.main(["--pipeline", path, "--artifacts", str(art)])
+        assert rc == 0
+        assert (art / "out.txt").read_text().strip() == "hello"
+        assert (art / "w.log").exists()
+
+
+class TestPublishGate:
+    def _publish(self, args, cwd=REPO):
+        return subprocess.run(
+            [sys.executable, str(REPO / "tools" / "release.py"), "publish",
+             "--registry", "example.test/proj", *args],
+            capture_output=True, text=True, cwd=cwd,
+        )
+
+    def test_refuses_without_ci_summary(self, tmp_path):
+        r = self._publish(["--ci-summary", str(tmp_path / "absent.json")])
+        assert r.returncode == 1
+        assert "no CI summary" in r.stderr
+
+    def test_refuses_red_ci(self, tmp_path):
+        s = tmp_path / "summary.json"
+        s.write_text(json.dumps(
+            {"ok": False, "stages": {"unit": {"status": "failed"}}}
+        ))
+        r = self._publish(["--ci-summary", str(s)])
+        assert r.returncode == 1
+        assert "not green" in r.stderr
+
+    def test_dry_run_plan_on_green_ci(self, tmp_path):
+        s = tmp_path / "summary.json"
+        s.write_text(json.dumps(
+            {"ok": True, "stages": {"unit": {"status": "ok"}}}
+        ))
+        r = self._publish(["--ci-summary", str(s)])
+        assert r.returncode == 0, r.stderr
+        assert "dry-run" in r.stdout
+        assert "docker push example.test/proj/tpujob-operator:" in r.stdout
+        assert "git push origin green-postsubmit-" in r.stdout
+        # dry-run must not have run anything
+        assert "would run:" in r.stdout
+
+    def test_no_gate_skips_summary_check(self, tmp_path):
+        r = self._publish(["--no-gate"])
+        assert r.returncode == 0, r.stderr
+        assert "dry-run" in r.stdout
+
+
+class TestRunnerErrorPath:
+    def test_runner_crash_recorded_not_green(self, tmp_path):
+        # A stage whose log file cannot be created crashes _run_stage itself
+        # (not the stage command); that must surface as status=error and a
+        # nonzero exit, never a green summary.
+        p = tmp_path / "p.yaml"
+        p.write_text("stages:\n  'a/b': {cmd: 'true'}\n")
+        rc = ci.main(["--pipeline", str(p), "--artifacts", str(tmp_path / "a")])
+        assert rc == 1
+        summary = json.loads((tmp_path / "a" / "summary.json").read_text())
+        assert not summary["ok"]
+        assert summary["stages"]["a/b"]["status"] == "error"
+
+    def test_summary_records_sha_and_skips(self, tmp_path):
+        p = tmp_path / "p.yaml"
+        p.write_text("stages:\n  a: {cmd: 'true'}\n  b: {cmd: 'true'}\n")
+        rc = ci.main(["--pipeline", str(p), "--artifacts", str(tmp_path / "a"),
+                      "--skip", "b"])
+        assert rc == 0
+        summary = json.loads((tmp_path / "a" / "summary.json").read_text())
+        assert summary["skipped_stages"] == ["b"]
+        head = subprocess.run(["git", "rev-parse", "HEAD"], cwd=REPO,
+                              capture_output=True, text=True).stdout.strip()
+        assert summary["git_sha"] == head
+
+
+class TestPublishGateStaleness:
+    def _publish(self, args):
+        return subprocess.run(
+            [sys.executable, str(REPO / "tools" / "release.py"), "publish",
+             "--registry", "example.test/proj", *args],
+            capture_output=True, text=True, cwd=REPO,
+        )
+
+    def test_refuses_stale_sha(self, tmp_path):
+        s = tmp_path / "summary.json"
+        s.write_text(json.dumps({
+            "ok": True, "git_sha": "0" * 40, "skipped_stages": [],
+            "stages": {"unit": {"status": "ok"}},
+        }))
+        r = self._publish(["--ci-summary", str(s)])
+        assert r.returncode == 1
+        assert "re-run tools/ci.py" in r.stderr
+
+    def test_refuses_partial_run(self, tmp_path):
+        s = tmp_path / "summary.json"
+        s.write_text(json.dumps({
+            "ok": True, "skipped_stages": ["e2e-kube"],
+            "stages": {"unit": {"status": "ok"}},
+        }))
+        r = self._publish(["--ci-summary", str(s)])
+        assert r.returncode == 1
+        assert "partial run" in r.stderr
+
+    def test_green_current_sha_passes(self, tmp_path):
+        head = subprocess.run(["git", "rev-parse", "HEAD"], cwd=REPO,
+                              capture_output=True, text=True).stdout.strip()
+        s = tmp_path / "summary.json"
+        s.write_text(json.dumps({
+            "ok": True, "git_sha": head, "skipped_stages": [],
+            "stages": {"unit": {"status": "ok"}},
+        }))
+        r = self._publish(["--ci-summary", str(s)])
+        assert r.returncode == 0, r.stderr
+        assert "dry-run" in r.stdout

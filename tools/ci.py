@@ -1,0 +1,202 @@
+#!/usr/bin/env python3
+"""CI DAG runner: executes ci/pipeline.yaml.
+
+The reference's CI is an Argo workflow DAG submitted by Prow
+(test/workflows/components/workflows.libsonnet:216-298): a directed graph of
+steps with dependencies, independent branches running in parallel, logs and
+JUnit XML copied out as artifacts. This is the same model as a single
+dependency-free script: parse the YAML DAG, topo-sort, run each stage's
+command in a subprocess as soon as its deps are green (ThreadPoolExecutor),
+stream logs to {artifacts}/<stage>.log, and write summary.json at the end.
+
+Exit 0 iff every (non-skipped) stage succeeded. A failing stage marks all
+its dependents "skipped", like Argo's dag failure propagation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PIPELINE = os.path.join(REPO, "ci", "pipeline.yaml")
+
+
+def load_pipeline(path: str) -> dict[str, dict]:
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    stages = doc.get("stages")
+    if not isinstance(stages, dict) or not stages:
+        raise ValueError(f"{path}: top-level 'stages' map required")
+    for name, spec in stages.items():
+        if not isinstance(spec, dict) or "cmd" not in spec:
+            raise ValueError(f"stage {name!r}: needs a 'cmd'")
+        for dep in spec.get("deps", []):
+            if dep not in stages:
+                raise ValueError(f"stage {name!r}: unknown dep {dep!r}")
+    import graphlib
+
+    try:
+        order = list(graphlib.TopologicalSorter(
+            {n: s.get("deps", []) for n, s in stages.items()}
+        ).static_order())
+    except graphlib.CycleError as e:
+        raise ValueError(f"dependency cycle: {e.args[1]}") from None
+    return {n: stages[n] for n in order}
+
+
+def prune(stages: dict[str, dict], skip: set[str]) -> dict[str, dict]:
+    """Drop skipped stages and (transitively) everything depending on them."""
+    dropped = set(skip)
+    changed = True
+    while changed:
+        changed = False
+        for n, s in stages.items():
+            if n not in dropped and any(d in dropped for d in s.get("deps", [])):
+                dropped.add(n)
+                changed = True
+    return {n: s for n, s in stages.items() if n not in dropped}
+
+
+class Runner:
+    def __init__(self, stages: dict[str, dict], artifacts: str,
+                 max_workers: int = 4, skipped: list[str] | None = None):
+        self.stages = stages
+        self.artifacts = artifacts
+        self.max_workers = max_workers
+        self.skipped = skipped or []  # recorded so the publish gate sees them
+        self.results: dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def _run_stage(self, name: str) -> bool:
+        cmd = self.stages[name]["cmd"].replace("{artifacts}", self.artifacts)
+        log_path = os.path.join(self.artifacts, f"{name}.log")
+        t0 = time.time()
+        print(f"[ci] {name}: {cmd}", file=sys.stderr, flush=True)
+        with open(log_path, "wb") as log:
+            # bench redirects its own stdout inside cmd (shell), so run via sh.
+            r = subprocess.run(
+                cmd, shell=True, cwd=REPO, stdout=log,
+                stderr=subprocess.STDOUT,
+            )
+        dt = round(time.time() - t0, 2)
+        ok = r.returncode == 0
+        with self._lock:
+            self.results[name] = {
+                "status": "ok" if ok else "failed",
+                "seconds": dt,
+                "returncode": r.returncode,
+                "log": log_path,
+            }
+        print(f"[ci] {name}: {'ok' if ok else 'FAILED'} ({dt}s)",
+              file=sys.stderr, flush=True)
+        if not ok:
+            with open(log_path, "rb") as f:
+                tail = f.read()[-4000:].decode("utf-8", "replace")
+            print(f"[ci] {name} log tail:\n{tail}", file=sys.stderr)
+        return ok
+
+    def run(self) -> int:
+        os.makedirs(self.artifacts, exist_ok=True)
+        pending = dict(self.stages)
+        futures: dict[concurrent.futures.Future, str] = {}
+        with concurrent.futures.ThreadPoolExecutor(self.max_workers) as pool:
+            while pending or futures:
+                for name in [n for n, s in pending.items()
+                             if all(self.results.get(d, {}).get("status") == "ok"
+                                    for d in s.get("deps", []))]:
+                    futures[pool.submit(self._run_stage, name)] = name
+                    del pending[name]
+                # A failed dep never turns ok: mark dependents skipped.
+                failed = {n for n, r in self.results.items()
+                          if r["status"] in ("failed", "error")}
+                for name in [n for n, s in pending.items()
+                             if any(d in failed or
+                                    self.results.get(d, {}).get("status")
+                                    == "skipped"
+                                    for d in s.get("deps", []))]:
+                    self.results[name] = {"status": "skipped", "seconds": 0}
+                    print(f"[ci] {name}: skipped (failed dep)",
+                          file=sys.stderr)
+                    del pending[name]
+                if not futures:
+                    if pending:  # nothing running, nothing runnable
+                        raise RuntimeError(f"deadlocked stages: {sorted(pending)}")
+                    break
+                done, _ = concurrent.futures.wait(
+                    futures, return_when=concurrent.futures.FIRST_COMPLETED
+                )
+                for fut in done:
+                    name = futures.pop(fut)
+                    try:
+                        fut.result()
+                    except Exception as e:  # harness crash, not stage failure
+                        with self._lock:
+                            self.results[name] = {
+                                "status": "error",
+                                "seconds": 0,
+                                "error": f"{type(e).__name__}: {e}",
+                            }
+                        print(f"[ci] {name}: runner ERROR: {e}",
+                              file=sys.stderr)
+        try:
+            sha = subprocess.run(
+                ["git", "rev-parse", "HEAD"], cwd=REPO,
+                capture_output=True, text=True, check=True,
+            ).stdout.strip()
+        except (subprocess.CalledProcessError, OSError):
+            sha = None
+        summary = {
+            "ok": all(r["status"] == "ok" for r in self.results.values()),
+            "git_sha": sha,  # the publish gate refuses a stale summary
+            "skipped_stages": self.skipped,
+            "stages": self.results,
+        }
+        path = os.path.join(self.artifacts, "summary.json")
+        with open(path, "w") as f:
+            json.dump(summary, f, indent=2)
+        print(f"[ci] summary -> {path}", file=sys.stderr)
+        return 0 if summary["ok"] else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="ci.py", description=__doc__)
+    ap.add_argument("--pipeline", default=DEFAULT_PIPELINE)
+    ap.add_argument("--artifacts", default=os.path.join(REPO, "artifacts", "ci"))
+    ap.add_argument("--only", default=None, metavar="STAGE",
+                    help="run a single stage, assuming its deps already ran")
+    ap.add_argument("--skip", nargs="*", default=[], metavar="STAGE",
+                    help="skip stages (and everything depending on them)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the execution plan and exit")
+    ap.add_argument("--max-workers", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    stages = load_pipeline(args.pipeline)
+    for s in args.skip:
+        if s not in stages:
+            ap.error(f"--skip {s}: no such stage")
+    stages = prune(stages, set(args.skip))
+    if args.only:
+        if args.only not in stages:
+            ap.error(f"--only {args.only}: no such stage (or it was skipped)")
+        stages = {args.only: {**stages[args.only], "deps": []}}
+    if args.dry_run:
+        for name, spec in stages.items():
+            deps = ",".join(spec.get("deps", [])) or "-"
+            print(f"{name}  deps={deps}  cmd={spec['cmd']}")
+        return 0
+    return Runner(stages, args.artifacts, args.max_workers,
+                  skipped=list(args.skip)).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,13 @@ build artifacts, build/push the operator image, changelog), local-first:
   python tools/release.py image      docker build (uses build/Dockerfile);
                                      prints the command if docker is absent
   python tools/release.py changelog  commits since the last release tag
+  python tools/release.py publish    push the image to a registry and tag the
+                                     green postsubmit (parity with reference
+                                     release.py:248 build_and_push_artifacts
+                                     + prow.py tag-green): DRY-RUN by default,
+                                     pass --execute to actually push. Requires
+                                     a green CI summary (tools/ci.py) unless
+                                     --no-gate.
 
 Artifacts land in dist/: tf_operator_tpu-<version>+<sha>.tar.gz (git archive,
 reproducible) and libtpujob_native.so.
@@ -73,6 +80,76 @@ def cmd_image(args) -> int:
     return 0
 
 
+def cmd_publish(args) -> int:
+    """Push image + git tag for a green build. Dry-run unless --execute."""
+    import json
+
+    tag = _version_tag()
+    # Gate on CI: the reference only tags postsubmits whose Prow run was
+    # green; our equivalent evidence is tools/ci.py's summary.json.
+    if not args.no_gate:
+        summary_path = args.ci_summary or os.path.join(
+            REPO, "artifacts", "ci", "summary.json"
+        )
+        if not os.path.exists(summary_path):
+            print(f"publish: no CI summary at {summary_path}; run "
+                  f"`python tools/ci.py` first or pass --no-gate",
+                  file=sys.stderr)
+            return 1
+        with open(summary_path) as f:
+            summary = json.load(f)
+        if not summary.get("ok"):
+            bad = [n for n, r in summary.get("stages", {}).items()
+                   if r.get("status") != "ok"]
+            print(f"publish: CI not green (stages {bad}); refusing to "
+                  f"publish", file=sys.stderr)
+            return 1
+        if summary.get("skipped_stages"):
+            print(f"publish: CI summary skipped stages "
+                  f"{summary['skipped_stages']}; a partial run cannot "
+                  f"green-light a release (use --no-gate to override)",
+                  file=sys.stderr)
+            return 1
+        head = subprocess.run(
+            ["git", "-C", REPO, "rev-parse", "HEAD"],
+            capture_output=True, text=True,
+        ).stdout.strip()
+        if summary.get("git_sha") and head and summary["git_sha"] != head:
+            print(f"publish: CI summary is for {summary['git_sha'][:12]} but "
+                  f"HEAD is {head[:12]}; re-run tools/ci.py on this commit",
+                  file=sys.stderr)
+            return 1
+        print(f"publish: CI green ({summary_path})", file=sys.stderr)
+
+    image = f"{args.registry.rstrip('/')}/tpujob-operator:{tag}"
+    git_tag = f"green-postsubmit-{tag.replace('+', '-')}"
+    plan = [
+        ["docker", "build", "-f", "build/Dockerfile", "-t", image, "."],
+        ["docker", "push", image],
+        ["git", "tag", "-f", git_tag, "HEAD"],
+        ["git", "push", args.remote, git_tag],
+    ]
+    if not args.execute:
+        print(f"publish (dry-run): image={image} tag={git_tag}")
+        for cmd in plan:
+            print("  would run:", " ".join(cmd))
+        print("pass --execute to run the above")
+        return 0
+    if shutil.which("docker") is None:
+        # Tagging green without a pushed image would advertise a release
+        # nobody can pull; abort before any git step.
+        print("publish: docker unavailable on this host — cannot push the "
+              "image, so the green tag will not be created. Run on a build "
+              "host:", file=sys.stderr)
+        for cmd in plan:
+            print("  " + " ".join(cmd), file=sys.stderr)
+        return 1
+    for cmd in plan:
+        sh(cmd)
+    print(f"published: {image} (+git tag {git_tag})")
+    return 0
+
+
 def cmd_changelog(args) -> int:
     r = subprocess.run(
         ["git", "-C", REPO, "describe", "--tags", "--abbrev=0"],
@@ -93,6 +170,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--tag", default=None)
     p.add_argument("--push", action="store_true")
     p.set_defaults(fn=cmd_image)
+    p = sub.add_parser("publish")
+    p.add_argument("--registry", required=True,
+                   help="image registry prefix, e.g. gcr.io/my-project")
+    p.add_argument("--remote", default="origin", help="git remote for tags")
+    p.add_argument("--ci-summary", default=None,
+                   help="path to tools/ci.py summary.json (default "
+                        "artifacts/ci/summary.json)")
+    p.add_argument("--no-gate", action="store_true",
+                   help="skip the green-CI check")
+    p.add_argument("--execute", action="store_true",
+                   help="actually push; default is a dry-run that prints "
+                        "the plan")
+    p.set_defaults(fn=cmd_publish)
     sub.add_parser("changelog").set_defaults(fn=cmd_changelog)
     args = ap.parse_args(argv)
     return args.fn(args)
