@@ -278,7 +278,8 @@ def _main() -> int:
     rn_batch = 256 if on_tpu else 8
     rn_steps = 60 if on_tpu else 15
     rn_size = 224 if on_tpu else 64
-    rn_extra = ["--image-size", str(rn_size)]
+    rn_profile_dir = tempfile.mkdtemp(prefix="tpujob-bench-prof-")
+    rn_extra = ["--image-size", str(rn_size), "--profile-dir", rn_profile_dir]
     if not on_tpu:
         rn_extra += ["--log-every", "5"]
     resnet = run_job_e2e(
@@ -288,6 +289,22 @@ def _main() -> int:
     rn_ips = rev.get("done", {}).get("examples_per_sec")
     log(f"  ok={resnet['ok']} wallclock={resnet.get('wallclock_s')}s "
         f"images/s={rn_ips}")
+    # Roofline attribution from the trace: which roofline (HBM vs MXU) the
+    # workload sits on and how close — MFU alone misreads a bandwidth-bound
+    # conv workload (see README perf table for the measured split). The
+    # trainer traces a chunk OUTSIDE the timed window, so the headline
+    # images/s is unaffected; the trace dir is consumed once and deleted.
+    import shutil
+
+    from tf_operator_tpu.utils.roofline import summarize_trace
+
+    try:
+        rn_roofline = summarize_trace(rn_profile_dir)
+    finally:
+        shutil.rmtree(rn_profile_dir, ignore_errors=True)
+    if rn_roofline:
+        log(f"  roofline: bound_by={rn_roofline['bound_by_pct']} "
+            f"hbm_bw={rn_roofline['hbm_bound_achieved_bw_gibps']}GiB/s")
 
     # --- Workload 3: long-context LM (pallas flash attention path) ---
     # seq 8192 is past the point where plain XLA attention fails to compile
@@ -338,6 +355,7 @@ def _main() -> int:
         "resnet50_batch": rn_batch,
         "resnet50_image_size": rn_size,
         "resnet50_mfu": rn_mfu,
+        "resnet50_roofline": rn_roofline,
         "resnet50_segments": resnet.get("segments"),
         "longctx_ok": lm["ok"],
         "longctx_seq": lm_seq,

@@ -231,3 +231,40 @@ class TestPublishGateStaleness:
         r = self._publish(["--ci-summary", str(s)])
         assert r.returncode == 0, r.stderr
         assert "dry-run" in r.stdout
+
+
+class TestPublishGatePartialRuns:
+    def _publish(self, args):
+        return subprocess.run(
+            [sys.executable, str(REPO / "tools" / "release.py"), "publish",
+             "--registry", "example.test/proj", *args],
+            capture_output=True, text=True, cwd=REPO,
+        )
+
+    def _head(self):
+        return subprocess.run(["git", "rev-parse", "HEAD"], cwd=REPO,
+                              capture_output=True, text=True).stdout.strip()
+
+    def test_only_run_summary_refused(self, tmp_path):
+        # `ci.py --only X` marks the summary partial; publish must refuse.
+        p = tmp_path / "p.yaml"
+        p.write_text("stages:\n  a: {cmd: 'true'}\n  b: {cmd: 'true'}\n")
+        rc = ci.main(["--pipeline", str(p), "--artifacts", str(tmp_path / "art"),
+                      "--only", "a"])
+        assert rc == 0
+        summary = json.loads((tmp_path / "art" / "summary.json").read_text())
+        assert summary["partial"] is True
+        r = self._publish(["--ci-summary", str(tmp_path / "art" / "summary.json")])
+        assert r.returncode == 1
+        assert "partial run" in r.stderr
+
+    def test_non_default_pipeline_refused(self, tmp_path):
+        s = tmp_path / "summary.json"
+        s.write_text(json.dumps({
+            "ok": True, "git_sha": self._head(), "skipped_stages": [],
+            "partial": False, "pipeline": str(tmp_path / "other.yaml"),
+            "stages": {"a": {"status": "ok"}},
+        }))
+        r = self._publish(["--ci-summary", str(s)])
+        assert r.returncode == 1
+        assert "not" in r.stderr and "pipeline" in r.stderr

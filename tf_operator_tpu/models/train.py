@@ -32,6 +32,24 @@ def _emit(event: dict) -> None:
             f.write(line + "\n")
 
 
+def _start_profile(profile_dir: str) -> None:
+    """Start an XProf device trace under profile_dir/<replica rank>.
+
+    Replica type+index is unique per pod in every regime (chief-0 and
+    worker-0 differ by type; non-distributed local pods have no distinct
+    jax.process_index()). The reference delegated all profiling to
+    cAdvisor/Prometheus node metrics (SURVEY.md §5); this is the TPU-native
+    equivalent: per-op XProf timelines.
+    """
+    import jax
+
+    rank = (f"{os.environ.get('TPUJOB_REPLICA_TYPE') or 'local'}-"
+            f"{os.environ.get('TPUJOB_REPLICA_INDEX', '0')}")
+    trace_dir = os.path.join(profile_dir, rank)
+    jax.profiler.start_trace(trace_dir)
+    _emit({"event": "profile_start", "dir": trace_dir})
+
+
 def _is_checkpoint_writer() -> bool:
     """Chief (or worker-0 when no chief exists) writes checkpoints — the same
     role the reference gave worker-0/chief for summaries (SURVEY.md §3.4).
@@ -249,11 +267,7 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
     )
     profiling = bool(args.profile_dir) and done < args.steps
     if profiling:
-        rank = (f"{os.environ.get('TPUJOB_REPLICA_TYPE') or 'local'}-"
-                f"{os.environ.get('TPUJOB_REPLICA_INDEX', '0')}")
-        trace_dir = os.path.join(args.profile_dir, rank)
-        jax.profiler.start_trace(trace_dir)
-        _emit({"event": "profile_start", "dir": trace_dir})
+        _start_profile(args.profile_dir)
     t0 = time.time()
     while done < args.steps:
         state, metrics = step(state, next(it), jax.random.key(done))
@@ -619,20 +633,16 @@ def main(argv: list[str] | None = None) -> int:
     full_chunks = (args.steps - done) // chunk
     tail = (args.steps - done) % chunk
     profiling = bool(args.profile_dir) and full_chunks > 0
-    if profiling:
-        # Device-level trace of the steady window (the reference delegated
-        # all profiling to cAdvisor/Prometheus node metrics — SURVEY.md §5;
-        # this is the TPU-native equivalent: per-op XProf timelines).
-        # Replica type+index is unique per pod in every regime (chief-0 and
-        # worker-0 differ by type; non-distributed local pods have no
-        # distinct jax.process_index()).
-        rank = (f"{os.environ.get('TPUJOB_REPLICA_TYPE') or 'local'}-"
-                f"{os.environ.get('TPUJOB_REPLICA_INDEX', '0')}")
-        trace_dir = os.path.join(args.profile_dir, rank)
-        jax.profiler.start_trace(trace_dir)
-        _emit({"event": "profile_start", "dir": trace_dir})
+    # Tracing adds host/device overhead, so the profiled chunk must sit
+    # OUTSIDE the throughput window: with >=2 full chunks, time the first
+    # n-1 untraced and trace only the last; with a single chunk the trace
+    # covers it and the throughput is marked as measured-under-profiling.
+    profile_last_chunk = profiling and full_chunks >= 2
+    timed_chunks = full_chunks - 1 if profile_last_chunk else full_chunks
+    if profiling and not profile_last_chunk:
+        _start_profile(args.profile_dir)
     t0 = time.time()
-    for _ in range(full_chunks):
+    for _ in range(timed_chunks):
         state, metrics = step_chunk(state)
         done += chunk
         # Throttle to the requested cadence: float() is a device sync, and
@@ -644,11 +654,24 @@ def main(argv: list[str] | None = None) -> int:
         maybe_checkpoint(done)
     jax.block_until_ready(metrics["loss"])
     dt = time.time() - t0
-    steady = full_chunks * chunk
-    if profiling:
+    steady = timed_chunks * chunk
+    if profile_last_chunk:
+        _start_profile(args.profile_dir)
+    if profiling and not profile_last_chunk:
         jax.profiler.stop_trace()
         _emit({"event": "profile_done", "dir": args.profile_dir,
-               "steps_traced": steady})
+               "steps_traced": steady, "in_timed_window": True})
+    if profile_last_chunk:
+        state, metrics = step_chunk(state)
+        done += chunk
+        if done % args.log_every == 0 or done == args.steps:
+            _emit({"event": "progress", "step": done,
+                   "loss": float(metrics["loss"])})
+        jax.block_until_ready(metrics["loss"])
+        jax.profiler.stop_trace()
+        _emit({"event": "profile_done", "dir": args.profile_dir,
+               "steps_traced": chunk, "in_timed_window": False})
+        maybe_checkpoint(done)
 
     if tail:
         state, metrics = compile_scanned(state, tail)(state)

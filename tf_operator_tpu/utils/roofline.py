@@ -1,0 +1,99 @@
+"""Roofline attribution from an XProf trace.
+
+The reference delegates per-pod utilization to cAdvisor/prometheus queries
+(docs/monitoring/README.md:1-60) and publishes no efficiency accounting at
+all (SURVEY.md §6). On TPU, "percent of MXU peak" (MFU) is the wrong
+efficiency metric for bandwidth-bound workloads (conv training lives on the
+HBM roofline, not the matmul one), so the bench reports *which roofline the
+workload sits on and how close it is* — parsed from the same XProf traces
+the trainer's --profile-dir already writes.
+
+Parsing goes through the xprof/tensorboard-plugin-profile "hlo_stats" tool
+(per-HLO self time, bound-by classification, achieved HBM bandwidth). All
+failures degrade to None: profiling is diagnostic, never load-bearing.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any
+
+
+def _load_hlo_stats(xplane_paths: list[str]) -> list[dict[str, Any]] | None:
+    try:
+        from xprof.convert import raw_to_tool_data as r2t
+    except Exception:
+        try:
+            from tensorboard_plugin_profile.convert import (  # type: ignore
+                raw_to_tool_data as r2t,
+            )
+        except Exception:
+            return None
+    try:
+        out, _ = r2t.xspace_to_tool_data(xplane_paths, "hlo_stats", {})
+        data = json.loads(out) if isinstance(out, (str, bytes)) else out
+        cols = [c["label"] for c in data["cols"]]
+        return [
+            dict(zip(cols, [c.get("v") for c in row["c"]]))
+            for row in data["rows"]
+        ]
+    except Exception:
+        return None
+
+
+def summarize_trace(trace_dir: str, top_k: int = 5) -> dict[str, Any] | None:
+    """Roofline summary of every xplane.pb under trace_dir, or None.
+
+    Returns {total_self_time_us, bound_by_pct: {HBM, Compute, ...},
+    hbm_bound_achieved_bw_gibps (self-time-weighted mean over HBM-bound
+    ops), top_ops: [{name, category, pct, bound_by, gflops, bw_gibps}]}.
+    """
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True)
+    )
+    if not paths:
+        return None
+    rows = _load_hlo_stats(paths)
+    if not rows:
+        return None
+
+    t_key = "Total self time (us)"
+    total = sum(r.get(t_key) or 0 for r in rows)
+    if total <= 0:
+        return None
+
+    bound: dict[str, float] = {}
+    bw_weight = bw_time = 0.0
+    for r in rows:
+        t = r.get(t_key) or 0
+        b = str(r.get("Bound by") or "Unknown")
+        bound[b] = bound.get(b, 0.0) + t
+        if b == "HBM" and r.get("HBM BW (GiB/s)"):
+            bw_weight += t * float(r["HBM BW (GiB/s)"])
+            bw_time += t
+
+    rows.sort(key=lambda r: -(r.get(t_key) or 0))
+    top = [
+        {
+            "name": r.get("HLO op name"),
+            "category": r.get("HLO op category"),
+            "pct": round((r.get(t_key) or 0) / total * 100, 1),
+            "bound_by": r.get("Bound by"),
+            "gflops": r.get("Model GFLOP/s"),
+            "bw_gibps": r.get("HBM BW (GiB/s)"),
+        }
+        for r in rows[:top_k]
+    ]
+    return {
+        "total_self_time_us": round(total, 1),
+        "bound_by_pct": {
+            k: round(v / total * 100, 1) for k, v in
+            sorted(bound.items(), key=lambda kv: -kv[1])
+        },
+        "hbm_bound_achieved_bw_gibps": (
+            round(bw_weight / bw_time, 1) if bw_time else None
+        ),
+        "top_ops": top,
+    }

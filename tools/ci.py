@@ -68,11 +68,14 @@ def prune(stages: dict[str, dict], skip: set[str]) -> dict[str, dict]:
 
 class Runner:
     def __init__(self, stages: dict[str, dict], artifacts: str,
-                 max_workers: int = 4, skipped: list[str] | None = None):
+                 max_workers: int = 4, skipped: list[str] | None = None,
+                 partial: bool = False, pipeline: str | None = None):
         self.stages = stages
         self.artifacts = artifacts
         self.max_workers = max_workers
         self.skipped = skipped or []  # recorded so the publish gate sees them
+        self.partial = partial        # --only runs can never gate a release
+        self.pipeline = pipeline
         self.results: dict[str, dict] = {}
         self._lock = threading.Lock()
 
@@ -158,6 +161,8 @@ class Runner:
             "ok": all(r["status"] == "ok" for r in self.results.values()),
             "git_sha": sha,  # the publish gate refuses a stale summary
             "skipped_stages": self.skipped,
+            "partial": self.partial,
+            "pipeline": self.pipeline,
             "stages": self.results,
         }
         path = os.path.join(self.artifacts, "summary.json")
@@ -195,7 +200,8 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{name}  deps={deps}  cmd={spec['cmd']}")
         return 0
     return Runner(stages, args.artifacts, args.max_workers,
-                  skipped=list(args.skip)).run()
+                  skipped=list(args.skip), partial=bool(args.only),
+                  pipeline=os.path.abspath(args.pipeline)).run()
 
 
 if __name__ == "__main__":

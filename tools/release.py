@@ -104,10 +104,19 @@ def cmd_publish(args) -> int:
             print(f"publish: CI not green (stages {bad}); refusing to "
                   f"publish", file=sys.stderr)
             return 1
-        if summary.get("skipped_stages"):
-            print(f"publish: CI summary skipped stages "
-                  f"{summary['skipped_stages']}; a partial run cannot "
+        if summary.get("skipped_stages") or summary.get("partial"):
+            how = (f"skipped stages {summary.get('skipped_stages')}"
+                   if summary.get("skipped_stages") else "a --only run")
+            print(f"publish: CI summary records {how}; a partial run cannot "
                   f"green-light a release (use --no-gate to override)",
+                  file=sys.stderr)
+            return 1
+        default_pipeline = os.path.join(REPO, "ci", "pipeline.yaml")
+        if (summary.get("pipeline")
+                and os.path.abspath(summary["pipeline"])
+                != os.path.abspath(default_pipeline)):
+            print(f"publish: CI summary is from pipeline "
+                  f"{summary['pipeline']}, not {default_pipeline}; refusing",
                   file=sys.stderr)
             return 1
         head = subprocess.run(
