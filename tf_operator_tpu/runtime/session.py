@@ -144,6 +144,11 @@ class LocalSession:
 
     # ------------------------------------------------------------------ stop
 
+    def prewarm(self, timeout: float = 30.0) -> bool:
+        """Wait for the runtime's prespawn fork server (deploy-time warmup;
+        jobs submitted after this start their pods pre-imported)."""
+        return self.runtime.prewarm(timeout)
+
     def close(self) -> None:
         self.runtime.stop()
         self.controller.stop()

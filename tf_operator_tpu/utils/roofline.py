@@ -50,6 +50,13 @@ def summarize_trace(trace_dir: str, top_k: int = 5) -> dict[str, Any] | None:
     hbm_bound_achieved_bw_gibps (self-time-weighted mean over HBM-bound
     ops), top_ops: [{name, category, pct, bound_by, gflops, bw_gibps}]}.
     """
+    try:
+        return _summarize(trace_dir, top_k)
+    except Exception:
+        return None  # diagnostics only — any surprise degrades to None
+
+
+def _summarize(trace_dir: str, top_k: int) -> dict[str, Any] | None:
     paths = sorted(
         glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True)
     )
