@@ -3,12 +3,14 @@
 import json
 import subprocess
 import sys
+import urllib.error
 import urllib.request
 from pathlib import Path
 
 import pytest
 
 from tf_operator_tpu.api import compat
+from tf_operator_tpu.api.types import ReplicaType
 from tf_operator_tpu.cli.server import ApiServer
 from tf_operator_tpu.core.cluster import InMemoryCluster
 from tf_operator_tpu.core.trainjob_controller import TrainJobController
@@ -322,3 +324,79 @@ class TestDashboardFormBuilder:
     def _get(self, server, path):
         with urllib.request.urlopen(f"http://{server}{path}", timeout=5) as r:
             return json.loads(r.read())
+
+
+class TestScaleApi:
+    """Elastic scaling surface: POST /api/trainjobs/{ns}/{name}/scale and
+    the `tpujob scale` verb (the reconciler-side behavior is pinned by
+    tests/test_controller.py::TestElasticScaling)."""
+
+    @pytest.fixture
+    def served(self):
+        cluster = InMemoryCluster()
+        controller = TrainJobController(cluster, enable_gang=False)
+        api = ApiServer(cluster, port=0)
+        api.start()
+        yield cluster, controller, f"127.0.0.1:{api.port}"
+        api.stop()
+        controller.stop()
+
+    def _submit(self, server, workers=2):
+        manifest = {
+            "apiVersion": "tpujob.dev/v1", "kind": "TrainJob",
+            "metadata": {"name": "sc", "namespace": "default"},
+            "spec": {"replicaSpecs": {"Worker": {
+                "replicas": workers,
+                "template": {"spec": {"containers": [{
+                    "name": "tensorflow", "image": "img", "command": ["true"],
+                }]}},
+            }}},
+        }
+        req = urllib.request.Request(
+            f"http://{server}/api/trainjobs",
+            data=json.dumps(manifest).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 201
+
+    def test_scale_endpoint(self, served):
+        cluster, controller, server = served
+        self._submit(server)
+        req = urllib.request.Request(
+            f"http://{server}/api/trainjobs/default/sc/scale",
+            data=json.dumps({"replicas": {"worker": 4}}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            data = json.loads(r.read())
+        assert data["manifest"]["spec"]["replicaSpecs"]["Worker"]["replicas"] == 4
+        assert cluster.get_job("default", "sc").spec.replica_specs[
+            ReplicaType.WORKER
+        ].replicas == 4
+
+    def test_scale_unknown_type_400(self, served):
+        _, _, server = served
+        self._submit(server)
+        req = urllib.request.Request(
+            f"http://{server}/api/trainjobs/default/sc/scale",
+            data=json.dumps({"replicas": {"nope": 4}}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 400
+
+    def test_scale_cli_verb(self, served):
+        _, _, server = served
+        self._submit(server)
+        from tf_operator_tpu.cli.main import main as cli_main
+
+        rc = cli_main(["scale", "sc", "worker=3", "--server", server])
+        assert rc == 0
+        data = json.loads(
+            urllib.request.urlopen(
+                f"http://{server}/api/trainjobs/default/sc", timeout=5
+            ).read()
+        )
+        assert data["manifest"]["spec"]["replicaSpecs"]["Worker"]["replicas"] == 3

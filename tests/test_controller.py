@@ -577,3 +577,110 @@ class TestEvents:
         reasons = [e.reason for e in events]
         assert reasons.count("SuccessfulCreatePod") == 2
         assert reasons.count("SuccessfulCreateService") == 2
+
+
+class TestElasticScaling:
+    """Beyond the reference (SURVEY §5: "replica counts are static"):
+    spec edits take effect — scale-up creates pods AND rolls live peers
+    whose injected TF_CONFIG predates the new topology; scale-down deletes
+    out-of-range pods and services; evaluator-count changes roll nothing
+    (evaluators are excluded from the cluster map, tensorflow.go:110)."""
+
+    def _update_replicas(self, cluster, controller, job, rtype, n):
+        cur = cluster.get_job(job.namespace, job.name)
+        cur.spec.replica_specs[rtype].replicas = n
+        cluster.update_job(cur)
+        assert controller.run_until_idle()
+        return cluster.get_job(job.namespace, job.name)
+
+    def test_scale_up_rolls_stale_pods_and_creates_new(self, env):
+        cluster, controller = env
+        job = make_job(worker=2)
+        submit_and_sync(cluster, controller, job)
+        from tf_operator_tpu.core.controller import LABEL_SPEC_HASH
+        old_pods = cluster.list_pods("default")
+        assert len(old_pods) == 2
+        old_hash = old_pods[0].metadata.labels[LABEL_SPEC_HASH]
+
+        self._update_replicas(cluster, controller, job, ReplicaType.WORKER, 4)
+        # Rolled pods are deleted this sync; their replacements (and the two
+        # new indices) appear over the following syncs.
+        for _ in range(6):
+            controller.run_until_idle()
+            pods = cluster.list_pods("default")
+            if len(pods) == 4 and all(
+                p.metadata.labels[LABEL_SPEC_HASH] != old_hash for p in pods
+            ):
+                break
+        pods = cluster.list_pods("default")
+        assert len(pods) == 4
+        hashes = {p.metadata.labels[LABEL_SPEC_HASH] for p in pods}
+        assert len(hashes) == 1 and old_hash not in hashes
+        # Every pod's TF_CONFIG now lists 4 workers.
+        for p in pods:
+            c = p.spec.containers[0]
+            tfconf = json.loads(next(e.value for e in c.env if e.name == "TF_CONFIG"))
+            assert len(tfconf["cluster"]["worker"]) == 4
+        events = [e.reason for e in cluster.all_events()]
+        assert "TopologyChanged" in events
+
+    def test_scale_down_deletes_pods_and_services(self, env):
+        cluster, controller = env
+        job = make_job(worker=4)
+        submit_and_sync(cluster, controller, job)
+        assert len(cluster.list_pods("default")) == 4
+
+        self._update_replicas(cluster, controller, job, ReplicaType.WORKER, 2)
+        for _ in range(6):
+            controller.run_until_idle()
+            if (len(cluster.list_pods("default")) == 2
+                    and len(cluster.list_services("default")) == 2):
+                break
+        pods = cluster.list_pods("default")
+        svcs = cluster.list_services("default")
+        assert {p.name for p in pods} == {"test-job-worker-0", "test-job-worker-1"}
+        assert {s.name for s in svcs} == {"test-job-worker-0", "test-job-worker-1"}
+        events = [e.reason for e in cluster.all_events()]
+        assert "ScaleDown" in events
+
+    def test_adding_evaluator_rolls_nothing(self, env):
+        # Evaluators consume the cluster map but are excluded from it
+        # (tensorflow.go:110-114), so attaching one must not roll trainers.
+        cluster, controller = env
+        job = make_job(worker=2)
+        submit_and_sync(cluster, controller, job)
+        before = {p.name: p.metadata.uid for p in cluster.list_pods("default")}
+
+        cur = cluster.get_job(job.namespace, job.name)
+        cur.spec.replica_specs[ReplicaType.EVALUATOR] = ReplicaSpec(
+            replicas=1,
+            template=PodTemplateSpec(
+                containers=[ContainerSpec(name="tensorflow", image="img:1")]
+            ),
+        )
+        defaults.set_defaults(cur)
+        cluster.update_job(cur)
+        for _ in range(4):
+            controller.run_until_idle()
+            if len(cluster.list_pods("default")) == 3:
+                break
+        after = {p.name: p.metadata.uid for p in cluster.list_pods("default")}
+        assert len(after) == 3  # the new evaluator pod
+        for name, uid in before.items():
+            assert after[name] == uid, f"{name} was rolled by adding an evaluator"
+
+    def test_finished_pods_not_rolled(self, env):
+        cluster, controller = env
+        job = make_job(worker=2)
+        submit_and_sync(cluster, controller, job)
+        set_phase(cluster, controller, "default", "test-job-worker-1",
+                  PodPhase.SUCCEEDED, exit_code=0)
+        done_uid = cluster.get_pod("default", "test-job-worker-1").metadata.uid
+
+        self._update_replicas(cluster, controller, job, ReplicaType.WORKER, 3)
+        for _ in range(6):
+            controller.run_until_idle()
+            if len(cluster.list_pods("default")) == 3:
+                break
+        # worker-1 finished under the old topology; its history is kept.
+        assert cluster.get_pod("default", "test-job-worker-1").metadata.uid == done_uid

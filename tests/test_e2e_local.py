@@ -259,3 +259,60 @@ class TestPodNames:
         assert names == {"names-worker-0", "names-worker-1", "names-ps-0"}
         svc_names = {s.name for s in session.cluster.list_services("default")}
         assert svc_names == names
+
+
+class TestElasticScaling:
+    """Live elastic scaling (beyond the reference, SURVEY §5): scale a
+    RUNNING job up, see every worker re-injected with the new ClusterSpec
+    (verified over the fake workload's /tfconfig HTTP surface), then scale
+    back down and see the extra replica disappear."""
+
+    def test_scale_up_then_down_reinjects_tf_config(self, session):
+        job = make_job("elastic", {"worker": (2, workload_cmd())})
+        session.submit(job)
+        session.wait_for_condition("default", "elastic", RUNNING_OR_DONE)
+        session.wait_replica_serving("elastic", "default", "Worker", 0)
+        import json as _json
+
+        def worker_count(payload):
+            return len(_json.loads(payload["TF_CONFIG"])["cluster"]["worker"])
+
+        tfc = session.replica_http("elastic", "default", "Worker", 0, "/tfconfig")
+        assert worker_count(tfc) == 2
+
+        # Scale 2 -> 3: rolling re-injection replaces live workers.
+        cur = session.get("default", "elastic")
+        from tf_operator_tpu.api.types import ReplicaType
+
+        cur.spec.replica_specs[ReplicaType.WORKER].replicas = 3
+        session.runtime.cluster.update_job(cur)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                session.wait_replica_serving(
+                    "elastic", "default", "Worker", 2, timeout=5
+                )
+                tfc0 = session.replica_http(
+                    "elastic", "default", "Worker", 0, "/tfconfig"
+                )
+                if worker_count(tfc0) == 3:
+                    break
+            except Exception:
+                time.sleep(0.25)
+        else:
+            pytest.fail("scale-up never re-injected a 3-worker ClusterSpec")
+
+        # Scale 3 -> 2: worker-2 and its service go away.
+        cur = session.get("default", "elastic")
+        cur.spec.replica_specs[ReplicaType.WORKER].replicas = 2
+        session.runtime.cluster.update_job(cur)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            pods = {p.name for p in session.runtime.cluster.list_pods("default")
+                    if p.metadata.labels.get("job-name") == "elastic"}
+            if "elastic-worker-2" not in pods and len(pods) == 2:
+                break
+            time.sleep(0.25)
+        else:
+            pytest.fail("scale-down never removed worker-2")
+        session.delete("default", "elastic")

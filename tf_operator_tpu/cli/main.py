@@ -26,6 +26,7 @@ import json
 import signal
 import sys
 import threading
+import urllib.error
 import urllib.request
 
 from tf_operator_tpu import __version__
@@ -257,6 +258,39 @@ def cmd_submit(args) -> int:
     return 0
 
 
+def cmd_scale(args) -> int:
+    """Elastic scaling: `tpujob scale myjob worker=4 ps=2`. The reconciler
+    rolls live pods onto the new topology (beyond the reference, which kept
+    replica counts static — SURVEY §5)."""
+    replicas = {}
+    for spec in args.replicas:
+        rname, eq, n = spec.partition("=")
+        if not eq or not n.isdigit():
+            print(f"scale: expected TYPE=N, got {spec!r}", file=sys.stderr)
+            return 2
+        replicas[rname] = int(n)
+    body = json.dumps({"replicas": replicas}).encode()
+    req = urllib.request.Request(
+        f"http://{args.server}/api/trainjobs/{args.namespace}/{args.name}/scale",
+        data=body,
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            data = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        print(f"scale: {e.code} {e.read().decode(errors='replace')}",
+              file=sys.stderr)
+        return 1
+    counts = {
+        t: s.get("replicas")
+        for t, s in data["manifest"]["spec"]["replicaSpecs"].items()
+    }
+    print(json.dumps({"scaled": counts}))
+    return 0
+
+
 def cmd_version(args) -> int:
     from tf_operator_tpu.version import version_string
 
@@ -331,6 +365,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("manifest")
     p.add_argument("--server", default="127.0.0.1:8443")
     p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("scale")
+    p.add_argument("name")
+    p.add_argument("replicas", nargs="+", metavar="TYPE=N",
+                   help="e.g. worker=4 ps=2")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--server", default="127.0.0.1:8443")
+    p.set_defaults(fn=cmd_scale)
 
     p = sub.add_parser("version")
     p.set_defaults(fn=cmd_version)

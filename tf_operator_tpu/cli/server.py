@@ -5,6 +5,8 @@ Dashboard-backend parity (dashboard/backend/handler/api_handler.go:42-267):
   GET    /api/trainjobs/{ns}                 list jobs in a namespace
   GET    /api/trainjobs/{ns}/{name}          one job (spec + status + events)
   POST   /api/trainjobs                      submit a manifest (JSON body)
+  POST   /api/trainjobs/{ns}/{name}/scale    elastic scaling: body
+                                             {"replicas": {"Worker": 4}}
   DELETE /api/trainjobs/{ns}/{name}          delete a job
   GET    /api/namespaces                     namespaces in use
   GET    /api/pods/{ns}                      pods in a namespace
@@ -228,6 +230,37 @@ class ApiServer:
 
             def do_POST(self):
                 parts = [p for p in self.path.split("?")[0].split("/") if p]
+                # POST /api/trainjobs/{ns}/{name}/scale {"replicas": {"Worker": 4}}
+                # -> elastic scaling: the reconciler rolls/creates/deletes pods
+                # to the new counts (core/trainjob_controller.py).
+                if (parts[:2] == ["api", "trainjobs"] and len(parts) == 5
+                        and parts[4] == "scale"):
+                    try:
+                        length = int(self.headers.get("Content-Length", "0"))
+                        body = json.loads(self.rfile.read(length))
+                        job = outer.cluster.try_get_job(parts[2], parts[3])
+                        if job is None:
+                            self._send({"error": "not found"}, 404)
+                            return
+                        for rname, count in (body.get("replicas") or {}).items():
+                            rtype = defaults.canonical_replica_type(rname)
+                            spec = job.spec.replica_specs.get(
+                                rtype if rtype is not None else rname
+                            )
+                            if spec is None:
+                                self._send({"error": f"no replica type {rname}"}, 400)
+                                return
+                            spec.replicas = int(count)
+                        problems = validation.validate_job(job)
+                        if problems:
+                            self._send({"error": "invalid scale",
+                                        "problems": problems}, 400)
+                            return
+                        updated = outer.cluster.update_job(job)
+                        self._send(_job_payload(outer.cluster, updated))
+                    except Exception as e:
+                        self._send({"error": f"{type(e).__name__}: {e}"}, 400)
+                    return
                 if parts[:2] != ["api", "trainjobs"]:
                     self._send({"error": "not found"}, 404)
                     return

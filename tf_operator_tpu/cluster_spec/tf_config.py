@@ -93,3 +93,32 @@ def is_distributed(job: TrainJob) -> bool:
     """TF_CONFIG is only injected for >1 total replicas (isDistributed,
     pod.go:292-313)."""
     return job.total_replicas() > 1
+
+
+def topology_hash(job: TrainJob, domain: str | None = None) -> str:
+    """Fingerprint of every job-wide topology input the operator injects
+    into pods (cluster map incl. ports/DNS, SPMD process set, mesh axes,
+    TPU slice topology).
+
+    Pods are labeled with this at creation; the reconciler rolls live pods
+    whose label mismatches, which is what makes `kubectl`-style replica
+    edits take effect (elastic scaling — the reference has none, SURVEY §5
+    "replica counts are static; scale changes mean delete/recreate").
+    Evaluator count is deliberately absent: evaluators consume the cluster
+    map but are excluded from it (tensorflow.go:110-114), so adding one
+    must not roll the training pods.
+    """
+    import hashlib
+
+    from tf_operator_tpu.cluster_spec import tpu_env
+
+    payload = {
+        "cluster": gen_cluster_spec(job, domain),
+        "procs": len(tpu_env._process_replicas(job)),
+        "mesh": job.spec.mesh.axes if job.spec.mesh else None,
+        "topology": job.spec.tpu.topology if job.spec.tpu else None,
+    }
+    digest = hashlib.sha1(
+        json.dumps(payload, sort_keys=True).encode()
+    ).hexdigest()
+    return digest[:12]

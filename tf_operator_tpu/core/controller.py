@@ -41,6 +41,11 @@ LABEL_JOB_NAME = "job-name"
 LABEL_REPLICA_TYPE = "replica-type"
 LABEL_REPLICA_INDEX = "replica-index"
 LABEL_JOB_ROLE = "job-role"
+# Topology fingerprint stamped at pod creation (cluster_spec.tf_config.
+# topology_hash); a live pod whose label mismatches the job's current hash
+# is rolled so its injected TF_CONFIG/TPU env matches the spec (elastic
+# scaling — beyond the reference, SURVEY §5 "No elasticity").
+LABEL_SPEC_HASH = "spec-hash"
 
 
 def gen_labels(job_name: str) -> dict[str, str]:
@@ -182,9 +187,18 @@ class JobControllerBase:
             self.enqueue(owner[0])
 
     def _on_service_delete(self, svc: Service) -> None:
+        # Unlike the reference's TODO no-op (service.go:58-66), deletions are
+        # observed: elastic scale-down raises service-delete expectations,
+        # and an unobserved expectation would gate the next sync until the
+        # 5-minute expectation timeout.
         owner = self._owner_key(svc)
-        if owner is not None:
-            self.enqueue(owner[0])
+        if owner is None:
+            return
+        key, rtype = owner
+        self.expectations.deletion_observed(
+            naming.gen_expectation_services_key(key, rtype)
+        )
+        self.enqueue(key)
 
     # ---- claim/adopt (ref ClaimPods/ClaimServices + ref managers) ----
 

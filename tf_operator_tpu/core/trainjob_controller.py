@@ -348,7 +348,8 @@ class TrainJobController(ctrl.JobControllerBase):
     def reconcile_pods(
         self, job: TrainJob, pods: list[Pod], rtype: ReplicaType, spec: ReplicaSpec
     ) -> None:
-        """reconcilePods (pod.go:89-170)."""
+        """reconcilePods (pod.go:89-170) + elastic scaling (beyond the
+        reference, which keeps replica counts static — SURVEY §5)."""
         replicas = int(spec.replicas or 0)
         rpods = self.filter_pods_for_replica_type(pods, str(rtype))
         slices = self.get_pod_slices(rpods, replicas)
@@ -358,6 +359,14 @@ class TrainJobController(ctrl.JobControllerBase):
         restart = False
         worker0_completed = self._worker0_completed(job, pods)
         masters_present = status_engine.has_chief_or_master(job)
+        spec_hash = tf_config.topology_hash(job)
+
+        # Scale-down: replicas beyond the (possibly just lowered) count are
+        # removed — without this, a spec edit orphans live trainers forever.
+        self._delete_out_of_range(
+            job, rpods, replicas, exp_key, self.pod_control.delete_pod,
+            event_reason="ScaleDown",
+        )
 
         for index, pod_slice in enumerate(slices):
             if not pod_slice:
@@ -376,6 +385,28 @@ class TrainJobController(ctrl.JobControllerBase):
                     if not self.pod_control.delete_pod(dup.namespace, dup.name, job):
                         self.expectations.deletion_observed(exp_key)
             pod = pod_slice[0]
+
+            # Rolling re-injection: a live pod created under a different
+            # topology (old replica count / mesh / slice) carries a stale
+            # TF_CONFIG + TPU env, which are injected at creation and cannot
+            # be updated in place. Replace it; trainers resume from their
+            # checkpoints at the new world size (models/train.py auto-resume).
+            # Finished pods keep their history; unlabeled pods (pre-feature)
+            # are left alone.
+            pod_hash = pod.metadata.labels.get(ctrl.LABEL_SPEC_HASH)
+            if (pod_hash is not None and pod_hash != spec_hash
+                    and not pod.is_finished()):
+                self.cluster.record_event(
+                    TrainJob.KIND, job.namespace, job.name, "Normal",
+                    "TopologyChanged",
+                    f"Rolling pod {pod.name}: topology {pod_hash} -> "
+                    f"{spec_hash}",
+                )
+                restart = True
+                self.expectations.raise_expectations(exp_key, 0, 1)
+                if not self.pod_control.delete_pod(pod.namespace, pod.name, job):
+                    self.expectations.deletion_observed(exp_key)
+                continue
 
             # Exit-code restart: a failed pod whose training container exited
             # with a retryable code is deleted; the next sync recreates it
@@ -408,6 +439,29 @@ class TrainJobController(ctrl.JobControllerBase):
             job, rtype, replicas, restart, worker0_completed, self._now()
         )
 
+    def _delete_out_of_range(
+        self, job: TrainJob, objs, replicas: int, exp_key: str, delete_fn,
+        event_reason: str | None = None,
+    ) -> None:
+        """Delete pods/services whose replica-index is >= the current count
+        (elastic scale-down), with delete-expectation bookkeeping."""
+        for obj in objs:
+            try:
+                idx = int(obj.metadata.labels.get(ctrl.LABEL_REPLICA_INDEX, ""))
+            except ValueError:
+                continue
+            if idx < replicas:
+                continue
+            if event_reason:
+                self.cluster.record_event(
+                    TrainJob.KIND, job.namespace, job.name, "Normal",
+                    event_reason,
+                    f"Deleting {obj.name}: index {idx} >= {replicas} replicas",
+                )
+            self.expectations.raise_expectations(exp_key, 0, 1)
+            if not delete_fn(obj.metadata.namespace, obj.name, job):
+                self.expectations.deletion_observed(exp_key)
+
     def _worker0_completed(self, job: TrainJob, pods: list[Pod]) -> bool:
         """worker-0 success detection (pod.go:159-162)."""
         for pod in self.filter_pods_for_replica_type(pods, str(ReplicaType.WORKER)):
@@ -437,6 +491,7 @@ class TrainJobController(ctrl.JobControllerBase):
             **ctrl.gen_labels(job.name),
             ctrl.LABEL_REPLICA_TYPE: str(rtype).lower(),
             ctrl.LABEL_REPLICA_INDEX: str(index),
+            ctrl.LABEL_SPEC_HASH: tf_config.topology_hash(job),
         }
         if master_role:
             labels[ctrl.LABEL_JOB_ROLE] = "master"
@@ -504,6 +559,11 @@ class TrainJobController(ctrl.JobControllerBase):
         rsvcs = self.filter_services_for_replica_type(services, str(rtype))
         slices = self.get_service_slices(rsvcs, replicas)
         exp_key = naming.gen_expectation_services_key(job.key(), str(rtype))
+
+        # Scale-down: drop DNS identities beyond the current replica count.
+        self._delete_out_of_range(
+            job, rsvcs, replicas, exp_key, self.service_control.delete_service
+        )
 
         for index, svc_slice in enumerate(slices):
             if svc_slice:

@@ -102,6 +102,8 @@ class _Server:
         logfile = req.get("logfile")
         pid = os.fork()
         if pid:
+            # A recycled pid must not inherit a prior pod's exit record.
+            self.exits.pop(pid, None)
             self.live.add(pid)
             return {"pid": pid}
         # ---- child ----
@@ -116,6 +118,15 @@ class _Server:
             os.dup2(devnull_in, 0)
             os.dup2(fd, 1)
             os.dup2(fd, 2)
+            try:
+                # Line-buffer the redirected stdio: a real pod spawn writes
+                # to its logfile promptly, and a block-buffered tail would be
+                # lost on SIGKILL (the dashboard log endpoint reads this file
+                # live).
+                sys.stdout.reconfigure(line_buffering=True)
+                sys.stderr.reconfigure(line_buffering=True)
+            except (AttributeError, OSError, ValueError):
+                pass
             if cwd:
                 os.chdir(cwd)
             os.environ.clear()
@@ -166,7 +177,10 @@ class _Server:
             pid = req["poll"]
             self._reap()
             if pid in self.exits:
-                return {"exit": self.exits[pid]}
+                # One handle per pid, and it caches the code on first read:
+                # dropping the entry bounds `exits` and removes the pid-reuse
+                # window entirely.
+                return {"exit": self.exits.pop(pid)}
             return {"exit": None}
         if "signal" in req:
             try:
@@ -367,10 +381,16 @@ def parse_module_cmd(cmd: list[str]) -> tuple[str, list[str]] | None:
     """
     if len(cmd) < 3:
         return None
-    exe = os.path.basename(cmd[0])
-    if (cmd[0] != sys.executable
-            and exe not in ("python", "python3", os.path.basename(sys.executable))):
-        return None
+    if cmd[0] != sys.executable:
+        # Bare names resolve to this interpreter on PATH-less pod specs;
+        # an explicit path to a DIFFERENT python (another venv) must fall
+        # through to a real spawn, not run under our site-packages.
+        if os.path.dirname(cmd[0]):
+            if os.path.realpath(cmd[0]) != os.path.realpath(sys.executable):
+                return None
+        elif cmd[0] not in ("python", "python3",
+                            os.path.basename(sys.executable)):
+            return None
     i = 1
     while i < len(cmd) and cmd[i] in ("-u", "-B"):
         i += 1
@@ -405,8 +425,11 @@ class PrespawnSupervisor:
             self._ensure_started()
         if parsed is not None and self.client.ready():
             module, argv = parsed
+            # env=None means inherit, like Popen: snapshot the runtime's env
+            # rather than handing the child an empty environment.
             resp = self.client.request({"spawn": {
-                "module": module, "argv": argv, "env": dict(env or {}),
+                "module": module, "argv": argv,
+                "env": dict(os.environ) if env is None else dict(env),
                 "cwd": cwd, "logfile": logfile,
             }})
             if resp and "pid" in resp:
