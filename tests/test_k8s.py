@@ -523,3 +523,84 @@ class TestPodLogs:
             assert exc.value.code == 404
         finally:
             api.stop()
+
+
+class TestElasticScalingOverWire:
+    """Elastic scaling through the real K8s wire path: a kubectl-style PUT
+    of the CR with a new replica count makes the operator roll live pods
+    (stale TF_CONFIG re-injected) and delete out-of-range ones — the same
+    reconciler behavior tests/test_controller.py::TestElasticScaling pins
+    on the in-memory substrate."""
+
+    @staticmethod
+    def _kubectl_edit(server, name, mutate, attempts=10):
+        """kubectl-edit semantics: GET a COPY of the CR, mutate, PUT with
+        the read resourceVersion; retry on 409 (the controller's concurrent
+        status writes bump the rv, like a real API server)."""
+        import copy as _copy
+
+        for _ in range(attempts):
+            cur = _copy.deepcopy(
+                server.get_object(TrainJob.PLURAL, "default", name)
+            )
+            mutate(cur)
+            req = urllib.request.Request(
+                f"{server.url}/apis/{TrainJob.API_VERSION}/namespaces/default/"
+                f"{TrainJob.PLURAL}/{name}",
+                data=json.dumps(cur).encode(), method="PUT",
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=5).read()
+                return
+            except urllib.error.HTTPError as e:
+                if e.code != 409:
+                    raise
+                time.sleep(0.05)
+        raise AssertionError("PUT kept conflicting")
+
+    def test_cr_edit_scales_pods(self, k8s):
+        server, cluster, controller = k8s
+        _kubectl_create(server, _mk_job("k8s-elastic", workers=2))
+        _wait(
+            lambda: (server.list_objects("pods")
+                     if len(server.list_objects("pods")) == 2 else None),
+            what="2 pods",
+        )
+        for p in ("k8s-elastic-worker-0", "k8s-elastic-worker-1"):
+            server.set_pod_status("default", p, "Running")
+
+        def set_workers(n):
+            def mutate(cur):
+                cur["spec"]["replicaSpecs"]["Worker"]["replicas"] = n
+            return mutate
+
+        self._kubectl_edit(server, "k8s-elastic", set_workers(3))
+
+        def three_fresh_workers():
+            pods = server.list_objects("pods")
+            if len(pods) != 3:
+                return None
+            for p in pods:
+                env = {e["name"]: e.get("value", "")
+                       for e in p["spec"]["containers"][0]["env"]}
+                tfc = json.loads(env.get("TF_CONFIG", "{}"))
+                if len(tfc.get("cluster", {}).get("worker", [])) != 3:
+                    return None
+            return pods
+
+        _wait(three_fresh_workers, what="3 workers with 3-worker TF_CONFIG")
+
+        # And back down: worker-2 AND its headless service disappear (a
+        # leaked service would be a stale DNS entry for a dead peer).
+        self._kubectl_edit(server, "k8s-elastic", set_workers(1))
+        _wait(
+            lambda: (
+                {p["metadata"]["name"] for p in server.list_objects("pods")}
+                == {"k8s-elastic-worker-0"}
+                and {s["metadata"]["name"]
+                     for s in server.list_objects("services")}
+                == {"k8s-elastic-worker-0"}
+            ) or None,
+            what="scale-down to worker-0 pod + service only",
+        )
