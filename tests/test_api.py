@@ -106,9 +106,12 @@ class TestDefaults:
         assert job.spec.mesh.axes == {"dp": 32}
         assert job.spec.tpu.accelerator == "v5e"
 
-    def test_min_available_default(self):
+    def test_min_available_stays_none_for_elasticity(self):
+        # None = "track ΣReplicas at sync time": materializing the sum at
+        # admission would pin the PodGroup's minMember to the original count
+        # across elastic scale edits (defaults.py note, gang/podgroup.py).
         job = make_job(ps=2, worker=4)
-        assert job.spec.run_policy.scheduling.min_available == 6
+        assert job.spec.run_policy.scheduling.min_available is None
 
 
 class TestTopology:

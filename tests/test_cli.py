@@ -277,6 +277,7 @@ class TestDashboardFormBuilder:
             'id="create-btn"', "addReplicaRow", "buildManifest",
             'id="f-topology"', 'id="f-cpp"', 'id="f-gang"',
             'id="ns-filter"', "refreshNamespaces",
+            'id="scale-type"', "scaleJob",  # elastic scaling control
             "Evaluator",  # replica type choices present
             "ExitCode",   # restart policy choices present
             "v5e-32",     # TPU topology picker

@@ -684,3 +684,29 @@ class TestElasticScaling:
                 break
         # worker-1 finished under the old topology; its history is kept.
         assert cluster.get_pod("default", "test-job-worker-1").metadata.uid == done_uid
+
+
+class TestElasticGang:
+    def test_scale_resizes_podgroup_min_member(self, env):
+        """Elastic scaling x gang: the PodGroup's minMember must follow the
+        new replica total, or the gang scheduler would admit a partial (or
+        over-demand a full) gang after a scale edit."""
+        cluster = InMemoryCluster()
+        controller = TrainJobController(cluster, enable_gang=True)
+        job = make_job(worker=3, gang=True)
+        cluster.create_job(job)
+        assert controller.run_until_idle()
+        assert cluster.list_podgroups("default")[0].min_member == 3
+
+        cur = cluster.get_job(job.namespace, job.name)
+        cur.spec.replica_specs[ReplicaType.WORKER].replicas = 5
+        cluster.update_job(cur)
+        for _ in range(6):
+            controller.run_until_idle()
+            pgs = cluster.list_podgroups("default")
+            if pgs and pgs[0].min_member == 5 and len(
+                cluster.list_pods("default")
+            ) == 5:
+                break
+        assert cluster.list_podgroups("default")[0].min_member == 5
+        assert len(cluster.list_pods("default")) == 5

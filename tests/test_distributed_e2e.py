@@ -16,7 +16,10 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 from pathlib import Path
+
+import pytest
 
 from tf_operator_tpu.api import defaults
 from tf_operator_tpu.api.types import (
@@ -133,3 +136,98 @@ class TestJaxDistributedE2E:
         # Each process reads half the dataset (2 of 4 shards = 32 samples).
         assert firsts and all(e["local_samples"] == 32 for e in firsts)
         assert all(e["n_devices"] == 2 for e in firsts)
+
+
+class TestElasticDistributedTraining:
+    """Elastic scaling of LIVE multi-process training: a dp=2
+    jax.distributed job is scaled to dp=4 mid-run. The operator rolls every
+    worker (their injected world is stale), the four new processes form a
+    fresh global runtime, resume from the shared checkpoint, and train to
+    completion — the full story the reference could never tell (static
+    replica counts, SURVEY §5)."""
+
+    @pytest.mark.slow
+    def test_scale_2_to_4_processes_resumes_and_completes(self, tmp_path):
+        metrics_file = str(tmp_path / "elastic-events.jsonl")
+        ckpt_dir = str(tmp_path / "ckpt")
+        job = TrainJob(
+            metadata=ObjectMeta(name="dist-elastic"),
+            spec=TrainJobSpec(
+                replica_specs={
+                    ReplicaType.WORKER: ReplicaSpec(
+                        replicas=2,
+                        template=PodTemplateSpec(
+                            containers=[
+                                ContainerSpec(
+                                    name="tensorflow", image="local",
+                                    command=[
+                                        sys.executable, "-m",
+                                        "tf_operator_tpu.models.train",
+                                        "--model", "mnist-mlp",
+                                        "--steps", "4000",
+                                        "--batch", "8",
+                                        "--log-every", "50",
+                                        "--checkpoint-every", "50",
+                                        "--checkpoint-dir", ckpt_dir,
+                                    ],
+                                )
+                            ]
+                        ),
+                    )
+                },
+                mesh=MeshSpec(axes={"dp": 2}),
+            ),
+        )
+        defaults.set_defaults(job)
+        job.spec.run_policy.scheduling.gang = False
+
+        pythonpath = str(REPO)
+        if os.environ.get("PYTHONPATH"):
+            pythonpath += os.pathsep + os.environ["PYTHONPATH"]
+        with LocalSession(
+            env_overrides={
+                "PYTHONPATH": pythonpath,
+                "TPUJOB_METRICS_FILE": metrics_file,
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "JAX_PLATFORMS": "cpu",
+            },
+            log_dir=str(tmp_path / "logs"),
+        ) as s:
+            s.submit(job)
+
+            def checkpointed():
+                if not os.path.isdir(ckpt_dir):
+                    return False
+                return any(n.startswith("trainstate_") for n in os.listdir(ckpt_dir))
+
+            deadline = time.time() + 240
+            while time.time() < deadline and not checkpointed():
+                time.sleep(0.5)
+            assert checkpointed(), "no checkpoint appeared before the scale"
+
+            # kubectl-style edit: dp 2 -> 4. The mesh spec scales with it.
+            cur = s.get("default", "dist-elastic")
+            cur.spec.replica_specs[ReplicaType.WORKER].replicas = 4
+            cur.spec.mesh = MeshSpec(axes={"dp": 4})
+            s.runtime.cluster.update_job(cur)
+
+            final = s.wait_for_condition(
+                "default", "dist-elastic",
+                (JobConditionType.SUCCEEDED, JobConditionType.FAILED),
+                timeout=600,
+            )
+            assert is_succeeded(final.status), final.status.conditions
+
+        with open(metrics_file) as f:
+            events = [json.loads(ln) for ln in f if ln.strip()]
+        # The rolled generation resumed from the shared checkpoint...
+        resumed = [e for e in events if e["event"] == "resumed"]
+        assert resumed, "no process resumed from checkpoint after the roll"
+        assert all(e["from_step"] > 0 for e in resumed)
+        # ...into a 4-process, 4-device global runtime...
+        firsts = [e for e in events if e["event"] == "first_step"]
+        assert any(e["n_devices"] == 4 and e["mesh"] == {"dp": 4}
+                   for e in firsts), firsts
+        # ...and trained to the full step budget.
+        dones = [e for e in events if e["event"] == "done"]
+        assert any(e["steps"] == 4000 for e in dones), dones

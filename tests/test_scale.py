@@ -97,3 +97,31 @@ class TestHundredConcurrentJobs:
                     assert is_succeeded(final.status), f"mix-{i}"
                 else:
                     assert is_failed(final.status), f"mix-{i}"
+
+
+class TestPrespawnAtScale:
+    def test_100_forked_pods_all_succeed(self):
+        """The O(100)-job target through the prespawn fork server: 100
+        `python -m` pods forked from one warm image by a single-threaded
+        server (spawn storm + poll traffic), every job Succeeded."""
+        cmd = [sys.executable, "-m", "timeit", "-n", "1", "-r", "1", "pass"]
+        t0 = time.monotonic()
+        with LocalSession(workers=4) as s:
+            warmed = s.prewarm(timeout=120)
+            for i in range(N_JOBS):
+                s.submit(_job(f"fork-{i}", cmd))
+            for i in range(N_JOBS):
+                final = s.wait_for_condition(
+                    "default", f"fork-{i}",
+                    (JobConditionType.SUCCEEDED, JobConditionType.FAILED),
+                    timeout=180,
+                )
+                assert is_succeeded(final.status), (
+                    f"fork-{i}: {final.status.conditions}"
+                )
+        wall = time.monotonic() - t0
+        assert wall < 150, f"100 prespawn jobs took {wall:.1f}s"
+        # With a warm server the whole fleet should clear far faster than
+        # 100 x the ~3s interpreter boot it avoids.
+        if warmed:
+            assert wall < 90, f"prespawn at scale too slow: {wall:.1f}s"

@@ -112,9 +112,11 @@ def set_defaults_spec(spec: TrainJobSpec) -> None:
                 # Default: pure data parallelism over every chip in the slice.
                 spec.mesh = MeshSpec(axes={"dp": topo.num_chips})
 
-    if spec.run_policy.scheduling.min_available is None:
-        total = sum(int(s.replicas or 0) for s in spec.replica_specs.values())
-        spec.run_policy.scheduling.min_available = total
+    # min_available stays None unless the user set it: None means "track
+    # ΣReplicas at sync time" (gang/podgroup.py), which is what lets the
+    # PodGroup's minMember follow elastic scale edits. Materializing the sum
+    # here would bake in the admission-time count forever (the reference
+    # computes minMember per sync too, jobcontroller.go:226-250).
 
 
 def set_defaults(job: TrainJob) -> TrainJob:

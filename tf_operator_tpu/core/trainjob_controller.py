@@ -360,6 +360,18 @@ class TrainJobController(ctrl.JobControllerBase):
         worker0_completed = self._worker0_completed(job, pods)
         masters_present = status_engine.has_chief_or_master(job)
         spec_hash = tf_config.topology_hash(job)
+        # Two-phase roll: while ANY live pod of this job (any type) still
+        # carries a stale topology, hold replacement creations. Mixing
+        # generations is not just wasteful — a new worker can dial the OLD
+        # generation's jax.distributed coordinator on the reused port and
+        # abort the whole gang ("unexpected incarnation"). Deletes below
+        # proceed; their events re-sync and creation happens once the old
+        # generation is gone.
+        stale_live = any(
+            p.metadata.labels.get(ctrl.LABEL_SPEC_HASH) not in (None, spec_hash)
+            and not p.is_finished()
+            for p in pods
+        )
 
         # Scale-down: replicas beyond the (possibly just lowered) count are
         # removed — without this, a spec edit orphans live trainers forever.
@@ -370,6 +382,8 @@ class TrainJobController(ctrl.JobControllerBase):
 
         for index, pod_slice in enumerate(slices):
             if not pod_slice:
+                if stale_live:
+                    continue  # old generation still draining (see above)
                 master_role = (
                     rtype in (ReplicaType.CHIEF, ReplicaType.MASTER)
                     if masters_present

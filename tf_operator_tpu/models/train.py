@@ -98,9 +98,13 @@ def _save_checkpoint(ckpt_dir: str, step: int, state, final: bool = False) -> No
 
     ckpt.save_named(ckpt_dir, f"trainstate_{step}", jax.device_get(_aux_tree(state)))
     path = ckpt.save(ckpt_dir, step, jax.device_get(state.params))
-    if final:
-        ckpt.mark_final(ckpt_dir, step)
-    _emit({"event": "checkpoint", "step": step, "path": path, "final": final})
+    # orbax coordinates the collective save, but mark_final/_emit are plain
+    # file IO: one writer only, or concurrent os.replace of the shared
+    # .FINAL.tmp races (loser raises, failing a finished job).
+    if jax.process_index() == 0:
+        if final:
+            ckpt.mark_final(ckpt_dir, step)
+        _emit({"event": "checkpoint", "step": step, "path": path, "final": final})
 
 
 def _try_resume(ckpt_dir: str | None, state):
@@ -542,7 +546,16 @@ def main(argv: list[str] | None = None) -> int:
         )
         return _run_evaluator(args, model, template, make_batch, loss_fn)
 
-    saver = _is_checkpoint_writer() and args.checkpoint_dir
+    # Single-writer semantics differ by runtime shape. Independent
+    # processes (PS-strategy: each worker is its own jax runtime): only the
+    # chief/worker-0 touches the shared dir. ONE multi-process runtime
+    # (jax.distributed): EVERY process must enter the save — orbax runs
+    # multihost sync barriers inside save(), and a single process calling it
+    # deadlocks against the others' next collective (orbax itself writes
+    # from process 0 only).
+    saver = args.checkpoint_dir and (
+        _is_checkpoint_writer() or jax.process_count() > 1
+    )
 
     tx = optax.adamw(args.lr)
 
@@ -565,7 +578,7 @@ def main(argv: list[str] | None = None) -> int:
         # idempotent, not retrain.
         from tf_operator_tpu.models import checkpoint as ckpt_lib
 
-        if (saver and start_step > 0
+        if (saver and jax.process_index() == 0 and start_step > 0
                 and ckpt_lib.final_step(args.checkpoint_dir) is None):
             ckpt_lib.mark_final(args.checkpoint_dir, start_step)
         _emit({"event": "done", "t": time.time(), "steps": start_step,

@@ -165,6 +165,7 @@ class LocalProcessRuntime:
         self.inherit_env = inherit_env
         self.log_dir = log_dir
         self._procs: dict[tuple[str, str], _Proc] = {}
+        self._draining: dict[tuple[str, str], object] = {}
         self._supervisor = make_supervisor()
         # Pre-warmed fork server: cuts the ~4 s Python/JAX import tax off
         # every `python -m` pod (runtime/prespawn.py). Started here so it
@@ -242,10 +243,57 @@ class LocalProcessRuntime:
 
     def _on_pod_delete(self, pod: Pod) -> None:
         with self._lock:
+            # Opportunistic purge: entries whose process already exited are
+            # dead weight (a job deleted mid-run with no successor would
+            # otherwise pin its handles for the runtime's lifetime).
+            for key in [k for k, (_, p) in self._draining.items()
+                        if p.poll() is not None]:
+                del self._draining[key]
             proc = self._procs.pop((pod.namespace, pod.name), None)
+            if proc is not None:
+                # Track the dying process: replacement pods of the SAME JOB
+                # (elastic roll, ExitCode recreate) must not start while any
+                # old-generation process still runs — a new jax.distributed
+                # worker dialing the OLD generation's still-alive coordinator
+                # aborts the whole gang ("unexpected incarnation"), and a
+                # SIGTERM'd process can linger seconds inside a collective
+                # before its handler runs.
+                job = pod.metadata.labels.get("job-name", "")
+                self._draining[(pod.namespace, pod.name)] = (job, proc.process)
         if proc is not None:
             proc.stopping = True
             self._terminate(proc.process)
+
+    def _await_drained(self, ns: str, job: str, grace: float = 2.0,
+                       timeout: float = 8.0) -> None:
+        """Block until every draining process of (ns, job) is dead (SIGKILL
+        after `grace`), so a new generation can bind the old one's ports."""
+        with self._lock:
+            priors = [
+                (key, p) for key, (j, p) in self._draining.items()
+                if key[0] == ns and j == job
+            ]
+        if not priors:
+            return
+        start = time.time()
+        deadline = start + timeout
+        killed = False
+        while time.time() < deadline:
+            if all(p.poll() is not None for _, p in priors):
+                break
+            if not killed and time.time() - start > grace:
+                for _, p in priors:
+                    if p.poll() is None:
+                        try:
+                            p.kill()
+                        except ProcessLookupError:
+                            pass
+                killed = True
+            time.sleep(0.02)
+        with self._lock:
+            for key, p in priors:
+                if (self._draining.get(key) or (None, None))[1] is p:
+                    del self._draining[key]
 
     @staticmethod
     def _terminate(process) -> None:
@@ -313,6 +361,9 @@ class LocalProcessRuntime:
             return
         container = pod.spec.containers[0]
         cmd = list(container.command) + list(container.args)
+        self._await_drained(
+            pod.namespace, pod.metadata.labels.get("job-name", "")
+        )
         pm = self._port_map_for(pod)
         env = self._build_env(pod, pm)
         restart_policy = pod.spec.restart_policy or "Never"
