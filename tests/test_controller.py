@@ -710,3 +710,34 @@ class TestElasticGang:
                 break
         assert cluster.list_podgroups("default")[0].min_member == 5
         assert len(cluster.list_pods("default")) == 5
+
+
+class TestRemovedReplicaType:
+    def test_removing_type_deletes_its_pods_and_job_proceeds(self, env):
+        """A spec edit that drops a replica type entirely must delete its
+        pods (they'd otherwise hold the two-phase roll gate forever) and the
+        remaining types must re-create under the new topology."""
+        cluster, controller = env
+        job = make_job(worker=2, ps=1)
+        submit_and_sync(cluster, controller, job)
+        assert len(cluster.list_pods("default")) == 3
+
+        cur = cluster.get_job(job.namespace, job.name)
+        del cur.spec.replica_specs[defaults.canonical_replica_type("ps")]
+        cluster.update_job(cur)
+        for _ in range(8):
+            controller.run_until_idle()
+            pods = cluster.list_pods("default")
+            names = {p.name for p in pods}
+            if names == {"test-job-worker-0", "test-job-worker-1"}:
+                break
+        names = {p.name for p in cluster.list_pods("default")}
+        assert names == {"test-job-worker-0", "test-job-worker-1"}, names
+        # Workers were rolled onto the PS-less topology.
+        from tf_operator_tpu.cluster_spec import tf_config
+        fresh = tf_config.topology_hash(cluster.get_job("default", "test-job"))
+        from tf_operator_tpu.core.controller import LABEL_SPEC_HASH
+        for p in cluster.list_pods("default"):
+            assert p.metadata.labels[LABEL_SPEC_HASH] == fresh
+        svc_names = {s.name for s in cluster.list_services("default")}
+        assert "test-job-ps-0" not in svc_names

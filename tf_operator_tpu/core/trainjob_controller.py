@@ -206,6 +206,33 @@ class TrainJobController(ctrl.JobControllerBase):
                 self.queue.add_after(key, SLICE_RETRY_DELAY_S)
                 return
 
+        # Pods/services of replica types REMOVED from the spec would never be
+        # visited by the per-type loop: delete them, or their stale topology
+        # label holds the two-phase roll gate forever (wedging creations).
+        known = {str(rt).lower() for rt in job.spec.replica_specs}
+        for pod in pods:
+            rt = pod.metadata.labels.get(ctrl.LABEL_REPLICA_TYPE, "")
+            if rt and rt not in known and not pod.is_finished():
+                self.cluster.record_event(
+                    TrainJob.KIND, job.namespace, job.name, "Normal",
+                    "ScaleDown",
+                    f"Deleting pod {pod.name}: replica type {rt!r} removed "
+                    f"from spec",
+                )
+                exp_key = naming.gen_expectation_pods_key(key, rt)
+                self.expectations.raise_expectations(exp_key, 0, 1)
+                if not self.pod_control.delete_pod(pod.namespace, pod.name, job):
+                    self.expectations.deletion_observed(exp_key)
+        for svc in services:
+            rt = svc.metadata.labels.get(ctrl.LABEL_REPLICA_TYPE, "")
+            if rt and rt not in known:
+                exp_key = naming.gen_expectation_services_key(key, rt)
+                self.expectations.raise_expectations(exp_key, 0, 1)
+                if not self.service_control.delete_service(
+                    svc.namespace, svc.name, job
+                ):
+                    self.expectations.deletion_observed(exp_key)
+
         for rtype, spec in sorted(
             job.spec.replica_specs.items(), key=lambda kv: str(kv[0])
         ):

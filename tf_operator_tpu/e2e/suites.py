@@ -1,4 +1,5 @@
-"""The eight E2E behavior suites, over REST against a live operator.
+"""The E2E behavior suites, over REST against a live operator:
+the reference's eight plus a ninth (elastic) the reference could not have.
 
 1:1 with the reference's suite files (SURVEY.md §4 Tier 3):
   simple            <- simple_tfjob_tests.py
@@ -405,6 +406,50 @@ def pod_names_contract(client: TrainJobClient) -> None:
 # ----------------------------------------------------------------- registry
 
 
+# ------------------------------------------------------------------ elastic
+
+
+def elastic_scale_up_down(client: TrainJobClient) -> None:
+    """Beyond the reference's eight behaviors (SURVEY §5 'No elasticity'):
+    scale a RUNNING job up, see the new replica appear (and every worker
+    re-injected with the new topology via the rolling replacement), then
+    back down, see the extra replica and its DNS identity vanish."""
+    name = "e2e-elastic"
+    _cleanup(client, name)
+    client.create(manifest(name, {"Worker": (2, WORKLOAD)}))
+    try:
+        client.wait_for_condition(NS, name, ("Running",))
+
+        client.scale(NS, name, {"Worker": 3})
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            pods = {p["name"] for p in client.list_pods(NS)
+                    if p["name"].startswith(f"{name}-")}
+            if pods == {f"{name}-worker-{i}" for i in range(3)}:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"scale-up never produced 3 workers: {pods}")
+        job = client.get(NS, name)
+        assert job["manifest"]["spec"]["replicaSpecs"]["Worker"]["replicas"] == 3
+
+        client.scale(NS, name, {"Worker": 1})
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            pods = {p["name"] for p in client.list_pods(NS)
+                    if p["name"].startswith(f"{name}-")}
+            if pods == {f"{name}-worker-0"}:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"scale-down never drained to worker-0: {pods}")
+        events = [e["reason"] for e in client.get_events(NS, name)]
+        assert "ScaleDown" in events, events
+        assert "TopologyChanged" in events, events
+    finally:
+        _cleanup(client, name)
+
+
 SUITES = {
     "simple": lambda: [
         TestCase("simple_success", simple_success, trials=2),
@@ -435,5 +480,9 @@ SUITES = {
     ],
     "pod_names": lambda: [
         TestCase("pod_names_contract", pod_names_contract),
+    ],
+    # Ninth suite, beyond the reference's eight: elastic scaling.
+    "elastic": lambda: [
+        TestCase("elastic_scale_up_down", elastic_scale_up_down),
     ],
 }

@@ -73,6 +73,14 @@ class TrainJobClient:
     def delete(self, namespace: str, name: str) -> None:
         self._request("DELETE", f"/api/trainjobs/{namespace}/{name}")
 
+    def scale(self, namespace: str, name: str, replicas: dict[str, int]) -> dict:
+        """Elastic scaling (beyond the reference): new replica counts take
+        effect on the running job."""
+        return self._request(
+            "POST", f"/api/trainjobs/{namespace}/{name}/scale",
+            {"replicas": replicas},
+        )
+
     def list_pods(self, namespace: str) -> list[dict]:
         return self._request("GET", f"/api/pods/{namespace}")["items"]
 

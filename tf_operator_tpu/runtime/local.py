@@ -364,6 +364,12 @@ class LocalProcessRuntime:
         self._await_drained(
             pod.namespace, pod.metadata.labels.get("job-name", "")
         )
+        # The pod may have been deleted while we waited (rapid successive
+        # scale edits): spawning now would orphan a process that binds the
+        # job's reused ports with no pod object tracking it.
+        cur = self.cluster.try_get_pod(pod.namespace, pod.name)
+        if self._stopped or cur is None or cur.metadata.uid != pod.metadata.uid:
+            return
         pm = self._port_map_for(pod)
         env = self._build_env(pod, pm)
         restart_policy = pod.spec.restart_policy or "Never"

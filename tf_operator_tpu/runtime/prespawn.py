@@ -259,8 +259,17 @@ class PrespawnProcess:
         self._client = client
         self.pid = pid
         self._exit: int | None = None
+        self._poll_lock = threading.Lock()
 
     def poll(self) -> int | None:
+        # Serialized: the server's exit record is a destructive read (popped
+        # on first report), and several threads poll one handle (the owning
+        # pod thread's wait() plus drain/purge scans) — a second in-flight
+        # poll must not clobber the cached code with None.
+        with self._poll_lock:
+            return self._poll_locked()
+
+    def _poll_locked(self) -> int | None:
         if self._exit is not None:
             return self._exit
         resp = self._client.request({"poll": self.pid})
