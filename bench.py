@@ -333,6 +333,27 @@ def _main() -> int:
     lm_tps = round(lm_eps * lm_seq, 1) if lm_eps else None
     log(f"  ok={lm['ok']} seq={lm_seq} tokens/s={lm_tps}")
 
+    # --- Workload 3b: DOUBLE the context (seq 16k, same 140M model) ---
+    # The chunked cross-entropy (models/transformer.py lm_loss_chunked)
+    # keeps the [B, T, vocab] logits out of the HBM peak, so 16k trains
+    # first-class on one v5e chip; this pins that capability + its MFU.
+    lm16_tps = lm16_mfu = None
+    lm16_ok = None
+    if on_tpu:
+        log("bench: long-context seq 16384...")
+        lm16 = run_job_e2e(
+            "transformer-lm", steps=10, batch=2,
+            extra=["--seq", "16384", "--layers", str(lm_layers),
+                   "--hidden", str(lm_hidden), "--heads", str(lm_heads),
+                   "--log-every", "5"],
+            timeout=900,
+        )
+        l16 = {e["event"]: e for e in lm16["events"]}
+        eps16 = l16.get("done", {}).get("examples_per_sec")
+        lm16_ok = lm16["ok"]
+        lm16_tps = round(eps16 * 16384, 1) if eps16 else None
+        log(f"  ok={lm16_ok} seq=16384 tokens/s={lm16_tps}")
+
     # --- MFU accounting + achievable-ceiling probe ---
     rn_mfu = lm_mfu = None
     lm_ftok = lm_train_flops_per_token(lm_layers, lm_hidden, lm_seq)
@@ -341,6 +362,9 @@ def _main() -> int:
             rn_mfu = round(rn_ips * RESNET50_TRAIN_FLOPS_PER_IMG / (peak * 1e12), 4)
         if lm_tps:
             lm_mfu = round(lm_tps * lm_ftok / (peak * 1e12), 4)
+        if lm16_tps:
+            ftok16 = lm_train_flops_per_token(lm_layers, lm_hidden, 16384)
+            lm16_mfu = round(lm16_tps * ftok16 / (peak * 1e12), 4)
     mxu = measure_mxu_ceiling() if on_tpu else None
     log(f"  device={device_kind} peak={peak}TF/s measured-mxu={mxu}TF/s "
         f"resnet50_mfu={rn_mfu} longctx_mfu={lm_mfu}")
@@ -371,6 +395,9 @@ def _main() -> int:
         "longctx_tokens_per_sec": lm_tps,
         "longctx_flops_per_token": lm_ftok,
         "longctx_mfu": lm_mfu,
+        "longctx16k_ok": lm16_ok,
+        "longctx16k_tokens_per_sec": lm16_tps,
+        "longctx16k_mfu": lm16_mfu,
         "longctx_segments": lm.get("segments"),
         "bench_total_s": round(time.time() - t_total, 1),
     }

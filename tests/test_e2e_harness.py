@@ -62,6 +62,9 @@ class TestClient:
     def test_invalid_suite(self, client):
         suites.invalid_rejected_at_admission(client)
 
+    def test_elastic_suite(self, client):
+        suites.elastic_scale_up_down(client)
+
     def test_fault_injection_endpoints(self, client):
         suites.shutdown_worker0_completes(client)
 

@@ -53,3 +53,6 @@ class TestKubeSubstrateSuites:
 
     def test_pod_names_contract(self, kube_client):
         suites.pod_names_contract(kube_client)
+
+    def test_elastic_scale_up_down(self, kube_client):
+        suites.elastic_scale_up_down(kube_client)

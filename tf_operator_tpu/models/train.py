@@ -531,7 +531,20 @@ def main(argv: list[str] | None = None) -> int:
                 )
             }
 
+        # Past ~16k tokens the full [B, T, vocab] logits tensor (not the
+        # activations) is the HBM peak: compute the head + softmax per
+        # sequence chunk instead (numerics identical; see lm_loss_chunked).
+        chunked_loss = args.seq * cfg.vocab_size >= 16384 * 32000
+
         def loss_fn(params, model_state, batch, rng):
+            if chunked_loss:
+                h = model.apply(
+                    {"params": params}, batch["tokens"], method="hidden"
+                )
+                loss = tfm.lm_loss_chunked(
+                    h, params["lm_head"]["kernel"], batch["tokens"]
+                )
+                return loss, model_state
             logits = model.apply({"params": params}, batch["tokens"])
             return tfm.lm_loss(logits, batch["tokens"]), model_state
 

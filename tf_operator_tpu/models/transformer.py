@@ -137,19 +137,30 @@ class Transformer(nn.Module):
 
 
 class TransformerLM(nn.Module):
-    """Causal language model head over the trunk (flagship long-context model)."""
+    """Causal language model head over the trunk (flagship long-context
+    model). setup() (not @nn.compact) so `hidden` can expose the trunk
+    output without the head: at very long sequences the full [B, T, vocab]
+    logits tensor is the HBM peak (seq 32k x vocab 32k in f32 = 4 GB), and
+    the chunked loss (lm_loss_chunked) computes head+softmax per sequence
+    chunk instead. Param paths ("trunk", "lm_head") are unchanged."""
 
     cfg: TransformerConfig
     attn_fn: AttnFn | None = None
 
-    @nn.compact
-    def __call__(self, tokens, deterministic=True):
-        h = Transformer(self.cfg, self.attn_fn, name="trunk")(tokens, deterministic)
-        logits = nn.Dense(
+    def setup(self):
+        self.trunk = Transformer(self.cfg, self.attn_fn, name="trunk")
+        self.lm_head = nn.Dense(
             self.cfg.vocab_size, dtype=self.cfg.dtype, param_dtype=jnp.float32,
             use_bias=False, name="lm_head",
-        )(h)
-        return logits.astype(jnp.float32)
+        )
+
+    def __call__(self, tokens, deterministic=True):
+        h = self.trunk(tokens, deterministic)
+        return self.lm_head(h).astype(jnp.float32)
+
+    def hidden(self, tokens, deterministic=True):
+        """Trunk output [B, T, H] (post final LayerNorm), no head."""
+        return self.trunk(tokens, deterministic)
 
 
 class TransformerClassifier(nn.Module):
@@ -221,3 +232,45 @@ def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     tgt = tokens[:, 1:]
     nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)
+
+
+def lm_loss_chunked(
+    h: jax.Array, head_kernel: jax.Array, tokens: jax.Array,
+    chunk: int = 2048,
+) -> jax.Array:
+    """lm_loss without materializing the full [B, T, vocab] logits.
+
+    Scans the sequence in chunks: each iteration projects one [B, chunk, H]
+    slice of trunk output through the head and reduces its cross entropy,
+    so peak logits memory is B*chunk*vocab instead of B*T*vocab — the
+    difference between OOM and fitting at seq 32k on one v5e chip. AD
+    transposes the scan, so the backward pass is chunked too (the head
+    gradient accumulates across chunks). Numerics match lm_loss exactly:
+    softmax is per-position, and the final mean is over the same T-1
+    shifted targets.
+    """
+    B, T, H = h.shape
+    preds, tgt = h[:, :-1], tokens[:, 1:]  # predict token t+1 from h_t
+    n = T - 1
+    pad = (-n) % chunk
+    if pad:
+        preds = jnp.pad(preds, ((0, 0), (0, pad), (0, 0)))
+        tgt = jnp.pad(tgt, ((0, 0), (0, pad)))
+    mask = (jnp.arange(n + pad) < n).astype(jnp.float32)[None]  # [1, n+pad]
+    k = (n + pad) // chunk
+    # [k, B, chunk, ...] scan layout
+    preds = preds.reshape(B, k, chunk, H).swapaxes(0, 1)
+    tgt = tgt.reshape(B, k, chunk).swapaxes(0, 1)
+    mask = jnp.broadcast_to(mask, (B, n + pad)).reshape(B, k, chunk).swapaxes(0, 1)
+
+    kernel = head_kernel.astype(h.dtype)  # match the Dense's bf16 matmul
+
+    def body(acc, xs):
+        h_c, t_c, m_c = xs
+        logits = (h_c @ kernel).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, t_c[..., None], axis=-1)[..., 0]
+        return acc + jnp.sum(nll * m_c), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (preds, tgt, mask))
+    return total / (B * n)
