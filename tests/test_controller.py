@@ -8,6 +8,7 @@ SetPodsStatuses.
 """
 
 import json
+import time
 
 import pytest
 
@@ -741,3 +742,95 @@ class TestRemovedReplicaType:
             assert p.metadata.labels[LABEL_SPEC_HASH] == fresh
         svc_names = {s.name for s in cluster.list_services("default")}
         assert "test-job-ps-0" not in svc_names
+
+
+class TestSuspendResume:
+    """Suspend/resume (beyond the reference; batch/v1 Job.spec.suspend
+    shape): suspend deletes every pod/service and releases the gang claim
+    while the job stays alive with a Suspended condition; resume recreates
+    everything and the job can still succeed."""
+
+    def test_suspend_tears_down_and_resume_recreates(self, env):
+        from tf_operator_tpu.api.types import is_suspended
+
+        cluster, controller = env
+        job = make_job(worker=2)
+        submit_and_sync(cluster, controller, job)
+        for p in cluster.list_pods("default"):
+            cluster.set_pod_phase("default", p.name, PodPhase.RUNNING)
+        assert controller.run_until_idle()
+
+        cur = cluster.get_job(job.namespace, job.name)
+        cur.spec.run_policy.suspend = True
+        cluster.update_job(cur)
+        for _ in range(6):
+            controller.run_until_idle()
+            if not cluster.list_pods("default"):
+                break
+        assert cluster.list_pods("default") == []
+        assert cluster.list_services("default") == []
+        st = cluster.get_job("default", "test-job").status
+        assert is_suspended(st), st.conditions
+        assert not is_failed(st) and not is_succeeded(st)
+        events = [e.reason for e in cluster.all_events()]
+        assert "Suspended" in events
+
+        # Resume: pods come back; completing them succeeds the job, and the
+        # Suspended condition yields to Running/Succeeded.
+        cur = cluster.get_job(job.namespace, job.name)
+        cur.spec.run_policy.suspend = False
+        cluster.update_job(cur)
+        for _ in range(6):
+            controller.run_until_idle()
+            if len(cluster.list_pods("default")) == 2:
+                break
+        assert len(cluster.list_pods("default")) == 2
+        for p in cluster.list_pods("default"):
+            cluster.set_pod_phase("default", p.name, PodPhase.RUNNING)
+        assert controller.run_until_idle()
+        st = cluster.get_job("default", "test-job").status
+        assert not is_suspended(st), st.conditions
+        for p in cluster.list_pods("default"):
+            cluster.set_pod_phase("default", p.name, PodPhase.SUCCEEDED,
+                                  exit_code=0)
+        assert controller.run_until_idle()
+        assert is_succeeded(cluster.get_job("default", "test-job").status)
+
+    def test_suspend_releases_slice_for_other_jobs(self, env):
+        """The TPU story: a suspended job's whole-slice claim is freed and
+        another gang job can take it."""
+        from tf_operator_tpu.api.types import TPUSpec
+
+        cluster = InMemoryCluster()
+        allocator = SliceAllocator.of("v5e-8")
+        controller = TrainJobController(
+            cluster, enable_gang=True, slice_allocator=allocator
+        )
+        j1 = make_job(name="holder", worker=2, gang=True)
+        j1.spec.tpu = TPUSpec(topology="v5e-8")
+        defaults.set_defaults(j1)
+        cluster.create_job(j1)
+        assert controller.run_until_idle()
+        assert len(cluster.list_pods("default")) == 2  # holds the slice
+
+        j2 = make_job(name="waiter", worker=2, gang=True)
+        j2.spec.tpu = TPUSpec(topology="v5e-8")
+        defaults.set_defaults(j2)
+        cluster.create_job(j2)
+        assert controller.run_until_idle()
+        waiter_pods = [p for p in cluster.list_pods("default")
+                       if p.metadata.labels["job-name"] == "waiter"]
+        assert waiter_pods == []  # gated: slice busy
+
+        cur = cluster.get_job("default", "holder")
+        cur.spec.run_policy.suspend = True
+        cluster.update_job(cur)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            controller.run_until_idle()
+            waiter_pods = [p for p in cluster.list_pods("default")
+                           if p.metadata.labels["job-name"] == "waiter"]
+            if len(waiter_pods) == 2:
+                break
+            time.sleep(0.2)
+        assert len(waiter_pods) == 2, "suspend never freed the slice"

@@ -134,6 +134,7 @@ def job_from_dict(manifest: dict[str, Any], apply_defaults: bool = True) -> Trai
         ttl_seconds_after_finished=policy_field("ttlSecondsAfterFinished"),
         active_deadline_seconds=policy_field("activeDeadlineSeconds"),
         backoff_limit=policy_field("backoffLimit"),
+        suspend=bool(policy_field("suspend") or False),
         scheduling=SchedulingPolicy(
             gang=bool(sched_d.get("gang", True)),
             queue=sched_d.get("queue", ""),
@@ -237,6 +238,7 @@ def job_to_dict(job: TrainJob) -> dict[str, Any]:
                 "ttlSecondsAfterFinished": rp.ttl_seconds_after_finished,
                 "activeDeadlineSeconds": rp.active_deadline_seconds,
                 "backoffLimit": rp.backoff_limit,
+                "suspend": rp.suspend,
                 "schedulingPolicy": {
                     "gang": rp.scheduling.gang,
                     "queue": rp.scheduling.queue,

@@ -68,6 +68,10 @@ class JobConditionType(str, enum.Enum):
     RESTARTING = "Restarting"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
+    # Beyond the reference's five (common/v1/types.go:106-132): a suspended
+    # job keeps its object + status but holds no pods (and no TPU slice) —
+    # batch/v1 Job.spec.suspend semantics, resumable via checkpoints.
+    SUSPENDED = "Suspended"
 
     def __str__(self) -> str:
         return self.value
@@ -203,6 +207,10 @@ class RunPolicy:
     ttl_seconds_after_finished: int | None = None
     active_deadline_seconds: int | None = None
     backoff_limit: int | None = None
+    # True = tear down every pod (freeing the whole TPU slice) but keep the
+    # job; flip back to False to resume — trainers continue from their
+    # checkpoints. The active-deadline clock keeps running while suspended.
+    suspend: bool = False
     scheduling: SchedulingPolicy = field(default_factory=SchedulingPolicy)
 
 
@@ -330,3 +338,7 @@ def is_failed(status: JobStatus) -> bool:
 
 def is_terminal(status: JobStatus) -> bool:
     return is_succeeded(status) or is_failed(status)
+
+
+def is_suspended(status: JobStatus) -> bool:
+    return has_condition(status, JobConditionType.SUSPENDED)

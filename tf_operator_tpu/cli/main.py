@@ -291,6 +291,28 @@ def cmd_scale(args) -> int:
     return 0
 
 
+def cmd_suspend(args) -> int:
+    """Suspend/resume (batch/v1 Job.spec.suspend shape, beyond the
+    reference): suspend frees every pod and the whole TPU slice while the
+    job object and its checkpoints persist; resume recreates the pods and
+    the trainers continue the trajectory."""
+    verb = "suspend" if args.cmd == "suspend" else "resume"
+    req = urllib.request.Request(
+        f"http://{args.server}/api/trainjobs/{args.namespace}/{args.name}/{verb}",
+        data=b"{}", headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        print(f"{verb}: {e.code} {e.read().decode(errors='replace')}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps({verb: f"{args.namespace}/{args.name}"}))
+    return 0
+
+
 def cmd_version(args) -> int:
     from tf_operator_tpu.version import version_string
 
@@ -373,6 +395,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-n", "--namespace", default="default")
     p.add_argument("--server", default="127.0.0.1:8443")
     p.set_defaults(fn=cmd_scale)
+
+    for verb in ("suspend", "resume"):
+        p = sub.add_parser(verb)
+        p.add_argument("name")
+        p.add_argument("-n", "--namespace", default="default")
+        p.add_argument("--server", default="127.0.0.1:8443")
+        p.set_defaults(fn=cmd_suspend)
 
     p = sub.add_parser("version")
     p.set_defaults(fn=cmd_version)

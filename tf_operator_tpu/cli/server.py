@@ -7,6 +7,9 @@ Dashboard-backend parity (dashboard/backend/handler/api_handler.go:42-267):
   POST   /api/trainjobs                      submit a manifest (JSON body)
   POST   /api/trainjobs/{ns}/{name}/scale    elastic scaling: body
                                              {"replicas": {"Worker": 4}}
+  POST   /api/trainjobs/{ns}/{name}/suspend  free every pod + the TPU slice,
+                                             keep the job (checkpoints kept)
+  POST   /api/trainjobs/{ns}/{name}/resume   recreate pods; trainers resume
   DELETE /api/trainjobs/{ns}/{name}          delete a job
   GET    /api/namespaces                     namespaces in use
   GET    /api/pods/{ns}                      pods in a namespace
@@ -233,6 +236,21 @@ class ApiServer:
                 # POST /api/trainjobs/{ns}/{name}/scale {"replicas": {"Worker": 4}}
                 # -> elastic scaling: the reconciler rolls/creates/deletes pods
                 # to the new counts (core/trainjob_controller.py).
+                # POST /api/trainjobs/{ns}/{name}/suspend | /resume: tear
+                # down / recreate every pod, keeping the job (+ checkpoints).
+                if (parts[:2] == ["api", "trainjobs"] and len(parts) == 5
+                        and parts[4] in ("suspend", "resume")):
+                    try:
+                        job = outer.cluster.try_get_job(parts[2], parts[3])
+                        if job is None:
+                            self._send({"error": "not found"}, 404)
+                            return
+                        job.spec.run_policy.suspend = parts[4] == "suspend"
+                        updated = outer.cluster.update_job(job)
+                        self._send(_job_payload(outer.cluster, updated))
+                    except Exception as e:
+                        self._send({"error": f"{type(e).__name__}: {e}"}, 400)
+                    return
                 if (parts[:2] == ["api", "trainjobs"] and len(parts) == 5
                         and parts[4] == "scale"):
                     try:

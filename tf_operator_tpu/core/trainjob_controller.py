@@ -111,7 +111,8 @@ class TrainJobController(ctrl.JobControllerBase):
                     naming.gen_expectation_services_key(key, str(rtype))
                 )
             if self.slice_allocator is not None:
-                self.slice_allocator.release(key)
+                if self.slice_allocator.release(key):
+                    self._kick_slice_waiters()
             return
 
         job = shared.deep_copy()
@@ -171,6 +172,44 @@ class TrainJobController(ctrl.JobControllerBase):
         pods = self.get_pods_for_job(job)
         services = self.get_services_for_job(job)
 
+        # Suspend (beyond the reference; batch/v1 Job.spec.suspend shape):
+        # tear down every pod AND the gang/slice claim but keep the job;
+        # flipping suspend back resumes via the normal reconcile (trainers
+        # continue from checkpoints). Terminal states win over suspend.
+        if job.spec.run_policy.suspend and not is_terminal(job.status):
+            if pods:
+                self.cluster.record_event(
+                    TrainJob.KIND, job.namespace, job.name, "Normal",
+                    "Suspended", f"Suspending: deleting {len(pods)} pod(s)",
+                )
+            for pod in pods:
+                rt = pod.metadata.labels.get(ctrl.LABEL_REPLICA_TYPE, "")
+                exp_key = naming.gen_expectation_pods_key(key, rt)
+                self.expectations.raise_expectations(exp_key, 0, 1)
+                if not self.pod_control.delete_pod(pod.namespace, pod.name, job):
+                    self.expectations.deletion_observed(exp_key)
+            for svc in services:
+                rt = svc.metadata.labels.get(ctrl.LABEL_REPLICA_TYPE, "")
+                exp_key = naming.gen_expectation_services_key(key, rt)
+                self.expectations.raise_expectations(exp_key, 0, 1)
+                if not self.service_control.delete_service(
+                    svc.namespace, svc.name, job
+                ):
+                    self.expectations.deletion_observed(exp_key)
+            if self.enable_gang:
+                gang.delete_podgroup(self.cluster, job)
+            if self.slice_allocator is not None:
+                if self.slice_allocator.release(key):
+                    self._kick_slice_waiters()
+            status_engine.set_condition(
+                job.status, JobConditionType.SUSPENDED,
+                status_engine.REASON_SUSPENDED,
+                f"TrainJob {key} is suspended.", self._now(),
+            )
+            if job.status != old_status:
+                self.cluster.update_job_status(job)
+            return
+
         exceeded, exceed_reason, exceed_msg = self._past_limits(job, pods)
 
         if is_terminal(job.status) or exceeded:
@@ -190,7 +229,8 @@ class TrainJobController(ctrl.JobControllerBase):
             if self.enable_gang:
                 gang.delete_podgroup(self.cluster, job)
             if self.slice_allocator is not None:
-                self.slice_allocator.release(job.key())
+                if self.slice_allocator.release(job.key()):
+                    self._kick_slice_waiters()
             # Status must be durable before TTL GC may delete the job.
             if job.status != old_status:
                 self.cluster.update_job_status(job)
@@ -272,6 +312,19 @@ class TrainJobController(ctrl.JobControllerBase):
         return True
 
     # ---------------------------------------------------------- limit checks
+
+    def _kick_slice_waiters(self) -> None:
+        """A slice was just freed (job finished/suspended/deleted): enqueue
+        every non-terminal slice-requesting job immediately instead of
+        leaving it to the SLICE_RETRY_DELAY_S backoff."""
+        try:
+            jobs = self.cluster.list_jobs()
+        except Exception:
+            return
+        for j in jobs:
+            if (j.spec.tpu is not None and j.spec.tpu.topology
+                    and not is_terminal(j.status)):
+                self.enqueue(naming.job_key(j.namespace, j.name))
 
     def _past_limits(self, job: TrainJob, pods: list[Pod]) -> tuple[bool, str, str]:
         if self._past_active_deadline(job):

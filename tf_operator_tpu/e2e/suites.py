@@ -450,6 +450,42 @@ def elastic_scale_up_down(client: TrainJobClient) -> None:
         _cleanup(client, name)
 
 
+def suspend_resume_roundtrip(client: TrainJobClient) -> None:
+    """Suspend a RUNNING job (all pods torn down, job alive, Suspended
+    condition), then resume it (pods recreated, Running again)."""
+    name = "e2e-suspend"
+    _cleanup(client, name)
+    client.create(manifest(name, {"Worker": (2, WORKLOAD)}))
+    try:
+        client.wait_for_condition(NS, name, ("Running",))
+
+        client.suspend(NS, name)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            pods = [p for p in client.list_pods(NS)
+                    if p["name"].startswith(f"{name}-")]
+            job = client.get(NS, name)
+            suspended = any(c["type"] == "Suspended" and c["status"]
+                            for c in job["status"]["conditions"])
+            if not pods and suspended:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"suspend never drained: pods={pods}")
+        assert not _succeeded(job) and not any(
+            c["type"] == "Failed" and c["status"]
+            for c in job["status"]["conditions"]
+        ), job["status"]
+
+        client.resume(NS, name)
+        client.wait_for_condition(NS, name, ("Running",))
+        pods = [p for p in client.list_pods(NS)
+                if p["name"].startswith(f"{name}-")]
+        assert len(pods) == 2, pods
+    finally:
+        _cleanup(client, name)
+
+
 SUITES = {
     "simple": lambda: [
         TestCase("simple_success", simple_success, trials=2),
@@ -481,8 +517,10 @@ SUITES = {
     "pod_names": lambda: [
         TestCase("pod_names_contract", pod_names_contract),
     ],
-    # Ninth suite, beyond the reference's eight: elastic scaling.
+    # Ninth suite, beyond the reference's eight: elastic scaling +
+    # suspend/resume.
     "elastic": lambda: [
         TestCase("elastic_scale_up_down", elastic_scale_up_down),
+        TestCase("suspend_resume_roundtrip", suspend_resume_roundtrip),
     ],
 }

@@ -81,6 +81,16 @@ class TrainJobClient:
             {"replicas": replicas},
         )
 
+    def suspend(self, namespace: str, name: str) -> dict:
+        return self._request(
+            "POST", f"/api/trainjobs/{namespace}/{name}/suspend", {}
+        )
+
+    def resume(self, namespace: str, name: str) -> dict:
+        return self._request(
+            "POST", f"/api/trainjobs/{namespace}/{name}/resume", {}
+        )
+
     def list_pods(self, namespace: str) -> list[dict]:
         return self._request("GET", f"/api/pods/{namespace}")["items"]
 

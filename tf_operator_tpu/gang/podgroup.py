@@ -109,11 +109,17 @@ class SliceAllocator:
                     return s.slice_id
         return None
 
-    def release(self, holder: str) -> None:
+    def release(self, holder: str) -> bool:
+        """Free the holder's slices; True if anything was actually held (so
+        the controller can kick jobs waiting on slice admission instead of
+        leaving them to the retry backoff)."""
+        freed = False
         with self._lock:
             for s in self.slices:
                 if s.held_by == holder:
                     s.held_by = None
+                    freed = True
+        return freed
 
     def free_slices(self) -> int:
         with self._lock:

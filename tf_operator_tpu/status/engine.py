@@ -39,6 +39,7 @@ REASON_FAILED = "TrainJobFailed"
 REASON_INVALID_SPEC = "TrainJobFailedValidation"
 REASON_BACKOFF_EXCEEDED = "BackoffLimitExceeded"
 REASON_DEADLINE_EXCEEDED = "DeadlineExceeded"
+REASON_SUSPENDED = "TrainJobSuspended"
 
 
 def _find(status: JobStatus, ctype: JobConditionType) -> JobCondition | None:
@@ -65,10 +66,11 @@ def set_condition(status: JobStatus, ctype: JobConditionType, reason: str, messa
     for c in status.conditions:
         if c.type == ctype:
             continue
-        # Running and Restarting are mutually exclusive views of "active".
-        if ctype == JobConditionType.RESTARTING and c.type == JobConditionType.RUNNING:
-            continue
-        if ctype == JobConditionType.RUNNING and c.type == JobConditionType.RESTARTING:
+        # Running, Restarting, and Suspended are mutually exclusive views of
+        # the job's activity state.
+        _ACTIVE = (JobConditionType.RUNNING, JobConditionType.RESTARTING,
+                   JobConditionType.SUSPENDED)
+        if ctype in _ACTIVE and c.type in _ACTIVE:
             continue
         # A terminal condition demotes Running to status=False.
         if (
