@@ -27,7 +27,11 @@ if (not os.environ.get("TPUJOB_TEST_TPU")
     # PALLAS_AXON_POOL_IPS is set; dropping it here makes pods we spawn in
     # tests honor JAX_PLATFORMS=cpu. Without this, every test pod grabs the
     # single-process TPU tunnel and multi-pod jobs deadlock on the chip.
-    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    # Stashed (not discarded) so tests that deliberately probe the real
+    # chip in a one-off subprocess (test_roofline) can restore it.
+    _pool_ips = os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    if _pool_ips is not None:
+        os.environ["TPUJOB_STASHED_AXON_POOL_IPS"] = _pool_ips
     try:
         import jax
 

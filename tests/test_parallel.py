@@ -230,6 +230,65 @@ class TestModels:
         assert logits.shape == (2, 10) and logits.dtype == jnp.float32
         assert "batch_stats" in mut
 
+    def test_tpu_batchnorm_matches_flax(self):
+        """TpuBatchNorm is a numerical drop-in for nn.BatchNorm (f32)."""
+        import flax.linen as nn
+        from tf_operator_tpu.models.resnet import TpuBatchNorm
+
+        x = jax.random.normal(jax.random.key(0), (4, 8, 8, 16), jnp.float32)
+        ours = TpuBatchNorm(use_running_average=False, momentum=0.9)
+        ref = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                           param_dtype=jnp.float32)
+        vo = ours.init(jax.random.key(1), x)
+        vr = ref.init(jax.random.key(1), x)
+        # same parameter/variable tree → checkpoint-compatible
+        assert jax.tree.structure(vo) == jax.tree.structure(vr)
+        yo, mo = ours.apply(vo, x, mutable=["batch_stats"])
+        yr, mr = ref.apply(vr, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(yo), np.asarray(yr),
+                                   rtol=2e-4, atol=2e-4)
+        for k in ("mean", "var"):
+            np.testing.assert_allclose(
+                np.asarray(mo["batch_stats"][k]),
+                np.asarray(mr["batch_stats"][k]), rtol=2e-4, atol=2e-4)
+        # eval mode (running averages) also agrees
+        eo = TpuBatchNorm(use_running_average=True).apply(
+            {"params": vo["params"], "batch_stats": mo["batch_stats"]}, x)
+        er = nn.BatchNorm(use_running_average=True,
+                          param_dtype=jnp.float32).apply(
+            {"params": vr["params"], "batch_stats": mr["batch_stats"]}, x)
+        np.testing.assert_allclose(np.asarray(eo), np.asarray(er),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_tpu_batchnorm_bf16_offset_channel(self):
+        """bf16 path: variance survives |mean| >> std (no bf16-square
+        cancellation), matching flax's f32-promoted stats."""
+        import flax.linen as nn
+        from tf_operator_tpu.models.resnet import TpuBatchNorm
+
+        key = jax.random.key(0)
+        # channel with mean ~10, std ~0.1 — the cancellation-prone regime
+        x = (10.0 + 0.1 * jax.random.normal(key, (8, 16, 16, 4))).astype(
+            jnp.bfloat16)
+        ours = TpuBatchNorm(use_running_average=False, momentum=0.9)
+        ref = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                           dtype=jnp.bfloat16, param_dtype=jnp.float32)
+        vo = ours.init(jax.random.key(1), x)
+        vr = ref.init(jax.random.key(1), x)
+        yo, mo = ours.apply(vo, x, mutable=["batch_stats"])
+        yr, mr = ref.apply(vr, x, mutable=["batch_stats"])
+        # running var moved 10% toward the batch var: recover and compare
+        # the batch var itself — the quantity the cancellation bug corrupts
+        vo_ = (np.asarray(mo["batch_stats"]["var"]) - 0.9) / 0.1
+        vr_ = (np.asarray(mr["batch_stats"]["var"]) - 0.9) / 0.1
+        np.testing.assert_allclose(vo_, vr_, rtol=0.15)
+        # and it must be the true ~0.01, not cancellation garbage
+        np.testing.assert_allclose(vo_, 0.01, rtol=0.5)
+        assert np.all(np.abs(np.asarray(yo, np.float32)) < 8.0)
+        np.testing.assert_allclose(np.asarray(yo, np.float32),
+                                   np.asarray(yr, np.float32),
+                                   rtol=0.15, atol=0.3)
+
     def test_resnet50_param_count(self):
         from tf_operator_tpu.models.resnet import ResNet50
 

@@ -20,6 +20,67 @@ import jax
 import jax.numpy as jnp
 
 
+class TpuBatchNorm(nn.Module):
+    """bf16-resident batch norm (drop-in for nn.BatchNorm on NHWC convs).
+
+    flax's nn.BatchNorm promotes the whole activation tensor to f32 inside
+    its normalize step (y = x - mean with an f32 mean), dragging full-size
+    f32 elementwise chains through HBM on every layer. Here the f32
+    *per-channel* statistics are folded into per-channel scale/bias applied
+    in the activation dtype (y = x * a + b), so no tensor-sized f32 op ever
+    exists: the stats reductions ride the producing conv as a fused
+    convert+reduce epilogue, and the fold is two C-sized vectors.
+
+    Parameter/variable names match nn.BatchNorm ("scale"/"bias" params,
+    "mean"/"var" batch_stats), so checkpoints are interchangeable.
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    axis_name: str | None = None
+    scale_init: nn.initializers.Initializer = nn.initializers.ones
+    dtype: jnp.dtype = jnp.bfloat16        # accepted for API parity; the
+    param_dtype: jnp.dtype = jnp.float32   # fold always runs in x.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        scale = self.param("scale", self.scale_init, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((c,), jnp.float32))
+        if self.use_running_average:
+            m, v = ra_mean.value, ra_var.value
+        else:
+            red = tuple(range(x.ndim - 1))
+            # Convert before squaring: E[x^2]-E[x]^2 cancels catastrophically
+            # for |mean| >> std if the squares carry bf16 rounding. The
+            # convert+square+reduce chain still fuses into the producing
+            # conv's epilogue — no f32 tensor is materialized.
+            xf = x.astype(jnp.float32)
+            m = jnp.mean(xf, axis=red)
+            m2 = jnp.mean(jax.lax.square(xf), axis=red)
+            if self.axis_name is not None:
+                m, m2 = jax.lax.pmean(jnp.stack([m, m2]), self.axis_name)
+            v = jnp.maximum(m2 - jnp.square(m), 0.0)
+            if not self.is_initializing():
+                mom = self.momentum
+                ra_mean.value = mom * ra_mean.value + (1.0 - mom) * m
+                ra_var.value = mom * ra_var.value + (1.0 - mom) * v
+        inv = scale * jax.lax.rsqrt(v + self.epsilon)
+        # Subtract-then-scale, not a y = x*a + b fold: with |mean| >> std the
+        # fold cancels catastrophically in bf16 (x*a and b are both huge, the
+        # result small). x - mean is exact in bf16 for nearby magnitudes; the
+        # tiny residual from rounding mean to bf16 is folded into the bias.
+        mh = m.astype(x.dtype)
+        a = inv.astype(x.dtype)
+        b = (bias + (mh.astype(jnp.float32) - m) * inv).astype(x.dtype)
+        return (x - mh) * a + b
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: int
@@ -62,7 +123,7 @@ class ResNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = True):
         norm = partial(
-            nn.BatchNorm,
+            TpuBatchNorm,
             use_running_average=not train,
             momentum=self.bn_momentum,
             dtype=self.dtype,

@@ -1,0 +1,82 @@
+"""Flash-attention block-size sweep on the real chip (VERDICT r2 item 3).
+
+Times fwd+bwd of the pallas kernel at the long-context bench shapes and
+prints one JSON line per (seq, block_q, block_k) so the dispatch default
+in ops/attention.py can be a measured choice, not a guess.
+
+Usage: python tools/exp_flash_sweep.py [--seqs 8192,16384] [--blocks 256,512,1024,2048]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.ops.flash_attention import flash_attention_pallas
+
+
+def time_config(seq: int, bq: int, bk: int, batch: int, heads: int,
+                d: int, iters: int = 20) -> dict:
+    q = jax.random.normal(jax.random.key(0), (batch, heads, seq, d),
+                          jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(1), q.shape, jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), q.shape, jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            flash_attention_pallas(q, k, v, True, bq, bk).astype(jnp.float32))
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    fwd = jax.jit(lambda q, k, v: jnp.sum(
+        flash_attention_pallas(q, k, v, True, bq, bk).astype(jnp.float32)))
+
+    out = {"seq": seq, "block_q": bq, "block_k": bk}
+    try:
+        # fwd only
+        r = fwd(q, k, v); float(r)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fwd(q, k, v)
+        float(r)
+        out["fwd_ms"] = round((time.perf_counter() - t0) / iters * 1e3, 3)
+        # fwd+bwd
+        g = step(q, k, v); float(g[0][0, 0, 0, 0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            g = step(q, k, v)
+        float(g[0][0, 0, 0, 0])
+        out["fwdbwd_ms"] = round((time.perf_counter() - t0) / iters * 1e3, 3)
+        # causal model FLOPs: fwd 2 matmuls, bwd 5 matmuls, each 2*T^2*D*BH/2
+        f = 2 * seq * seq * d * batch * heads / 2
+        out["fwdbwd_tflops"] = round(7 * f / (out["fwdbwd_ms"] / 1e3) / 1e12, 1)
+    except Exception as e:  # noqa: BLE001 — a failing config is a data point
+        out["error"] = f"{type(e).__name__}: {e}"[:200]
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="8192,16384")
+    ap.add_argument("--blocks", default="256,512,1024,2048")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--head-dim", type=int, default=64)
+    args = ap.parse_args()
+    blocks = [int(b) for b in args.blocks.split(",")]
+    for seq in (int(s) for s in args.seqs.split(",")):
+        batch = max(1, args.batch * 8192 // seq)  # constant token count
+        for bq, bk in itertools.product(blocks, blocks):
+            if bq > seq or bk > seq:
+                continue
+            r = time_config(seq, bq, bk, batch, args.heads, args.head_dim)
+            r["batch"] = batch
+            print(json.dumps(r), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""ResNet-50 BN A/B experiment (round-3 perf work, run on the real chip).
+
+Hypothesis (VERDICT r2 item 1): flax nn.BatchNorm promotes the whole activation
+tensor to f32 inside _normalize (y = x - mean with f32 mean), so every BN layer
+drags full-size f32 elementwise chains + f32 backward residuals through HBM.
+A BN that computes f32 *per-channel* stats but applies them as folded bf16
+scale/bias keeps all tensor-sized traffic in bf16.
+
+Usage: python tools/exp_resnet_bn.py [--variants v0,v2] [--steps 30] [--batch 256]
+Prints one JSON line per variant: {"variant":..., "images_per_sec":..., "mfu":...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from tf_operator_tpu.models import mnist as M
+from tf_operator_tpu.models.resnet import ResNet  # noqa: F401 (variants)
+
+
+def make_model(variant: str):
+    if variant == "v0_flax":
+        class R(ResNet):
+            @nn.compact
+            def __call__(self, x, train=True):  # noqa: D102
+                norm = partial(
+                    nn.BatchNorm, use_running_average=not train,
+                    momentum=self.bn_momentum, dtype=self.dtype,
+                    param_dtype=jnp.float32, axis_name=self.bn_axis_name,
+                )
+                return self._body(x, norm)
+        return _with_body(R)(stage_sizes=[3, 4, 6, 3])
+    if variant == "v1_flax_bf16red":
+        class R(ResNet):
+            @nn.compact
+            def __call__(self, x, train=True):  # noqa: D102
+                norm = partial(
+                    nn.BatchNorm, use_running_average=not train,
+                    momentum=self.bn_momentum, dtype=self.dtype,
+                    param_dtype=jnp.float32, axis_name=self.bn_axis_name,
+                    force_float32_reductions=False,
+                )
+                return self._body(x, norm)
+        return _with_body(R)(stage_sizes=[3, 4, 6, 3])
+    if variant == "v2_custom":
+        # the library default after the round-3 swap: TpuBatchNorm
+        return ResNet(stage_sizes=[3, 4, 6, 3])
+    raise ValueError(variant)
+
+
+def _with_body(cls):
+    """Graft a norm-parameterized body onto a ResNet subclass."""
+    from tf_operator_tpu.models.resnet import BottleneckBlock
+
+    def _body(self, x, norm):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2),
+                    padding=[(3, 3), (3, 3)], use_bias=False,
+                    dtype=self.dtype, name="stem")(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                x = BottleneckBlock(
+                    filters=self.width * 2 ** i,
+                    strides=2 if i > 0 and j == 0 else 1,
+                    dtype=self.dtype, norm=norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+    cls._body = _body
+    return cls
+
+
+RESNET50_FWD_GFLOPS_PER_IMG = 4.09  # 224x224, standard count (matches bench.py)
+
+
+def run_variant(name: str, batch: int, steps: int, image_size: int,
+                profile_dir: str | None = None) -> dict:
+    model = make_model(name)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(
+        rng, jnp.zeros((2, image_size, image_size, 3), jnp.float32),
+        train=False)
+    params, batch_stats = variables["params"], variables.get("batch_stats", {})
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt = tx.init(params)
+
+    kx, ky = jax.random.split(rng)
+    bx = jax.random.normal(kx, (batch, image_size, image_size, 3))
+    by = jax.random.randint(ky, (batch,), 0, 1000)
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(params, batch_stats, opt, bx, by):
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": batch_stats}, bx, train=True,
+                mutable=["batch_stats"])
+            return M.cross_entropy_loss(logits, by), mut["batch_stats"]
+
+        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, new_opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), new_bs, new_opt, loss
+
+    # warmup / compile. NOTE: on the axon tunnel backend block_until_ready
+    # does not actually fence the device queue — a host transfer does.
+    for _ in range(3):
+        params, batch_stats, opt, loss = step(params, batch_stats, opt, bx, by)
+    float(loss)
+
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, batch_stats, opt, loss = step(params, batch_stats, opt, bx, by)
+    lv = float(loss)
+    dt = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
+
+    ips = batch * steps / dt
+    flops = 3.0 * RESNET50_FWD_GFLOPS_PER_IMG * 1e9 * ips  # fwd+bwd ~3x
+    peak = 197e12  # v5e bf16 peak
+    return {"variant": name, "images_per_sec": round(ips, 1),
+            "step_ms": round(1e3 * dt / steps, 2),
+            "mfu": round(flops / peak, 4), "loss": lv}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variants", default="v0_flax,v2_custom")
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--profile-dir", default=None)
+    args = ap.parse_args()
+    for v in args.variants.split(","):
+        pdir = f"{args.profile_dir}/{v}" if args.profile_dir else None
+        out = run_variant(v, args.batch, args.steps, args.image_size, pdir)
+        print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
