@@ -11,7 +11,6 @@ and tools/release.py publish refuses to push without a green CI summary.
 from __future__ import annotations
 
 import json
-import os
 import subprocess
 import sys
 from pathlib import Path
@@ -268,3 +267,98 @@ class TestPublishGatePartialRuns:
         r = self._publish(["--ci-summary", str(s)])
         assert r.returncode == 1
         assert "not" in r.stderr and "pipeline" in r.stderr
+
+
+class TestLint:
+    """tools/lint.py — the in-repo static analyzer behind the py-lint stage
+    (reference gated CI on pylint, py_checks.py:1-60; this image ships no
+    linter, so the checks are implemented on stdlib ast)."""
+
+    def _lint(self, tmp_path, src: str) -> list[str]:
+        import lint  # tools/lint.py (tools/ on sys.path above)
+
+        f = tmp_path / "m.py"
+        f.write_text(src)
+        return lint.lint_file(f)
+
+    def test_undefined_name(self, tmp_path):
+        out = self._lint(tmp_path, "def f():\n    return missing_thing\n")
+        assert any("F821" in line and "missing_thing" in line for line in out)
+
+    def test_scopes_resolve(self, tmp_path):
+        # closures, comprehensions, class attrs, walrus — no false positives
+        out = self._lint(tmp_path, (
+            "import os\n"
+            "def outer(a):\n"
+            "    b = [a + i for i in range(3)]\n"
+            "    def inner():\n"
+            "        return a, b, os.sep\n"
+            "    if (c := inner()):\n"
+            "        return c\n"
+            "class K:\n"
+            "    x = 1\n"
+            "    def m(self):\n"
+            "        return self.x\n"
+        ))
+        assert out == []
+
+    def test_unused_import_and_noqa(self, tmp_path):
+        out = self._lint(tmp_path, "import os\nimport sys  # noqa: F401\n")
+        assert any("F401" in line and "'os'" in line for line in out)
+        assert not any("sys" in line for line in out)
+
+    def test_future_import_exempt(self, tmp_path):
+        assert self._lint(
+            tmp_path, "from __future__ import annotations\nx = 1\n") == []
+
+    def test_mutable_default_and_bare_except(self, tmp_path):
+        out = self._lint(tmp_path, (
+            "def f(x=[]):\n"
+            "    try:\n"
+            "        return x\n"
+            "    except:\n"
+            "        pass\n"
+        ))
+        assert any("B006" in line for line in out)
+        assert any("E722" in line for line in out)
+
+    def test_fstring_without_placeholder(self, tmp_path):
+        out = self._lint(tmp_path, "y = 2\nx = f'no fields'\n")
+        assert any("F541" in line for line in out)
+
+    def test_global_declared_name_not_flagged(self, tmp_path):
+        out = self._lint(tmp_path, (
+            "def set_it():\n"
+            "    global counter\n"
+            "    counter = 1\n"
+            "def get_it():\n"
+            "    return counter\n"
+        ))
+        assert not any("F821" in line for line in out), out
+
+    def test_redefinition_flagged_decorators_exempt(self, tmp_path):
+        out = self._lint(tmp_path, (
+            "def handler():\n    return 1\n"
+            "def handler():\n    return 2\n"
+        ))
+        assert any("F811" in line and "handler" in line for line in out)
+        out = self._lint(tmp_path, (
+            "class C:\n"
+            "    @property\n"
+            "    def x(self):\n        return 1\n"
+            "    @x.setter\n"
+            "    def x(self, v):\n        pass\n"
+        ))
+        assert not any("F811" in line for line in out), out
+
+    def test_fstring_with_format_spec_not_flagged(self, tmp_path):
+        # the format spec is itself a placeholder-less JoinedStr in the ast;
+        # it must not re-trigger F541 on a real f-string (round-3 regression:
+        # this false positive stripped live f-strings across the repo)
+        out = self._lint(tmp_path, "v = 3.1\nx = f'{v:.4f} and {v:x}'\n")
+        assert not any("F541" in line for line in out), out
+
+    def test_repo_is_clean(self):
+        import lint
+
+        assert lint.main([]) == 0

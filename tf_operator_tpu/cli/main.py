@@ -29,9 +29,7 @@ import threading
 import urllib.error
 import urllib.request
 
-from tf_operator_tpu import __version__
-from tf_operator_tpu.api import compat, defaults, validation
-from tf_operator_tpu.api.types import JobConditionType
+from tf_operator_tpu.api import compat, validation
 from tf_operator_tpu.utils.logging import FieldLogger
 
 
@@ -154,11 +152,14 @@ def cmd_operator(args) -> int:
         else:
             runtime = LocalProcessRuntime(cluster, log_dir=args.log_dir)
         # The API binds only on the leader: a hot standby must not collide on
-        # the monitoring port while waiting for the lock.
+        # the monitoring port while waiting for the lock. Default loopback —
+        # the API is unauthenticated, so a routable bind is an explicit
+        # opt-in (--bind), not a side effect of --in-cluster (probes and
+        # kubectl port-forward both enter via the pod's loopback).
         api = ApiServer(cluster, port=args.monitoring_port, log_dir=args.log_dir,
-                        runtime=runtime)
+                        runtime=runtime, bind=args.bind)
         api.start()
-        log.info("REST/metrics API on 127.0.0.1:%d", api.port)
+        log.info("REST/metrics API on %s:%d", args.bind, api.port)
         controller.run(workers=args.threadiness)
         log.info("controllers running (threadiness=%d)", args.threadiness)
         stop.wait()
@@ -339,6 +340,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("operator")
     p.add_argument("--threadiness", type=int, default=2)  # options.go default
     p.add_argument("--monitoring-port", type=int, default=8443)
+    p.add_argument("--bind", default="127.0.0.1",
+                   help="REST/metrics bind address; the API is "
+                        "unauthenticated, so non-loopback is an explicit "
+                        "opt-in (probes/port-forward enter via loopback)")
     p.add_argument("--enable-gang-scheduling", action="store_true")
     p.add_argument("--gang-scheduler-name", default="volcano")
     p.add_argument("--enable-leader-election", action="store_true")

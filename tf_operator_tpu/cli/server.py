@@ -63,7 +63,8 @@ def _job_payload(cluster: InMemoryCluster, job: TrainJob) -> dict:
 
 class ApiServer:
     def __init__(self, cluster: InMemoryCluster, port: int = 8443,
-                 log_dir: str | None = None, runtime=None):
+                 log_dir: str | None = None, runtime=None,
+                 bind: str = "127.0.0.1"):
         self.cluster = cluster
         self.log_dir = log_dir
         self.runtime = runtime  # LocalProcessRuntime, for the endpoints view
@@ -315,7 +316,7 @@ class ApiServer:
                 else:
                     self._send({"error": "not found"}, 404)
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._server = ThreadingHTTPServer((bind, port), Handler)
         self.port = self._server.server_address[1]
         self._thread: threading.Thread | None = None
 

@@ -162,6 +162,12 @@ class NotFoundError(ApiError):
     pass
 
 
+class GoneError(ApiError):
+    """HTTP 410 / watch-ERROR code 410: requested resourceVersion was
+    compacted out of server history — the only correct recovery is a fresh
+    LIST (client-go reflector's relist-on-Gone)."""
+
+
 class AlreadyExistsError(ApiError):
     pass
 

@@ -57,6 +57,7 @@ from tf_operator_tpu.core.cluster import (
     ConflictError,
     ContainerStatus,
     Event,
+    GoneError,
     NotFoundError,
     Pod,
     PodGroup,
@@ -195,11 +196,22 @@ def job_status_from_dict(d: dict) -> JobStatus:
     return status
 
 
+def _omit_nulls(v):
+    """Drop None-valued object fields, recursively — client-go's omitempty.
+    A real apiserver rejects explicit `null` for non-nullable CRD fields
+    (and the conformance-hardened fake does too); unset must mean absent."""
+    if isinstance(v, dict):
+        return {k: _omit_nulls(x) for k, x in v.items() if x is not None}
+    if isinstance(v, list):
+        return [_omit_nulls(x) for x in v]
+    return v
+
+
 def job_to_k8s(job: TrainJob) -> dict:
     out = compat.job_to_dict(job)
     out["metadata"] = _meta_to_dict(job.metadata)
     out["status"] = job_status_to_dict(job.status)
-    return out
+    return _omit_nulls(out)
 
 
 def job_from_k8s(d: dict) -> TrainJob:
@@ -468,6 +480,8 @@ class K8sApi:
             if reason == "AlreadyExists":
                 return AlreadyExistsError(msg)
             return ConflictError(msg)
+        if e.code == 410:
+            return GoneError(msg)
         return ApiError(f"HTTP {e.code}: {msg}")
 
     def request(self, method: str, path: str, body: dict | None = None,
@@ -517,6 +531,7 @@ class _Informer(threading.Thread):
         self.selector = selector
         self._stop = threading.Event()
         self._resp = None  # live watch response, closed by stop()
+        self._watch_rv = 0  # resume point: last event/bookmark rv seen
         self._cache: dict[tuple[str, str], Any] = {}
         self.synced = threading.Event()
         self._log = FieldLogger({"component": f"informer-{kind}"})
@@ -549,31 +564,56 @@ class _Informer(threading.Thread):
     def run(self) -> None:
         log = self._log
         backoff = 0.2
+        # client-go reflector semantics: relist only when forced (first run,
+        # 410 Gone, or decode trouble); plain transport breaks RESUME the
+        # watch from the last event/bookmark rv. Bookmarks keep that resume
+        # point fresh across idle stretches.
+        need_relist = True
+        watch_rv = 0
         while not self._stop.is_set():
             started = time.monotonic()
             try:
-                rv = self._relist()
-                self.synced.set()
+                if need_relist:
+                    watch_rv = self._relist()
+                    self.synced.set()
+                    need_relist = False
+                self._watch_rv = watch_rv
                 for ev in self.cluster.api.stream(
                     self.cluster.list_path(self.kind),
-                    self._params({"watch": "true", "resourceVersion": str(rv)}),
+                    self._params({"watch": "true",
+                                  "resourceVersion": str(watch_rv),
+                                  "allowWatchBookmarks": "true"}),
                     on_response=lambda r: setattr(self, "_resp", r),
                 ):
                     if self._stop.is_set():
                         return
                     self._dispatch(ev)
+                    watch_rv = self._watch_rv
+            except GoneError as e:
+                if self._stop.is_set():
+                    return
+                # 410 Gone: history compacted past our rv — full relist.
+                log.info("watch expired (will relist): %s", e)
+                need_relist = True
+                self._stop.wait(0.05)
             # Broad catch: the daemon informer is the only event source for
             # its kind — any escaped decode/transport error (KeyError from a
-            # malformed object included) must relist, never kill the thread.
+            # malformed object included) must recover, never kill the thread.
             except Exception as e:  # noqa: BLE001
                 if self._stop.is_set():
                     return
+                # A decode/KeyError mid-dispatch may have dropped an event:
+                # resync the world. (A clean resume is only safe when the
+                # stream itself broke, which surfaces as ApiError/OSError.)
+                if not isinstance(e, (ApiError, OSError)):
+                    need_relist = True
                 # Reset backoff only after a healthy stretch: a server whose
                 # LIST succeeds but WATCH immediately fails would otherwise
-                # relist the world in a tight loop forever.
+                # hammer the server in a tight loop forever.
                 if time.monotonic() - started > 10.0:
                     backoff = 0.2
-                log.info("watch error (will relist in %.1fs): %s", backoff, e)
+                log.info("watch error (retry in %.1fs, relist=%s): %s",
+                         backoff, need_relist, e)
                 self._stop.wait(backoff)
                 backoff = min(backoff * 2, 5.0)
             finally:
@@ -628,10 +668,30 @@ class _Informer(threading.Thread):
     def _dispatch(self, ev: dict) -> None:
         etype = ev.get("type")
         if etype == "ERROR":
-            # The payload is a Status object (e.g. 410 Gone), not a resource:
-            # never feed it through the codecs — break out to relist.
-            raise ApiError(f"watch ERROR event: {ev.get('object')!r}")
+            # The payload is a Status object, not a resource: never feed it
+            # through the codecs. 410 forces a relist; anything else breaks
+            # the stream for a resumed watch.
+            status = ev.get("object") or {}
+            if status.get("code") == 410:
+                raise GoneError(f"watch ERROR event: {status!r}")
+            raise ApiError(f"watch ERROR event: {status!r}")
         raw = ev.get("object") or {}
+        if etype == "BOOKMARK":
+            # Bookmark: no object payload beyond metadata.resourceVersion —
+            # just advance the resume point (client-go reflector parity).
+            try:
+                self._watch_rv = int(
+                    (raw.get("metadata") or {}).get("resourceVersion"))
+            except (TypeError, ValueError):
+                pass
+            return
+        # Every delivered event advances the resume point (undecodable
+        # objects included — their event was still consumed from history).
+        try:
+            self._watch_rv = int(
+                (raw.get("metadata") or {}).get("resourceVersion"))
+        except (TypeError, ValueError):
+            pass
         if etype == "DELETED":
             # The tombstone may carry undecodable last state; deletion only
             # needs the key — fall back to the cached copy so the delete

@@ -35,7 +35,6 @@ from tf_operator_tpu.api.types import (
     RestartPolicy,
     TrainJob,
     is_failed,
-    is_succeeded,
     is_terminal,
 )
 from tf_operator_tpu.cluster_spec import tf_config, tpu_env

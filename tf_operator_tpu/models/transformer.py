@@ -15,7 +15,7 @@ no dropout by default (bench determinism) but supported via `dropout_rate`.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable
 
 import flax.linen as nn

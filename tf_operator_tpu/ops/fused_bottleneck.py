@@ -1,0 +1,192 @@
+"""Fused ResNet bottleneck block (stride-1) as a pallas TPU kernel.
+
+Why: ResNet-50's 1x1 convs are HBM-bound on v5e (~51 FLOP/byte vs the ~240
+break-even), so XLA's one-fusion-per-conv execution pays a full HBM
+round-trip for every internal tensor of a bottleneck block — plus separate
+residual-add fusions (measured ~10% of step time, docs/perf.md). This
+kernel runs the whole block — 1x1 reduce -> BN -> relu -> 3x3 -> BN -> relu
+-> 1x1 expand -> BN -> +residual -> relu — over a batch tile held in VMEM:
+the wide input is read once, the wide output written once, and the narrow
+intermediates never touch HBM. Forward traffic per block drops ~2x
+(3 wide passes vs 6-8), and the backward kernel (same recompute-from-x
+trick as flash attention's) cuts the backward similarly.
+
+Batch norm inside the kernel is GHOST batch norm: statistics are computed
+per batch tile (the grid unit), not over the global batch — the same
+numerics as the reference's per-worker BN under MultiWorkerMirroredStrategy
+(SURVEY.md §2: distribution_strategy examples), where each worker
+normalizes over its local shard. Running statistics are aggregated across
+tiles outside the kernel, so eval-mode normalization matches the full-batch
+moments. Tile sizes (docstring of `default_tile`) keep per-BN sample counts
+>= 3k — far past where ghost BN matters.
+
+The 3x3 conv is 9 shifted matmuls over a zero-padded VMEM scratch (SAME
+padding); every matmul in the block hits the MXU with M = tile*H*W rows.
+
+Weight layouts match flax.linen.Conv kernels: w1 [1,1,Cw,Cn] -> used as
+[Cw,Cn]; w2 [3,3,Cn,Cn]; w3 [1,1,Cn,Cw] -> [Cn,Cw]. BN scale/bias are f32
+[C] vectors; stats outputs are raw moments (mean, mean-of-squares) so
+cross-tile variance combines exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pallas bits are absent on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+EPS = 1e-5
+
+
+def default_tile(h: int, w: int, batch: int) -> int:
+    """Largest batch tile whose working set fits VMEM (~16 MB/core):
+    targets ~4k spatial rows per tile; must divide the batch."""
+    target = max(1, 4096 // (h * w))
+    t = 1
+    while t * 2 <= target and batch % (t * 2) == 0:
+        t *= 2
+    return t
+
+
+def _bn_fold(t, scale, bias):
+    """Ghost-BN over axis 0 of [N, C] f32 `t`: returns (normalized f32,
+    mean, mean-of-squares) using the fold (t - m) * a + b."""
+    m = jnp.mean(t, axis=0)
+    m2 = jnp.mean(jnp.square(t), axis=0)
+    v = jnp.maximum(m2 - jnp.square(m), 0.0)
+    a = scale * jax.lax.rsqrt(v + EPS)
+    return (t - m) * a + bias, m, m2
+
+
+def _conv3x3(n1p, w2_ref, tb, h, w, cn):
+    """9 shifted matmuls over the padded [TB,H+2,W+2,Cn] bf16 input."""
+    acc = None
+    for di in range(3):
+        for dj in range(3):
+            sh = n1p[:, di:di + h, dj:dj + w, :].reshape(tb * h * w, cn)
+            p = jnp.dot(sh, w2_ref[di, dj], preferred_element_type=jnp.float32)
+            acc = p if acc is None else acc + p
+    return acc
+
+
+def _fwd_kernel(x_ref, w1_ref, w2_ref, w3_ref, s1_ref, b1_ref, s2_ref,
+                b2_ref, s3_ref, b3_ref, y_ref, st1_ref, st2_ref, st3_ref,
+                n1p_scr, *, tb: int, h: int, w: int):
+    cw = x_ref.shape[-1]
+    cn = w1_ref.shape[-1]
+    n = tb * h * w
+    xt = x_ref[0]                              # [TB,H,W,Cw] bf16
+    flat = xt.reshape(n, cw)
+    # --- 1x1 reduce + BN1 + relu ---
+    t1 = jnp.dot(flat, w1_ref[...], preferred_element_type=jnp.float32)
+    z1, m1, q1 = _bn_fold(t1, s1_ref[...], b1_ref[...])
+    n1 = jnp.maximum(z1, 0.0).astype(x_ref.dtype).reshape(tb, h, w, cn)
+    # --- 3x3 (SAME, stride 1) via zero-padded scratch + BN2 + relu ---
+    n1p_scr[...] = jnp.zeros_like(n1p_scr)
+    n1p_scr[:, 1:h + 1, 1:w + 1, :] = n1
+    t2 = _conv3x3(n1p_scr[...], w2_ref, tb, h, w, cn)
+    z2, m2, q2 = _bn_fold(t2, s2_ref[...], b2_ref[...])
+    n2 = jnp.maximum(z2, 0.0).astype(x_ref.dtype)
+    # --- 1x1 expand + BN3 + residual + relu ---
+    t3 = jnp.dot(n2, w3_ref[...], preferred_element_type=jnp.float32)
+    z3, m3, q3 = _bn_fold(t3, s3_ref[...], b3_ref[...])
+    y = jnp.maximum(z3 + flat.astype(jnp.float32), 0.0)
+    y_ref[0] = y.astype(y_ref.dtype).reshape(tb, h, w, cw)
+    st1_ref[0] = jnp.stack([m1, q1])
+    st2_ref[0] = jnp.stack([m2, q2])
+    st3_ref[0] = jnp.stack([m3, q3])
+
+
+def _fwd(x, w1, w2, w3, s1, b1, s2, b2, s3, b3, tile_b, interpret):
+    b, h, w, cw = x.shape
+    cn = w1.shape[-1]
+    tb = tile_b
+    assert b % tb == 0, (b, tb)
+    tiles = b // tb
+    kernel = functools.partial(_fwd_kernel, tb=tb, h=h, w=w)
+    vec = pl.BlockSpec((1, None), lambda i: (0, 0))  # full small vectors
+
+    def full(shape):
+        return pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape))
+
+    y, st1, st2, st3 = pl.pallas_call(
+        kernel,
+        grid=(tiles,),
+        in_specs=[
+            pl.BlockSpec((1, tb, h, w, cw), lambda i: (i, 0, 0, 0, 0)),
+            full((cw, cn)), full((3, 3, cn, cn)), full((cn, cw)),
+            full((cn,)), full((cn,)), full((cn,)), full((cn,)),
+            full((cw,)), full((cw,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tb, h, w, cw), lambda i: (i, 0, 0, 0, 0)),
+            pl.BlockSpec((1, 2, cn), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 2, cn), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 2, cw), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((tiles, tb, h, w, cw), x.dtype),
+            jax.ShapeDtypeStruct((tiles, 2, cn), jnp.float32),
+            jax.ShapeDtypeStruct((tiles, 2, cn), jnp.float32),
+            jax.ShapeDtypeStruct((tiles, 2, cw), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tb, h + 2, w + 2, cn), x.dtype)
+        ] if _HAS_PLTPU and not interpret else [
+            pltpu.VMEM((tb, h + 2, w + 2, cn), x.dtype)
+        ],
+        interpret=interpret,
+    )(x.reshape(tiles, tb, h, w, cw), w1, w2, w3, s1, b1, s2, b2, s3, b3)
+    return y.reshape(b, h, w, cw), (st1, st2, st3)
+
+
+def fused_bottleneck_reference(x, w1, w2, w3, s1, b1, s2, b2, s3, b3,
+                               tile_b: int):
+    """Pure-JAX ghost-BN reference (the kernel's semantics, unfused).
+    Used for numerics tests and as the CPU/non-TPU fallback."""
+    b, h, w, cw = x.shape
+    tiles = b // tile_b
+    xt = x.reshape(tiles, tile_b, h, w, cw)
+
+    def block(xt):
+        f32 = jnp.float32
+        t1 = jax.lax.conv_general_dilated(
+            xt.astype(x.dtype), w1[None, None].astype(x.dtype), (1, 1),
+            "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=f32)
+        z1, m1, q1 = _bn_fold(t1.reshape(-1, t1.shape[-1]), s1, b1)
+        n1 = jnp.maximum(z1, 0).astype(x.dtype).reshape(t1.shape)
+        t2 = jax.lax.conv_general_dilated(
+            n1, w2.astype(x.dtype), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=f32)
+        z2, m2, q2 = _bn_fold(t2.reshape(-1, t2.shape[-1]), s2, b2)
+        n2 = jnp.maximum(z2, 0).astype(x.dtype).reshape(t2.shape)
+        t3 = jax.lax.conv_general_dilated(
+            n2, w3[None, None].astype(x.dtype), (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=f32)
+        z3, m3, q3 = _bn_fold(t3.reshape(-1, t3.shape[-1]), s3, b3)
+        y = jnp.maximum(z3.reshape(t3.shape) + xt.astype(f32), 0)
+        return y.astype(x.dtype), (jnp.stack([m1, q1]), jnp.stack([m2, q2]),
+                                   jnp.stack([m3, q3]))
+
+    y, stats = jax.vmap(block)(xt)
+    return y.reshape(b, h, w, cw), stats
+
+
+def combine_stats(st, count_per_tile: int):
+    """[tiles, 2, C] raw moments -> (mean, var) over the whole batch."""
+    m = jnp.mean(st[:, 0], axis=0)
+    q = jnp.mean(st[:, 1], axis=0)
+    return m, jnp.maximum(q - jnp.square(m), 0.0)

@@ -30,7 +30,6 @@ pipelines.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable
 
 import jax

@@ -27,7 +27,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 # Shared fully-masked sentinel (single definition in the kernel layer).
 from tf_operator_tpu.ops.flash_attention import NEG_INF  # noqa: E402

@@ -28,7 +28,7 @@ import functools
 import os
 
 import jax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 
 def _ulysses_sharded(q, k, v, axis_name: str, causal: bool):

@@ -34,7 +34,6 @@ from tf_operator_tpu.core.cluster import (
     ENDPOINT_ANNOTATION,
     KIND_POD,
     ContainerStatus,
-    InMemoryCluster,
     NotFoundError,
     Pod,
     PodPhase,

@@ -9,7 +9,19 @@ labelSelector lists, /status subresources, resourceVersions, and chunked
 `?watch=true` streams — so the controller's full reconcile loop runs over
 REAL HTTP against REAL watch semantics with no cluster.
 
-Not modeled: auth, admission, field selectors, patch types.
+Round-3 conformance hardening (VERDICT r2 item 5) — the ways a real
+apiserver is stricter than a naive fake:
+  * watch bookmarks (`allowWatchBookmarks=true` → periodic BOOKMARK events
+    carrying the current resourceVersion);
+  * watch-log compaction + 410 Gone (a watch from a resourceVersion older
+    than the retained window gets an ERROR event with code 410 and must
+    relist — real apiservers compact etcd history);
+  * server-side structural-schema validation of CRs, driven by the SAME
+    manifests/*-crd.yaml a real cluster would apply: type/required/enum/
+    bounds violations → 422, unknown fields pruned (except
+    x-kubernetes-preserve-unknown-fields subtrees).
+
+Not modeled: auth, field selectors, patch types.
 """
 
 from __future__ import annotations
@@ -20,6 +32,93 @@ import threading
 import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+# ----------------------------------------------------------- CRD schemas
+
+
+def _load_crd_schemas() -> dict[str, dict]:
+    """{plural resource -> openAPIV3Schema} from manifests/*-crd.yaml."""
+    out: dict[str, dict] = {}
+    manifests = Path(__file__).resolve().parents[2] / "manifests"
+    try:
+        import yaml
+    except ImportError:  # pragma: no cover — pyyaml is a test-env staple
+        return out
+    for p in sorted(manifests.glob("*-crd.yaml")):
+        try:
+            doc = yaml.safe_load(p.read_text())
+            plural = doc["spec"]["names"]["plural"]
+            for v in doc["spec"]["versions"]:
+                if v.get("storage"):
+                    out[plural] = v["schema"]["openAPIV3Schema"]
+        except (OSError, KeyError, TypeError, ValueError):
+            continue
+    return out
+
+
+# Top-level keys the apiserver owns; never pruned or schema-checked.
+_IMPLICIT_META = ("apiVersion", "kind", "metadata")
+
+
+def _validate_and_prune(obj, schema: dict, path: str = "") -> list[str]:
+    """Structural-schema subset: type/required/enum/minimum/maximum checks
+    (errors returned as strings) + in-place pruning of unknown object keys,
+    honoring x-kubernetes-preserve-unknown-fields. Mirrors how a real
+    apiserver treats structural CRD schemas (prune, then validate)."""
+    errs: list[str] = []
+    t = schema.get("type")
+    if t == "object":
+        if not isinstance(obj, dict):
+            return [f"{path or '.'}: expected object, got {type(obj).__name__}"]
+        for req in schema.get("required", []):
+            if req not in obj:
+                errs.append(f"{path}.{req}: required field missing")
+        props = schema.get("properties")
+        addl = schema.get("additionalProperties")
+        preserve = schema.get("x-kubernetes-preserve-unknown-fields", False)
+        for k in list(obj):
+            sub = f"{path}.{k}"
+            if not path and k in _IMPLICIT_META:
+                continue
+            if props and k in props:
+                errs.extend(_validate_and_prune(obj[k], props[k], sub))
+            elif isinstance(addl, dict):
+                errs.extend(_validate_and_prune(obj[k], addl, sub))
+            elif preserve or addl is True:
+                continue
+            elif props is not None:
+                del obj[k]  # unknown field: pruned, like the real server
+        return errs
+    if t == "array":
+        if not isinstance(obj, list):
+            return [f"{path}: expected array, got {type(obj).__name__}"]
+        items = schema.get("items")
+        if isinstance(items, dict):
+            for i, v in enumerate(obj):
+                errs.extend(_validate_and_prune(v, items, f"{path}[{i}]"))
+        return errs
+    if t == "string":
+        if not isinstance(obj, str):
+            return [f"{path}: expected string, got {type(obj).__name__}"]
+    elif t == "integer":
+        if isinstance(obj, bool) or not isinstance(obj, int):
+            return [f"{path}: expected integer, got {type(obj).__name__}"]
+    elif t == "number":
+        if isinstance(obj, bool) or not isinstance(obj, (int, float)):
+            return [f"{path}: expected number, got {type(obj).__name__}"]
+    elif t == "boolean":
+        if not isinstance(obj, bool):
+            return [f"{path}: expected boolean, got {type(obj).__name__}"]
+    enum = schema.get("enum")
+    if enum is not None and obj not in enum:
+        errs.append(f"{path}: {obj!r} not in {enum}")
+    if isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        if "minimum" in schema and obj < schema["minimum"]:
+            errs.append(f"{path}: {obj} < minimum {schema['minimum']}")
+        if "maximum" in schema and obj > schema["maximum"]:
+            errs.append(f"{path}: {obj} > maximum {schema['maximum']}")
+    return errs
 
 # /api/v1/... (core) or /apis/<group>/<version>/... (CRDs); optionally
 # namespaced; optional name; optional subresource.
@@ -33,13 +132,15 @@ _PATH_RE = re.compile(
 
 
 class _Store:
-    def __init__(self):
+    def __init__(self, watch_log_retain: int = 4096):
         self.lock = threading.Condition()
         self.rv = 0
         # {resource: {(ns, name): obj_dict}}
         self.objects: dict[str, dict[tuple[str, str], dict]] = {}
-        # append-only watch log: (rv, type, resource, obj_dict)
+        # watch log, COMPACTED like etcd history: only the last
+        # `watch_log_retain` entries are retained; (rv, type, resource, obj)
         self.log: list[tuple[int, str, str, dict]] = []
+        self.watch_log_retain = watch_log_retain
         # kubelet-side pod logs, served by GET .../pods/{name}/log
         self.pod_logs: dict[tuple[str, str], str] = {}
 
@@ -47,10 +148,23 @@ class _Store:
         self.rv += 1
         return self.rv
 
+    def append_log(self, entry: tuple[int, str, str, dict]) -> None:
+        self.log.append(entry)
+        while len(self.log) > self.watch_log_retain:
+            self.compacted_before = self.log[0][0]
+            del self.log[0]
+
+    # rv of the newest discarded entry: a watch from since_rv can only be
+    # served when since_rv >= compacted_before (otherwise events are gone
+    # from history and the client must relist → 410).
+    compacted_before: int = 0
+
 
 class FakeApiServer:
-    def __init__(self, port: int = 0):
-        store = self.store = _Store()
+    def __init__(self, port: int = 0, watch_log_retain: int = 4096,
+                 validate_schemas: bool = True):
+        store = self.store = _Store(watch_log_retain=watch_log_retain)
+        schemas = _load_crd_schemas() if validate_schemas else {}
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -113,6 +227,7 @@ class FakeApiServer:
                     return self._watch(
                         res, ns, int(q.get("resourceVersion") or 0),
                         q.get("labelSelector"),
+                        bookmarks=q.get("allowWatchBookmarks") == "true",
                     )
                 with store.lock:
                     objs = store.objects.setdefault(res, {})
@@ -141,8 +256,15 @@ class FakeApiServer:
                         "items": items,
                     })
 
+            def _send_chunk(self, payload: dict):
+                line = json.dumps(payload) + "\n"
+                data = line.encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
             def _watch(self, res: str, ns: str | None, since_rv: int,
-                       selector: str | None = None):
+                       selector: str | None = None, bookmarks: bool = False):
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -153,9 +275,34 @@ class FakeApiServer:
                 )
                 sent = since_rv
                 try:
+                    # History compaction, like etcd: a start rv older than
+                    # the retained window cannot be replayed — the client
+                    # gets 410 Gone as a watch ERROR event and must relist.
+                    # (rv 0/unset means "from any point" — never expired)
+                    with store.lock:
+                        expired = 0 < since_rv < store.compacted_before
+                    if expired:
+                        self._send_chunk({
+                            "type": "ERROR",
+                            "object": {"kind": "Status", "status": "Failure",
+                                       "code": 410, "reason": "Expired",
+                                       "message": f"too old resource version:"
+                                                  f" {since_rv}"},
+                        })
+                        return
                     while True:
+                        send_bookmark = False
                         with store.lock:
-                            fresh = [
+                            # Compaction can overtake an established watch
+                            # between polls (writer bursts past the retained
+                            # window): events in (sent, compacted_before)
+                            # are gone from history — that stream must get
+                            # 410 too, not silently skip them.
+                            if 0 < sent < store.compacted_before:
+                                mid_expired = True
+                            else:
+                                mid_expired = False
+                            fresh = [] if mid_expired else [
                                 (rv, t, o) for rv, t, r, o in store.log
                                 if r == res and rv > sent
                                 and (ns is None or o["metadata"].get("namespace") == ns)
@@ -173,18 +320,41 @@ class FakeApiServer:
                             watermark = max([sent] + [rv for rv, _, _ in fresh])
                             if not pending:
                                 sent = watermark
-                                store.lock.wait(timeout=0.5)
+                                # On idle ticks an opted-in client gets a
+                                # BOOKMARK so its resume point stays fresh
+                                # without relists. The bookmark carries the
+                                # PRE-wait watermark: an event that lands
+                                # during the wait has rv > watermark and
+                                # must still be scanned next loop — using
+                                # post-wait store.rv here would skip it.
+                                send_bookmark = bookmarks
+                                bookmark_rv = watermark
+                                if not mid_expired:
+                                    store.lock.wait(timeout=0.5)
                         # Socket writes happen OUTSIDE the lock: a stalled
                         # watch client must not block writers.
+                        if mid_expired:
+                            self._send_chunk({
+                                "type": "ERROR",
+                                "object": {"kind": "Status",
+                                           "status": "Failure", "code": 410,
+                                           "reason": "Expired",
+                                           "message": "watch history "
+                                                      "compacted mid-stream"},
+                            })
+                            return
                         for rv, etype, obj in pending:
-                            line = json.dumps({"type": etype, "object": obj}) + "\n"
-                            data = line.encode()
-                            self.wfile.write(f"{len(data):x}\r\n".encode())
-                            self.wfile.write(data + b"\r\n")
-                            self.wfile.flush()
+                            self._send_chunk({"type": etype, "object": obj})
                             sent = rv
                         if pending:
                             sent = max(sent, watermark)
+                        elif send_bookmark:
+                            self._send_chunk({
+                                "type": "BOOKMARK",
+                                "object": {"metadata": {
+                                    "resourceVersion": str(bookmark_rv)}},
+                            })
+                            sent = max(sent, bookmark_rv)
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     return
 
@@ -197,6 +367,16 @@ class FakeApiServer:
                 meta = obj.setdefault("metadata", {})
                 meta.setdefault("namespace", ns)
                 name = meta.get("name", "")
+                # Server-side structural-schema validation, as a real
+                # apiserver does for CRDs: prune unknown fields, 422 on
+                # type/required/enum/bounds violations.
+                if res in schemas:
+                    errs = _validate_and_prune(obj, schemas[res])
+                    if errs:
+                        return self._error(
+                            422, "Invalid",
+                            f"{res} {ns}/{name}: " + "; ".join(errs[:5]),
+                        )
                 with store.lock:
                     objs = store.objects.setdefault(res, {})
                     if (ns, name) in objs:
@@ -207,7 +387,7 @@ class FakeApiServer:
                     meta["resourceVersion"] = str(rv)
                     meta.setdefault("uid", f"uid-{rv}")
                     objs[(ns, name)] = obj
-                    store.log.append((rv, "ADDED", res, obj))
+                    store.append_log((rv, "ADDED", res, obj))
                     store.lock.notify_all()
                 return self._send_json(obj, 201)
 
@@ -217,6 +397,13 @@ class FakeApiServer:
                     return self._error(404, "NotFound", self.path)
                 res, ns, name, sub = m["resource"], m["ns"], m["name"], m["sub"]
                 body = self._body()
+                if sub is None and res in schemas:
+                    errs = _validate_and_prune(body, schemas[res])
+                    if errs:
+                        return self._error(
+                            422, "Invalid",
+                            f"{res} {ns}/{name}: " + "; ".join(errs[:5]),
+                        )
                 with store.lock:
                     objs = store.objects.setdefault(res, {})
                     cur = objs.get((ns, name))
@@ -250,7 +437,7 @@ class FakeApiServer:
                     rv = store.bump()
                     new["metadata"]["resourceVersion"] = str(rv)
                     objs[(ns, name)] = new
-                    store.log.append((rv, "MODIFIED", res, new))
+                    store.append_log((rv, "MODIFIED", res, new))
                     store.lock.notify_all()
                 return self._send_json(new)
 
@@ -268,7 +455,7 @@ class FakeApiServer:
                     obj = dict(obj)
                     obj["metadata"] = dict(obj["metadata"])
                     obj["metadata"]["resourceVersion"] = str(rv)
-                    store.log.append((rv, "DELETED", res, obj))
+                    store.append_log((rv, "DELETED", res, obj))
                     store.lock.notify_all()
                 return self._send_json(obj)
 
@@ -340,5 +527,5 @@ class FakeApiServer:
             pod["metadata"] = dict(pod["metadata"])
             pod["metadata"]["resourceVersion"] = str(rv)
             self.store.objects["pods"][(namespace, name)] = pod
-            self.store.log.append((rv, "MODIFIED", "pods", pod))
+            self.store.append_log((rv, "MODIFIED", "pods", pod))
             self.store.lock.notify_all()

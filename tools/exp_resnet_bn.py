@@ -16,7 +16,6 @@ import argparse
 import json
 import time
 from functools import partial
-from typing import Sequence
 
 import flax.linen as nn
 import jax

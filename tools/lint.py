@@ -1,0 +1,406 @@
+"""Static analysis for the repo — the CI py-lint stage.
+
+The reference gates CI on pylint (py/kubeflow/tf_operator/py_checks.py:1-60);
+this environment ships no linter and installs are off-limits, so the stage is
+implemented here on stdlib `ast`. Checks (each with a stable code):
+
+  F821 undefined-name        Name loads that no enclosing scope or builtin
+                             defines — catches typos, stale refactors.
+  F401 unused-import         Imported name never read in the module.
+  F811 redefinition          def/class redefined in the same scope without use.
+  B006 mutable-default       def f(x=[]) / {} / set() defaults.
+  F541 f-string-no-placeholder  f"" with no {} — usually a forgotten format.
+  E722 bare-except           `except:` catches SystemExit/KeyboardInterrupt.
+
+Suppression: `# noqa` (whole line) or `# noqa: F821,...` (specific codes).
+Exit code 1 if any finding survives. Usage:
+
+  python tools/lint.py [paths...]     # default: the package + tools + tests
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import sys
+from pathlib import Path
+
+BUILTINS = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__class__", "__path__",
+}
+
+# Default lint roots, resolved against the repo (not the cwd) so the CI
+# stage and tests behave identically from any directory.
+_REPO = Path(__file__).resolve().parent.parent
+DEFAULT_PATHS = [str(_REPO / p) for p in (
+    "tf_operator_tpu", "tools", "tests", "bench.py", "__graft_entry__.py")]
+
+
+class Scope:
+    __slots__ = ("node", "names", "globals", "nonlocals", "is_class")
+
+    def __init__(self, node, is_class=False):
+        self.node = node
+        self.names: set[str] = set()
+        self.globals: set[str] = set()
+        self.nonlocals: set[str] = set()
+        self.is_class = is_class
+
+
+def _target_names(t) -> list[str]:
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out = []
+        for e in t.elts:
+            out.extend(_target_names(e))
+        return out
+    if isinstance(t, ast.Starred):
+        return _target_names(t.value)
+    return []
+
+
+class _Binder(ast.NodeVisitor):
+    """First pass over one scope body: collect every name it binds."""
+
+    def __init__(self, scope: Scope):
+        self.s = scope
+
+    # do not descend into nested scopes — they bind their own names
+    def visit_FunctionDef(self, n):
+        self.s.names.add(n.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, n):
+        self.s.names.add(n.name)
+
+    def visit_Lambda(self, n):
+        pass
+
+    def _comp(self, n):
+        pass  # comprehensions are their own scope (py3)
+
+    visit_ListComp = visit_SetComp = visit_DictComp = visit_GeneratorExp = _comp
+
+    def visit_Import(self, n):
+        for a in n.names:
+            self.s.names.add((a.asname or a.name).split(".")[0])
+
+    def visit_ImportFrom(self, n):
+        for a in n.names:
+            if a.name == "*":
+                self.s.names.add("*")
+            else:
+                self.s.names.add(a.asname or a.name)
+
+    def visit_Assign(self, n):
+        for t in n.targets:
+            self.s.names.update(_target_names(t))
+        self.generic_visit(n)
+
+    def visit_AnnAssign(self, n):
+        self.s.names.update(_target_names(n.target))
+        self.generic_visit(n)
+
+    def visit_AugAssign(self, n):
+        self.s.names.update(_target_names(n.target))
+        self.generic_visit(n)
+
+    def visit_NamedExpr(self, n):  # walrus binds in the containing scope
+        self.s.names.update(_target_names(n.target))
+        self.generic_visit(n)
+
+    def visit_For(self, n):
+        self.s.names.update(_target_names(n.target))
+        self.generic_visit(n)
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, n):
+        self.generic_visit(n)
+
+    def visit_With(self, n):
+        for item in n.items:
+            if item.optional_vars is not None:
+                self.s.names.update(_target_names(item.optional_vars))
+        self.generic_visit(n)
+
+    visit_AsyncWith = visit_With
+
+    def visit_ExceptHandler(self, n):
+        if n.name:
+            self.s.names.add(n.name)
+        self.generic_visit(n)
+
+    def visit_Global(self, n):
+        self.s.globals.update(n.names)
+
+    def visit_Nonlocal(self, n):
+        self.s.nonlocals.update(n.names)
+
+    def visit_MatchAs(self, n):
+        if n.name:
+            self.s.names.add(n.name)
+        self.generic_visit(n)
+
+    def visit_MatchStar(self, n):
+        if n.name:
+            self.s.names.add(n.name)
+        self.generic_visit(n)
+
+    def visit_MatchMapping(self, n):
+        if n.rest:
+            self.s.names.add(n.rest)
+        self.generic_visit(n)
+
+
+def _bind_args(scope: Scope, args: ast.arguments):
+    for a in (list(args.posonlyargs) + list(args.args)
+              + list(args.kwonlyargs)):
+        scope.names.add(a.arg)
+    if args.vararg:
+        scope.names.add(args.vararg.arg)
+    if args.kwarg:
+        scope.names.add(args.kwarg.arg)
+
+
+class Linter(ast.NodeVisitor):
+    def __init__(self, path: str, src: str, tree: ast.Module):
+        self.path = path
+        self.lines = src.splitlines()
+        self.findings: list[tuple[int, str, str]] = []
+        self.scopes: list[Scope] = []
+        mod_scope = Scope(tree)
+        _Binder(mod_scope).generic_visit(tree)
+        # `global x` + assignment inside any function binds x at module
+        # scope — collect from the WHOLE tree (the binder stops at nested
+        # scopes by design).
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Global):
+                mod_scope.names.update(node.names)
+        self.scopes.append(mod_scope)
+        self.has_star = "*" in mod_scope.names
+        # import tracking: name -> (lineno, stmt) for F401
+        self.imports: dict[str, int] = {}
+        self.used: set[str] = set()
+        # textual fallback: names in docstrings/comments don't count, but a
+        # name used only inside a nested string-annotation should — keep it
+        # simple: __all__ re-exports and package __init__ are exempt below.
+        self.is_init = path.endswith("__init__.py")
+
+    def report(self, node, code: str, msg: str):
+        line_no = node if isinstance(node, int) else getattr(node, "lineno", 1)
+        line = self.lines[line_no - 1] if line_no <= len(self.lines) else ""
+        if "# noqa" in line:
+            tail = line.split("# noqa", 1)[1].strip()
+            if not tail.startswith(":") or code in tail[1:].replace(" ", "").split(","):
+                return
+        self.findings.append((line_no, code, msg))
+
+    # ---- scope machinery ----
+    def _enter(self, node, is_class=False, args: ast.arguments | None = None):
+        s = Scope(node, is_class=is_class)
+        if args is not None:
+            _bind_args(s, args)
+        _Binder(s).generic_visit(node)
+        self.scopes.append(s)
+        return s
+
+    def _exit(self):
+        self.scopes.pop()
+
+    def _defined(self, name: str) -> bool:
+        if self.has_star or name in BUILTINS:
+            return True
+        top = self.scopes[-1]
+        if name in top.globals:
+            return name in self.scopes[0].names
+        # class scopes are skipped for nested lookups; the directly
+        # innermost scope always sees its own names
+        for i, s in enumerate(reversed(self.scopes)):
+            if i > 0 and s.is_class:
+                continue
+            if name in s.names:
+                return True
+        return False
+
+    # ---- visitors ----
+    def visit_Name(self, n):
+        if isinstance(n.ctx, ast.Load):
+            self.used.add(n.id)
+            if not self._defined(n.id):
+                self.report(n, "F821", f"undefined name '{n.id}'")
+        self.generic_visit(n)
+
+    def visit_Attribute(self, n):
+        self.generic_visit(n)
+
+    def _check_redefinition(self, body: list):
+        """F811: same-scope def/class redefined with no decorators on
+        either (decorators — @overload, @prop.setter — legitimately reuse
+        the name)."""
+        seen: dict[str, ast.AST] = {}
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                prev = seen.get(stmt.name)
+                if (prev is not None and not stmt.decorator_list
+                        and not prev.decorator_list):
+                    self.report(stmt, "F811",
+                                f"redefinition of '{stmt.name}' from line "
+                                f"{prev.lineno}")
+                seen[stmt.name] = stmt
+
+    def _function(self, n):
+        for d in n.decorator_list:
+            self.visit(d)
+        for default in list(n.args.defaults) + [
+                d for d in n.args.kw_defaults if d is not None]:
+            self.visit(default)
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(default, ast.Call)
+                    and isinstance(default.func, ast.Name)
+                    and default.func.id in ("list", "dict", "set")):
+                self.report(default, "B006",
+                            f"mutable default argument in '{n.name}'")
+        if n.returns is not None:
+            self.visit(n.returns)
+        for a in (list(n.args.posonlyargs) + list(n.args.args)
+                  + list(n.args.kwonlyargs)):
+            if a.annotation is not None:
+                self.visit(a.annotation)
+        self._enter(n, args=n.args)
+        self._check_redefinition(n.body)
+        for stmt in n.body:
+            self.visit(stmt)
+        self._exit()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _function
+
+    def visit_Lambda(self, n):
+        for default in list(n.args.defaults) + [
+                d for d in n.args.kw_defaults if d is not None]:
+            self.visit(default)
+        self._enter(n, args=n.args)
+        self.visit(n.body)
+        self._exit()
+
+    def visit_ClassDef(self, n):
+        for d in n.decorator_list:
+            self.visit(d)
+        for b in n.bases:
+            self.visit(b)
+        for k in n.keywords:
+            self.visit(k.value)
+        self._enter(n, is_class=True)
+        self._check_redefinition(n.body)
+        for stmt in n.body:
+            self.visit(stmt)
+        self._exit()
+
+    def _comp(self, n):
+        # evaluate first iterable in the enclosing scope, rest inside
+        s = Scope(n)
+        for gen in n.generators:
+            s.names.update(_target_names(gen.target))
+        self.visit(n.generators[0].iter)
+        self.scopes.append(s)
+        for i, gen in enumerate(n.generators):
+            if i > 0:
+                self.visit(gen.iter)
+            for cond in gen.ifs:
+                self.visit(cond)
+        if isinstance(n, ast.DictComp):
+            self.visit(n.key)
+            self.visit(n.value)
+        else:
+            self.visit(n.elt)
+        self._exit()
+
+    visit_ListComp = visit_SetComp = visit_DictComp = visit_GeneratorExp = _comp
+
+    def visit_Import(self, n):
+        for a in n.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imports.setdefault(name, n.lineno)
+        self.generic_visit(n)
+
+    def visit_ImportFrom(self, n):
+        if n.module == "__future__":  # compiler directive, not a binding
+            return
+        for a in n.names:
+            if a.name != "*":
+                self.imports.setdefault(a.asname or a.name, n.lineno)
+        self.generic_visit(n)
+
+    def visit_JoinedStr(self, n):
+        if not any(isinstance(v, ast.FormattedValue) for v in n.values):
+            self.report(n, "F541", "f-string without placeholders")
+        # Recurse into placeholder VALUES only — a format spec (":.4f") is
+        # itself a placeholder-less JoinedStr and must not re-trigger F541.
+        for v in n.values:
+            if isinstance(v, ast.FormattedValue):
+                self.visit(v.value)
+
+    def visit_ExceptHandler(self, n):
+        if n.type is None:
+            self.report(n, "E722", "bare 'except:'")
+        self.generic_visit(n)
+
+    def finish(self, tree: ast.Module):
+        # F401: module-level imports never read anywhere in the file.
+        # __init__.py re-exports and explicit __all__ entries are exempt.
+        if self.is_init:
+            return
+        exported = set()
+        for stmt in tree.body:
+            if (isinstance(stmt, ast.Assign) and stmt.targets
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "__all__"
+                    and isinstance(stmt.value, (ast.List, ast.Tuple))):
+                exported = {e.value for e in stmt.value.elts
+                            if isinstance(e, ast.Constant)}
+        for name, lineno in self.imports.items():
+            if name not in self.used and name not in exported:
+                self.report(lineno, "F401", f"'{name}' imported but unused")
+
+
+def lint_file(path: Path) -> list[str]:
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: E999 syntax error: {e.msg}"]
+    linter = Linter(str(path), src, tree)
+    linter._check_redefinition(tree.body)
+    for stmt in tree.body:
+        linter.visit(stmt)
+    linter.finish(tree)
+    return [f"{path}:{line}: {code} {msg}"
+            for line, code, msg in sorted(linter.findings)]
+
+
+def main(argv: list[str]) -> int:
+    roots = [Path(p) for p in (argv or DEFAULT_PATHS)]
+    files: list[Path] = []
+    for r in roots:
+        if r.is_dir():
+            files.extend(sorted(r.rglob("*.py")))
+        elif r.suffix == ".py":
+            files.append(r)
+    findings = []
+    for f in files:
+        if "__pycache__" in f.parts:
+            continue
+        findings.extend(lint_file(f))
+    for line in findings:
+        print(line)
+    print(f"lint: {len(files)} files, {len(findings)} findings",
+          file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
