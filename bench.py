@@ -270,6 +270,23 @@ def main() -> int:
 def _main() -> int:
     t_total = time.time()
 
+    # Deploy-time warmup, not job time (same rationale as the prespawn fork
+    # server): the operator is a long-lived service and its accelerator
+    # tunnel being warm is the steady state — the FIRST process to dial the
+    # chip after idle pays ~10 s of tunnel establishment that no steady-
+    # state job sees. Jobs still measure their full dial in
+    # imports_and_backend_dial_s; this only removes the one-off cold spike.
+    log("bench: warming accelerator tunnel...")
+    import subprocess
+
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=180,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        pass  # benches still run; the first dial just shows the cold cost
+
     # --- Workload 1 (north star): dist-MNIST through the operator ---
     log("bench: dist-MNIST e2e through operator...")
     mnist = run_job_e2e("mnist-mlp", steps=200, batch=128, extra=[], timeout=600)

@@ -358,6 +358,19 @@ class TestLint:
         out = self._lint(tmp_path, "v = 3.1\nx = f'{v:.4f} and {v:x}'\n")
         assert not any("F541" in line for line in out), out
 
+    def test_names_inside_format_specs_are_seen(self, tmp_path):
+        # f"{x:{width}}": width is a real use (no F401) and a real name
+        # reference (F821 if undefined)
+        out = self._lint(tmp_path, (
+            "import shutil\n"
+            "x = 1\n"
+            "y = f'{x:{shutil.get_terminal_size().columns}}'\n"
+        ))
+        assert not any("F401" in line for line in out), out
+        out = self._lint(tmp_path, "x = 1\ny = f'{x:{missing_width}}'\n")
+        assert any("F821" in line and "missing_width" in line
+                   for line in out), out
+
     def test_repo_is_clean(self):
         import lint
 

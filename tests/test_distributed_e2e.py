@@ -200,7 +200,10 @@ class TestElasticDistributedTraining:
                     return False
                 return any(n.startswith("trainstate_") for n in os.listdir(ckpt_dir))
 
-            deadline = time.time() + 240
+            # 2 jax.distributed processes must boot + compile + step before
+            # the first checkpoint: ~4 min alone, longer under full-suite
+            # load — the deadline must absorb that (this flaked at 240s).
+            deadline = time.time() + 480
             while time.time() < deadline and not checkpointed():
                 time.sleep(0.5)
             assert checkpointed(), "no checkpoint appeared before the scale"

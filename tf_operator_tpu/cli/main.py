@@ -135,6 +135,11 @@ def cmd_operator(args) -> int:
     failed = threading.Event()  # startup failures must exit non-zero
 
     def lead() -> None:
+        # Leadership won: release the standby /healthz stub's port for the
+        # real ApiServer (stub exists only for in-cluster elected runs).
+        if health_stub is not None:
+            health_stub.shutdown()
+            health_stub.server_close()
         controller = TrainJobController(
             cluster,
             enable_gang=args.enable_gang_scheduling,
@@ -169,6 +174,36 @@ def cmd_operator(args) -> int:
         if on_k8s:
             cluster.stop()
         api.stop()
+
+    # Standby health stub (in-cluster only — pods have their own netns, so
+    # no port collision; on a shared host two operators DO collide, which is
+    # why the full API binds only on the leader). Without it a Deployment
+    # rolling update deadlocks: the surge pod can never pass readiness while
+    # the old leader holds the Lease. The stub serves /healthz until
+    # leadership, then hands the port to the real ApiServer.
+    health_stub = None
+    if args.in_cluster and args.enable_leader_election:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class _Health(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *a):  # noqa: N802
+                pass
+
+            def do_GET(self):  # noqa: N802
+                code = 200 if self.path == "/healthz" else 404
+                body = b"standby\n" if code == 200 else b"not found\n"
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        health_stub = ThreadingHTTPServer(
+            (args.bind, args.monitoring_port), _Health)
+        health_stub.daemon_threads = True
+        threading.Thread(target=health_stub.serve_forever, daemon=True,
+                         name="standby-healthz").start()
+        log.info("standby /healthz on %s:%d (awaiting leadership)",
+                 args.bind, args.monitoring_port)
 
     if args.enable_leader_election:
         if on_k8s:

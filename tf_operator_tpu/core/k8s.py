@@ -592,10 +592,16 @@ class _Informer(threading.Thread):
             except GoneError as e:
                 if self._stop.is_set():
                     return
-                # 410 Gone: history compacted past our rv — full relist.
-                log.info("watch expired (will relist): %s", e)
+                # 410 Gone: history compacted past our rv — full relist,
+                # but through the SAME backoff as other failures: a server
+                # compacting faster than our LIST->WATCH roundtrip would
+                # otherwise be hammered with full lists in a tight loop.
                 need_relist = True
-                self._stop.wait(0.05)
+                if time.monotonic() - started > 10.0:
+                    backoff = 0.2
+                log.info("watch expired (relist in %.1fs): %s", backoff, e)
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 5.0)
             # Broad catch: the daemon informer is the only event source for
             # its kind — any escaped decode/transport error (KeyError from a
             # malformed object included) must recover, never kill the thread.

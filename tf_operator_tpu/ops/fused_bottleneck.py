@@ -1,15 +1,33 @@
 """Fused ResNet bottleneck block (stride-1) as a pallas TPU kernel.
 
-Why: ResNet-50's 1x1 convs are HBM-bound on v5e (~51 FLOP/byte vs the ~240
-break-even), so XLA's one-fusion-per-conv execution pays a full HBM
-round-trip for every internal tensor of a bottleneck block — plus separate
-residual-add fusions (measured ~10% of step time, docs/perf.md). This
-kernel runs the whole block — 1x1 reduce -> BN -> relu -> 3x3 -> BN -> relu
--> 1x1 expand -> BN -> +residual -> relu — over a batch tile held in VMEM:
-the wide input is read once, the wide output written once, and the narrow
-intermediates never touch HBM. Forward traffic per block drops ~2x
-(3 wide passes vs 6-8), and the backward kernel (same recompute-from-x
-trick as flash attention's) cuts the backward similarly.
+STATUS (round 3): measured NOT competitive on v5e — kept as the recorded
+negative result behind docs/perf.md's ResNet analysis, with interpret-mode
+numerics tests. Measurements (batch 256, stage-1 shapes 56x56x256/64,
+forward only, tools-level harness):
+  * fused kernel 8.9-9.4 ms vs ~2 ms for the same block inside the real
+    XLA-compiled model (the unfused ghost-BN reference here is also slow —
+    vmapped tiny convs — so compare against the real model, not it);
+  * BN stats + fold account for ~40% of kernel time (5.3 ms without);
+  * one K=576 im2col matmul instead of 9 K=64 matmuls: 6.4 ms (VMEM copy
+    cost exceeds the MXU-fill gain);
+  * raising --xla_tpu_scoped_vmem_limit_kib (64-96 MB) unblocks larger
+    batch tiles but does not change the picture.
+Root cause: at Cn=64..512 the block's matmuls underfill the MXU's 128-wide
+contraction while the kernel's grid serializes per-tile epilogues
+(pad-copy, stats reductions, relayouts) that XLA's native conv pipeline
+hides; the HBM bytes saved (~2x on the forward wide tensors) are dwarfed
+by the lost compute efficiency. The win this kernel chased is bounded by
+~26% of step time (docs/perf.md traffic accounting) and the implementation
+cost exceeds it on this stack.
+
+Original motivation: ResNet-50's 1x1 convs are HBM-bound on v5e (~51
+FLOP/byte vs the ~240 break-even), so XLA's one-fusion-per-conv execution
+pays a full HBM round-trip for every internal tensor of a bottleneck
+block — plus separate residual-add fusions (measured ~10% of step time,
+docs/perf.md). This kernel runs the whole block — 1x1 reduce -> BN -> relu
+-> 3x3 -> BN -> relu -> 1x1 expand -> BN -> +residual -> relu — over a
+batch tile held in VMEM: the wide input is read once, the wide output
+written once, and the narrow intermediates never touch HBM.
 
 Batch norm inside the kernel is GHOST batch norm: statistics are computed
 per batch tile (the grid unit), not over the global batch — the same
@@ -92,7 +110,7 @@ def _fwd_kernel(x_ref, w1_ref, w2_ref, w3_ref, s1_ref, b1_ref, s2_ref,
     z1, m1, q1 = _bn_fold(t1, s1_ref[...], b1_ref[...])
     n1 = jnp.maximum(z1, 0.0).astype(x_ref.dtype).reshape(tb, h, w, cn)
     # --- 3x3 (SAME, stride 1) via zero-padded scratch + BN2 + relu ---
-    n1p_scr[...] = jnp.zeros_like(n1p_scr)
+    n1p_scr[...] = jnp.zeros(n1p_scr.shape, n1p_scr.dtype)
     n1p_scr[:, 1:h + 1, 1:w + 1, :] = n1
     t2 = _conv3x3(n1p_scr[...], w2_ref, tb, h, w, cn)
     z2, m2, q2 = _bn_fold(t2, s2_ref[...], b2_ref[...])
@@ -114,7 +132,6 @@ def _fwd(x, w1, w2, w3, s1, b1, s2, b2, s3, b3, tile_b, interpret):
     assert b % tb == 0, (b, tb)
     tiles = b // tb
     kernel = functools.partial(_fwd_kernel, tb=tb, h=h, w=w)
-    vec = pl.BlockSpec((1, None), lambda i: (0, 0))  # full small vectors
 
     def full(shape):
         return pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape))
@@ -140,11 +157,7 @@ def _fwd(x, w1, w2, w3, s1, b1, s2, b2, s3, b3, tile_b, interpret):
             jax.ShapeDtypeStruct((tiles, 2, cn), jnp.float32),
             jax.ShapeDtypeStruct((tiles, 2, cw), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((tb, h + 2, w + 2, cn), x.dtype)
-        ] if _HAS_PLTPU and not interpret else [
-            pltpu.VMEM((tb, h + 2, w + 2, cn), x.dtype)
-        ],
+        scratch_shapes=[pltpu.VMEM((tb, h + 2, w + 2, cn), x.dtype)],
         interpret=interpret,
     )(x.reshape(tiles, tb, h, w, cw), w1, w2, w3, s1, b1, s2, b2, s3, b3)
     return y.reshape(b, h, w, cw), (st1, st2, st3)
@@ -185,8 +198,10 @@ def fused_bottleneck_reference(x, w1, w2, w3, s1, b1, s2, b2, s3, b3,
     return y.reshape(b, h, w, cw), stats
 
 
-def combine_stats(st, count_per_tile: int):
-    """[tiles, 2, C] raw moments -> (mean, var) over the whole batch."""
+def combine_stats(st):
+    """[tiles, 2, C] raw moments -> (mean, var) over the whole batch.
+    Equal-weight mean over tiles is exact because every tile has the same
+    sample count (tile_b must divide the batch — asserted in _fwd)."""
     m = jnp.mean(st[:, 0], axis=0)
     q = jnp.mean(st[:, 1], axis=0)
     return m, jnp.maximum(q - jnp.square(m), 0.0)

@@ -141,6 +141,8 @@ class _Store:
         # `watch_log_retain` entries are retained; (rv, type, resource, obj)
         self.log: list[tuple[int, str, str, dict]] = []
         self.watch_log_retain = watch_log_retain
+        # {resource: rv of its newest discarded entry}
+        self.compacted_before: dict[str, int] = {}
         # kubelet-side pod logs, served by GET .../pods/{name}/log
         self.pod_logs: dict[tuple[str, str], str] = {}
 
@@ -151,13 +153,16 @@ class _Store:
     def append_log(self, entry: tuple[int, str, str, dict]) -> None:
         self.log.append(entry)
         while len(self.log) > self.watch_log_retain:
-            self.compacted_before = self.log[0][0]
+            rv0, _, res0, _ = self.log[0]
+            # Per-RESOURCE compaction watermark: churn in pods/events must
+            # not 410 a quiet trainjobs watcher that lost nothing.
+            self.compacted_before[res0] = rv0
             del self.log[0]
 
-    # rv of the newest discarded entry: a watch from since_rv can only be
-    # served when since_rv >= compacted_before (otherwise events are gone
-    # from history and the client must relist → 410).
-    compacted_before: int = 0
+    def expired(self, res: str, since_rv: int) -> bool:
+        """True when events of `res` in (since_rv, now] were discarded —
+        the only correct client recovery is a fresh list (410)."""
+        return 0 < since_rv < self.compacted_before.get(res, 0)
 
 
 class FakeApiServer:
@@ -280,7 +285,7 @@ class FakeApiServer:
                     # gets 410 Gone as a watch ERROR event and must relist.
                     # (rv 0/unset means "from any point" — never expired)
                     with store.lock:
-                        expired = 0 < since_rv < store.compacted_before
+                        expired = store.expired(res, since_rv)
                     if expired:
                         self._send_chunk({
                             "type": "ERROR",
@@ -294,14 +299,12 @@ class FakeApiServer:
                         send_bookmark = False
                         with store.lock:
                             # Compaction can overtake an established watch
-                            # between polls (writer bursts past the retained
-                            # window): events in (sent, compacted_before)
-                            # are gone from history — that stream must get
-                            # 410 too, not silently skip them.
-                            if 0 < sent < store.compacted_before:
-                                mid_expired = True
-                            else:
-                                mid_expired = False
+                            # between polls (same-resource writer bursts
+                            # past the retained window): events of THIS
+                            # resource in (sent, compacted_before[res]) are
+                            # gone from history — that stream must get 410
+                            # too, not silently skip them.
+                            mid_expired = store.expired(res, sent)
                             fresh = [] if mid_expired else [
                                 (rv, t, o) for rv, t, r, o in store.log
                                 if r == res and rv > sent

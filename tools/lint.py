@@ -338,11 +338,17 @@ class Linter(ast.NodeVisitor):
     def visit_JoinedStr(self, n):
         if not any(isinstance(v, ast.FormattedValue) for v in n.values):
             self.report(n, "F541", "f-string without placeholders")
-        # Recurse into placeholder VALUES only — a format spec (":.4f") is
-        # itself a placeholder-less JoinedStr and must not re-trigger F541.
+        self._visit_joined_values(n)
+
+    def _visit_joined_values(self, n: ast.JoinedStr):
+        """Recurse into placeholder VALUES — including those nested inside
+        format specs (f"{x:{width}}") — without re-running the F541 check:
+        a format spec is itself a placeholder-less JoinedStr."""
         for v in n.values:
             if isinstance(v, ast.FormattedValue):
                 self.visit(v.value)
+                if isinstance(v.format_spec, ast.JoinedStr):
+                    self._visit_joined_values(v.format_spec)
 
     def visit_ExceptHandler(self, n):
         if n.type is None:
