@@ -276,16 +276,21 @@ def _main() -> int:
     # chip after idle pays ~10 s of tunnel establishment that no steady-
     # state job sees. Jobs still measure their full dial in
     # imports_and_backend_dial_s; this only removes the one-off cold spike.
-    log("bench: warming accelerator tunnel...")
-    import subprocess
+    # (skipped on hosts with no accelerator tunnel to warm — a JAX import
+    # subprocess on the CPU-only CI path would be pure waste)
+    platforms = os.environ.get("JAX_PLATFORMS", "").lower()
+    if (os.environ.get("PALLAS_AXON_POOL_IPS")
+            or "tpu" in platforms or "axon" in platforms):
+        log("bench: warming accelerator tunnel...")
+        import subprocess
 
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True, timeout=180,
-        )
-    except (subprocess.TimeoutExpired, OSError):
-        pass  # benches still run; the first dial just shows the cold cost
+        try:
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True, timeout=180,
+            )
+        except (subprocess.TimeoutExpired, OSError):
+            pass  # benches still run; first dial just shows the cold cost
 
     # --- Workload 1 (north star): dist-MNIST through the operator ---
     log("bench: dist-MNIST e2e through operator...")
@@ -382,17 +387,25 @@ def _main() -> int:
     # model) --- The chunked cross-entropy (models/transformer.py
     # lm_loss_chunked) keeps the [B, T, vocab] logits out of the HBM peak,
     # so 16k (and, round 3, 32k) train first-class on one v5e chip.
-    lm16_tps = lm16_mfu = lm32_tps = lm32_mfu = None
-    lm16_ok = lm32_ok = None
-    lm16_seg = lm32_seg = None
+    lm16_tps = lm16_mfu = lm32_tps = lm32_mfu = lm64_tps = lm64_mfu = None
+    lm16_ok = lm32_ok = lm64_ok = None
+    lm16_seg = lm32_seg = lm64_seg = None
     if on_tpu:
-        for seq_x, batch_x in ((16384, 2), (32768, 1)):
+        # seq 64k needs per-layer rematerialization (saved intermediates
+        # alone exceed HBM — models/transformer.py remat_layers): --remat
+        # trades ~33% backward FLOPs for 8x the r1 context on one chip.
+        # log-every stays at each config's proven value: 5 for 16k/32k
+        # (two full green bench runs), 4 for the 64k point (validated
+        # standalone; steps=8 needs a chunk that divides it).
+        for seq_x, batch_x, steps_x, log_x, extra_x in (
+                (16384, 2, 10, 5, []), (32768, 1, 10, 5, []),
+                (65536, 1, 8, 4, ["--remat"])):
             log(f"bench: long-context seq {seq_x}...")
             lmx = run_job_e2e(
-                "transformer-lm", steps=10, batch=batch_x,
+                "transformer-lm", steps=steps_x, batch=batch_x,
                 extra=["--seq", str(seq_x), "--layers", str(lm_layers),
                        "--hidden", str(lm_hidden), "--heads", str(lm_heads),
-                       "--log-every", "5"],
+                       "--log-every", str(log_x), *extra_x],
                 timeout=1200,
             )
             lx = {e["event"]: e for e in lmx["events"]}
@@ -401,8 +414,10 @@ def _main() -> int:
             log(f"  ok={lmx['ok']} seq={seq_x} tokens/s={tpsx}")
             if seq_x == 16384:
                 lm16_ok, lm16_tps, lm16_seg = lmx["ok"], tpsx, lmx.get("segments")
-            else:
+            elif seq_x == 32768:
                 lm32_ok, lm32_tps, lm32_seg = lmx["ok"], tpsx, lmx.get("segments")
+            else:
+                lm64_ok, lm64_tps, lm64_seg = lmx["ok"], tpsx, lmx.get("segments")
 
     # --- Workload 4 (round 3): MoE transformer on the chip (ep=1 dense
     # dispatch) — pins the MoE compute path's perf, not just correctness
@@ -446,6 +461,11 @@ def _main() -> int:
         if lm32_tps:
             ftok32 = lm_train_flops_per_token(lm_layers, lm_hidden, 32768)
             lm32_mfu = round(lm32_tps * ftok32 / (peak * 1e12), 4)
+        if lm64_tps:
+            # model FLOPs only — remat recompute is device work, not model
+            # work (same rule as MoE capacity padding)
+            ftok64 = lm_train_flops_per_token(lm_layers, lm_hidden, 65536)
+            lm64_mfu = round(lm64_tps * ftok64 / (peak * 1e12), 4)
         if moe_tps:
             moe_mfu = round(moe_tps * moe_ftok / (peak * 1e12), 4)
     mxu = measure_mxu_ceiling() if on_tpu else None
@@ -479,6 +499,9 @@ def _main() -> int:
         "longctx32k_ok": lm32_ok,
         "longctx32k_tokens_per_sec": lm32_tps,
         "longctx32k_mfu": lm32_mfu,
+        "longctx64k_ok": lm64_ok,
+        "longctx64k_tokens_per_sec": lm64_tps,
+        "longctx64k_mfu": lm64_mfu,
         "moe_ok": moe["ok"],
         "moe_tokens_per_sec": moe_tps,
         "moe_mfu": moe_mfu,
@@ -505,6 +528,8 @@ def _main() -> int:
             lm16_mfu, lm_layers, lm_hidden, 16384),
         "longctx32k_mfu_causal_discounted": _discount(
             lm32_mfu, lm_layers, lm_hidden, 32768),
+        "longctx64k_mfu_causal_discounted": _discount(
+            lm64_mfu, lm_layers, lm_hidden, 65536),
         "resnet50_wallclock_s": resnet.get("wallclock_s"),
         "resnet50_image_size": rn_size,
         "resnet50_roofline": rn_roofline,
@@ -520,6 +545,7 @@ def _main() -> int:
         "longctx_segments": lm.get("segments"),
         "longctx16k_segments": lm16_seg,
         "longctx32k_segments": lm32_seg,
+        "longctx64k_segments": lm64_seg,
         "moe_segments": moe.get("segments"),
     }
     # A failed side-file write must not discard 30 minutes of measurements.

@@ -135,11 +135,6 @@ def cmd_operator(args) -> int:
     failed = threading.Event()  # startup failures must exit non-zero
 
     def lead() -> None:
-        # Leadership won: release the standby /healthz stub's port for the
-        # real ApiServer (stub exists only for in-cluster elected runs).
-        if health_stub is not None:
-            health_stub.shutdown()
-            health_stub.server_close()
         controller = TrainJobController(
             cluster,
             enable_gang=args.enable_gang_scheduling,
@@ -156,6 +151,14 @@ def cmd_operator(args) -> int:
             log.info("K8s informers synced (%s)", args.kube_api or "in-cluster")
         else:
             runtime = LocalProcessRuntime(cluster, log_dir=args.log_dir)
+        # Leadership won and informers synced: hand the port from the
+        # standby /healthz stub to the real ApiServer HERE (not at the top
+        # of lead() — controller construction + informer sync can take tens
+        # of seconds, and a probe gap that long would flip the just-promoted
+        # leader to NotReady mid-rollout).
+        if health_stub is not None:
+            health_stub.shutdown()
+            health_stub.server_close()
         # The API binds only on the leader: a hot standby must not collide on
         # the monitoring port while waiting for the lock. Default loopback —
         # the API is unauthenticated, so a routable bind is an explicit

@@ -513,6 +513,10 @@ def main(argv: list[str] | None = None) -> int:
         cfg = tfm.TransformerConfig(
             vocab_size=32000, num_layers=args.layers, hidden=args.hidden,
             num_heads=args.heads, max_len=args.seq, causal=True,
+            # --remat also remats per layer: at seq 64k the saved per-layer
+            # intermediates alone exceed the chip (models/transformer.py
+            # remat_layers note) — this is what makes 64k trainable.
+            remat_layers=args.remat,
         )
         attn = make_attention_fn(mesh, causal=True)
         model = tfm.TransformerLM(cfg, attn_fn=attn)
