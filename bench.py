@@ -188,6 +188,23 @@ def device_peak_tflops(device_kind: str | None) -> float | None:
     return None
 
 
+def tunnel_alive(timeout: float = 60.0) -> bool:
+    """Quick accelerator-dial probe in a subprocess. A SIGKILLed trainer
+    can wedge the tunnel's chip grant (observed: every later dial blocks
+    forever); after a failed job this decides whether running the
+    remaining chip workloads is pointless."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout,
+        )
+        return r.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
 def measure_mxu_ceiling() -> float | None:
     """Achievable bf16 TFLOP/s on this chip: 50 chained 8192^3 matmuls in
     one dispatch. Runs as a subprocess (the bench parent must stay jax-free:
@@ -276,21 +293,10 @@ def _main() -> int:
     # chip after idle pays ~10 s of tunnel establishment that no steady-
     # state job sees. Jobs still measure their full dial in
     # imports_and_backend_dial_s; this only removes the one-off cold spike.
-    # (skipped on hosts with no accelerator tunnel to warm — a JAX import
-    # subprocess on the CPU-only CI path would be pure waste)
-    platforms = os.environ.get("JAX_PLATFORMS", "").lower()
-    if (os.environ.get("PALLAS_AXON_POOL_IPS")
-            or "tpu" in platforms or "axon" in platforms):
-        log("bench: warming accelerator tunnel...")
-        import subprocess
-
-        try:
-            subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                capture_output=True, timeout=180,
-            )
-        except (subprocess.TimeoutExpired, OSError):
-            pass  # benches still run; first dial just shows the cold cost
+    # On CPU-only hosts this costs one jax import (~5 s) — cheaper than an
+    # env heuristic that could disagree with the backend-derived on_tpu.
+    log("bench: warming accelerator tunnel...")
+    tunnel_alive(timeout=180)
 
     # --- Workload 1 (north star): dist-MNIST through the operator ---
     log("bench: dist-MNIST e2e through operator...")
@@ -325,6 +331,22 @@ def _main() -> int:
     # --log-every <= steps/2 so a steady window exists past the first chunk
     # (the trainer reports null throughput without one).
     on_tpu = backend in ("tpu", "axon")
+
+    # Every chip workload below goes through chip_job: after ANY failed
+    # on-TPU job, one probe decides whether the tunnel is wedged (a
+    # SIGKILLed pod can wedge the chip grant — every later dial would then
+    # block for its full timeout) and the remaining chip jobs are skipped.
+    _state = {"tunnel_ok": True}
+
+    def chip_job(model, **kw):
+        if on_tpu and not _state["tunnel_ok"]:
+            log(f"bench: SKIP {model} (tunnel wedged)")
+            return {"ok": False, "events": [], "error": "tunnel wedged"}
+        r = run_job_e2e(model, **kw)
+        if on_tpu and not r["ok"]:
+            _state["tunnel_ok"] = tunnel_alive()
+            log(f"  tunnel_alive={_state['tunnel_ok']}")
+        return r
     rn_batch = 256 if on_tpu else 8
     rn_steps = 60 if on_tpu else 15
     rn_size = 224 if on_tpu else 64
@@ -332,7 +354,7 @@ def _main() -> int:
     rn_extra = ["--image-size", str(rn_size), "--profile-dir", rn_profile_dir]
     if not on_tpu:
         rn_extra += ["--log-every", "5"]
-    resnet = run_job_e2e(
+    resnet = chip_job(
         "resnet50", steps=rn_steps, batch=rn_batch, extra=rn_extra, timeout=1800,
     )
     rev = {e["event"]: e for e in resnet["events"]}
@@ -371,7 +393,7 @@ def _main() -> int:
     # on v5e: attention fwd+bwd 36.2 -> 68.5 TF/s, e2e 48.9k -> 72.4k tok/s
     # at seq 8k (tools/exp_flash_sweep.py).
     lm_layers, lm_hidden, lm_heads = (12, 768, 6) if on_tpu else (2, 128, 4)
-    lm = run_job_e2e(
+    lm = chip_job(
         "transformer-lm", steps=25 if on_tpu else 10, batch=lm_batch,
         extra=["--seq", str(lm_seq), "--layers", str(lm_layers),
                "--hidden", str(lm_hidden), "--heads", str(lm_heads),
@@ -401,7 +423,7 @@ def _main() -> int:
                 (16384, 2, 10, 5, []), (32768, 1, 10, 5, []),
                 (65536, 1, 8, 4, ["--remat"])):
             log(f"bench: long-context seq {seq_x}...")
-            lmx = run_job_e2e(
+            lmx = chip_job(
                 "transformer-lm", steps=steps_x, batch=batch_x,
                 extra=["--seq", str(seq_x), "--layers", str(lm_layers),
                        "--hidden", str(lm_hidden), "--heads", str(lm_heads),
@@ -427,13 +449,14 @@ def _main() -> int:
     moe_batch = 8 if on_tpu else 2
     moe_layers_n, moe_hidden, moe_heads = (12, 768, 6) if on_tpu else (2, 128, 4)
     moe_profile_dir = tempfile.mkdtemp(prefix="tpujob-bench-moeprof-")
-    moe = run_job_e2e(
-        "moe-lm", steps=20 if on_tpu else 15, batch=moe_batch,
-        extra=["--seq", str(moe_seq), "--layers", str(moe_layers_n),
-               "--hidden", str(moe_hidden), "--heads", str(moe_heads),
-               "--log-every", "5", "--profile-dir", moe_profile_dir],
-        timeout=1200,
-    )
+    if True:
+        moe = chip_job(
+            "moe-lm", steps=20 if on_tpu else 15, batch=moe_batch,
+            extra=["--seq", str(moe_seq), "--layers", str(moe_layers_n),
+                   "--hidden", str(moe_hidden), "--heads", str(moe_heads),
+                   "--log-every", "5", "--profile-dir", moe_profile_dir],
+            timeout=1200,
+        )
     mev = {e["event"]: e for e in moe["events"]}
     moe_eps = mev.get("done", {}).get("examples_per_sec")
     moe_tps = round(moe_eps * moe_seq, 1) if moe_eps else None
@@ -468,7 +491,7 @@ def _main() -> int:
             lm64_mfu = round(lm64_tps * ftok64 / (peak * 1e12), 4)
         if moe_tps:
             moe_mfu = round(moe_tps * moe_ftok / (peak * 1e12), 4)
-    mxu = measure_mxu_ceiling() if on_tpu else None
+    mxu = measure_mxu_ceiling() if on_tpu and _state["tunnel_ok"] else None
     log(f"  device={device_kind} peak={peak}TF/s measured-mxu={mxu}TF/s "
         f"resnet50_mfu={rn_mfu} longctx_mfu={lm_mfu} moe_mfu={moe_mfu}")
 
