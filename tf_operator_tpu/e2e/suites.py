@@ -252,7 +252,7 @@ def restart_exitcode_retryable(client: TrainJobClient) -> None:
         client.wait_for_replicas_serving(NS, name, 1)
         client.terminate_replicas(NS, name, "worker", exit_code=130)
         # The replacement pod serves again (start over), then exits cleanly.
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             ev = client.get_events(NS, name)
             if any(e["reason"] == "ExitedWithCode" for e in ev):
@@ -295,7 +295,7 @@ def restart_onfailure_restarts(client: TrainJobClient) -> None:
         client.wait_for_condition(NS, name, ("Running",) + TERMINAL)
         client.wait_for_replicas_serving(NS, name, 1)
         client.terminate_replicas(NS, name, "worker", exit_code=5)
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             pods = [p for p in client.list_pods(NS)
                     if p["name"] == f"{name}-worker-0"]
@@ -421,7 +421,7 @@ def elastic_scale_up_down(client: TrainJobClient) -> None:
         client.wait_for_condition(NS, name, ("Running",))
 
         client.scale(NS, name, {"Worker": 3})
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             pods = {p["name"] for p in client.list_pods(NS)
                     if p["name"].startswith(f"{name}-")}
@@ -434,7 +434,7 @@ def elastic_scale_up_down(client: TrainJobClient) -> None:
         assert job["manifest"]["spec"]["replicaSpecs"]["Worker"]["replicas"] == 3
 
         client.scale(NS, name, {"Worker": 1})
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             pods = {p["name"] for p in client.list_pods(NS)
                     if p["name"].startswith(f"{name}-")}
@@ -460,7 +460,7 @@ def suspend_resume_roundtrip(client: TrainJobClient) -> None:
         client.wait_for_condition(NS, name, ("Running",))
 
         client.suspend(NS, name)
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             pods = [p for p in client.list_pods(NS)
                     if p["name"].startswith(f"{name}-")]
