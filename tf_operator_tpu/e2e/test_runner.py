@@ -28,6 +28,8 @@ from tf_operator_tpu.e2e.trainjob_client import TrainJobClient
 
 @dataclass
 class TestCase:
+    __test__ = False  # not a pytest class (silences collection warning)
+
     name: str
     fn: object  # Callable[[TrainJobClient], None]
     trials: int = 1

@@ -82,7 +82,9 @@ def _with_body(cls):
     return cls
 
 
-RESNET50_FWD_GFLOPS_PER_IMG = 4.09  # 224x224, standard count (matches bench.py)
+# 224x224, 2-FLOPs-per-MAC convention (4.09 GMACs x 2), same scale as
+# bench.py's RESNET50_TRAIN_FLOPS_PER_IMG since the round-3 convention fix.
+RESNET50_FWD_GFLOPS_PER_IMG = 8.18
 
 
 def run_variant(name: str, batch: int, steps: int, image_size: int,
