@@ -223,3 +223,118 @@ class TestExpertSequenceParallel:
         assert all(np.isfinite(composed)), composed
         assert composed[-1] < composed[0], composed
         np.testing.assert_allclose(composed, plain, rtol=2e-2)
+
+
+class TestSparseDispatch:
+    """Dropless sorted-dispatch path (models/moe.py sparse_moe_ffn): ragged
+    grouped matmuls over expert-sorted token copies — the ep=1 perf path
+    (VERDICT r3 #2). No capacity, so it must agree EXACTLY with the
+    per-token oracle (the dense path only agrees when capacity is ample)."""
+
+    def _layer_and_params(self, top_k, dtype=jnp.float32):
+        cfg = moe_lib.MoEConfig(
+            hidden=32, mlp_ratio=2, num_experts=4, top_k=top_k,
+            dtype=dtype, dispatch="sparse",
+        )
+        x = jax.random.normal(jax.random.key(0), (2, 8, 32))
+        layer = moe_lib.MoEMlp(cfg)
+        params = layer.init(jax.random.key(1), x)["params"]
+        return cfg, layer, params, x
+
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_matches_per_token_oracle(self, top_k):
+        cfg, layer, params, x = self._layer_and_params(top_k)
+        y, _ = layer.apply({"params": params}, x, mutable=["moe_losses"])
+        y_ref = moe_lib.moe_reference_forward(params, cfg, x)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(y_ref), atol=1e-4, rtol=1e-4
+        )
+
+    def test_all_tokens_one_expert_none_dropped(self):
+        """Unlike the dense path (test_capacity_drops), a pathological
+        router that sends every token to one expert drops nothing."""
+        cfg, layer, params, x = self._layer_and_params(1)
+        params = dict(params)
+        params["router"] = (
+            jnp.zeros_like(params["router"]).at[:, 0].set(10.0)
+        )  # expert 0 dominates every token's routing
+        y, _ = layer.apply({"params": params}, x, mutable=["moe_losses"])
+        y_ref = moe_lib.moe_reference_forward(params, cfg, x)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(y_ref), atol=1e-4, rtol=1e-4
+        )
+
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_router_gets_gradient(self, top_k):
+        cfg, layer, params, x = self._layer_and_params(top_k)
+
+        def out_norm(p):
+            y, _ = layer.apply({"params": p}, x, mutable=["moe_losses"])
+            return (y.astype(jnp.float32) ** 2).mean()
+
+        g = jax.grad(out_norm)(params)
+        assert float(jnp.abs(g["router"]).max()) > 1e-4
+        assert float(jnp.abs(g["experts_in"]).max()) > 1e-4
+
+    def test_aux_losses_match_dense(self):
+        """balance/z-loss see the same router distribution in both paths
+        (ample capacity so dense drops nothing)."""
+        x = jax.random.normal(jax.random.key(0), (2, 8, 32))
+        vals = {}
+        for dispatch in ("dense", "sparse"):
+            cfg = moe_lib.MoEConfig(
+                hidden=32, mlp_ratio=2, num_experts=4, top_k=2,
+                capacity_factor=8.0, dtype=jnp.float32, dispatch=dispatch,
+            )
+            layer = moe_lib.MoEMlp(cfg)
+            params = layer.init(jax.random.key(1), x)["params"]
+            _, mut = layer.apply({"params": params}, x,
+                                 mutable=["moe_losses"])
+            flat, _ = jax.tree_util.tree_flatten_with_path(
+                mut["moe_losses"]
+            )
+            vals[dispatch] = sorted(
+                (str(p), float(jnp.asarray(v).sum())) for p, v in flat
+            )
+        for (n_d, v_d), (n_s, v_s) in zip(vals["dense"], vals["sparse"]):
+            assert n_d == n_s
+            np.testing.assert_allclose(v_d, v_s, rtol=1e-4)
+
+    def test_sparse_train_step_descends(self):
+        """Full jitted LM train step on a dp mesh (ep=1 — the bench
+        configuration) with sparse dispatch."""
+        mesh = mesh_lib.make_mesh({"dp": 8})
+        cfg = moe_lib.MoEConfig(
+            vocab_size=1024, num_layers=2, hidden=128, num_heads=4,
+            max_len=256, num_experts=4, top_k=2, moe_every=1,
+            dispatch="sparse",
+        )
+        model = moe_lib.MoETransformerLM(cfg)
+        params = model.init(
+            jax.random.key(0), jnp.zeros((1, 32), jnp.int32)
+        )["params"]
+
+        def loss_fn(params, model_state, batch, rng):
+            return (
+                moe_lib.moe_lm_loss(model, params, batch["tokens"]),
+                model_state,
+            )
+
+        tx = optax.adam(1e-3)
+        state = shard_state(
+            create_train_state(params, tx), mesh, sharding_rules.MOE_RULES
+        )
+        step, _ = make_train_step(
+            loss_fn, tx, mesh, rules=sharding_rules.MOE_RULES
+        )
+        batch = {
+            "tokens": jax.random.randint(
+                jax.random.key(1), (8, 32), 0, cfg.vocab_size
+            )
+        }
+        losses = []
+        for i in range(4):
+            state, metrics = step(state, batch, jax.random.key(i))
+            losses.append(float(metrics["loss"]))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]

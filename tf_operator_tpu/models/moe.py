@@ -41,6 +41,19 @@ class MoEConfig:
     zloss_coef: float = 1e-3    # router logit z-loss weight
     causal: bool = True
     dtype: jnp.dtype = jnp.bfloat16
+    # Token->expert dispatch formulation:
+    #   "dense"  — GShard one-hot einsums with static capacity. Every shape
+    #              is expert-count-independent, so sharding the stacked
+    #              expert weights over `ep` makes XLA insert the all-to-all;
+    #              the price is dead compute (capacity padding) and the
+    #              [B,T,E,C] dispatch/combine einsums themselves.
+    #   "sparse" — sort-by-expert + ragged grouped matmul (Megablocks
+    #              formulation): no capacity, no dropped tokens, no padding
+    #              FLOPs. Experts must be local (ep=1) — the sorted layout
+    #              is token-order-dependent, which GSPMD cannot re-shard
+    #              automatically. This is the single-chip/ep=1 perf path
+    #              (VERDICT r3 #2); dense stays the ep>1 path.
+    dispatch: str = "dense"
 
     @property
     def head_dim(self) -> int:
@@ -124,6 +137,83 @@ def topk_routing(
     return combine, dispatch, aux
 
 
+def _grouped_matmul(
+    x: jax.Array, w: jax.Array, group_sizes: jax.Array
+) -> jax.Array:
+    """[M, K] x [E, K, N] -> [M, N] where rows of x are grouped by expert
+    (group_sizes[e] consecutive rows use w[e]).
+
+    Default engine is `lax.ragged_dot` (XLA ragged dot, differentiable).
+    TPUJOB_MOE_GMM=megablox swaps in the pallas megablocks gmm kernel
+    (jax.experimental.pallas.ops.tpu.megablox) on TPU — kept switchable so
+    the bench can measure both lowerings on the chip.
+    """
+    import os
+
+    if os.environ.get("TPUJOB_MOE_GMM") == "megablox":
+        # the package re-exports the gmm custom_vjp function itself
+        from jax.experimental.pallas.ops.tpu.megablox import gmm as _gmm
+
+        return _gmm(x, w, group_sizes.astype(jnp.int32))
+    return jax.lax.ragged_dot(x, w, group_sizes.astype(jnp.int32))
+
+
+def sparse_moe_ffn(
+    x: jax.Array,
+    w_router: jax.Array,
+    experts_in: jax.Array,
+    experts_out: jax.Array,
+    cfg: MoEConfig,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Dropless sorted dispatch (Megablocks): route, sort token copies by
+    expert, run the expert FFNs as ragged grouped matmuls over contiguous
+    groups, unsort, and gate-combine.
+
+    Static shapes throughout: every token contributes exactly top_k rows
+    ([N*K, H] workset), the per-expert split lives in `group_sizes` data —
+    not in shapes — so jit traces once. No capacity limit: unlike the dense
+    path nothing is dropped, which also makes this path agree exactly with
+    `moe_reference_forward`. All data movement is gathers over a permutation
+    (argsort + inverse), never duplicate-index scatters.
+    """
+    b, t, h = x.shape
+    n = b * t
+    k = cfg.top_k
+    xf = x.reshape(n, h)
+
+    logits = xf.astype(jnp.float32) @ w_router                  # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)                        # [N, K]
+    if k > 1:
+        topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+    # top_k == 1 keeps the raw softmax prob (Switch eq. 2) — see topk_routing.
+
+    flat_e = topi.reshape(n * k)          # assignment a <-> token a // k
+    order = jnp.argsort(flat_e)           # stable: groups rows by expert
+    token_of = order // k                 # source token per sorted row
+    group_sizes = jnp.bincount(flat_e, length=cfg.num_experts)
+
+    x_sorted = jnp.take(xf, token_of, axis=0).astype(cfg.dtype)  # [NK, H]
+    hmid = _grouped_matmul(x_sorted, experts_in.astype(cfg.dtype), group_sizes)
+    hmid = nn.gelu(hmid)
+    y_sorted = _grouped_matmul(hmid, experts_out.astype(cfg.dtype), group_sizes)
+
+    gate_sorted = jnp.take(topv.reshape(n * k), order).astype(cfg.dtype)
+    weighted = gate_sorted[:, None] * y_sorted                   # [NK, H]
+    inv = jnp.argsort(order)               # inverse permutation: unsort
+    y = jnp.take(weighted, inv, axis=0).reshape(n, k, h).sum(axis=1)
+
+    aux = {
+        # fraction of tokens whose FIRST choice is expert e (Switch f_e)
+        "fraction": jax.nn.one_hot(
+            topi[:, 0], cfg.num_experts, dtype=jnp.float32
+        ).mean(axis=0),
+        "prob": probs.mean(axis=0),
+        "logits": logits.reshape(b, t, cfg.num_experts),
+    }
+    return y.reshape(b, t, h), aux
+
+
 def load_balance_loss(aux: dict, num_experts: int) -> jax.Array:
     """Switch-transformer load-balancing loss: E * sum_e f_e * p_e (== 1.0 at
     perfect uniformity)."""
@@ -165,6 +255,13 @@ class MoEMlp(nn.Module):
             "experts_out", expert_init,
             (cfg.num_experts, cfg.ffn, h), jnp.float32,
         )
+
+        if cfg.dispatch == "sparse":
+            y, aux = sparse_moe_ffn(x, w_router, experts_in, experts_out, cfg)
+            self.sow("moe_losses", "balance",
+                     load_balance_loss(aux, cfg.num_experts))
+            self.sow("moe_losses", "zloss", router_z_loss(aux))
+            return y
 
         # Router math in f32 (bf16 softmax over experts is too coarse).
         logits = jnp.einsum("bth,he->bte", x.astype(jnp.float32), w_router)

@@ -323,6 +323,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="transformer-lm/moe-lm width")
     ap.add_argument("--heads", type=int, default=8,
                     help="transformer-lm/moe-lm attention heads")
+    ap.add_argument("--moe-dispatch", default="dense",
+                    choices=["dense", "sparse"],
+                    help="moe-lm token dispatch: dense = GShard capacity "
+                         "einsums (ep-shardable); sparse = dropless sorted "
+                         "ragged matmul (ep=1 perf path)")
     ap.add_argument("--remat", action="store_true",
                     help="activation checkpointing: rematerialize the loss, "
                          "and (transformer-lm) each block — saves only "
@@ -485,7 +490,7 @@ def main(argv: list[str] | None = None) -> int:
         cfg = moe_lib.MoEConfig(
             vocab_size=32000, num_layers=args.layers, hidden=args.hidden,
             num_heads=args.heads, max_len=args.seq, num_experts=8, top_k=2,
-            moe_every=2,
+            moe_every=2, dispatch=args.moe_dispatch,
         )
         attn = make_attention_fn(mesh, causal=True)
         model = moe_lib.MoETransformerLM(cfg, attn_fn=attn)

@@ -1,0 +1,112 @@
+"""MoE dispatch-formulation sweep on one chip (VERDICT r3 next #2 evidence).
+
+Times one full train step (fwd+bwd+adam) of the bench moe-lm config
+(12L x 768h, 8 experts top-2, every 2nd block, seq 2048, batch 8) under:
+
+  dense            GShard one-hot capacity einsums (rounds 1-3 path)
+  sparse-ragged    sort-by-expert + lax.ragged_dot (XLA ragged dot)
+  sparse-megablox  sort-by-expert + pallas megablocks gmm kernel
+                   (TPUJOB_MOE_GMM=megablox)
+
+Each variant runs in a SUBPROCESS (the chip admits one process at a time,
+and TPUJOB_MOE_GMM is read at trace time). Prints one JSON line per variant:
+step time, tokens/s, and MFU at the bench's FLOPs accounting
+(bench.moe_train_flops_per_token — active-parameter FLOPs; capacity padding
+and routing are device work, not model work, in EVERY variant, so the
+comparison is apples-to-apples).
+
+Usage: python tools/exp_moe_dispatch.py [--steps 20] [--variants dense,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import json, os, sys, time
+import jax, jax.numpy as jnp, optax
+
+sys.path.insert(0, {repo!r})
+from tf_operator_tpu.models import moe as moe_lib
+from tf_operator_tpu.parallel import mesh as mesh_lib
+from tf_operator_tpu.parallel import sharding_rules
+from tf_operator_tpu.parallel.train_step import (
+    create_train_state, make_train_step, shard_state,
+)
+
+variant = {variant!r}
+steps = {steps}
+seq, batch = 2048, 8
+cfg = moe_lib.MoEConfig(
+    vocab_size=32000, num_layers=12, hidden=768, num_heads=6,
+    max_len=seq, num_experts=8, top_k=2, moe_every=2,
+    dispatch="dense" if variant == "dense" else "sparse",
+)
+mesh = mesh_lib.make_mesh({{"dp": 1}})
+model = moe_lib.MoETransformerLM(cfg)
+params = model.init(jax.random.key(0), jnp.zeros((1, seq), jnp.int32))["params"]
+
+def loss_fn(params, model_state, batch, rng):
+    return moe_lib.moe_lm_loss(model, params, batch["tokens"]), model_state
+
+tx = optax.adamw(1e-3)
+state = shard_state(create_train_state(params, tx), mesh,
+                    sharding_rules.MOE_RULES)
+tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0, cfg.vocab_size)
+step, _ = make_train_step(loss_fn, tx, mesh, rules=sharding_rules.MOE_RULES)
+state, m = step(state, {{"tokens": tokens}}, jax.random.key(0))
+float(m["loss"])  # host sync: the axon backend's block_until_ready is a no-op
+t0 = time.perf_counter()
+for i in range(steps):
+    state, m = step(state, {{"tokens": tokens}}, jax.random.key(i))
+loss = float(m["loss"])  # host sync closes the timed window
+dt = (time.perf_counter() - t0) / steps
+sys.path.insert(0, {repo!r})
+from bench import device_peak_tflops, moe_train_flops_per_token
+kind = getattr(jax.devices()[0], "device_kind", "")
+peak = device_peak_tflops(kind)
+tps = batch * seq / dt
+ftok = moe_train_flops_per_token(12, 768, seq)
+print(json.dumps({{
+    "variant": variant, "step_ms": round(dt * 1e3, 2),
+    "tokens_per_sec": round(tps, 1),
+    "mfu": round(tps * ftok / (peak * 1e12), 4) if peak else None,
+    "device": kind, "loss": round(loss, 3),
+}}))
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--variants",
+                    default="dense,sparse-ragged,sparse-megablox")
+    args = ap.parse_args()
+    rc = 0
+    for variant in args.variants.split(","):
+        env = dict(os.environ)
+        env.pop("TPUJOB_MOE_GMM", None)
+        if variant == "sparse-megablox":
+            env["TPUJOB_MOE_GMM"] = "megablox"
+        r = subprocess.run(
+            [sys.executable, "-c",
+             CHILD.format(repo=REPO, variant=variant, steps=args.steps)],
+            env=env, capture_output=True, text=True, timeout=1200,
+        )
+        if r.returncode != 0:
+            print(json.dumps({"variant": variant, "error":
+                              r.stderr.strip().splitlines()[-1:]}))
+            rc = 1
+            continue
+        print(r.stdout.strip().splitlines()[-1])
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
