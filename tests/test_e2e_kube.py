@@ -20,13 +20,29 @@ from tf_operator_tpu.e2e.trainjob_client import TrainJobClient
 from tf_operator_tpu.testing.fake_apiserver import FakeApiServer
 
 
+from tf_operator_tpu.e2e.operator_fixture import _free_port  # noqa: E402
+
+
 @pytest.fixture(scope="module")
 def kube_client(tmp_path_factory):
+    """Full deployment shape: fake apiserver consults the operator's
+    admission webhook (manifests/webhook.yaml registration), operator
+    reconciles over the wire, kubelet feeds pod status back."""
     log_dir = str(tmp_path_factory.mktemp("kube-e2e"))
-    with FakeApiServer() as fake:
-        with OperatorProcess(log_dir, extra_args=["--kube-api", fake.url]) as op:
+    webhook_port = _free_port()
+    with FakeApiServer(admission_webhooks={
+        "trainjobs": f"http://127.0.0.1:{webhook_port}/validate"
+    }) as fake:
+        with OperatorProcess(
+            log_dir,
+            extra_args=["--kube-api", fake.url,
+                        "--webhook-port", str(webhook_port),
+                        "--webhook-bind", "127.0.0.1"],
+        ) as op:
             with KubeletProcess(fake.url, log_dir):
-                yield TrainJobClient(op.server)
+                client = TrainJobClient(op.server)
+                client.apiserver_url = fake.url
+                yield client
 
 
 class TestKubeSubstrateSuites:
@@ -50,6 +66,36 @@ class TestKubeSubstrateSuites:
 
     def test_invalid_rejected_at_admission(self, kube_client):
         suites.invalid_rejected_at_admission(kube_client)
+
+    def test_invalid_rejected_at_admission_kubectl_path(self, kube_client):
+        """The kubectl path (raw POST to the apiserver, bypassing the
+        operator's REST API): the registered webhook — not the operator's
+        own server — must reject the semantically-invalid CR with 400
+        (VERDICT r3 next #4). Structurally it is schema-clean, so only
+        webhook admission can catch it."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        from tf_operator_tpu.api import compat
+        from tf_operator_tpu.core.k8s import job_to_k8s
+
+        # native tpujob.dev/v1 TrainJob shape (what kubectl would apply)
+        bad = job_to_k8s(compat.job_from_dict(
+            suites.manifest("e2e-kubectl-invalid",
+                            {"Chief": (2, suites.sleep_cmd(1))}),
+            apply_defaults=False,
+        ))
+        req = urllib.request.Request(
+            f"{kube_client.apiserver_url}/apis/tpujob.dev/v1/namespaces/"
+            "default/trainjobs",
+            data=json.dumps(bad).encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 400
+        assert "webhook" in json.loads(exc.value.read())["message"]
 
     def test_pod_names_contract(self, kube_client):
         suites.pod_names_contract(kube_client)

@@ -1,0 +1,199 @@
+"""Gang-scheduling protocol conformance over the wire substrate
+(VERDICT r3 next #7): the operator's half of the volcano/kube-batch
+contract, proven against a scheduler DOUBLE that actually admits/denies
+PodGroups and binds pods (testing/fake_scheduler.py).
+
+Reference anchor: the real semantics were co-defined by kube-batch
+(/root/reference/pkg/common/jobcontroller/jobcontroller.go:226-250) — the
+operator creates the PodGroup + the whole gang's pods with schedulerName and
+the group annotation; an external scheduler binds them all-or-nothing. The
+kubelet runs in external-scheduler mode (runtime/local.py), so unbound pods
+observably stay Pending.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import pytest
+
+from tf_operator_tpu.api import defaults
+from tf_operator_tpu.api.types import (
+    ContainerSpec,
+    JobConditionType,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    ReplicaType,
+    TrainJob,
+    TrainJobSpec,
+    is_succeeded,
+)
+from tf_operator_tpu.core.k8s import K8sApi, K8sCluster
+from tf_operator_tpu.core.trainjob_controller import TrainJobController
+from tf_operator_tpu.gang.podgroup import ANNOTATION_GROUP_NAME
+from tf_operator_tpu.runtime.local import LocalProcessRuntime
+from tf_operator_tpu.testing.fake_apiserver import FakeApiServer
+from tf_operator_tpu.testing.fake_scheduler import FakeGangScheduler
+
+
+def _gang_job(name: str, workers: int, sleep_s: float = 0.3,
+              min_available: int | None = None) -> TrainJob:
+    job = TrainJob(
+        metadata=ObjectMeta(name=name),
+        spec=TrainJobSpec(replica_specs={
+            ReplicaType.WORKER: ReplicaSpec(
+                replicas=workers,
+                template=PodTemplateSpec(containers=[ContainerSpec(
+                    name="tensorflow", image="local",
+                    command=[sys.executable, "-c",
+                             f"import time; time.sleep({sleep_s})"],
+                )]),
+            )
+        }),
+    )
+    defaults.set_defaults(job)
+    job.spec.run_policy.scheduling.gang = True
+    if min_available is not None:
+        job.spec.run_policy.scheduling.min_available = min_available
+    return job
+
+
+class _Deployment:
+    """Operator + external-scheduler kubelet, both over the wire (two
+    adapters on one fake apiserver — the two-process deployment shape,
+    in-process for speed)."""
+
+    def __init__(self, server: FakeApiServer, log_dir: str):
+        self.api = K8sApi(server.url)
+        self.op_cluster = K8sCluster(self.api)
+        self.controller = TrainJobController(self.op_cluster, enable_gang=True)
+        self.kubelet_cluster = K8sCluster(K8sApi(server.url))
+        self.runtime = LocalProcessRuntime(
+            self.kubelet_cluster, log_dir=log_dir, external_scheduler=True,
+        )
+
+    def start(self):
+        self.op_cluster.start()
+        from tf_operator_tpu.core.cluster import KIND_POD
+
+        self.kubelet_cluster.start((KIND_POD,))
+        assert self.op_cluster.wait_synced(10)
+        assert self.kubelet_cluster.wait_synced(10)
+        self.controller.run(workers=2)
+        return self
+
+    def stop(self):
+        self.controller.stop()
+        self.runtime.stop()
+        self.op_cluster.stop()
+        self.kubelet_cluster.stop()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def _wait(predicate, timeout=30.0, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = predicate()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {what}")
+
+
+def _job_pods(server: FakeApiServer, name: str) -> list[dict]:
+    return [
+        o for o in server.list_objects("pods")
+        if o["metadata"]["name"].startswith(f"{name}-")
+    ]
+
+
+class TestGangConformance:
+    def test_pods_pending_until_scheduler_admits(self, tmp_path):
+        """Without the scheduler: whole gang created, annotated, unbound,
+        NOT executing. With it: bound all-at-once, runs, succeeds."""
+        with FakeApiServer() as server, \
+                _Deployment(server, str(tmp_path)) as dep:
+            dep.op_cluster.create_job(_gang_job("gangwait", workers=2))
+            pods = _wait(lambda: len(_job_pods(server, "gangwait")) == 2
+                         and _job_pods(server, "gangwait"),
+                         what="gang pods created")
+            # operator half: schedulerName + group annotation on every pod
+            for p in pods:
+                assert p["spec"]["schedulerName"] == "volcano"
+                assert (p["metadata"]["annotations"][ANNOTATION_GROUP_NAME]
+                        == "gangwait")
+            pg = server.get_object("podgroups", "default",
+                                   "gangwait")
+            assert pg is not None and pg["spec"]["minMember"] == 2
+            # no scheduler running: pods must stay unbound + Pending
+            time.sleep(1.0)
+            for p in _job_pods(server, "gangwait"):
+                assert not p["spec"].get("nodeName")
+                assert (p.get("status") or {}).get("phase", "Pending") \
+                    == "Pending"
+            # now run the scheduler double: gang binds, job completes
+            with FakeGangScheduler(dep.api) as sched:
+                _wait(lambda: is_succeeded(
+                    dep.op_cluster.get_job("default", "gangwait").status),
+                    what="job success after gang admission")
+                bound = [d for d in sched.decisions if d.action == "bound"]
+                assert len(bound) == 1 and len(bound[0].pods) == 2
+            # PodGroup deleted on completion (operator half, teardown leg)
+            _wait(lambda: server.get_object(
+                "podgroups", "default", "gangwait") is None,
+                what="podgroup deleted after job completion")
+
+    def test_min_member_honored(self, tmp_path):
+        """minMember > created pods: the double must never bind (the
+        operator publishes minMember; the scheduler enforces it)."""
+        with FakeApiServer() as server, \
+                _Deployment(server, str(tmp_path)) as dep, \
+                FakeGangScheduler(dep.api) as sched:
+            dep.op_cluster.create_job(
+                _gang_job("undersized", workers=2, min_available=3))
+            _wait(lambda: len(_job_pods(server, "undersized")) == 2,
+                  what="pods created")
+            _wait(lambda: any(d.action == "denied" and "2/3" in d.reason
+                              for d in sched.decisions),
+                  what="denial recorded")
+            for p in _job_pods(server, "undersized"):
+                assert not p["spec"].get("nodeName")
+
+    def test_partial_capacity_denied_all_or_nothing(self, tmp_path):
+        """Two 3-pod gangs on a 3-seat cluster: the second gang gets
+        NOTHING while the first runs (no partial binding), then binds as a
+        whole once seats free up."""
+        with FakeApiServer() as server, \
+                _Deployment(server, str(tmp_path)) as dep, \
+                FakeGangScheduler(dep.api, capacity_pods=3) as sched:
+            dep.op_cluster.create_job(_gang_job("ga", workers=3, sleep_s=1.0))
+            _wait(lambda: [d for d in sched.decisions
+                           if d.group == "default/ga"
+                           and d.action == "bound"],
+                  what="gang A bound")
+            dep.op_cluster.create_job(_gang_job("gb", workers=3,
+                                                sleep_s=0.2))
+            _wait(lambda: any(d.group == "default/gb"
+                              and d.action == "denied"
+                              for d in sched.decisions),
+                  what="gang B denied while A holds the seats")
+            # while denied, NO pod of B is bound (all-or-nothing)
+            for p in _job_pods(server, "gb"):
+                assert not p["spec"].get("nodeName")
+            # A finishes -> seats free -> B binds whole and succeeds
+            _wait(lambda: is_succeeded(
+                dep.op_cluster.get_job("default", "gb").status),
+                timeout=60, what="gang B runs after A frees capacity")
+            b_bound = [d for d in sched.decisions
+                       if d.group == "default/gb"
+                       and d.action == "bound"]
+            assert len(b_bound) == 1 and len(b_bound[0].pods) == 3
+            assert is_succeeded(
+                dep.op_cluster.get_job("default", "ga").status)

@@ -768,6 +768,318 @@ class TestApiServerConformance:
         assert api.watch_rvs[2] == "5"   # fresh relist after 410
 
 
+class TestMergePatch:
+    """JSON merge-patch conformance (VERDICT r3 next #5): the fake apiserver
+    speaks application/merge-patch+json with the real server's semantics,
+    and the adapter's contended status writes go through it so they never
+    fight other writers on resourceVersion the way whole-object PUTs do
+    (ref pkg/control/pod_control.go:104-126 PatchPod)."""
+
+    def _raw(self, server, method, path, body, ctype="application/json"):
+        req = urllib.request.Request(
+            f"{server.url}{path}", data=json.dumps(body).encode(),
+            method=method, headers={"Content-Type": ctype},
+        )
+        return urllib.request.urlopen(req)
+
+    def _job_path(self, name):
+        return (f"/apis/{TrainJob.API_VERSION}/namespaces/default/"
+                f"{TrainJob.PLURAL}/{name}")
+
+    def test_patch_lands_where_stale_put_conflicts(self):
+        """The defining difference: writer A bumps rv; writer B's
+        whole-object PUT from the stale view 409s, but writer B's
+        merge-patch of its own field lands."""
+        with FakeApiServer() as server:
+            api = K8sApi(server.url)
+            job = job_to_k8s(_mk_job("contended", workers=1))
+            with self._raw(server, "POST",
+                           self._job_path("")[: -1], job) as r:
+                assert r.status == 201
+            stale = api.request("GET", self._job_path("contended"))
+            # writer A: an independent spec edit bumps the rv
+            fresh = dict(stale)
+            fresh["metadata"] = dict(stale["metadata"])
+            api.request("PUT", self._job_path("contended"), fresh)
+            # writer B, stale PUT -> 409
+            from tf_operator_tpu.core.k8s import ConflictError
+            with pytest.raises(ConflictError):
+                api.request("PUT", self._job_path("contended"), stale)
+            # writer B, merge-patch -> lands regardless of rv drift
+            out = api.merge_patch(
+                self._job_path("contended"),
+                {"metadata": {"annotations": {"who": "writer-b"}}},
+            )
+            assert out["metadata"]["annotations"]["who"] == "writer-b"
+
+    def test_merge_semantics_null_deletes_arrays_replace(self):
+        with FakeApiServer() as server:
+            api = K8sApi(server.url)
+            job = job_to_k8s(_mk_job("merge", workers=1))
+            job["metadata"]["annotations"] = {"keep": "1", "drop": "2"}
+            with self._raw(server, "POST", self._job_path("")[: -1], job) as r:
+                assert r.status == 201
+            out = api.merge_patch(
+                self._job_path("merge"),
+                {"metadata": {"annotations": {"drop": None, "new": "3"}}},
+            )
+            anns = out["metadata"]["annotations"]
+            assert anns == {"keep": "1", "new": "3"}  # recursive merge + delete
+            # arrays replace wholesale (no strategic merge-by-key)
+            api.merge_patch(
+                self._job_path("merge") + "/status",
+                {"status": {"conditions": [
+                    {"type": "Created", "status": "True"}]}},
+            )
+            api.merge_patch(
+                self._job_path("merge") + "/status",
+                {"status": {"conditions": [
+                    {"type": "Running", "status": "True"}]}},
+            )
+            got = api.request("GET", self._job_path("merge"))
+            assert [c["type"] for c in got["status"]["conditions"]] == ["Running"]
+
+    def test_status_subresource_patch_ignores_spec(self):
+        with FakeApiServer() as server:
+            api = K8sApi(server.url)
+            job = job_to_k8s(_mk_job("statusonly", workers=1))
+            with self._raw(server, "POST", self._job_path("")[: -1], job) as r:
+                assert r.status == 201
+            before = api.request("GET", self._job_path("statusonly"))
+            api.merge_patch(
+                self._job_path("statusonly") + "/status",
+                {"spec": {"runPolicy": {"suspend": True}},
+                 "status": {"startTime": 12.5}},
+            )
+            after = api.request("GET", self._job_path("statusonly"))
+            assert after["spec"] == before["spec"]  # spec untouched
+            assert after["status"]["startTime"] == 12.5
+
+    def test_patch_rv_precondition_and_unsupported_type(self):
+        with FakeApiServer() as server:
+            api = K8sApi(server.url)
+            job = job_to_k8s(_mk_job("pre", workers=1))
+            with self._raw(server, "POST", self._job_path("")[: -1], job) as r:
+                assert r.status == 201
+            from tf_operator_tpu.core.k8s import ConflictError
+            # a patch that DOES carry rv keeps optimistic concurrency
+            with pytest.raises(ConflictError):
+                api.merge_patch(
+                    self._job_path("pre"),
+                    {"metadata": {"resourceVersion": "999999",
+                                  "annotations": {"x": "y"}}},
+                )
+            # only merge-patch is modeled; json-patch gets 415
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                self._raw(server, "PATCH", self._job_path("pre"),
+                          [{"op": "add", "path": "/metadata/labels",
+                            "value": {}}],
+                          ctype="application/json-patch+json")
+            assert exc.value.code == 415
+
+    def test_patched_invalid_object_still_schema_checked(self):
+        with FakeApiServer() as server:
+            api = K8sApi(server.url)
+            job = job_to_k8s(_mk_job("schema", workers=1))
+            with self._raw(server, "POST", self._job_path("")[: -1], job) as r:
+                assert r.status == 201
+            from tf_operator_tpu.core.k8s import ApiError
+            with pytest.raises(ApiError, match="422"):
+                api.merge_patch(
+                    self._job_path("schema"),
+                    {"spec": {"runPolicy": {"backoffLimit": -5}}},
+                )
+
+    def test_patch_does_not_rewrite_watch_history(self):
+        """A patch (or /status PUT) must not mutate objects already in the
+        watch log: _merge_patch shallow-shares unpatched subtrees, and an
+        in-place rv write would retroactively bump old events' rvs —
+        resuming informers would adopt a too-new resume point and skip
+        real events (review r4 finding)."""
+        with FakeApiServer() as server:
+            api = K8sApi(server.url)
+            job = job_to_k8s(_mk_job("history", workers=1))
+            with self._raw(server, "POST", self._job_path("")[: -1], job) as r:
+                created = json.loads(r.read())
+            rv_created = created["metadata"]["resourceVersion"]
+            api.merge_patch(
+                self._job_path("history") + "/status",
+                {"status": {"startTime": 1.0}},
+            )
+            api.merge_patch(
+                self._job_path("history"),
+                {"metadata": {"annotations": {"a": "b"}}},
+            )
+            # replay the watch log from the beginning: the ADDED event must
+            # still carry the CREATION rv, not the post-patch one
+            u = (f"{server.url}/apis/{TrainJob.API_VERSION}/"
+                 f"{TrainJob.PLURAL}?watch=true&resourceVersion=0")
+            with urllib.request.urlopen(u, timeout=5) as resp:
+                ev = json.loads(next(iter(resp)))
+            assert ev["type"] == "ADDED"
+            assert ev["object"]["metadata"]["resourceVersion"] == rv_created
+            # and a 422-rejected patch must leave the store untouched
+            from tf_operator_tpu.core.k8s import ApiError
+            before = api.request("GET", self._job_path("history"))
+            with pytest.raises(ApiError, match="422"):
+                api.merge_patch(
+                    self._job_path("history"),
+                    {"spec": {"runPolicy": {"backoffLimit": -1}}},
+                )
+            assert api.request("GET", self._job_path("history")) == before
+
+    def test_adapter_status_writes_are_patches(self):
+        """update_job_status must not 409 against a concurrent spec editor
+        holding the write 'lock' (rv) — the adapter's write is a patch."""
+        with FakeApiServer() as server:
+            api = K8sApi(server.url)
+            cluster = K8sCluster(api)
+            job = _mk_job("adapter", workers=1)
+            created = cluster.create_job(job)
+            # concurrent editor bumps rv behind the adapter's back
+            raw = api.request("GET", self._job_path("adapter"))
+            api.request("PUT", self._job_path("adapter"), dict(raw))
+            # adapter writes status from its stale typed copy
+            from tf_operator_tpu.api.types import (
+                JobCondition,
+                JobConditionType,
+            )
+            created.metadata.annotations["slice"] = "0"
+            created.status.conditions.append(
+                JobCondition(type=JobConditionType.CREATED, status=True,
+                             reason="TJCreated", message="ok",
+                             last_update_time=1.0, last_transition_time=1.0)
+            )
+            updated = cluster.update_job_status(created)  # must not raise
+            assert any(c.type == JobConditionType.CREATED
+                       for c in updated.status.conditions)
+            got = api.request("GET", self._job_path("adapter"))
+            assert got["metadata"]["annotations"]["slice"] == "0"
+
+
+class TestAdmissionWebhook:
+    """ValidatingAdmissionWebhook (VERDICT r3 next #4): semantic validation
+    at admission on the K8s substrate. The fake apiserver consults the
+    webhook like a registered ValidatingWebhookConfiguration
+    (manifests/webhook.yaml); cli/webhook.py reuses api/validation.py —
+    the same invariants as the reference's validation.go:27-73, but
+    enforced BEFORE storage instead of informer.go's tolerate-and-fail."""
+
+    def _post_raw(self, server, obj: dict):
+        req = urllib.request.Request(
+            f"{server.url}/apis/{TrainJob.API_VERSION}/namespaces/default/"
+            f"{TrainJob.PLURAL}",
+            data=json.dumps(obj).encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        return urllib.request.urlopen(req)
+
+    def test_semantically_invalid_rejected_at_admission(self):
+        from tf_operator_tpu.cli.webhook import AdmissionWebhookServer
+
+        with AdmissionWebhookServer() as hook:
+            with FakeApiServer(
+                admission_webhooks={TrainJob.PLURAL: hook.url}
+            ) as server:
+                # valid CR sails through
+                with self._post_raw(
+                        server, job_to_k8s(_mk_job("ok-job"))) as r:
+                    assert r.status == 201
+                # two chiefs: structurally valid (schema can't count),
+                # semantically invalid -> 400 at admission, nothing stored
+                bad = _mk_job("two-chiefs")
+                from tf_operator_tpu.api.types import ReplicaSpec
+                bad.spec.replica_specs[ReplicaType.CHIEF] = ReplicaSpec(
+                    replicas=2,
+                    template=PodTemplateSpec(containers=[
+                        ContainerSpec(name="tensorflow", image="img:1")]),
+                )
+                import urllib.error
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    self._post_raw(server, job_to_k8s(bad))
+                assert exc.value.code == 400
+                msg = json.loads(exc.value.read())["message"]
+                assert "chief" in msg.lower()
+                assert server.get_object(
+                    TrainJob.PLURAL, "default", "two-chiefs") is None
+
+    def test_update_and_patch_also_validated(self):
+        from tf_operator_tpu.cli.webhook import AdmissionWebhookServer
+
+        with AdmissionWebhookServer() as hook:
+            with FakeApiServer(
+                admission_webhooks={TrainJob.PLURAL: hook.url}
+            ) as server:
+                api = K8sApi(server.url)
+                with self._post_raw(
+                        server, job_to_k8s(_mk_job("mutate"))) as r:
+                    assert r.status == 201
+                path = (f"/apis/{TrainJob.API_VERSION}/namespaces/default/"
+                        f"{TrainJob.PLURAL}/mutate")
+                cur = api.request("GET", path)
+                # UPDATE that zeroes every replica spec -> denied
+                broken = json.loads(json.dumps(cur))
+                broken["spec"]["replicaSpecs"] = {}
+                from tf_operator_tpu.core.k8s import ApiError
+                with pytest.raises(ApiError, match="webhook"):
+                    api.request("PUT", path, broken)
+                # merge-patch producing the same invalid merged object is
+                # denied too (admission sees the MERGED object)
+                with pytest.raises(ApiError, match="webhook"):
+                    api.merge_patch(
+                        path, {"spec": {"replicaSpecs": None}})
+                # but a benign patch (annotation) passes admission
+                out = api.merge_patch(
+                    path, {"metadata": {"annotations": {"a": "b"}}})
+                assert out["metadata"]["annotations"]["a"] == "b"
+                # status subresource writes bypass admission (real webhooks
+                # only register the main resource in webhook.yaml rules)
+                api.merge_patch(path + "/status",
+                                {"status": {"startTime": 1.0}})
+
+    def test_unreachable_webhook_fails_closed(self):
+        from tf_operator_tpu.cli.webhook import AdmissionWebhookServer
+
+        hook = AdmissionWebhookServer().start()
+        hook.stop()  # port now dead
+        with FakeApiServer(
+            admission_webhooks={TrainJob.PLURAL: hook.url}
+        ) as server:
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                self._post_raw(server, job_to_k8s(_mk_job("noservice")))
+            assert exc.value.code == 500  # failurePolicy=Fail
+            assert server.get_object(
+                TrainJob.PLURAL, "default", "noservice") is None
+
+    def test_review_response_contract(self):
+        """AdmissionReview v1 envelope: uid echo, allowed flag, 400 status
+        on denial, DELETE short-circuit."""
+        from tf_operator_tpu.cli.webhook import review_response
+
+        ok = review_response({"request": {
+            "uid": "u1", "operation": "CREATE",
+            "object": job_to_k8s(_mk_job("fine"))}})
+        assert ok["kind"] == "AdmissionReview"
+        assert ok["response"] == {"uid": "u1", "allowed": True}
+        bad_obj = job_to_k8s(_mk_job("badname"))
+        bad_obj["metadata"]["name"] = "Not-A-DNS-Name!"
+        deny = review_response({"request": {
+            "uid": "u2", "operation": "CREATE", "object": bad_obj}})
+        assert deny["response"]["allowed"] is False
+        assert deny["response"]["status"]["code"] == 400
+        # garbage object: denied, not crashed
+        garbage = review_response({"request": {
+            "uid": "u3", "operation": "CREATE",
+            "object": {"spec": {"tfReplicaSpecs": 7}}}})
+        assert garbage["response"]["allowed"] is False
+        # deletes carry no object; always allowed
+        rm = review_response({"request": {"uid": "u4",
+                                          "operation": "DELETE"}})
+        assert rm["response"]["allowed"] is True
+
+
 class TestDeployManifests:
     """manifests/operator.yaml — the `kubectl apply -f manifests/` deploy
     path (reference deploys via kubeflow manifests around its Dockerfile)."""

@@ -129,6 +129,19 @@ def cmd_operator(args) -> int:
         cluster = InMemoryCluster()
     allocator = SliceAllocator.of(*args.tpu_slices) if args.tpu_slices else None
 
+    # Admission webhook serves on EVERY replica (stateless, no leadership
+    # needed — a real cluster load-balances webhook calls across the
+    # Service's endpoints). 0 = disabled.
+    webhook_server = None
+    if args.webhook_port:
+        from tf_operator_tpu.cli.webhook import AdmissionWebhookServer
+
+        webhook_server = AdmissionWebhookServer(
+            port=args.webhook_port, host=args.webhook_bind,
+            cert_file=args.webhook_cert, key_file=args.webhook_key,
+        ).start()
+        log.info("admission webhook on %s", webhook_server.url)
+
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
@@ -228,6 +241,8 @@ def cmd_operator(args) -> int:
             LeaderElector(args.lock_file).run_or_die(lead, stop)
     else:
         lead()
+    if webhook_server is not None:
+        webhook_server.stop()
     return 1 if failed.is_set() else 0
 
 
@@ -252,7 +267,10 @@ def cmd_kubelet(args) -> int:
                     insecure=args.kube_insecure)
     )
     cluster = K8sCluster(api_client, namespace=args.namespace or None)
-    runtime = LocalProcessRuntime(cluster, log_dir=args.log_dir)
+    runtime = LocalProcessRuntime(
+        cluster, log_dir=args.log_dir,
+        external_scheduler=args.external_scheduler,
+    )
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
@@ -409,6 +427,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--namespace", default=None,
                    help="restrict the operator to one namespace "
                         "(options.go namespace scope)")
+    p.add_argument("--webhook-port", type=int, default=0,
+                   help="serve the ValidatingAdmissionWebhook (POST "
+                        "/validate) on this port; 0 disables. Register it "
+                        "with manifests/webhook.yaml")
+    p.add_argument("--webhook-bind", default="0.0.0.0",
+                   help="webhook bind address — unlike the REST API the "
+                        "apiserver must reach it over the pod network")
+    p.add_argument("--webhook-cert", default=None,
+                   help="TLS cert for the webhook (real clusters require "
+                        "HTTPS webhooks); plain HTTP without it")
+    p.add_argument("--webhook-key", default=None)
     p.set_defaults(fn=cmd_operator)
 
     p = sub.add_parser("kubelet")
@@ -418,6 +447,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kube-insecure", action="store_true")
     p.add_argument("--namespace", default=None)
     p.add_argument("--log-dir", default=None)
+    p.add_argument("--external-scheduler", action="store_true",
+                   help="real-kubelet placement semantics: pods naming a "
+                        "foreign schedulerName stay Pending until that "
+                        "scheduler binds them (sets spec.nodeName); "
+                        "without this flag the node agent starts pods on "
+                        "creation (it plays scheduler+kubelet in one)")
     p.set_defaults(fn=cmd_kubelet)
 
     p = sub.add_parser("get")

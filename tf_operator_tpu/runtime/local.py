@@ -158,6 +158,7 @@ class LocalProcessRuntime:
         env_overrides: dict[str, str] | None = None,
         inherit_env: bool = True,
         log_dir: str | None = None,
+        external_scheduler: bool = False,
     ):
         self.cluster = cluster
         self.env_overrides = env_overrides or {}
@@ -185,9 +186,19 @@ class LocalProcessRuntime:
         self._port_maps: dict[tuple[str, str], PortMap] = {}  # (ns, job) -> map
         self._lock = threading.Lock()
         self._threads: list[threading.Thread] = []
+        self._started: set[tuple[str, str]] = set()
         self._stopped = False
         cluster.on_add(KIND_POD, self._on_pod_add)
         cluster.on_delete(KIND_POD, self._on_pod_delete)
+        # Gang-scheduler conformance mode (VERDICT r3 next #7): when an
+        # external gang scheduler owns placement, this kubelet behaves like
+        # a real one — a pod naming a foreign schedulerName stays Pending
+        # (never executed) until that scheduler BINDS it (sets
+        # spec.nodeName). Default off: the local runtime otherwise plays
+        # scheduler+kubelet in one, starting pods on creation.
+        self.external_scheduler = external_scheduler
+        if external_scheduler:
+            cluster.on_update(KIND_POD, self._on_pod_update)
 
     # ----------------------------------------------------------- port wiring
 
@@ -229,19 +240,46 @@ class LocalProcessRuntime:
 
     # ------------------------------------------------------------- lifecycle
 
+    def _awaits_binding(self, pod: Pod) -> bool:
+        """True when an external gang scheduler owns this pod's placement
+        and has not bound it yet (volcano protocol: the operator creates
+        the whole gang with schedulerName + group annotation; pods run only
+        after the scheduler binds them — jobcontroller.go:226-250)."""
+        scheduler = pod.scheduler_name or pod.spec.scheduler_name
+        return bool(self.external_scheduler and scheduler and not pod.node_name)
+
     def _on_pod_add(self, pod: Pod) -> None:
         if self._stopped:
             return
-        t = threading.Thread(
-            target=self._run_pod, args=(pod,), name=f"pod-{pod.name}", daemon=True
-        )
+        if self._awaits_binding(pod):
+            return  # Pending until the gang scheduler binds it
+        self._launch(pod)
+
+    def _on_pod_update(self, old: Pod, new: Pod) -> None:
+        if self._stopped:
+            return
+        if new.node_name and not self._awaits_binding(new):
+            self._launch(new)  # just bound (no-op if already started)
+
+    def _launch(self, pod: Pod) -> None:
+        key = (pod.namespace, pod.name)
         with self._lock:
+            if key in self._started:
+                return  # updates replay; a pod executes once per creation
+            self._started.add(key)
+            t = threading.Thread(
+                target=self._run_pod, args=(pod,), name=f"pod-{pod.name}",
+                daemon=True,
+            )
             self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
         t.start()
 
     def _on_pod_delete(self, pod: Pod) -> None:
         with self._lock:
+            # A recreated pod (ExitCode restart, elastic roll) is a new
+            # execution: forget the old one's started mark.
+            self._started.discard((pod.namespace, pod.name))
             # Opportunistic purge: entries whose process already exited are
             # dead weight (a job deleted mid-run with no successor would
             # otherwise pin its handles for the runtime's lifetime).

@@ -21,11 +21,17 @@ apiserver is stricter than a naive fake:
     bounds violations → 422, unknown fields pruned (except
     x-kubernetes-preserve-unknown-fields subtrees).
 
-Not modeled: auth, field selectors, patch types.
+Round-4: PATCH with `application/merge-patch+json` (RFC 7386) on resources
+and /status subresources, with the real apiserver's semantics (recursive
+object merge, array/scalar replace, null deletes, no rv precondition unless
+the patch carries one, 415 for other patch types).
+
+Not modeled: auth, field selectors, json-patch/strategic-merge patch types.
 """
 
 from __future__ import annotations
 
+import copy
 import json
 import re
 import threading
@@ -54,6 +60,21 @@ def _load_crd_schemas() -> dict[str, dict]:
                     out[plural] = v["schema"]["openAPIV3Schema"]
         except (OSError, KeyError, TypeError, ValueError):
             continue
+    return out
+
+
+def _merge_patch(target, patch):
+    """RFC 7386: recursively merge `patch` into `target` (copy-on-write).
+    Dicts merge key-by-key; a null value deletes the key; anything else
+    (arrays, scalars) replaces wholesale."""
+    if not isinstance(patch, dict):
+        return patch
+    out = dict(target) if isinstance(target, dict) else {}
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge_patch(out.get(k), v)
     return out
 
 
@@ -167,9 +188,45 @@ class _Store:
 
 class FakeApiServer:
     def __init__(self, port: int = 0, watch_log_retain: int = 4096,
-                 validate_schemas: bool = True):
+                 validate_schemas: bool = True,
+                 admission_webhooks: dict[str, str] | None = None):
         store = self.store = _Store(watch_log_retain=watch_log_retain)
         schemas = _load_crd_schemas() if validate_schemas else {}
+        # {resource plural -> webhook URL}: like a registered
+        # ValidatingWebhookConfiguration (manifests/webhook.yaml), consulted
+        # on create/update/patch AFTER schema validation, BEFORE storage.
+        webhooks = dict(admission_webhooks or {})
+
+        def call_admission(res: str, operation: str, obj: dict):
+            """None if allowed; else (http_code, message): (400, ...) for a
+            webhook denial, (500, ...) when the webhook is unreachable —
+            failurePolicy: Fail, the safe default the manifest declares
+            (a real apiserver surfaces that as Internal Server Error)."""
+            url = webhooks.get(res)
+            if not url:
+                return None
+            import urllib.request as _rq
+
+            review = {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {"uid": f"rev-{store.rv}", "operation": operation,
+                            "object": obj},
+            }
+            req = _rq.Request(
+                url, data=json.dumps(review).encode(), method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with _rq.urlopen(req, timeout=5.0) as r:
+                    resp = (json.loads(r.read()) or {}).get("response") or {}
+            except (OSError, ValueError) as exc:
+                return (500, f"admission webhook for {res} unreachable "
+                             f"(failurePolicy=Fail): {exc}")
+            if resp.get("allowed"):
+                return None
+            return (400, (resp.get("status") or {}).get("message")
+                    or "denied by admission webhook")
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -351,7 +408,10 @@ class FakeApiServer:
                             sent = rv
                         if pending:
                             sent = max(sent, watermark)
-                        elif send_bookmark:
+                        elif send_bookmark and bookmark_rv > 0:
+                            # rv-0 bookmarks (empty store) are not a thing a
+                            # real apiserver emits; suppress them so clients
+                            # never adopt 0 as a resume point.
                             self._send_chunk({
                                 "type": "BOOKMARK",
                                 "object": {"metadata": {
@@ -380,6 +440,13 @@ class FakeApiServer:
                             422, "Invalid",
                             f"{res} {ns}/{name}: " + "; ".join(errs[:5]),
                         )
+                denied = call_admission(res, "CREATE", obj)
+                if denied:
+                    return self._error(
+                        denied[0], "AdmissionDenied",
+                        f'admission webhook: {res} {ns}/{name}: '
+                        f"{denied[1]}",
+                    )
                 with store.lock:
                     objs = store.objects.setdefault(res, {})
                     if (ns, name) in objs:
@@ -407,6 +474,14 @@ class FakeApiServer:
                             422, "Invalid",
                             f"{res} {ns}/{name}: " + "; ".join(errs[:5]),
                         )
+                if sub is None:
+                    denied = call_admission(res, "UPDATE", body)
+                    if denied:
+                        return self._error(
+                            denied[0], "AdmissionDenied",
+                            f'admission webhook: {res} {ns}/{name}: '
+                            f"{denied[1]}",
+                        )
                 with store.lock:
                     objs = store.objects.setdefault(res, {})
                     cur = objs.get((ns, name))
@@ -422,7 +497,11 @@ class FakeApiServer:
                             f"!= {cur['metadata'].get('resourceVersion')}",
                         )
                     if sub == "status":
-                        new = dict(cur)
+                        # deep copy: `new` must not share subtrees with the
+                        # stored object — the rv write below would otherwise
+                        # rewrite history inside old watch-log entries
+                        # (DELETE below dodges the same trap)
+                        new = copy.deepcopy(cur)
                         new["status"] = body.get("status", {})
                     else:
                         new = body
@@ -437,6 +516,87 @@ class FakeApiServer:
                         # status subresource enabled)
                         if "status" in cur:
                             new["status"] = cur["status"]
+                    rv = store.bump()
+                    new["metadata"]["resourceVersion"] = str(rv)
+                    objs[(ns, name)] = new
+                    store.append_log((rv, "MODIFIED", res, new))
+                    store.lock.notify_all()
+                return self._send_json(new)
+
+            def do_PATCH(self):  # noqa: N802
+                """RFC 7386 JSON merge-patch (the one patch type core/k8s.py
+                speaks): objects merge recursively, arrays and scalars
+                replace, explicit null deletes. No resourceVersion
+                precondition unless the patch itself carries one — that is
+                what makes PATCH safe for two writers owning disjoint
+                fields where PUT would 409 (pod_control.go PatchPod)."""
+                ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+                if ctype != "application/merge-patch+json":
+                    return self._error(
+                        415, "UnsupportedMediaType",
+                        f"unsupported patch type {ctype!r} (only "
+                        "application/merge-patch+json is modeled)",
+                    )
+                m, _ = self._parse()
+                if m is None or not m["name"]:
+                    return self._error(404, "NotFound", self.path)
+                res, ns, name, sub = m["resource"], m["ns"], m["name"], m["sub"]
+                patch = self._body()
+                if sub is None and res in webhooks:
+                    # Admission sees the merged object (what would be
+                    # stored). Preview-merge OUTSIDE the store lock — an
+                    # HTTP round-trip under it would stall every handler;
+                    # the final merge below re-reads the current object.
+                    with store.lock:
+                        cur0 = store.objects.get(res, {}).get((ns, name))
+                    if cur0 is not None:
+                        denied = call_admission(
+                            res, "UPDATE", _merge_patch(cur0, patch)
+                        )
+                        if denied:
+                            return self._error(
+                                denied[0], "AdmissionDenied",
+                                f'admission webhook: {res} '
+                                f"{ns}/{name}: {denied[1]}",
+                            )
+                with store.lock:
+                    objs = store.objects.setdefault(res, {})
+                    cur = objs.get((ns, name))
+                    if cur is None:
+                        return self._error(404, "NotFound", f"{res} {ns}/{name}")
+                    patch_rv = ((patch.get("metadata") or {})
+                                .get("resourceVersion"))
+                    if patch_rv and patch_rv != cur["metadata"].get(
+                            "resourceVersion"):
+                        return self._error(
+                            409, "Conflict",
+                            f"{res} {ns}/{name}: resourceVersion {patch_rv} "
+                            f"!= {cur['metadata'].get('resourceVersion')}",
+                        )
+                    if sub == "status":
+                        # the /status subresource only takes status changes
+                        patch = {"status": patch.get("status", {})}
+                    # deep-copy first: _merge_patch shallow-shares unpatched
+                    # subtrees with the stored object, so the rv write below
+                    # (or _validate_and_prune's in-place pruning on a patch
+                    # later REJECTED with 422) would corrupt the store and
+                    # rewrite rv history inside old watch-log entries,
+                    # making resuming informers skip real events.
+                    new = _merge_patch(copy.deepcopy(cur), patch)
+                    # server-owned identity survives any patch
+                    new.setdefault("metadata", {})
+                    new["metadata"]["namespace"] = ns
+                    new["metadata"]["name"] = name
+                    new["metadata"].setdefault(
+                        "uid", cur["metadata"].get("uid", "")
+                    )
+                    if sub is None and res in schemas:
+                        errs = _validate_and_prune(new, schemas[res])
+                        if errs:
+                            return self._error(
+                                422, "Invalid",
+                                f"{res} {ns}/{name}: " + "; ".join(errs[:5]),
+                            )
                     rv = store.bump()
                     new["metadata"]["resourceVersion"] = str(rv)
                     objs[(ns, name)] = new
