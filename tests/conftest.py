@@ -18,10 +18,13 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 # at interpreter startup and pins JAX_PLATFORMS to the TPU plugin, so setting
 # the env var here is too late — go through jax.config instead, before any
 # backend is initialized. Set TPUJOB_TEST_TPU=1 to run against real hardware.
-# An explicitly user-set JAX_PLATFORMS is honored; "axon" is the value the
-# sandbox sitecustomize setdefaults, i.e. "the user didn't choose".
-if (not os.environ.get("TPUJOB_TEST_TPU")
-        and os.environ.get("JAX_PLATFORMS", "axon") == "axon"):
+# Any non-axon JAX_PLATFORMS (explicit `cpu`, or unset) forces the CPU mesh:
+# merely LEAVING the env var at "cpu" is not enough, because the sandbox
+# sitecustomize pins the accelerator through jax.config at interpreter
+# startup (env alone is ignored) and the first device lookup would dial the
+# tunnel — a wedged tunnel then hangs the whole suite at collection
+# (observed round 4). Only TPUJOB_TEST_TPU=1 opts into the chip.
+if not os.environ.get("TPUJOB_TEST_TPU"):
     os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses we spawn
     # The sitecustomize only registers (and re-pins) the TPU plugin when
     # PALLAS_AXON_POOL_IPS is set; dropping it here makes pods we spawn in
@@ -38,3 +41,16 @@ if (not os.environ.get("TPUJOB_TEST_TPU")
         jax.config.update("jax_platforms", "cpu")
     except ImportError:
         pass
+
+# Persistent XLA compilation cache for the IN-PROCESS test compiles — the
+# exact mechanism pod processes already use (utils/compile_cache.py; pods
+# default to the same directory). The data-plane tiers (parallel/moe/
+# pipeline) are compile-bound on the CPU mesh; warm entries turn multi-
+# second XLA compiles into sub-second disk loads across suite runs.
+# TPUJOB_COMPILE_CACHE=off disables (same contract as the pods).
+try:
+    from tf_operator_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+except Exception:
+    pass  # cache is an optimization; never fail collection over it

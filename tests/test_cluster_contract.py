@@ -38,8 +38,6 @@ from __future__ import annotations
 
 import json
 
-import pytest
-
 from tf_operator_tpu.api import defaults
 from tf_operator_tpu.api.types import (
     ContainerSpec,

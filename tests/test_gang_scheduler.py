@@ -16,12 +16,9 @@ from __future__ import annotations
 import sys
 import time
 
-import pytest
-
 from tf_operator_tpu.api import defaults
 from tf_operator_tpu.api.types import (
     ContainerSpec,
-    JobConditionType,
     ObjectMeta,
     PodTemplateSpec,
     ReplicaSpec,

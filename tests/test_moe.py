@@ -305,8 +305,8 @@ class TestSparseDispatch:
         configuration) with sparse dispatch."""
         mesh = mesh_lib.make_mesh({"dp": 8})
         cfg = moe_lib.MoEConfig(
-            vocab_size=1024, num_layers=2, hidden=128, num_heads=4,
-            max_len=256, num_experts=4, top_k=2, moe_every=1,
+            vocab_size=512, num_layers=2, hidden=64, num_heads=4,
+            max_len=64, num_experts=4, top_k=2, moe_every=1,
             dispatch="sparse",
         )
         model = moe_lib.MoETransformerLM(cfg)

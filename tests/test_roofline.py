@@ -40,7 +40,9 @@ def _tpu_available() -> bool:
         out = subprocess.run(
             [sys.executable, "-c",
              "import jax; print(jax.default_backend())"],
-            capture_output=True, text=True, timeout=120, env=env)
+            # healthy dial ~10 s; a WEDGED tunnel hangs forever, and this
+            # timeout is then the test's entire cost — keep it tight
+            capture_output=True, text=True, timeout=45, env=env)
         return out.stdout.strip().splitlines()[-1] in ("tpu", "axon")
     except Exception:  # noqa: BLE001
         return False

@@ -39,7 +39,8 @@ class TrainJobClient:
 
     # ------------------------------------------------------------------ http
 
-    def _request(self, method: str, path: str, body: dict | None = None) -> dict:
+    def _request(self, method: str, path: str, body: dict | None = None,
+                 timeout_override: float | None = None) -> dict:
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
             f"http://{self.server}{path}",
@@ -48,7 +49,9 @@ class TrainJobClient:
             method=method,
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            with urllib.request.urlopen(
+                req, timeout=timeout_override or self.timeout
+            ) as r:
                 return json.loads(r.read())
         except urllib.error.HTTPError as e:
             raise ApiError(e.code, e.read().decode(errors="replace")) from None
@@ -120,17 +123,39 @@ class TrainJobClient:
         poll: float = 0.1,
     ) -> dict:
         """Block until the job has any of `conditions` with status True
-        (tf_job_client.wait_for_condition:117)."""
+        (tf_job_client.wait_for_condition:117).
+
+        Event-driven: long-polls the operator's `waitCondition` query (the
+        server holds the response on a cluster-event condition variable),
+        so the wait resolves at event latency with no sleep loop. `poll`
+        is kept for signature compatibility; it only paces the fallback
+        loop between long-poll windows."""
         deadline = time.monotonic() + timeout
         last = None
-        while time.monotonic() < deadline:
-            job = self.get(namespace, name)
-            if job is not None:
-                last = job
-                for c in job["status"]["conditions"]:
-                    if c["status"] and c["type"] in conditions:
-                        return job
-            time.sleep(poll)
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            window = min(remaining, 30.0)
+            try:
+                return self._request(
+                    "GET",
+                    f"/api/trainjobs/{namespace}/{name}"
+                    f"?waitCondition={','.join(conditions)}"
+                    f"&timeoutSeconds={window:.1f}",
+                    timeout_override=window + 10.0,
+                )
+            except ApiError as e:
+                if e.status == 408:  # window expired; job may not exist yet
+                    try:
+                        last = json.loads(e.body).get("job", last)
+                    except ValueError:
+                        pass
+                    continue
+                if e.status == 404:
+                    time.sleep(poll)  # not created yet: brief re-check
+                    continue
+                raise
         raise E2ETimeoutError(
             f"{namespace}/{name} never reached {conditions}; last={last}"
         )
@@ -141,10 +166,25 @@ class TrainJobClient:
     def wait_for_delete(self, namespace: str, name: str,
                         timeout: float = 60.0) -> None:
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if self.get(namespace, name) is None:
-                return
-            time.sleep(0.1)
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            window = min(remaining, 30.0)
+            try:
+                self._request(
+                    "GET",
+                    f"/api/trainjobs/{namespace}/{name}"
+                    f"?waitDeleted=1&timeoutSeconds={window:.1f}",
+                    timeout_override=window + 10.0,
+                )
+                return  # {"deleted": true}
+            except ApiError as e:
+                if e.status == 408:
+                    continue
+                if e.status == 404:
+                    return
+                raise
         raise E2ETimeoutError(f"{namespace}/{name} not deleted in {timeout}s")
 
     def wait_for_replicas_serving(

@@ -371,11 +371,21 @@ class PrespawnClient:
     def stop(self) -> None:
         if self._proc is None:
             return
-        self.request({"shutdown": True}, timeout=2.0)
+        acked = self.request({"shutdown": True}, timeout=2.0)
+        if acked is None and not self._ready:
+            # Never served a request — still BOOTING (importing jax, socket
+            # not yet listening; a short-lived session hits this every
+            # time). No forked children can exist before the first serve,
+            # so SIGKILL now instead of burning a 3 s grace wait. A server
+            # that HAS served (self._ready) keeps the grace period even on
+            # a timed-out reply: it may be busy with an in-flight request,
+            # and killing it would skip its finally-block child reaping.
+            self._proc.kill()
         try:
             self._proc.wait(timeout=3.0)
         except subprocess.TimeoutExpired:
             self._proc.kill()
+            self._proc.wait()
 
 
 # ----------------------------------------------------------------- supervisor

@@ -30,7 +30,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 
-from tf_operator_tpu.core.cluster import PodPhase
+
 from tf_operator_tpu.core.k8s import K8sApi, K8sCluster
 from tf_operator_tpu.gang.podgroup import ANNOTATION_GROUP_NAME
 

@@ -1,0 +1,140 @@
+"""ResNet-50 XLA compiler-flag/layout sweep on one chip (VERDICT r3 next #8).
+
+Round 2's roofline showed ResNet-50 HBM-bound at ~83% of achievable
+bandwidth with conv fusions at 660-700 GiB/s — ~15% theoretically still on
+the table. This sweep tries the remaining compiler-level levers through
+`jit(...).lower(...).compile(compiler_options=...)` (client XLA_FLAGS cannot
+carry TPU flags — the CPU-side parser aborts; proto-backed xla_* options ARE
+forwarded to the remote compile helper, docs/perf.md): scoped-VMEM budget
+(prefetch depth vs operand space) and scheduler toggles. Unknown/rejected
+options are reported as "rejected", not crashes.
+
+Per bench methodology: batch 256, bf16 activations via the model's dtype
+policy, fwd+bwd+SGD-momentum step, 30-step timed window closed by a host
+transfer (the axon tunnel's block_until_ready is a no-op). One SUBPROCESS
+per config — the chip admits one process at a time and compiler options are
+per-executable.
+
+Prints one JSON line per config; decision rule (VERDICT): < 5% best-vs-
+baseline gain => declare the HBM bound reached in docs/perf.md and stop
+spending rounds on ResNet.
+
+Usage: python tools/exp_resnet_flags.py [--steps 30] [--batch 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SWEEP: list[tuple[str, dict[str, str]]] = [
+    ("baseline", {}),
+    ("vmem32m", {"xla_tpu_scoped_vmem_limit_kib": "32768"}),
+    ("vmem64m", {"xla_tpu_scoped_vmem_limit_kib": "65536"}),
+    ("vmem96m", {"xla_tpu_scoped_vmem_limit_kib": "98304"}),
+    ("vmem128m", {"xla_tpu_scoped_vmem_limit_kib": "131072"}),
+    ("no-lhs", {"xla_tpu_enable_latency_hiding_scheduler": "false"}),
+    ("flash-conv-off", {"xla_tpu_enable_experimental_fusion_cost_model":
+                        "true"}),
+]
+
+CHILD = r"""
+import json, sys, time
+import jax, jax.numpy as jnp, optax
+
+sys.path.insert(0, {repo!r})
+from tf_operator_tpu.models.mnist import cross_entropy_loss
+from tf_operator_tpu.models.resnet import ResNet50, init_resnet
+
+opts = {opts!r}
+steps = {steps}
+batch = {batch}
+
+model = ResNet50(num_classes=1000)
+params, batch_stats = init_resnet(model, jax.random.key(0), image_size=224,
+                                  batch=2)
+tx = optax.sgd(0.1, momentum=0.9)
+opt_state = tx.init(params)
+x = jax.random.normal(jax.random.key(1), (batch, 224, 224, 3))
+y = jax.random.randint(jax.random.key(2), (batch,), 0, 1000)
+
+
+def step(params, batch_stats, opt_state, x, y):
+    def loss(p, bs):
+        logits, mut = model.apply(
+            {{"params": p, "batch_stats": bs}}, x, train=True,
+            mutable=["batch_stats"],
+        )
+        return cross_entropy_loss(logits, y), mut["batch_stats"]
+
+    (l, bs), grads = jax.value_and_grad(loss, has_aux=True)(
+        params, batch_stats
+    )
+    updates, opt_state = tx.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), bs, opt_state, l
+
+
+jitted = jax.jit(step, donate_argnums=(0, 1, 2))
+lowered = jitted.lower(params, batch_stats, opt_state, x, y)
+try:
+    compiled = lowered.compile(compiler_options=opts or None)
+except Exception as e:  # unknown/rejected option: report, don't crash
+    print(json.dumps({{"config": {name!r}, "rejected": str(e)[:200]}}))
+    sys.exit(0)
+params, batch_stats, opt_state, l = compiled(params, batch_stats, opt_state,
+                                             x, y)
+float(l)  # warm + host sync (tunnel block_until_ready is a no-op)
+t0 = time.perf_counter()
+for _ in range(steps):
+    params, batch_stats, opt_state, l = compiled(
+        params, batch_stats, opt_state, x, y
+    )
+loss = float(l)
+dt = (time.perf_counter() - t0) / steps
+ips = batch / dt
+from bench import RESNET50_TRAIN_FLOPS_PER_IMG, device_peak_tflops
+peak = device_peak_tflops(getattr(jax.devices()[0], "device_kind", ""))
+print(json.dumps({{
+    "config": {name!r}, "opts": opts, "step_ms": round(dt * 1e3, 2),
+    "images_per_sec": round(ips, 1),
+    "mfu": round(ips * RESNET50_TRAIN_FLOPS_PER_IMG / (peak * 1e12), 4)
+    if peak else None,
+    "loss": round(loss, 3),
+}}))
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--configs", default=None,
+                    help="comma-separated subset of config names")
+    args = ap.parse_args()
+    subset = set(args.configs.split(",")) if args.configs else None
+    rc = 0
+    for name, opts in SWEEP:
+        if subset and name not in subset:
+            continue
+        r = subprocess.run(
+            [sys.executable, "-c",
+             CHILD.format(repo=REPO, opts=opts, name=name,
+                          steps=args.steps, batch=args.batch)],
+            capture_output=True, text=True, timeout=1800,
+        )
+        if r.returncode != 0:
+            print(json.dumps({"config": name, "error":
+                              r.stderr.strip().splitlines()[-1:]}))
+            rc = 1
+            continue
+        print(r.stdout.strip().splitlines()[-1])
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
