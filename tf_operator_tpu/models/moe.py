@@ -137,8 +137,56 @@ def topk_routing(
     return combine, dispatch, aux
 
 
+@jax.custom_vjp
+def _dispatch_gather(xf, token_of, inv, k):
+    """x_sorted[i] = xf[token_of[i]] where token_of = order // k duplicates
+    every token top_k times then groups rows by expert.
+
+    Plain jnp.take here makes XLA emit a [N*K, H] -> [N, H] scatter-add for
+    the backward (it cannot see that the duplicate indices are a tiled
+    permutation) — measured at ~9% of the sparse step on-chip. The VJP is
+    written by hand instead: un-permute the cotangent with the inverse
+    permutation (a gather) and sum the K copies of each token (a reduce).
+    """
+    return jnp.take(xf, token_of, axis=0)
+
+
+def _dispatch_gather_fwd(xf, token_of, inv, k):
+    return jnp.take(xf, token_of, axis=0), (inv, k, xf.shape[0])
+
+
+def _dispatch_gather_bwd(res, g):
+    inv, k, n = res
+    g_rep = jnp.take(g, inv, axis=0)               # row a <-> token a // k
+    return g_rep.reshape(n, k, g.shape[-1]).sum(axis=1), None, None, None
+
+
+_dispatch_gather.defvjp(_dispatch_gather_fwd, _dispatch_gather_bwd)
+
+
+@jax.custom_vjp
+def _permute_rows(x, perm, inv_perm):
+    """y[i] = x[perm[i]] for a PERMUTATION perm with known inverse: the
+    cotangent flows back through a gather by inv_perm instead of the
+    duplicate-index scatter XLA emits for a generic take's transpose."""
+    return jnp.take(x, perm, axis=0)
+
+
+def _permute_rows_fwd(x, perm, inv_perm):
+    return jnp.take(x, perm, axis=0), (inv_perm,)
+
+
+def _permute_rows_bwd(res, g):
+    (inv_perm,) = res
+    return jnp.take(g, inv_perm, axis=0), None, None
+
+
+_permute_rows.defvjp(_permute_rows_fwd, _permute_rows_bwd)
+
+
 def _grouped_matmul(
-    x: jax.Array, w: jax.Array, group_sizes: jax.Array
+    x: jax.Array, w: jax.Array, group_sizes: jax.Array,
+    tiling: str | None = None,
 ) -> jax.Array:
     """[M, K] x [E, K, N] -> [M, N] where rows of x are grouped by expert
     (group_sizes[e] consecutive rows use w[e]).
@@ -155,6 +203,16 @@ def _grouped_matmul(
         from jax.experimental.pallas.ops.tpu.megablox import gmm as _gmm
 
         return _gmm(x, w, group_sizes.astype(jnp.int32))
+    if tiling:
+        from jax.experimental.xla_metadata import set_xla_metadata
+
+        # Mosaic honors a ragged_dot_tiling=(m,k,n) frontend attribute;
+        # standalone sweep (docs/perf.md) puts 4096,768,1024 ~8% over the
+        # compiler's default on the fwd expert matmul. Per call site — a
+        # K=768 tiling cannot compile the K=3072 matmul. Trace-time only,
+        # so the AD-generated backward ragged dots keep compiler defaults.
+        with set_xla_metadata(ragged_dot_tiling=tiling):
+            return jax.lax.ragged_dot(x, w, group_sizes.astype(jnp.int32))
     return jax.lax.ragged_dot(x, w, group_sizes.astype(jnp.int32))
 
 
@@ -193,15 +251,26 @@ def sparse_moe_ffn(
     token_of = order // k                 # source token per sorted row
     group_sizes = jnp.bincount(flat_e, length=cfg.num_experts)
 
-    x_sorted = jnp.take(xf, token_of, axis=0).astype(cfg.dtype)  # [NK, H]
-    hmid = _grouped_matmul(x_sorted, experts_in.astype(cfg.dtype), group_sizes)
-    hmid = nn.gelu(hmid)
-    y_sorted = _grouped_matmul(hmid, experts_out.astype(cfg.dtype), group_sizes)
-
-    gate_sorted = jnp.take(topv.reshape(n * k), order).astype(cfg.dtype)
-    weighted = gate_sorted[:, None] * y_sorted                   # [NK, H]
     inv = jnp.argsort(order)               # inverse permutation: unsort
-    y = jnp.take(weighted, inv, axis=0).reshape(n, k, h).sum(axis=1)
+    x_sorted = _dispatch_gather(
+        xf.astype(cfg.dtype), token_of, inv, k
+    )                                                            # [NK, H]
+    import os
+
+    tile_in = os.environ.get("TPUJOB_RAGGED_TILING_IN")
+    tile_out = os.environ.get("TPUJOB_RAGGED_TILING_OUT")
+    hmid = _grouped_matmul(x_sorted, experts_in.astype(cfg.dtype),
+                           group_sizes, tiling=tile_in)
+    hmid = nn.gelu(hmid)
+    y_sorted = _grouped_matmul(hmid, experts_out.astype(cfg.dtype),
+                               group_sizes, tiling=tile_out)
+
+    # Unsort FIRST, then gate-combine: the gate lives in unsorted (token,
+    # slot) order already (topv), so multiplying after the permutation
+    # needs no gate gather, and the [N, K, H] multiply + K-sum fuse into
+    # one pass instead of materializing a gated [NK, H] copy pre-permute.
+    y_unsorted = _permute_rows(y_sorted, inv, order).reshape(n, k, h)
+    y = (topv.astype(cfg.dtype)[..., None] * y_unsorted).sum(axis=1)
 
     aux = {
         # fraction of tokens whose FIRST choice is expert e (Switch f_e)
@@ -325,45 +394,74 @@ class MoEBlock(nn.Module):
 
 class MoETransformerLM(nn.Module):
     """Causal LM with MoE FFNs every `moe_every` blocks (Mixtral/Switch
-    layout: interleaved dense + expert layers)."""
+    layout: interleaved dense + expert layers).
+
+    setup() (not @nn.compact) so `hidden` can expose the trunk output
+    without the head, same pattern as TransformerLM: the full
+    [B, T, vocab] f32 logits tensor is the single biggest HBM tensor of a
+    step, and the chunked loss computes head+softmax per sequence chunk
+    instead. Explicit name= keeps every param path identical to the old
+    @nn.compact layout (embed/pos_embed/layer_i/ln_f/lm_head)."""
 
     cfg: MoEConfig
     attn_fn: AttnFn | None = None
 
-    @nn.compact
-    def __call__(self, tokens, deterministic=True):
+    def setup(self):
         cfg = self.cfg
-        x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
-                     param_dtype=jnp.float32, name="embed")(tokens)
-        pos = nn.Embed(cfg.max_len, cfg.hidden, dtype=cfg.dtype,
-                       param_dtype=jnp.float32, name="pos_embed")(
-            jnp.arange(tokens.shape[1])
-        )
-        x = x + pos[None]
-        for i in range(cfg.num_layers):
-            use_moe = (i % cfg.moe_every) == (cfg.moe_every - 1)
-            x = MoEBlock(cfg, use_moe, self.attn_fn, name=f"layer_{i}")(
-                x, deterministic
-            )
-        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
-                         name="ln_f")(x)
-        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
-                          param_dtype=jnp.float32, use_bias=False,
-                          name="lm_head")(x)
+        self.embed = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
+                              param_dtype=jnp.float32, name="embed")
+        self.pos_embed = nn.Embed(cfg.max_len, cfg.hidden, dtype=cfg.dtype,
+                                  param_dtype=jnp.float32, name="pos_embed")
+        self.blocks = [
+            MoEBlock(cfg, (i % cfg.moe_every) == (cfg.moe_every - 1),
+                     self.attn_fn, name=f"layer_{i}")
+            for i in range(cfg.num_layers)
+        ]
+        self.ln_f = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
+                                 name="ln_f")
+        self.lm_head = nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
+                                param_dtype=jnp.float32, use_bias=False,
+                                name="lm_head")
+
+    def hidden(self, tokens, deterministic=True):
+        """Trunk output [B, T, H] (post final LayerNorm), no head."""
+        x = self.embed(tokens)
+        x = x + self.pos_embed(jnp.arange(tokens.shape[1]))[None]
+        for block in self.blocks:
+            x = block(x, deterministic)
+        return self.ln_f(x)
+
+    def __call__(self, tokens, deterministic=True):
+        logits = self.lm_head(self.hidden(tokens, deterministic))
         return logits.astype(jnp.float32)
 
 
 def moe_lm_loss(
-    model: MoETransformerLM, params, tokens: jax.Array
+    model: MoETransformerLM, params, tokens: jax.Array,
+    chunked: bool = False, chunk: int = 2048,
 ) -> jax.Array:
-    """Next-token loss + the sown MoE aux losses (balance + z-loss)."""
-    from tf_operator_tpu.models.transformer import lm_loss
+    """Next-token loss + the sown MoE aux losses (balance + z-loss).
+
+    chunked=True computes head+softmax per `chunk`-token sequence slice
+    (transformer.lm_loss_chunked) instead of materializing [B, T, vocab]
+    f32 logits — numerics identical, and the loss fusions ride the scan
+    instead of three full-logits HBM round-trips."""
+    from tf_operator_tpu.models.transformer import lm_loss, lm_loss_chunked
 
     cfg = model.cfg
-    logits, mut = model.apply(
-        {"params": params}, tokens, mutable=["moe_losses"]
-    )
-    loss = lm_loss(logits, tokens)
+    if chunked:
+        h, mut = model.apply(
+            {"params": params}, tokens, mutable=["moe_losses"],
+            method="hidden",
+        )
+        loss = lm_loss_chunked(
+            h, params["lm_head"]["kernel"], tokens, chunk=chunk
+        )
+    else:
+        logits, mut = model.apply(
+            {"params": params}, tokens, mutable=["moe_losses"]
+        )
+        loss = lm_loss(logits, tokens)
     flat, _ = jax.tree_util.tree_flatten_with_path(mut.get("moe_losses", {}))
     balance = [leaf for path, leaf in flat if "balance" in str(path)]
     zloss = [leaf for path, leaf in flat if "zloss" in str(path)]

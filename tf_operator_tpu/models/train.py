@@ -217,7 +217,7 @@ def _run_evaluator(args, model, params_template, make_batch, loss_fn) -> int:
 
 
 def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
-                      saver, t_start) -> int:
+                      saver, t_start, xla_options=None) -> int:
     """Real-data loop: host batches from the sharded dataset, double-buffered
     onto the device so the transfer of batch i+2 rides under the compute of
     batch i. Each process reads its own shards (shard_from_env) and feeds
@@ -249,9 +249,11 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
     )
 
     batch = next(it)
-    step = compile_step(state, batch)
+    step = compile_step(state, batch, compiler_options=xla_options)
     state, metrics = step(state, batch, jax.random.key(start_step))
-    jax.block_until_ready(metrics["loss"])
+    # Host transfer (block_until_ready is a no-op through the axon tunnel):
+    # startup_s must include the first step's device execution.
+    first_loss = float(metrics["loss"])
     t_first = time.time()
     done = start_step + 1
     _emit(
@@ -260,7 +262,7 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
             "t": t_first,
             "startup_s": round(t_first - t_start, 3),
             "steps_in_first_call": 1,
-            "loss": float(metrics["loss"]),
+            "loss": first_loss,
             "mesh": dict(mesh.shape),
             "backend": jax.default_backend(),
             "device_kind": jax.devices()[0].device_kind,
@@ -282,7 +284,9 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
         if (saver and args.checkpoint_every and done < args.steps
                 and done % args.checkpoint_every == 0):
             _save_checkpoint(args.checkpoint_dir, done, state)
-    jax.block_until_ready(metrics["loss"])
+    # The loop's final iteration always emits (done == args.steps), whose
+    # float() is the real window-closing host sync; block_until_ready is a
+    # no-op through the axon tunnel.
     dt = time.time() - t0
     if profiling:
         jax.profiler.stop_trace()
@@ -349,6 +353,14 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler (XProf/TensorBoard) trace of "
                          "the steady-state window to this directory")
+    ap.add_argument("--xla-option", action="append", default=[],
+                    metavar="KEY=VALUE",
+                    help="per-executable XLA compiler option (repeatable), "
+                         "forwarded via jit(...).lower().compile(). sparse "
+                         "moe-lm on TPU defaults to "
+                         "xla_tpu_scoped_vmem_limit_kib=49152: ragged_dot's "
+                         "mosaic kernel at bench shapes needs ~22M (fwd) / "
+                         "~34M (bwd) scoped VMEM vs the 16M default")
     ap.add_argument("--data-dir", default=None,
                     help="train on a sharded on-disk dataset (data/dataset.py "
                          "layout; keys must match the model's batch keys) "
@@ -509,9 +521,17 @@ def main(argv: list[str] | None = None) -> int:
                 )
             }
 
+        # Same cutover as transformer-lm: chunking exists for memory (the
+        # [B, T, vocab] f32 logits are the HBM peak at long seq), not speed
+        # — measured on-chip at the bench shape (seq 2048) the scanned head
+        # LOSES ~2% (chunk 1024) to ~17% (chunk 512) vs the full-logits
+        # path, which XLA already epilogue-fuses.
+        moe_chunked = args.seq * cfg.vocab_size >= 16384 * 32000
+
         def loss_fn(params, model_state, batch, rng):
             return (
-                moe_lib.moe_lm_loss(model, params, batch["tokens"]),
+                moe_lib.moe_lm_loss(model, params, batch["tokens"],
+                                    chunked=moe_chunked),
                 model_state,
             )
 
@@ -611,12 +631,25 @@ def main(argv: list[str] | None = None) -> int:
                "final_loss": None, "total_s": round(time.time() - t_start, 3),
                "resumed_complete": True})
         return 0
+    for kv in args.xla_option:
+        if "=" not in kv:
+            raise SystemExit(f"--xla-option must be KEY=VALUE, got {kv!r}")
+    xla_options = dict(kv.split("=", 1) for kv in args.xla_option)
+    if (args.model == "moe-lm" and args.moe_dispatch == "sparse"
+            and jax.default_backend() == "tpu"):
+        # lax.ragged_dot's mosaic kernel at the bench expert shapes picks a
+        # 4096x768x512 tiling: ~21.5M scoped VMEM for the forward and
+        # ~33.8M for the dW ragged-dot in the backward; the 16M default
+        # fails the compile outright. 48M covers both with margin.
+        xla_options.setdefault("xla_tpu_scoped_vmem_limit_kib", "49152")
     if args.data_dir:
         return _train_on_dataset(args, state, start_step, loss_fn, tx, mesh,
-                                 rules, saver, t_start)
+                                 rules, saver, t_start,
+                                 xla_options=xla_options or None)
 
     compile_scanned = make_scanned_train_step(
-        loss_fn, tx, mesh, make_batch, rules=rules, remat=args.remat
+        loss_fn, tx, mesh, make_batch, rules=rules, remat=args.remat,
+        compiler_options=xla_options or None,
     )
     # Chunked on-device loop: one dispatch per `chunk` steps (batches are
     # generated inside the compiled program) — per-step host round-trips to
@@ -647,7 +680,9 @@ def main(argv: list[str] | None = None) -> int:
             _save_checkpoint(args.checkpoint_dir, done, state)
 
     state, metrics = step_chunk(state)
-    jax.block_until_ready(metrics["loss"])
+    # Host transfer, not block_until_ready (a no-op through the axon
+    # tunnel): startup_s must include the first chunk's device execution.
+    first_loss = float(metrics["loss"])
     t_first = time.time()
     done = start_step + chunk
     _emit(
@@ -656,7 +691,7 @@ def main(argv: list[str] | None = None) -> int:
             "t": t_first,
             "startup_s": round(t_first - t_start, 3),
             "steps_in_first_call": chunk,
-            "loss": float(metrics["loss"]),
+            "loss": first_loss,
             "mesh": dict(mesh.shape),
             "backend": jax.default_backend(),
             "device_kind": jax.devices()[0].device_kind,
@@ -680,17 +715,22 @@ def main(argv: list[str] | None = None) -> int:
     if profiling and not profile_last_chunk:
         _start_profile(args.profile_dir)
     t0 = time.time()
+    synced = True
     for _ in range(timed_chunks):
         state, metrics = step_chunk(state)
         done += chunk
         # Throttle to the requested cadence: float() is a device sync, and
         # emitting every sub-log_every chunk would reintroduce the per-step
         # host round-trips this loop exists to avoid.
-        if done % args.log_every == 0 or done == args.steps:
+        synced = done % args.log_every == 0 or done == args.steps
+        if synced:
             _emit({"event": "progress", "step": done,
                    "loss": float(metrics["loss"])})
         maybe_checkpoint(done)
-    jax.block_until_ready(metrics["loss"])
+    if not synced:
+        # block_until_ready is a no-op through the axon tunnel; only a host
+        # transfer actually closes the timed window.
+        float(metrics["loss"])
     dt = time.time() - t0
     steady = timed_chunks * chunk
     if profile_last_chunk:
@@ -702,10 +742,12 @@ def main(argv: list[str] | None = None) -> int:
     if profile_last_chunk:
         state, metrics = step_chunk(state)
         done += chunk
+        # Host transfer BEFORE stop_trace: block_until_ready is a no-op
+        # through the axon tunnel, and stopping the trace while the chunk
+        # is still executing would truncate it.
+        chunk_loss = float(metrics["loss"])
         if done % args.log_every == 0 or done == args.steps:
-            _emit({"event": "progress", "step": done,
-                   "loss": float(metrics["loss"])})
-        jax.block_until_ready(metrics["loss"])
+            _emit({"event": "progress", "step": done, "loss": chunk_loss})
         jax.profiler.stop_trace()
         _emit({"event": "profile_done", "dir": args.profile_dir,
                "steps_traced": chunk, "in_timed_window": False})

@@ -137,14 +137,22 @@ def make_train_step(
     def batch_shardings_for(batch):
         return jax.tree.map(lambda _: batch_sh, batch)
 
-    def compile_step(example_state: TrainState, example_batch):
+    def compile_step(example_state: TrainState, example_batch,
+                     compiler_options: dict[str, str] | None = None):
         st_sh = state_shardings(example_state, mesh, rules)
-        return jax.jit(
+        jitted = jax.jit(
             _step,
             in_shardings=(st_sh, batch_shardings_for(example_batch), repl),
             out_shardings=(st_sh, repl),
             donate_argnums=(0,),
         )
+        if not compiler_options:
+            return jitted
+        # Same per-executable XLA options hook as make_scanned_train_step
+        # (e.g. the scoped-VMEM raise lax.ragged_dot needs on TPU).
+        return jitted.lower(
+            example_state, example_batch, jax.random.key(0)
+        ).compile(compiler_options=compiler_options)
 
     return _step, compile_step
 
@@ -158,6 +166,7 @@ def make_scanned_train_step(
     remat: bool = False,
     seq_sharded_batch: bool = False,
     seed: int = 0,
+    compiler_options: dict[str, str] | None = None,
 ):
     """On-device training loop: one jit call runs `unroll` optimizer steps.
 
@@ -171,6 +180,13 @@ def make_scanned_train_step(
 
     Returns compile(example_state, unroll) -> step(state) -> (state,
     metrics) with donated state; metrics are the last step's.
+
+    compiler_options: per-executable XLA options forwarded through
+    jit(...).lower(...).compile(...) (proto-backed xla_* keys reach the
+    TPU compile helper; client XLA_FLAGS cannot carry TPU flags). Used
+    e.g. to raise xla_tpu_scoped_vmem_limit_kib for lax.ragged_dot's
+    mosaic kernel, whose default tiling at MoE bench shapes needs >16M
+    scoped VMEM.
     """
     _step, _ = make_train_step(loss_fn, tx, mesh, rules=rules, remat=remat)
     batch_sh = mesh_lib.batch_sharding(mesh, extra_seq_axis=seq_sharded_batch)
@@ -195,11 +211,16 @@ def make_scanned_train_step(
             )
             return state, jax.tree.map(lambda a: a[-1], ms)
 
-        return jax.jit(
+        jitted = jax.jit(
             _many,
             in_shardings=(st_sh,),
             out_shardings=(st_sh, repl),
             donate_argnums=(0,),
+        )
+        if not compiler_options:
+            return jitted
+        return jitted.lower(example_state).compile(
+            compiler_options=compiler_options
         )
 
     return compile_scanned

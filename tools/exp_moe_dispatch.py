@@ -37,7 +37,7 @@ from tf_operator_tpu.models import moe as moe_lib
 from tf_operator_tpu.parallel import mesh as mesh_lib
 from tf_operator_tpu.parallel import sharding_rules
 from tf_operator_tpu.parallel.train_step import (
-    create_train_state, make_train_step, shard_state,
+    create_train_state, make_scanned_train_step, shard_state,
 )
 
 variant = {variant!r}
@@ -49,24 +49,41 @@ cfg = moe_lib.MoEConfig(
     dispatch="dense" if variant == "dense" else "sparse",
 )
 mesh = mesh_lib.make_mesh({{"dp": 1}})
-model = moe_lib.MoETransformerLM(cfg)
+# Same attention as the trainer's bench path (flash kernel on TPU) — with
+# the default reference attention the whole ladder reads ~9% low.
+from tf_operator_tpu.parallel.ring_attention import make_attention_fn
+model = moe_lib.MoETransformerLM(cfg, attn_fn=make_attention_fn(mesh, causal=True))
 params = model.init(jax.random.key(0), jnp.zeros((1, seq), jnp.int32))["params"]
 
 def loss_fn(params, model_state, batch, rng):
     return moe_lib.moe_lm_loss(model, params, batch["tokens"]), model_state
 
+def make_batch(rng):
+    return {{"tokens": jax.random.randint(rng, (batch, seq), 0,
+                                          cfg.vocab_size)}}
+
 tx = optax.adamw(1e-3)
 state = shard_state(create_train_state(params, tx), mesh,
                     sharding_rules.MOE_RULES)
-tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0, cfg.vocab_size)
-step, _ = make_train_step(loss_fn, tx, mesh, rules=sharding_rules.MOE_RULES)
-state, m = step(state, {{"tokens": tokens}}, jax.random.key(0))
+# The SAME compiled shape as the trainer's bench path: scanned chunks of 5
+# with donated state (a bare un-jitted _step would run op-by-op and OOM),
+# and the ragged variants need the scoped-VMEM raise train.py applies.
+opts = None
+if variant != "dense" and "megablox" not in variant:
+    opts = {{"xla_tpu_scoped_vmem_limit_kib": "49152"}}
+compile_scanned = make_scanned_train_step(
+    loss_fn, tx, mesh, make_batch, rules=sharding_rules.MOE_RULES,
+    compiler_options=opts,
+)
+chunk = max(1, min(5, steps // 2))  # timed window needs >= 1 full chunk
+step_chunk = compile_scanned(state, chunk)
+state, m = step_chunk(state)
 float(m["loss"])  # host sync: the axon backend's block_until_ready is a no-op
 t0 = time.perf_counter()
-for i in range(steps):
-    state, m = step(state, {{"tokens": tokens}}, jax.random.key(i))
+for _ in range(steps // chunk):
+    state, m = step_chunk(state)
 loss = float(m["loss"])  # host sync closes the timed window
-dt = (time.perf_counter() - t0) / steps
+dt = (time.perf_counter() - t0) / (steps // chunk * chunk)
 sys.path.insert(0, {repo!r})
 from bench import device_peak_tflops, moe_train_flops_per_token
 kind = getattr(jax.devices()[0], "device_kind", "")
