@@ -188,6 +188,11 @@ def device_peak_tflops(device_kind: str | None) -> float | None:
     return None
 
 
+# Most recent committed canonical bench snapshot: where skip records
+# point reviewers when the accelerator is down at bench time.
+LAST_GOOD_SNAPSHOT = "docs/bench_r04.json"
+
+
 def tunnel_alive(timeout: float = 60.0) -> bool:
     """Quick accelerator-dial probe in a subprocess. A SIGKILLed trainer
     can wedge the tunnel's chip grant (observed: every later dial blocks
@@ -334,7 +339,7 @@ def _main() -> int:
             "details": {
                 "skipped": "tunnel_down",
                 "probe_error": dial["error"],
-                "last_good": "docs/bench_r03.json",
+                "last_good": LAST_GOOD_SNAPSHOT,
                 "note": "accelerator dial failed/hung before any workload; "
                         "this is an environment outage, not a perf "
                         "regression — see last_good for canonical numbers",
@@ -354,7 +359,7 @@ def _main() -> int:
             "details": {
                 "skipped": "tunnel_down",
                 "probe_error": f"warm re-dial failed: {dial_warm['error']}",
-                "last_good": "docs/bench_r03.json",
+                "last_good": LAST_GOOD_SNAPSHOT,
                 "note": "accelerator answered once then stopped; environment "
                         "outage, not a perf regression",
             },
@@ -388,7 +393,7 @@ def _main() -> int:
             "metric": "dist_mnist_e2e_wallclock_s", "value": -1.0, "unit": "s",
             "vs_baseline": 0.0,
             "details": {"error": "mnist job failed", "skipped": tunnel_note,
-                        "last_good": "docs/bench_r03.json"},
+                        "last_good": LAST_GOOD_SNAPSHOT},
         }))
         return 1
     ev = {e["event"]: e for e in mnist["events"]}

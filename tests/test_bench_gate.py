@@ -1,0 +1,90 @@
+"""The bench's dead-tunnel gate (VERDICT r3 weak #1 / next #1a).
+
+bench.py must never burn its 600 s budget on a wedged accelerator tunnel:
+the probe subprocess decides up front, and a dead tunnel yields ONE
+machine-distinguishable skip record (skipped=tunnel_down + last_good
+pointer) instead of value=-1 masquerading as a perf regression. These
+tests drive the probe's three outcomes with a fake interpreter and the
+_main gate with a stubbed probe — no accelerator, no jax import in the
+parent (bench's own invariant).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import stat
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def _fake_interpreter(tmp_path, body: str) -> str:
+    """A stand-in for sys.executable: ignores argv, runs `body` as shell."""
+    p = tmp_path / "fake-python"
+    p.write_text(f"#!/bin/sh\n{body}\n")
+    p.chmod(p.stat().st_mode | stat.S_IXUSR)
+    return str(p)
+
+
+class TestProbeBackend:
+    def test_healthy_dial(self, tmp_path, monkeypatch):
+        fake = _fake_interpreter(tmp_path, "printf 'tpu\\tTPU v5 lite'")
+        monkeypatch.setattr(bench.sys, "executable", fake)
+        r = bench.probe_backend(timeout=10)
+        assert r["ok"] and r["platform"] == "tpu"
+        assert r["device_kind"] == "TPU v5 lite"
+        assert r["error"] is None
+
+    def test_failed_dial_is_not_ok(self, tmp_path, monkeypatch):
+        fake = _fake_interpreter(
+            tmp_path, "echo 'RuntimeError: no accelerator' >&2; exit 3"
+        )
+        monkeypatch.setattr(bench.sys, "executable", fake)
+        r = bench.probe_backend(timeout=10)
+        assert not r["ok"]
+        assert "no accelerator" in r["error"]
+
+    def test_hung_dial_times_out_fast(self, tmp_path, monkeypatch):
+        """The wedged-tunnel mode: the dial blocks forever. The probe must
+        come back within its own timeout, not the caller's 600 s."""
+        fake = _fake_interpreter(tmp_path, "sleep 60")
+        monkeypatch.setattr(bench.sys, "executable", fake)
+        r = bench.probe_backend(timeout=1.5)
+        assert not r["ok"]
+        assert "hung" in r["error"]
+        assert r["dial_s"] < 10
+
+
+class TestDeadTunnelSkipRecord:
+    def test_main_emits_distinguishable_skip(self, monkeypatch, capsys):
+        """Probe says dead -> exactly one JSON record, skipped=tunnel_down,
+        a last_good pointer, rc 0 (outage, not failure), and NO workload
+        runs (run_job_e2e would blow up loudly if reached)."""
+        monkeypatch.setattr(
+            bench, "probe_backend",
+            lambda timeout=0: {"ok": False, "platform": None,
+                               "device_kind": None, "dial_s": 150.0,
+                               "error": "dial hung >150s (tunnel wedged)"},
+        )
+
+        def _boom(*a, **kw):  # pragma: no cover - reaching it is the bug
+            raise AssertionError("chip workload ran despite dead tunnel")
+
+        monkeypatch.setattr(bench, "run_job_e2e", _boom)
+        rc = bench._main()
+        out = capsys.readouterr().out.strip().splitlines()
+        rec = json.loads(out[-1])
+        assert rc == 0
+        assert rec["value"] == -1.0
+        assert rec["details"]["skipped"] == "tunnel_down"
+        # Must point at the CURRENT canonical snapshot (a stale pointer
+        # sends reviewers to superseded numbers).
+        assert rec["details"]["last_good"] == bench.LAST_GOOD_SNAPSHOT
+        assert os.path.exists(
+            os.path.join(os.path.dirname(bench.__file__),
+                         bench.LAST_GOOD_SNAPSHOT)
+        )
+        assert "outage" in rec["details"]["note"]
