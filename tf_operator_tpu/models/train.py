@@ -332,6 +332,13 @@ def main(argv: list[str] | None = None) -> int:
                     help="moe-lm token dispatch: dense = GShard capacity "
                          "einsums (ep-shardable); sparse = dropless sorted "
                          "ragged matmul (ep=1 perf path)")
+    ap.add_argument("--remat-save-flash", action="store_true",
+                    help="with --remat (transformer-lm): save the flash "
+                         "kernel's (o, lse) residuals so the backward "
+                         "replays only linear ops, never the O(T^2) "
+                         "kernel. Costs ~[B,T,H] bf16 per layer of HBM — "
+                         "use on sp-sharded multi-chip long-context jobs "
+                         "(single-chip 64k does not fit with it)")
     ap.add_argument("--remat", action="store_true",
                     help="activation checkpointing: rematerialize the loss, "
                          "and (transformer-lm) each block — saves only "
@@ -545,6 +552,11 @@ def main(argv: list[str] | None = None) -> int:
             # intermediates alone exceed the chip (models/transformer.py
             # remat_layers note) — this is what makes 64k trainable.
             remat_layers=args.remat,
+            # Selective policy: keep the flash (o, lse) residuals so the
+            # backward never replays the O(T^2) kernel. Doesn't fit the
+            # single-chip 64k bench point (see remat_save_flash note);
+            # multi-chip sp jobs opt in.
+            remat_save_flash=args.remat_save_flash,
         )
         attn = make_attention_fn(mesh, causal=True)
         model = tfm.TransformerLM(cfg, attn_fn=attn)
@@ -634,6 +646,9 @@ def main(argv: list[str] | None = None) -> int:
     for kv in args.xla_option:
         if "=" not in kv:
             raise SystemExit(f"--xla-option must be KEY=VALUE, got {kv!r}")
+    if args.remat_save_flash and not args.remat:
+        raise SystemExit("--remat-save-flash requires --remat (it selects "
+                         "WHICH residuals per-layer remat keeps)")
     xla_options = dict(kv.split("=", 1) for kv in args.xla_option)
     if (args.model == "moe-lm" and args.moe_dispatch == "sparse"
             and jax.default_backend() == "tpu"):

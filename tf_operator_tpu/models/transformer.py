@@ -43,6 +43,16 @@ class TransformerConfig:
     # on one v5e (~1.2 GB of layer inputs instead). ~33% more FLOPs on the
     # backward; the loss-level remat (--remat) composes with it.
     remat_layers: bool = False
+    # With remat_layers, ALSO save the flash kernel's (o, lse) residuals
+    # (checkpoint_name tags in ops/flash_attention._fwd_rule): the backward
+    # then replays only the linear ops (qkv/mlp/ln) and never re-runs the
+    # O(T^2) flash forward — ~25% less backward device work at long seq.
+    # Default OFF because the 64k x 12L x 768h single-chip bench point runs
+    # at ~15.6 G of the 15.75 G HBM and the +1.2 GB of saved o tensors OOMs
+    # it (measured: 16.84 G requested). The win is real where the residuals
+    # are sharded: under sp=4 the per-device o is ~25 MB/layer, so
+    # multi-chip long-context jobs should turn this on.
+    remat_save_flash: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -138,8 +148,13 @@ class Transformer(nn.Module):
             name="pos_embed",
         )(jnp.arange(tokens.shape[1]))
         x = x + pos[None]
-        block_cls = (nn.remat(Block, static_argnums=(2,))
-                     if cfg.remat_layers else Block)
+        if cfg.remat_layers:
+            policy = (jax.checkpoint_policies.save_only_these_names(
+                          "flash_o", "flash_lse")
+                      if cfg.remat_save_flash else None)
+            block_cls = nn.remat(Block, static_argnums=(2,), policy=policy)
+        else:
+            block_cls = Block
         for i in range(cfg.num_layers):
             x = block_cls(cfg, self.attn_fn, name=f"layer_{i}")(
                 x, deterministic)

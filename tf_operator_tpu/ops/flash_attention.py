@@ -473,11 +473,21 @@ def flash_attention_pallas(
 
 
 def _fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    from jax.ad_checkpoint import checkpoint_name
+
     b, h, t, d = q.shape
     flat = lambda x: x.reshape(b * h, x.shape[2], d)  # noqa: E731
     o, lse = _flash_fwd(
         flat(q), flat(k), flat(v), causal, block_q, block_k, interpret
     )
+    # Named for selective remat (TransformerConfig.remat_save_flash ->
+    # save_only_these_names policy): a
+    # rematted backward that saves (o, lse) — ~100 MB/layer at 64k vs the
+    # O(T^2) flash fwd replay — skips recomputing the quadratic kernel
+    # entirely; only the cheap linear ops replay. Tags are inert without a
+    # matching policy (default remat still recomputes everything).
+    o = checkpoint_name(o, "flash_o")
+    lse = checkpoint_name(lse, "flash_lse")
     return o.reshape(b, h, t, d), (q, k, v, o, lse)
 
 
