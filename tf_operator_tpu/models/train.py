@@ -375,6 +375,16 @@ def main(argv: list[str] | None = None) -> int:
                          "batch, sharded across processes")
     args = ap.parse_args(argv)
 
+    # Flag-only invariants fail HERE — before jax import, device dial, state
+    # build, or checkpoint resume (minutes on a tunneled chip), and on every
+    # path including --eval and resumed-complete early returns.
+    if args.remat_save_flash and not args.remat:
+        ap.error("--remat-save-flash requires --remat (it selects WHICH "
+                 "residuals per-layer remat keeps)")
+    for kv in args.xla_option:
+        if "=" not in kv:
+            ap.error(f"--xla-option must be KEY=VALUE, got {kv!r}")
+
     t_start = time.time()
     _emit({"event": "start", "t": t_start, "model": args.model})
 
@@ -643,12 +653,6 @@ def main(argv: list[str] | None = None) -> int:
                "final_loss": None, "total_s": round(time.time() - t_start, 3),
                "resumed_complete": True})
         return 0
-    for kv in args.xla_option:
-        if "=" not in kv:
-            raise SystemExit(f"--xla-option must be KEY=VALUE, got {kv!r}")
-    if args.remat_save_flash and not args.remat:
-        raise SystemExit("--remat-save-flash requires --remat (it selects "
-                         "WHICH residuals per-layer remat keeps)")
     xla_options = dict(kv.split("=", 1) for kv in args.xla_option)
     if (args.model == "moe-lm" and args.moe_dispatch == "sparse"
             and jax.default_backend() == "tpu"):

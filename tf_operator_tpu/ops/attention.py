@@ -62,5 +62,12 @@ def flash_attention(
         from tf_operator_tpu.ops.flash_attention import flash_attention_pallas
 
         block = int(os.environ.get("TPUJOB_FLASH_BLOCK", "1024"))
+        # TPUJOB_FLASH_INTERPRET=1: run the pallas kernels in interpret
+        # mode — with TPUJOB_ATTENTION=flash this exercises the REAL kernel
+        # (incl. its checkpoint_name-tagged vjp residuals) on a CPU mesh,
+        # which the dryrun's remat-policy regime relies on.
+        interpret = interpret or (
+            os.environ.get("TPUJOB_FLASH_INTERPRET", "") == "1"
+        )
         return flash_attention_pallas(q, k, v, causal, block, block, interpret)
     return attention_reference(q, k, v, causal)
