@@ -60,6 +60,7 @@ def run_job_e2e(model: str, steps: int, batch: int, extra: list[str],
         PodTemplateSpec,
         ReplicaSpec,
         ReplicaType,
+        RestartPolicy,
         TrainJob,
         TrainJobSpec,
         is_succeeded,
@@ -79,6 +80,14 @@ def run_job_e2e(model: str, steps: int, batch: int, extra: list[str],
             replica_specs={
                 ReplicaType.WORKER: ReplicaSpec(
                     replicas=1,
+                    # OnFailure: the 64k job runs at ~15.6 of 15.75 G HBM
+                    # and back-to-back chip pods can race the previous
+                    # pod's memory teardown through the tunnel ("TPU
+                    # worker process crashed", observed once per ~5 full
+                    # runs). The operator's own restart machinery — the
+                    # product feature — absorbs the transient; backoff
+                    # limit keeps a real regression from looping.
+                    restart_policy=RestartPolicy.ON_FAILURE,
                     template=PodTemplateSpec(
                         containers=[
                             ContainerSpec(name="tensorflow", image="local", command=cmd)
@@ -90,6 +99,7 @@ def run_job_e2e(model: str, steps: int, batch: int, extra: list[str],
     )
     defaults.set_defaults(job)
     job.spec.run_policy.scheduling.gang = False
+    job.spec.run_policy.backoff_limit = 2
 
     # Prepend the repo to PYTHONPATH, preserving any existing entries (the
     # TPU sandbox registers its backend via a sitecustomize on PYTHONPATH).
@@ -129,12 +139,21 @@ def run_job_e2e(model: str, steps: int, batch: int, extra: list[str],
         wallclock = t_observed - t_submit
         ok = is_succeeded(final.status)
         events = read_events(metrics_file)
-        return {
+        # A restarted pod (OnFailure absorbing the ~1-in-5 chip teardown
+        # transient) emits a second "start" event; surface the attempt
+        # count so an inflated wallclock_s is attributable to the restart
+        # rather than reading as a perf regression.
+        attempts = sum(1 for e in events if e.get("event") == "start") or 1
+        out = {
             "ok": ok,
             "wallclock_s": round(wallclock, 3),
             "events": events,
             "segments": _segments(events, t_submit, t_observed),
         }
+        if attempts > 1:
+            out["attempts"] = attempts
+            out["restarted"] = True
+        return out
     finally:
         session.close()
         try:
@@ -372,12 +391,24 @@ def _main() -> int:
     # can wedge the chip grant — every later dial would then block for its
     # full timeout) and the remaining chip jobs are skipped.
     _state = {"tunnel_ok": True}
+    restarted_jobs: list = []
 
     def chip_job(model, **kw):
         if on_tpu and not _state["tunnel_ok"]:
             log(f"bench: SKIP {model} (tunnel wedged)")
             return {"ok": False, "events": [], "error": "tunnel wedged"}
         r = run_job_e2e(model, **kw)
+        if r.get("restarted"):
+            # Attribution marker: a restart-absorbed transient inflates
+            # this job's wallclock; without the marker that reads as a
+            # perf regression.
+            seq = None
+            extra = kw.get("extra") or []
+            if "--seq" in extra:
+                seq = extra[extra.index("--seq") + 1]
+            restarted_jobs.append(
+                {"model": model, "seq": seq, "attempts": r["attempts"]})
+            log(f"  NOTE: {model} restarted (attempts={r['attempts']})")
         if on_tpu and not r["ok"]:
             _state["tunnel_ok"] = tunnel_alive()
             log(f"  tunnel_alive={_state['tunnel_ok']}")
@@ -613,6 +644,8 @@ def _main() -> int:
         "bench_total_s": round(time.time() - t_total, 1),
         "detail_file": "artifacts/bench_detail.json",
     }
+    if restarted_jobs:
+        details["restarted_jobs"] = restarted_jobs
     # Causal-discounted LM MFU (flash skips above-diagonal blocks; the
     # headline numbers use the standard PaLM-appendix-B convention, which
     # counts causal attention at the full 12*L*s*h — same as rounds 1-2).

@@ -274,20 +274,34 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
     profiling = bool(args.profile_dir) and done < args.steps
     if profiling:
         _start_profile(args.profile_dir)
+    # Same latency-hiding as the scanned loop: fetch step i's loss after
+    # dispatching step i+1 so the transfer rides under compute (the
+    # immediate fetch otherwise idles the chip one full tunnel round trip
+    # per emit). Only the window-closing fetch blocks.
     t0 = time.time()
+    pending = None
     while done < args.steps:
         state, metrics = step(state, next(it), jax.random.key(done))
         done += 1
-        if done % args.log_every == 0 or done == args.steps:
-            _emit({"event": "progress", "step": done,
-                   "loss": float(metrics["loss"])})
+        if pending is not None:
+            pstep, pmetrics = pending
+            if pstep % args.log_every == 0:
+                _emit({"event": "progress", "step": pstep,
+                       "loss": float(pmetrics["loss"])})
+        pending = (done, metrics)
         if (saver and args.checkpoint_every and done < args.steps
                 and done % args.checkpoint_every == 0):
             _save_checkpoint(args.checkpoint_dir, done, state)
-    # The loop's final iteration always emits (done == args.steps), whose
-    # float() is the real window-closing host sync; block_until_ready is a
-    # no-op through the axon tunnel.
+    if pending is not None:
+        # Real window closure: a host transfer (block_until_ready is a
+        # no-op through the axon tunnel).
+        pstep, pmetrics = pending
+        closing_loss = float(pmetrics["loss"])
     dt = time.time() - t0
+    if pending is not None:
+        # The loop exits only at done == args.steps, so the final progress
+        # event (pstep == args.steps) always emits.
+        _emit({"event": "progress", "step": pstep, "loss": closing_loss})
     if profiling:
         jax.profiler.stop_trace()
         _emit({"event": "profile_done", "dir": args.profile_dir,
@@ -733,24 +747,36 @@ def main(argv: list[str] | None = None) -> int:
     timed_chunks = full_chunks - 1 if profile_last_chunk else full_chunks
     if profiling and not profile_last_chunk:
         _start_profile(args.profile_dir)
+    # Latency-hiding progress: fetching a chunk's loss right after
+    # dispatching it idles the chip for a full host<->device round trip
+    # (~100 ms through the axon tunnel) every chunk. Instead, dispatch
+    # chunk i+1 FIRST (donated state returns immediately as a future),
+    # then fetch chunk i's loss while i+1 computes — the transfer rides
+    # under compute and only the window-closing fetch blocks. Progress
+    # events lag one chunk; each carries its own step number.
     t0 = time.time()
-    synced = True
+    pending = None  # (step count at fetch, metrics of that chunk)
     for _ in range(timed_chunks):
         state, metrics = step_chunk(state)
         done += chunk
-        # Throttle to the requested cadence: float() is a device sync, and
-        # emitting every sub-log_every chunk would reintroduce the per-step
-        # host round-trips this loop exists to avoid.
-        synced = done % args.log_every == 0 or done == args.steps
-        if synced:
-            _emit({"event": "progress", "step": done,
-                   "loss": float(metrics["loss"])})
+        if pending is not None:
+            pstep, pmetrics = pending
+            # Throttle to the requested cadence: emitting every
+            # sub-log_every chunk would reintroduce per-step round-trips.
+            if pstep % args.log_every == 0:
+                _emit({"event": "progress", "step": pstep,
+                       "loss": float(pmetrics["loss"])})
+        pending = (done, metrics)
         maybe_checkpoint(done)
-    if not synced:
-        # block_until_ready is a no-op through the axon tunnel; only a host
-        # transfer actually closes the timed window.
-        float(metrics["loss"])
+    if pending is not None:
+        # The last chunk's fetch is the REAL window closure —
+        # block_until_ready is a no-op through the axon tunnel.
+        pstep, pmetrics = pending
+        closing_loss = float(pmetrics["loss"])
     dt = time.time() - t0
+    if pending is not None and (pstep % args.log_every == 0
+                                or pstep == args.steps):
+        _emit({"event": "progress", "step": pstep, "loss": closing_loss})
     steady = timed_chunks * chunk
     if profile_last_chunk:
         _start_profile(args.profile_dir)
