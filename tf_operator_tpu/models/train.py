@@ -324,6 +324,17 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
     return 0
 
 
+def _logits_bytes(args, mesh, vocab_size: int) -> float:
+    """Per-device f32 logits bytes for the chunked-CE cutover: the batch
+    dim is sharded over dp x fsdp, so the global --batch is divided by
+    those axis sizes (each device materializes only its batch slice)."""
+    from tf_operator_tpu.parallel import mesh as mesh_lib
+
+    shards = max(1, mesh_lib.axis_size(mesh, "dp")
+                 * mesh_lib.axis_size(mesh, "fsdp"))
+    return 4.0 * (args.batch / shards) * args.seq * vocab_size
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -552,12 +563,11 @@ def main(argv: list[str] | None = None) -> int:
                 )
             }
 
-        # Same cutover as transformer-lm: chunking exists for memory (the
-        # [B, T, vocab] f32 logits are the HBM peak at long seq), not speed
-        # — measured on-chip at the bench shape (seq 2048) the scanned head
-        # LOSES ~2% (chunk 1024) to ~17% (chunk 512) vs the full-logits
-        # path, which XLA already epilogue-fuses.
-        moe_chunked = args.seq * cfg.vocab_size >= 16384 * 32000
+        # Same per-device logits-bytes cutover as transformer-lm: chunking
+        # exists for memory, not speed — measured on-chip at the bench
+        # shape (seq 2048) the scanned head LOSES ~2% (chunk 1024) to ~17%
+        # (chunk 512) vs the full-logits path, which XLA epilogue-fuses.
+        moe_chunked = _logits_bytes(args, mesh, cfg.vocab_size) >= 6e9
 
         def loss_fn(params, model_state, batch, rng):
             return (
@@ -599,10 +609,16 @@ def main(argv: list[str] | None = None) -> int:
                 )
             }
 
-        # Past ~16k tokens the full [B, T, vocab] logits tensor (not the
-        # activations) is the HBM peak: compute the head + softmax per
+        # When the full [B, T, vocab] f32 logits tensor gets big it (not
+        # the activations) is the HBM peak: compute the head + softmax per
         # sequence chunk instead (numerics identical; see lm_loss_chunked).
-        chunked_loss = args.seq * cfg.vocab_size >= 16384 * 32000
+        # Cutover on PER-DEVICE logits BYTES — batch scales the tensor
+        # exactly like seq, but the batch dim is dp/fsdp-sharded, so the
+        # global batch is divided by those axes first. Below the threshold
+        # the one-shot head is measurably faster than the scan
+        # (docs/perf.md): ~6 GB keeps every 4.2 GB case (8k b4, 16k b2,
+        # 32k b1 single-chip) on the fast path on a 15.75 GB chip.
+        chunked_loss = _logits_bytes(args, mesh, cfg.vocab_size) >= 6e9
 
         def loss_fn(params, model_state, batch, rng):
             if chunked_loss:
