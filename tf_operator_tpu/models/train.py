@@ -325,14 +325,17 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
 
 
 def _logits_bytes(args, mesh, vocab_size: int) -> float:
-    """Per-device f32 logits bytes for the chunked-CE cutover: the batch
-    dim is sharded over dp x fsdp, so the global --batch is divided by
-    those axis sizes (each device materializes only its batch slice)."""
+    """Per-device f32 logits bytes for the chunked-CE cutover. Every mesh
+    axis shards some dim of the [B, T, V] logits — batch over dp x fsdp,
+    seq over sp, vocab over tp (lm_head kernel is P(None, "tp")) — so the
+    global tensor is divided by all four axis sizes."""
     from tf_operator_tpu.parallel import mesh as mesh_lib
 
     shards = max(1, mesh_lib.axis_size(mesh, "dp")
-                 * mesh_lib.axis_size(mesh, "fsdp"))
-    return 4.0 * (args.batch / shards) * args.seq * vocab_size
+                 * mesh_lib.axis_size(mesh, "fsdp")
+                 * mesh_lib.axis_size(mesh, "sp")
+                 * mesh_lib.axis_size(mesh, "tp"))
+    return 4.0 * args.batch * args.seq * vocab_size / shards
 
 
 def main(argv: list[str] | None = None) -> int:
