@@ -196,6 +196,11 @@ def _run_evaluator(args, model, params_template, make_batch, loss_fn) -> int:
                 break  # stream complete
             print(f"evaluator: no new checkpoint in {args.eval_timeout}s",
                   file=sys.stderr)
+            from tf_operator_tpu.parallel.distributed import (
+                distributed_goodbye,
+            )
+
+            distributed_goodbye()
             return 1 if evaluated == 0 else 0
         seen.add(step)
         params = ckpt.restore(args.checkpoint_dir, step, template=params_template)
@@ -213,6 +218,9 @@ def _run_evaluator(args, model, params_template, make_batch, loss_fn) -> int:
             "n_batches": args.steps,
         })
     _emit({"event": "eval_done", "checkpoints_evaluated": evaluated})
+    from tf_operator_tpu.parallel.distributed import distributed_goodbye
+
+    distributed_goodbye()
     return 0
 
 
@@ -321,20 +329,29 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
             "total_s": round(time.time() - t_start, 3),
         }
     )
+    # Synchronized multi-process exit (no-op single-process): see
+    # parallel.distributed.distributed_goodbye.
+    from tf_operator_tpu.parallel.distributed import distributed_goodbye
+
+    distributed_goodbye()
     return 0
 
 
 def _logits_bytes(args, mesh, vocab_size: int) -> float:
-    """Per-device f32 logits bytes for the chunked-CE cutover. Every mesh
-    axis shards some dim of the [B, T, V] logits — batch over dp x fsdp,
-    seq over sp, vocab over tp (lm_head kernel is P(None, "tp")) — so the
-    global tensor is divided by all four axis sizes."""
+    """Per-device f32 logits bytes for the chunked-CE cutover.
+
+    Divides the global [B, T, V] tensor by dp x fsdp ONLY: the batch dim
+    is sharded by construction (batch_sharding). tp/sp are deliberately
+    excluded — tp shards the vocab dim of the lm_head matmul, but the
+    one-shot loss then gathers along that sharded dim
+    (take_along_axis), which GSPMD may resolve by all-gathering the
+    full-vocab logits per device; counting the 1/tp saving would steer
+    exactly those meshes onto the path that can OOM. Conservative
+    over-estimate -> worst case is the slightly slower chunked head."""
     from tf_operator_tpu.parallel import mesh as mesh_lib
 
     shards = max(1, mesh_lib.axis_size(mesh, "dp")
-                 * mesh_lib.axis_size(mesh, "fsdp")
-                 * mesh_lib.axis_size(mesh, "sp")
-                 * mesh_lib.axis_size(mesh, "tp"))
+                 * mesh_lib.axis_size(mesh, "fsdp"))
     return 4.0 * args.batch * args.seq * vocab_size / shards
 
 
@@ -685,6 +702,9 @@ def main(argv: list[str] | None = None) -> int:
                "steady_steps_per_sec": None, "examples_per_sec": None,
                "final_loss": None, "total_s": round(time.time() - t_start, 3),
                "resumed_complete": True})
+        from tf_operator_tpu.parallel.distributed import distributed_goodbye
+
+        distributed_goodbye()
         return 0
     xla_options = dict(kv.split("=", 1) for kv in args.xla_option)
     if (args.model == "moe-lm" and args.moe_dispatch == "sparse"
@@ -839,6 +859,11 @@ def main(argv: list[str] | None = None) -> int:
             "total_s": round(time.time() - t_start, 3),
         }
     )
+    # Synchronized multi-process exit (no-op single-process): see
+    # parallel.distributed.distributed_goodbye.
+    from tf_operator_tpu.parallel.distributed import distributed_goodbye
+
+    distributed_goodbye()
     return 0
 
 

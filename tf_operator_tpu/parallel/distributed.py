@@ -46,14 +46,10 @@ def initialize_from_env(force: bool = False) -> bool:
         num_processes=nprocs,
         process_id=pid,
     )
-    # Orderly teardown: without an explicit disconnect, the first process
-    # to exit (usually the coordinator) abruptly closes the coordination
-    # socket and slower peers' error-poll threads abort the interpreter
-    # with a FATAL ("another task died") AFTER their training already
-    # finished — a clean job then reads as "1 Worker replica(s) failed"
-    # (observed ~1-in-3 in the elastic multi-process e2e). atexit runs on
-    # every clean exit path; best-effort because a genuinely crashed peer
-    # can make shutdown itself raise.
+    # Last-resort teardown for exit paths that skip distributed_goodbye():
+    # disconnect the agent instead of letting interpreter exit slam the
+    # coordination socket. Best-effort — a genuinely crashed peer can make
+    # shutdown itself raise.
     import atexit
 
     def _orderly_shutdown():
@@ -65,3 +61,47 @@ def initialize_from_env(force: bool = False) -> bool:
     atexit.register(_orderly_shutdown)
     log.info("initialized: %d/%d via %s", pid, nprocs, coord)
     return True
+
+
+def distributed_goodbye() -> None:
+    """Synchronized clean exit for multi-process jobs.
+
+    Without this, the first process to finish (usually the coordinator)
+    exits and closes the coordination socket while slower peers are still
+    milliseconds from their own exit — their error-poll threads then abort
+    the interpreter with a FATAL ("another task died") AFTER training
+    completed, and a clean job reads as "1 Worker replica(s) failed"
+    (observed ~1-in-3 in the elastic multi-process e2e; an unsynchronized
+    atexit disconnect narrows but does not close the window).
+
+    Call at CLEAN completion only: every peer is provably alive and
+    heading to the same barrier (a peer that died earlier would have
+    broken this process's collectives first), so the barrier cannot hang.
+    The subsequent disconnects then race within microseconds and the
+    coordination service's own shutdown barrier covers the residue.
+    """
+    import threading
+
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    try:
+        from jax.experimental import multihost_utils
+
+        # Bounded wait: if a peer died between its last collective and
+        # this barrier (e.g. a post-step host-side error), the barrier
+        # would otherwise block until the coordination timeout. 60 s is
+        # enough for any healthy peer to drain its final emits; on expiry
+        # we proceed to shutdown and the dead peer's job fails as it
+        # should — same outcome as the pre-barrier behavior, just delayed.
+        t = threading.Thread(
+            target=lambda: multihost_utils.sync_global_devices(
+                "tpujob distributed_goodbye"),
+            daemon=True,
+        )
+        t.start()
+        t.join(timeout=60)
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001 - teardown must never mask success
+        pass
