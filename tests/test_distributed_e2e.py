@@ -242,3 +242,18 @@ class TestElasticDistributedTraining:
         # ...and trained to the full step budget.
         dones = [e for e in events if e["event"] == "done"]
         assert any(e["steps"] == 700 for e in dones), dones
+        # Teardown discipline (distributed_goodbye): the FINAL generation
+        # must exit cleanly — no post-completion coordination-service
+        # FATALs ("another task died"). Pod log files are APPENDED across
+        # generations (same pod names), and gen-1 workers are killed by
+        # the roll on purpose — so slice each log at the LAST "start"
+        # event (the final generation's section) before asserting.
+        import glob as _glob
+
+        for logf in _glob.glob(os.path.join(str(tmp_path), "logs", "*.log")):
+            with open(logf) as f:
+                text = f.read()
+            final_gen = text[text.rfind('"event": "start"'):]
+            if '"steps": 700' in final_gen:
+                assert "Terminating process" not in final_gen, (
+                    f"{logf}: completed worker FATALed during teardown")

@@ -196,11 +196,9 @@ def _run_evaluator(args, model, params_template, make_batch, loss_fn) -> int:
                 break  # stream complete
             print(f"evaluator: no new checkpoint in {args.eval_timeout}s",
                   file=sys.stderr)
-            from tf_operator_tpu.parallel.distributed import (
-                distributed_goodbye,
-            )
-
-            distributed_goodbye()
+            # No distributed teardown: the evaluator is excluded from
+            # the SPMD process world (cluster_spec only enrolls
+            # chief/master/worker), so it is always single-process.
             return 1 if evaluated == 0 else 0
         seen.add(step)
         params = ckpt.restore(args.checkpoint_dir, step, template=params_template)
@@ -218,9 +216,6 @@ def _run_evaluator(args, model, params_template, make_batch, loss_fn) -> int:
             "n_batches": args.steps,
         })
     _emit({"event": "eval_done", "checkpoints_evaluated": evaluated})
-    from tf_operator_tpu.parallel.distributed import distributed_goodbye
-
-    distributed_goodbye()
     return 0
 
 
@@ -340,18 +335,20 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
 def _logits_bytes(args, mesh, vocab_size: int) -> float:
     """Per-device f32 logits bytes for the chunked-CE cutover.
 
-    Divides the global [B, T, V] tensor by dp x fsdp ONLY: the batch dim
-    is sharded by construction (batch_sharding). tp/sp are deliberately
-    excluded — tp shards the vocab dim of the lm_head matmul, but the
-    one-shot loss then gathers along that sharded dim
-    (take_along_axis), which GSPMD may resolve by all-gathering the
-    full-vocab logits per device; counting the 1/tp saving would steer
-    exactly those meshes onto the path that can OOM. Conservative
-    over-estimate -> worst case is the slightly slower chunked head."""
+    Divides the global [B, T, V] tensor by dp x fsdp (batch dim, sharded
+    by construction) and sp (seq dim: the one-shot loss reduces/gathers
+    only along vocab, so sp sharding of T survives through it). tp is
+    deliberately EXCLUDED — tp shards the vocab dim, and the loss then
+    gathers along that sharded dim (take_along_axis), which GSPMD may
+    resolve by all-gathering the full-vocab logits per device; counting
+    the 1/tp saving would steer exactly those meshes onto the path that
+    can OOM. Conservative over-estimate -> worst case is the slightly
+    slower chunked head."""
     from tf_operator_tpu.parallel import mesh as mesh_lib
 
     shards = max(1, mesh_lib.axis_size(mesh, "dp")
-                 * mesh_lib.axis_size(mesh, "fsdp"))
+                 * mesh_lib.axis_size(mesh, "fsdp")
+                 * mesh_lib.axis_size(mesh, "sp"))
     return 4.0 * args.batch * args.seq * vocab_size / shards
 
 

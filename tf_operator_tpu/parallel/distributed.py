@@ -16,6 +16,9 @@ import os
 from tf_operator_tpu.cluster_spec import tpu_env
 from tf_operator_tpu.utils.logging import FieldLogger
 
+# Teardown coordination between distributed_goodbye and the atexit hook.
+_state: dict = {}
+
 
 def distributed_env() -> tuple[str | None, int, int]:
     """(coordinator_address, process_id, num_processes) from the injected env.
@@ -53,6 +56,12 @@ def initialize_from_env(force: bool = False) -> bool:
     import atexit
 
     def _orderly_shutdown():
+        if _state.get("skip_shutdown"):
+            # distributed_goodbye timed out with its barrier still in
+            # flight on this client; a shutdown now would race it at the
+            # C++ layer. Let interpreter exit handle it (the job is
+            # failing anyway — some peer is dead or wedged).
+            return
         try:
             jax.distributed.shutdown()
         except Exception:  # noqa: BLE001 - teardown must never mask the exit
@@ -91,17 +100,24 @@ def distributed_goodbye() -> None:
 
         # Bounded wait: if a peer died between its last collective and
         # this barrier (e.g. a post-step host-side error), the barrier
-        # would otherwise block until the coordination timeout. 60 s is
-        # enough for any healthy peer to drain its final emits; on expiry
-        # we proceed to shutdown and the dead peer's job fails as it
-        # should — same outcome as the pre-barrier behavior, just delayed.
+        # would otherwise block until the coordination timeout. 300 s
+        # covers healthy-but-slow peers draining final emits under heavy
+        # host load (full-suite boots have been observed at minutes); on
+        # expiry we return WITHOUT touching the client — the daemon
+        # thread may still be inside the barrier on that client, and a
+        # concurrent shutdown would race it at the C++ layer. The atexit
+        # disconnect (and, for a genuinely dead peer, the job's own
+        # failure) then proceed exactly as before this barrier existed.
         t = threading.Thread(
             target=lambda: multihost_utils.sync_global_devices(
                 "tpujob distributed_goodbye"),
             daemon=True,
         )
         t.start()
-        t.join(timeout=60)
+        t.join(timeout=300)
+        if t.is_alive():
+            _state["skip_shutdown"] = True
+            return
         jax.distributed.shutdown()
     except Exception:  # noqa: BLE001 - teardown must never mask success
         pass
