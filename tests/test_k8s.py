@@ -10,6 +10,7 @@ status subresource.
 from __future__ import annotations
 
 import json
+import threading
 import time
 import urllib.request
 from pathlib import Path
@@ -607,6 +608,65 @@ class TestElasticScalingOverWire:
         )
 
 
+class TestClientRateLimit:
+    """Client-side QPS/burst throttle (reference --qps/--burst,
+    options.go:40-46,81-82): an O(100)-request reconcile storm must stay
+    under the configured rate instead of hammering the apiserver unbounded
+    (VERDICT r4 #6)."""
+
+    def test_token_bucket_burst_then_refill(self):
+        from tf_operator_tpu.core.k8s import _TokenBucket
+
+        tb = _TokenBucket(qps=50.0, burst=10)
+        # The burst is free: the bucket's own accounting charges no sleep
+        # (wall-clock ceilings flake on loaded CI hosts).
+        assert sum(tb.acquire() for _ in range(10)) == 0.0
+        t0 = time.monotonic()
+        for _ in range(10):          # past the burst: pays 1/qps each
+            tb.acquire()
+        elapsed = time.monotonic() - t0
+        assert elapsed >= 10 / 50.0 * 0.9  # ~0.2 s at qps=50
+
+    def test_storm_stays_under_configured_rate(self):
+        """100 concurrent requests from many threads (the O(100)-job storm)
+        through one throttled client: wall-clock must be bounded below by
+        (n - burst)/qps, i.e. the apiserver never sees more than the
+        configured rate."""
+        qps, burst, n = 200.0, 20, 100
+        with FakeApiServer() as server:
+            api = K8sApi(server.url, qps=qps, burst=burst)
+            path = (f"/apis/{TrainJob.API_VERSION}/namespaces/default/"
+                    f"{TrainJob.PLURAL}")
+            errs: list = []
+
+            def worker():
+                try:
+                    for _ in range(n // 10):
+                        api.request("GET", path)
+                except Exception as e:  # pragma: no cover - fail loudly
+                    errs.append(e)
+
+            t0 = time.monotonic()
+            threads = [threading.Thread(target=worker) for _ in range(10)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30)
+            elapsed = time.monotonic() - t0
+            assert not errs
+            # n requests at qps with burst head-start need at least this
+            # long; generous 0.8 factor keeps the bound flake-free while
+            # still rejecting an unthrottled client (which finishes the
+            # storm in a few tens of ms).
+            assert elapsed >= (n - burst) / qps * 0.8
+
+    def test_unthrottled_by_default(self):
+        from tf_operator_tpu.core.k8s import K8sApi as Api
+
+        assert Api("http://127.0.0.1:1")._limiter is None
+        assert Api("http://127.0.0.1:1", qps=5.0)._limiter is not None
+
+
 class TestApiServerConformance:
     """Round-3 hardening (VERDICT r2 item 5): the fake apiserver models the
     ways a real one is stricter — bookmarks, history compaction (410 Gone),
@@ -621,6 +681,79 @@ class TestApiServerConformance:
             headers={"Content-Type": "application/json"},
         )
         return urllib.request.urlopen(req)
+
+    def test_field_selector_on_list_and_watch(self):
+        """fieldSelector (metadata.name=x / status.phase!=y, ','-conjunction)
+        filters lists and watches — the last line of the round-4 drift note
+        (VERDICT r4 missing #3)."""
+        with FakeApiServer() as server:
+            for nm in ("fs-a", "fs-b"):
+                with self._post(server, job_to_k8s(_mk_job(nm, workers=1))):
+                    pass
+            base = (f"{server.url}/apis/{TrainJob.API_VERSION}/namespaces/"
+                    f"default/{TrainJob.PLURAL}")
+            with urllib.request.urlopen(
+                base + "?fieldSelector=metadata.name%3Dfs-a"
+            ) as r:
+                items = json.loads(r.read())["items"]
+            assert [o["metadata"]["name"] for o in items] == ["fs-a"]
+            # != operator and conjunction
+            with urllib.request.urlopen(
+                base + "?fieldSelector=metadata.name!%3Dfs-a,"
+                       "metadata.namespace%3Ddefault"
+            ) as r:
+                items = json.loads(r.read())["items"]
+            assert [o["metadata"]["name"] for o in items] == ["fs-b"]
+            # watch: only fs-b events pass the selector
+            u = (f"{server.url}/apis/{TrainJob.API_VERSION}/{TrainJob.PLURAL}"
+                 f"?watch=true&resourceVersion=0"
+                 f"&fieldSelector=metadata.name%3Dfs-b")
+            with urllib.request.urlopen(u, timeout=5) as resp:
+                ev = json.loads(next(iter(resp)))
+            assert ev["object"]["metadata"]["name"] == "fs-b"
+
+    def test_selector_watch_synthesizes_membership_transitions(self):
+        """A selector over a MUTABLE field must behave like a real
+        apiserver: an object leaving the selected set emits DELETED, one
+        entering it emits ADDED — a plain filter would leave informer
+        caches stale (round-5 review finding)."""
+        with FakeApiServer() as server:
+            with self._post(server, job_to_k8s(_mk_job("tr", workers=1))):
+                pass
+            url = (f"{server.url}/apis/{TrainJob.API_VERSION}/"
+                   f"{TrainJob.PLURAL}?watch=true&resourceVersion=0"
+                   f"&fieldSelector=metadata.labels.tier%3Dhot")
+            events: list = []
+            done = threading.Event()
+
+            def watch():
+                with urllib.request.urlopen(url, timeout=10) as resp:
+                    for line in resp:
+                        events.append(json.loads(line))
+                        if len(events) >= 2:
+                            done.set()
+                            return
+
+            t = threading.Thread(target=watch, daemon=True)
+            t.start()
+            time.sleep(0.3)
+            # PATCH the label in: object ENTERS the set -> ADDED
+            patch_url = (f"{server.url}/apis/{TrainJob.API_VERSION}/"
+                         f"namespaces/default/{TrainJob.PLURAL}/tr")
+            for labels in ({"tier": "hot"}, {"tier": "cold"}):
+                req = urllib.request.Request(
+                    patch_url,
+                    data=json.dumps(
+                        {"metadata": {"labels": labels}}).encode(),
+                    method="PATCH",
+                    headers={"Content-Type":
+                             "application/merge-patch+json"},
+                )
+                urllib.request.urlopen(req)
+                time.sleep(0.3)
+            assert done.wait(5), f"only saw {events}"
+            # enter -> ADDED (not MODIFIED); leave -> DELETED (not dropped)
+            assert [e["type"] for e in events[:2]] == ["ADDED", "DELETED"]
 
     def test_watch_bookmarks_delivered(self):
         with FakeApiServer() as server:

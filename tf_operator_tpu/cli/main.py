@@ -119,10 +119,12 @@ def cmd_operator(args) -> int:
     if on_k8s:
         from tf_operator_tpu.core.k8s import K8sApi, K8sCluster
 
+        qps = getattr(args, "kube_api_qps", 5.0)  # parser default
+        burst = getattr(args, "kube_api_burst", 10)
         api_client = (
-            K8sApi.in_cluster() if args.in_cluster
+            K8sApi.in_cluster(qps=qps, burst=burst) if args.in_cluster
             else K8sApi(args.kube_api, token=args.kube_token,
-                        insecure=args.kube_insecure)
+                        insecure=args.kube_insecure, qps=qps, burst=burst)
         )
         cluster = K8sCluster(api_client, namespace=args.namespace or None)
     else:
@@ -424,6 +426,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "inside the cluster, ref server.go:99)")
     p.add_argument("--kube-token", default=None)
     p.add_argument("--kube-insecure", action="store_true")
+    p.add_argument("--kube-api-qps", type=float, default=5.0,
+                   help="client-side max QPS to the API server (reference "
+                        "--qps, options.go:81; 0 disables throttling)")
+    p.add_argument("--kube-api-burst", type=int, default=10,
+                   help="token-bucket burst above --kube-api-qps "
+                        "(reference --burst, options.go:82)")
     p.add_argument("--namespace", default=None,
                    help="restrict the operator to one namespace "
                         "(options.go namespace scope)")

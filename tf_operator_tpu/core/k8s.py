@@ -414,8 +414,44 @@ def podgroup_from_k8s(d: dict) -> PodGroup:
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
+class _TokenBucket:
+    """client-go-style flowcontrol token bucket: `qps` refill rate, `burst`
+    capacity. acquire() blocks until a token is available, so every caller
+    (reconcile workers, informer relists, status writers) shares one
+    client-side ceiling on API-server request rate — the reference's
+    --qps/--burst RESTClient throttle (options.go:40-43,81-82). Thread-safe."""
+
+    def __init__(self, qps: float, burst: int):
+        self.qps = float(qps)
+        self.burst = float(max(1, burst))
+        self._tokens = self.burst
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def acquire(self) -> float:
+        """Take one token, sleeping as needed. Returns seconds slept."""
+        slept = 0.0
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                self._tokens = min(
+                    self.burst, self._tokens + (now - self._last) * self.qps
+                )
+                self._last = now
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return slept
+                wait = (1.0 - self._tokens) / self.qps
+            time.sleep(wait)
+            slept += wait
+
+
 class K8sApi:
-    """Minimal stdlib HTTP client for the API server."""
+    """Minimal stdlib HTTP client for the API server.
+
+    qps/burst (reference: options.go:40-46, client-go DefaultQPS=5 /
+    DefaultBurst=10) apply a client-side token-bucket throttle to every
+    request, watches included; qps <= 0 disables throttling."""
 
     def __init__(
         self,
@@ -424,10 +460,13 @@ class K8sApi:
         ca_file: str | None = None,
         insecure: bool = False,
         timeout: float = 30.0,
+        qps: float = 0.0,
+        burst: int = 10,
     ):
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
+        self._limiter = _TokenBucket(qps, burst) if qps > 0 else None
         if base_url.startswith("https"):
             if insecure:
                 ctx = ssl._create_unverified_context()  # noqa: S323 — opt-in
@@ -438,7 +477,7 @@ class K8sApi:
             self._ctx = None
 
     @classmethod
-    def in_cluster(cls) -> "K8sApi":
+    def in_cluster(cls, qps: float = 0.0, burst: int = 10) -> "K8sApi":
         """Service-account config, like rest.InClusterConfig (server.go:99)."""
         import os
 
@@ -447,11 +486,13 @@ class K8sApi:
         with open(f"{SA_DIR}/token") as f:
             token = f.read().strip()
         return cls(f"https://{host}:{port}", token=token,
-                   ca_file=f"{SA_DIR}/ca.crt")
+                   ca_file=f"{SA_DIR}/ca.crt", qps=qps, burst=burst)
 
     def _open(self, method: str, path: str, body: dict | None,
               params: dict | None, timeout: float | None = None,
               content_type: str = "application/json"):
+        if self._limiter is not None:
+            self._limiter.acquire()
         url = self.base_url + path
         if params:
             url += "?" + urllib.parse.urlencode(params)

@@ -137,7 +137,6 @@ def topk_routing(
     return combine, dispatch, aux
 
 
-@jax.custom_vjp
 def _dispatch_gather(xf, token_of, inv, k):
     """x_sorted[i] = xf[token_of[i]] where token_of = order // k duplicates
     every token top_k times then groups rows by expert.
@@ -147,41 +146,48 @@ def _dispatch_gather(xf, token_of, inv, k):
     permutation) — measured at ~9% of the sparse step on-chip. The VJP is
     written by hand instead: un-permute the cotangent with the inverse
     permutation (a gather) and sum the K copies of each token (a reduce).
+
+    The index arrays and k are closed over rather than passed as formal
+    custom_vjp arguments — only the differentiable operand is formal, so
+    no None-cotangent convention or residual-carried k is needed
+    (round-4 advice: that convention is fragile against JAX's custom_vjp
+    cotangent checking).
     """
-    return jnp.take(xf, token_of, axis=0)
+    n = xf.shape[0]
+
+    @jax.custom_vjp
+    def gather(x):
+        return jnp.take(x, token_of, axis=0)
+
+    def fwd(x):
+        return jnp.take(x, token_of, axis=0), None
+
+    def bwd(_, g):
+        g_rep = jnp.take(g, inv, axis=0)           # row a <-> token a // k
+        return (g_rep.reshape(n, k, g.shape[-1]).sum(axis=1),)
+
+    gather.defvjp(fwd, bwd)
+    return gather(xf)
 
 
-def _dispatch_gather_fwd(xf, token_of, inv, k):
-    return jnp.take(xf, token_of, axis=0), (inv, k, xf.shape[0])
-
-
-def _dispatch_gather_bwd(res, g):
-    inv, k, n = res
-    g_rep = jnp.take(g, inv, axis=0)               # row a <-> token a // k
-    return g_rep.reshape(n, k, g.shape[-1]).sum(axis=1), None, None, None
-
-
-_dispatch_gather.defvjp(_dispatch_gather_fwd, _dispatch_gather_bwd)
-
-
-@jax.custom_vjp
 def _permute_rows(x, perm, inv_perm):
     """y[i] = x[perm[i]] for a PERMUTATION perm with known inverse: the
     cotangent flows back through a gather by inv_perm instead of the
-    duplicate-index scatter XLA emits for a generic take's transpose."""
-    return jnp.take(x, perm, axis=0)
+    duplicate-index scatter XLA emits for a generic take's transpose.
+    perm/inv_perm are closed over (see _dispatch_gather)."""
 
+    @jax.custom_vjp
+    def permute(x):
+        return jnp.take(x, perm, axis=0)
 
-def _permute_rows_fwd(x, perm, inv_perm):
-    return jnp.take(x, perm, axis=0), (inv_perm,)
+    def fwd(x):
+        return jnp.take(x, perm, axis=0), None
 
+    def bwd(_, g):
+        return (jnp.take(g, inv_perm, axis=0),)
 
-def _permute_rows_bwd(res, g):
-    (inv_perm,) = res
-    return jnp.take(g, inv_perm, axis=0), None, None
-
-
-_permute_rows.defvjp(_permute_rows_fwd, _permute_rows_bwd)
+    permute.defvjp(fwd, bwd)
+    return permute(x)
 
 
 def _grouped_matmul(

@@ -335,20 +335,22 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
 def _logits_bytes(args, mesh, vocab_size: int) -> float:
     """Per-device f32 logits bytes for the chunked-CE cutover.
 
-    Divides the global [B, T, V] tensor by dp x fsdp (batch dim, sharded
-    by construction) and sp (seq dim: the one-shot loss reduces/gathers
-    only along vocab, so sp sharding of T survives through it). tp is
-    deliberately EXCLUDED — tp shards the vocab dim, and the loss then
-    gathers along that sharded dim (take_along_axis), which GSPMD may
-    resolve by all-gathering the full-vocab logits per device; counting
-    the 1/tp saving would steer exactly those meshes onto the path that
-    can OOM. Conservative over-estimate -> worst case is the slightly
-    slower chunked head."""
+    Divides the global [B, T, V] tensor by dp x fsdp only (batch dim,
+    sharded by construction: the trainer puts the batch dim of every input
+    on dp/fsdp). tp AND sp are deliberately EXCLUDED. tp shards the vocab
+    dim, and the loss then gathers along that sharded dim
+    (take_along_axis), which GSPMD may resolve by all-gathering the
+    full-vocab logits per device. sp's seq sharding of T reaches the
+    logits only if GSPMD propagates the attention shard_map's seq
+    sharding through the blocks and lm_head — the trainer never shards
+    the batch's seq dim itself, so on a mesh where that propagation
+    fails the per-device logits are 1/sp bigger than the estimate and
+    the one-shot head OOMs (round-4 advice). Conservative over-estimate
+    -> worst case is the slightly slower chunked head."""
     from tf_operator_tpu.parallel import mesh as mesh_lib
 
     shards = max(1, mesh_lib.axis_size(mesh, "dp")
-                 * mesh_lib.axis_size(mesh, "fsdp")
-                 * mesh_lib.axis_size(mesh, "sp"))
+                 * mesh_lib.axis_size(mesh, "fsdp"))
     return 4.0 * args.batch * args.seq * vocab_size / shards
 
 

@@ -167,6 +167,7 @@ def make_scanned_train_step(
     seq_sharded_batch: bool = False,
     seed: int = 0,
     compiler_options: dict[str, str] | None = None,
+    scan_unroll: int = 1,
 ):
     """On-device training loop: one jit call runs `unroll` optimizer steps.
 
@@ -207,7 +208,8 @@ def make_scanned_train_step(
                 return _step(st, batch, jax.random.fold_in(rng, 1))
 
             state, ms = jax.lax.scan(
-                body, state, state.step + jnp.arange(unroll)
+                body, state, state.step + jnp.arange(unroll),
+                unroll=min(scan_unroll, unroll),
             )
             return state, jax.tree.map(lambda a: a[-1], ms)
 

@@ -26,7 +26,12 @@ and /status subresources, with the real apiserver's semantics (recursive
 object merge, array/scalar replace, null deletes, no rv precondition unless
 the patch carries one, 415 for other patch types).
 
-Not modeled: auth, field selectors, json-patch/strategic-merge patch types.
+Round-5: fieldSelector on lists and watches (`metadata.name=x`,
+`status.phase!=Running`, `,`-conjunction, `=`/`==`/`!=` operators — the
+subset real apiservers accept, generalized to any dotted path since a test
+double need not replicate the per-resource allowlist).
+
+Not modeled: auth, json-patch/strategic-merge patch types.
 """
 
 from __future__ import annotations
@@ -143,6 +148,31 @@ def _validate_and_prune(obj, schema: dict, path: str = "") -> list[str]:
 
 # /api/v1/... (core) or /apis/<group>/<version>/... (CRDs); optionally
 # namespaced; optional name; optional subresource.
+def _field_selector_match(obj: dict, selector: str | None) -> bool:
+    """K8s fieldSelector semantics: comma-conjunction of `path=value`,
+    `path==value`, `path!=value` terms, each path a dotted lookup into the
+    serialized object (metadata.name, status.phase, spec.nodeName, ...).
+    A missing field compares as the empty string, like the real server's
+    unset-field behavior."""
+    if not selector:
+        return True
+    for term in selector.split(","):
+        if "!=" in term:
+            key, _, val = term.partition("!=")
+            negate = True
+        else:
+            key, _, val = term.partition("=")
+            val = val[1:] if val.startswith("=") else val  # `==` form
+            negate = False
+        cur: object = obj
+        for seg in key.strip().split("."):
+            cur = cur.get(seg) if isinstance(cur, dict) else None
+        got = "" if cur is None else str(cur)
+        if (got == val) == negate:
+            return False
+    return True
+
+
 _PATH_RE = re.compile(
     r"^/(?:api/v1|apis/(?P<group>[^/]+)/(?P<version>[^/]+))"
     r"(?:/namespaces/(?P<ns>[^/]+))?"
@@ -290,6 +320,7 @@ class FakeApiServer:
                         res, ns, int(q.get("resourceVersion") or 0),
                         q.get("labelSelector"),
                         bookmarks=q.get("allowWatchBookmarks") == "true",
+                        field_selector=q.get("fieldSelector"),
                     )
                 with store.lock:
                     objs = store.objects.setdefault(res, {})
@@ -312,6 +343,10 @@ class FakeApiServer:
                                 for k, v in want.items()
                             )
                         ]
+                    fsel = q.get("fieldSelector")
+                    if fsel:
+                        items = [o for o in items
+                                 if _field_selector_match(o, fsel)]
                     return self._send_json({
                         "kind": "List",
                         "metadata": {"resourceVersion": str(store.rv)},
@@ -326,7 +361,8 @@ class FakeApiServer:
                 self.wfile.flush()
 
             def _watch(self, res: str, ns: str | None, since_rv: int,
-                       selector: str | None = None, bookmarks: bool = False):
+                       selector: str | None = None, bookmarks: bool = False,
+                       field_selector: str | None = None):
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -335,6 +371,31 @@ class FakeApiServer:
                     dict(p.split("=", 1) for p in selector.split(","))
                     if selector else None
                 )
+                selecting = want is not None or field_selector is not None
+
+                def _selector_match(o: dict) -> bool:
+                    return (
+                        want is None
+                        or all(
+                            (o["metadata"].get("labels") or {}).get(k) == v
+                            for k, v in want.items()
+                        )
+                    ) and _field_selector_match(o, field_selector)
+
+                # Membership set for selector transition synthesis (see the
+                # pending loop below). A selector watch from rv 0 builds it
+                # from the ADDED replay; one from rv > 0 is seeded from the
+                # CURRENT matching objects — the client is expected to have
+                # listed at that rv (reflector contract), and current state
+                # approximates state-at-rv well enough for a test double.
+                in_set: set = set()
+                if selecting and since_rv > 0:
+                    with store.lock:
+                        in_set = {
+                            k for k, o in store.objects.get(res, {}).items()
+                            if (ns is None or k[0] == ns)
+                            and _selector_match(o)
+                        }
                 sent = since_rv
                 try:
                     # History compaction, like etcd: a start rv older than
@@ -367,14 +428,34 @@ class FakeApiServer:
                                 if r == res and rv > sent
                                 and (ns is None or o["metadata"].get("namespace") == ns)
                             ]
-                            pending = [
-                                (rv, t, o) for rv, t, o in fresh
-                                if want is None
-                                or all(
-                                    (o["metadata"].get("labels") or {}).get(k) == v
-                                    for k, v in want.items()
-                                )
-                            ]
+                            if not selecting:
+                                pending = fresh
+                            else:
+                                # Selector semantics on a MUTABLE field: a
+                                # real apiserver synthesizes transitions —
+                                # an object leaving the selected set emits
+                                # DELETED, one entering it emits ADDED — so
+                                # informers never retain stale objects. A
+                                # plain filter (dropping non-matching
+                                # events) would do exactly that. `in_set`
+                                # tracks per-watch membership.
+                                pending = []
+                                for rv, t, o in fresh:
+                                    key = (o["metadata"].get("namespace"),
+                                           o["metadata"].get("name"))
+                                    matches = _selector_match(o)
+                                    if t == "DELETED":
+                                        if key in in_set:
+                                            in_set.discard(key)
+                                            pending.append((rv, t, o))
+                                    elif matches and key in in_set:
+                                        pending.append((rv, "MODIFIED", o))
+                                    elif matches:
+                                        in_set.add(key)
+                                        pending.append((rv, "ADDED", o))
+                                    elif key in in_set:  # left selected set
+                                        in_set.discard(key)
+                                        pending.append((rv, "DELETED", o))
                             # Watermark past selector-filtered events so the
                             # log isn't rescanned forever.
                             watermark = max([sent] + [rv for rv, _, _ in fresh])

@@ -1,0 +1,176 @@
+"""Attribute the sparse-MoE roofline's "Unknown" bucket op by op (VERDICT r4 #1).
+
+BENCH_r04's moe_roofline says 20.1% of step self-time is bound_by=Unknown —
+ops xprof's hlo_stats could not classify against either roofline. This tool
+runs the exact bench moe-lm sparse config under an XProf trace and prints the
+FULL per-op accounting the bench's 5-op summary truncates:
+
+  - self-time share grouped by (bound_by, HLO category)
+  - every op >= 0.3% in the Unknown bucket, with name + category
+  - a routing-chain rollup: sort / scatter / gather / ragged-dot / fusion
+    shares matched by op-name substring, so the argsort+bincount+permute
+    suspect chain (models/moe.py:249-272) gets a measured number
+
+Runs in a subprocess (one process per chip). Usage:
+  python tools/exp_moe_attrib.py [--steps 10] [--out artifacts/moe_attrib.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import json, os, sys, tempfile, time
+import jax, jax.numpy as jnp, optax
+
+sys.path.insert(0, {repo!r})
+from tf_operator_tpu.models import moe as moe_lib
+from tf_operator_tpu.parallel import mesh as mesh_lib
+from tf_operator_tpu.parallel import sharding_rules
+from tf_operator_tpu.parallel.ring_attention import make_attention_fn
+from tf_operator_tpu.parallel.train_step import (
+    create_train_state, make_scanned_train_step, shard_state,
+)
+
+steps = {steps}
+seq, batch = 2048, 8
+cfg = moe_lib.MoEConfig(
+    vocab_size=32000, num_layers=12, hidden=768, num_heads=6,
+    max_len=seq, num_experts=8, top_k=2, moe_every=2, dispatch="sparse",
+)
+mesh = mesh_lib.make_mesh({{"dp": 1}})
+model = moe_lib.MoETransformerLM(cfg, attn_fn=make_attention_fn(mesh, causal=True))
+params = model.init(jax.random.key(0), jnp.zeros((1, seq), jnp.int32))["params"]
+
+def loss_fn(params, model_state, batch, rng):
+    return moe_lib.moe_lm_loss(model, params, batch["tokens"]), model_state
+
+def make_batch(rng):
+    return {{"tokens": jax.random.randint(rng, (batch, seq), 0,
+                                          cfg.vocab_size)}}
+
+tx = optax.adamw(1e-3)
+state = shard_state(create_train_state(params, tx), mesh,
+                    sharding_rules.MOE_RULES)
+opts = {{"xla_tpu_scoped_vmem_limit_kib": "49152"}}
+compile_scanned = make_scanned_train_step(
+    loss_fn, tx, mesh, make_batch, rules=sharding_rules.MOE_RULES,
+    compiler_options=opts,
+)
+chunk = max(1, min(5, steps // 2))
+step_chunk = compile_scanned(state, chunk)
+state, m = step_chunk(state)
+float(m["loss"])  # warm-up + host sync
+
+trace_dir = {trace_dir!r}
+with jax.profiler.trace(trace_dir):
+    for _ in range(max(1, steps // chunk)):
+        state, m = step_chunk(state)
+    float(m["loss"])
+print(json.dumps({{"ok": True, "trace_dir": trace_dir}}))
+"""
+
+
+def full_attribution(trace_dir: str) -> dict | None:
+    """Per-op accounting: bound_by x category shares + Unknown op list."""
+    import glob
+
+    sys.path.insert(0, REPO)
+    from tf_operator_tpu.utils.roofline import _load_hlo_stats
+
+    paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                             recursive=True))
+    rows = _load_hlo_stats(paths) if paths else None
+    if not rows:
+        return None
+    t_key = "Total self time (us)"
+    total = sum(r.get(t_key) or 0 for r in rows)
+    if total <= 0:
+        return None
+
+    by_bound_cat: dict[str, float] = {}
+    unknown_ops = []
+    chain = {"sort": 0.0, "scatter": 0.0, "gather": 0.0, "ragged": 0.0,
+             "top-k": 0.0, "bincount/reduce": 0.0, "other": 0.0}
+    for r in rows:
+        t = r.get(t_key) or 0
+        b = str(r.get("Bound by") or "Unknown")
+        cat = str(r.get("HLO op category") or "?")
+        name = str(r.get("HLO op name") or "?")
+        by_bound_cat[f"{b} / {cat}"] = by_bound_cat.get(f"{b} / {cat}", 0) + t
+        if b == "Unknown":
+            unknown_ops.append((t, name, cat))
+            lname = (name + " " + cat).lower()
+            if "sort" in lname:
+                chain["sort"] += t
+            elif "scatter" in lname:
+                chain["scatter"] += t
+            elif "gather" in lname or "take" in lname:
+                chain["gather"] += t
+            elif "ragged" in lname:
+                chain["ragged"] += t
+            elif "top-k" in lname or "topk" in lname:
+                chain["top-k"] += t
+            elif "reduce" in lname or "bincount" in lname:
+                chain["bincount/reduce"] += t
+            else:
+                chain["other"] += t
+
+    unknown_ops.sort(key=lambda x: -x[0])
+    pct = lambda t: round(t / total * 100, 2)  # noqa: E731
+    return {
+        "total_self_time_us": round(total, 1),
+        "bound_by_x_category_pct": {
+            k: pct(v) for k, v in
+            sorted(by_bound_cat.items(), key=lambda kv: -kv[1])
+            if v / total >= 0.002
+        },
+        "unknown_pct_total": pct(sum(t for t, _, _ in unknown_ops)),
+        "unknown_chain_rollup_pct": {k: pct(v) for k, v in
+                                     sorted(chain.items(),
+                                            key=lambda kv: -kv[1]) if v},
+        "unknown_ops": [
+            {"name": n, "category": c, "pct": pct(t)}
+            for t, n, c in unknown_ops if t / total >= 0.003
+        ],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--out", default="artifacts/moe_attrib.json")
+    args = ap.parse_args()
+
+    import tempfile
+
+    trace_dir = tempfile.mkdtemp(prefix="tpujob-moe-attrib-")
+    r = subprocess.run(
+        [sys.executable, "-c",
+         CHILD.format(repo=REPO, steps=args.steps, trace_dir=trace_dir)],
+        capture_output=True, text=True, timeout=1800,
+    )
+    if r.returncode != 0:
+        print(json.dumps({"error": r.stderr.strip().splitlines()[-3:]}))
+        return 1
+    attrib = full_attribution(trace_dir)
+    out = {"config": "moe-lm 12Lx768h E8 top2 seq2048 b8 sparse",
+           "steps": args.steps, "attribution": attrib}
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+    import shutil
+
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+"""Attack the sparse-MoE residual's "scan boundary" bucket (VERDICT r4 #1).
+
+docs/perf.md's round-4 closing profile attributes 6.5% of the sparse step
+to scan-boundary ops (copies/dynamic-update-slice at the lax.scan carry
+edge). Hypothesis: fully unrolling the per-chunk scan removes them.
+This sweep times the bench moe-lm sparse config at scan unroll 1 (round-4
+baseline) vs full unroll, one subprocess per variant (one process per chip).
+
+Usage: python tools/exp_moe_scan.py [--steps 20] [--unrolls 1,5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import json, os, sys, time
+import jax, jax.numpy as jnp, optax
+
+sys.path.insert(0, {repo!r})
+from tf_operator_tpu.models import moe as moe_lib
+from tf_operator_tpu.parallel import mesh as mesh_lib
+from tf_operator_tpu.parallel import sharding_rules
+from tf_operator_tpu.parallel.ring_attention import make_attention_fn
+from tf_operator_tpu.parallel.train_step import (
+    create_train_state, make_scanned_train_step, shard_state,
+)
+
+unroll_opt = {unroll}
+steps = {steps}
+seq, batch = 2048, 8
+cfg = moe_lib.MoEConfig(
+    vocab_size=32000, num_layers=12, hidden=768, num_heads=6,
+    max_len=seq, num_experts=8, top_k=2, moe_every=2, dispatch="sparse",
+)
+mesh = mesh_lib.make_mesh({{"dp": 1}})
+model = moe_lib.MoETransformerLM(cfg, attn_fn=make_attention_fn(mesh, causal=True))
+params = model.init(jax.random.key(0), jnp.zeros((1, seq), jnp.int32))["params"]
+
+def loss_fn(params, model_state, batch, rng):
+    return moe_lib.moe_lm_loss(model, params, batch["tokens"]), model_state
+
+def make_batch(rng):
+    return {{"tokens": jax.random.randint(rng, (batch, seq), 0,
+                                          cfg.vocab_size)}}
+
+tx = optax.adamw(1e-3)
+state = shard_state(create_train_state(params, tx), mesh,
+                    sharding_rules.MOE_RULES)
+opts = {{"xla_tpu_scoped_vmem_limit_kib": "49152"}}
+compile_scanned = make_scanned_train_step(
+    loss_fn, tx, mesh, make_batch, rules=sharding_rules.MOE_RULES,
+    compiler_options=opts, scan_unroll=unroll_opt,
+)
+chunk = max(1, min(5, steps // 2))
+t_c0 = time.perf_counter()
+step_chunk = compile_scanned(state, chunk)
+state, m = step_chunk(state)
+float(m["loss"])
+compile_s = time.perf_counter() - t_c0
+t0 = time.perf_counter()
+for _ in range(steps // chunk):
+    state, m = step_chunk(state)
+loss = float(m["loss"])
+dt = (time.perf_counter() - t0) / (steps // chunk * chunk)
+from bench import device_peak_tflops, moe_train_flops_per_token
+kind = getattr(jax.devices()[0], "device_kind", "")
+peak = device_peak_tflops(kind)
+tps = batch * seq / dt
+ftok = moe_train_flops_per_token(12, 768, seq)
+print(json.dumps({{
+    "scan_unroll": unroll_opt, "step_ms": round(dt * 1e3, 2),
+    "tokens_per_sec": round(tps, 1),
+    "mfu": round(tps * ftok / (peak * 1e12), 4) if peak else None,
+    "compile_s": round(compile_s, 1), "loss": round(loss, 3),
+}}))
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--unrolls", default="1,5")
+    args = ap.parse_args()
+    rc = 0
+    for unroll in args.unrolls.split(","):
+        r = subprocess.run(
+            [sys.executable, "-c",
+             CHILD.format(repo=REPO, unroll=int(unroll), steps=args.steps)],
+            capture_output=True, text=True, timeout=1800,
+        )
+        if r.returncode != 0:
+            print(json.dumps({"scan_unroll": unroll, "error":
+                              r.stderr.strip().splitlines()[-3:]}))
+            rc = 1
+            continue
+        print(r.stdout.strip().splitlines()[-1])
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
