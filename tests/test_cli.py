@@ -157,6 +157,25 @@ class TestRestApi:
         with urllib.request.urlopen(f"http://{server}/metrics", timeout=5) as r:
             text = r.read().decode()
         assert "tpujob_operator_jobs_created_total" in text
+        # Sync-latency histogram (VERDICT r4 #9): full Prometheus histogram
+        # series — cumulative le-buckets, +Inf, _sum, _count.
+        assert "# TYPE tpujob_operator_reconcile_duration_seconds histogram" in text
+        assert 'tpujob_operator_reconcile_duration_seconds_bucket{le="+Inf"}' in text
+        assert "tpujob_operator_reconcile_duration_seconds_count" in text
+
+    def test_histogram_bucket_math(self):
+        from tf_operator_tpu.status.metrics import Histogram
+
+        h = Histogram("h", "", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.5, 5.0):
+            h.observe(v)
+        lines = h.expose_lines()
+        assert 'h_bucket{le="0.01"} 1' in lines
+        assert 'h_bucket{le="0.1"} 2' in lines      # cumulative
+        assert 'h_bucket{le="1.0"} 3' in lines
+        assert 'h_bucket{le="+Inf"} 4' in lines
+        assert "h_count 4" in lines
+        assert any(line.startswith("h_sum 5.5") for line in lines)
 
     def test_dashboard_ui_served(self, served):
         _, _, server = served

@@ -18,6 +18,7 @@ Responsibilities at this layer (ref jobcontroller.go:81-301, pod.go, service.go)
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable
 
 from tf_operator_tpu.api.types import TrainJob
@@ -291,16 +292,20 @@ class JobControllerBase:
 
     def _process_item(self, item) -> None:
         """Sync one key; on failure, requeue with backoff (controller.go:267)."""
+        from tf_operator_tpu.status import metrics
+
+        t0 = time.monotonic()
         try:
             self.sync_job(item)
             self.queue.forget(item)
         except Exception as e:
-            from tf_operator_tpu.status import metrics
-
             metrics.reconcile_errors.inc()
             logger_for_key(str(item)).error("sync failed: %s: %s", type(e).__name__, e)
             self.queue.add_rate_limited(item)
         finally:
+            # Sync-latency distribution (the reference logs this per pass,
+            # controller.go:289-291; we expose it on /metrics).
+            metrics.reconcile_latency.observe(time.monotonic() - t0)
             self.queue.done(item)
 
     def _worker(self) -> None:

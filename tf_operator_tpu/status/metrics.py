@@ -33,9 +33,54 @@ class Gauge(Counter):
             self._v = v
 
 
+class Histogram:
+    """Cumulative-bucket histogram, Prometheus semantics: each `le` bucket
+    counts observations <= its bound, plus +Inf / _sum / _count series.
+    The reference logs per-reconcile sync latency (controller.go:289-291);
+    this surfaces the same signal as a scrapeable distribution."""
+
+    # Reconcile passes are ms-scale in-memory and seconds-scale against a
+    # real apiserver; buckets span both.
+    DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
+
+    def __init__(self, name: str, help_text: str,
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def expose_lines(self) -> list[str]:
+        with self._lock:
+            lines = []
+            if self.help:
+                lines.append(f"# HELP {self.name} {self.help}")
+            lines.append(f"# TYPE {self.name} histogram")
+            cum = 0
+            for b, c in zip(self.buckets, self._counts):
+                cum += c
+                lines.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+            cum += self._counts[-1]
+            lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{self.name}_sum {self._sum}")
+            lines.append(f"{self.name}_count {cum}")
+            return lines
+
+
 class Registry:
     def __init__(self) -> None:
-        self._metrics: dict[str, Counter] = {}
+        self._metrics: dict[str, Counter | Histogram] = {}
         self._lock = threading.Lock()
 
     def counter(self, name: str, help_text: str = "") -> Counter:
@@ -52,11 +97,22 @@ class Registry:
             assert isinstance(m, Gauge)
             return m
 
+    def histogram(self, name: str, help_text: str = "") -> Histogram:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = Histogram(name, help_text)
+            m = self._metrics[name]
+            assert isinstance(m, Histogram)
+            return m
+
     def expose(self) -> str:
         """Prometheus text exposition format."""
         with self._lock:
             lines = []
             for m in self._metrics.values():
+                if isinstance(m, Histogram):
+                    lines.extend(m.expose_lines())
+                    continue
                 kind = "gauge" if isinstance(m, Gauge) else "counter"
                 if m.help:
                     lines.append(f"# HELP {m.name} {m.help}")
@@ -90,4 +146,9 @@ reconcile_total = DEFAULT.counter(
 )
 reconcile_errors = DEFAULT.counter(
     "tpujob_operator_reconcile_errors_total", "Total reconcile passes that errored"
+)
+reconcile_latency = DEFAULT.histogram(
+    "tpujob_operator_reconcile_duration_seconds",
+    "Per-reconcile sync latency (ref controller.go:289-291 logs this; "
+    "here it is a scrapeable histogram)",
 )

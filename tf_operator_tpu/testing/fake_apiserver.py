@@ -189,8 +189,11 @@ class _Store:
         # {resource: {(ns, name): obj_dict}}
         self.objects: dict[str, dict[tuple[str, str], dict]] = {}
         # watch log, COMPACTED like etcd history: only the last
-        # `watch_log_retain` entries are retained; (rv, type, resource, obj)
-        self.log: list[tuple[int, str, str, dict]] = []
+        # `watch_log_retain` entries are retained;
+        # (rv, type, resource, obj, prev_obj) — prev_obj is the version the
+        # event replaced (None for ADDED), so selector watches can compute
+        # membership transitions statelessly at any start rv.
+        self.log: list[tuple[int, str, str, dict, dict | None]] = []
         self.watch_log_retain = watch_log_retain
         # {resource: rv of its newest discarded entry}
         self.compacted_before: dict[str, int] = {}
@@ -201,10 +204,10 @@ class _Store:
         self.rv += 1
         return self.rv
 
-    def append_log(self, entry: tuple[int, str, str, dict]) -> None:
+    def append_log(self, entry: tuple[int, str, str, dict, dict | None]) -> None:
         self.log.append(entry)
         while len(self.log) > self.watch_log_retain:
-            rv0, _, res0, _ = self.log[0]
+            rv0, _, res0 = self.log[0][:3]
             # Per-RESOURCE compaction watermark: churn in pods/events must
             # not 410 a quiet trainjobs watcher that lost nothing.
             self.compacted_before[res0] = rv0
@@ -219,12 +222,17 @@ class _Store:
 class FakeApiServer:
     def __init__(self, port: int = 0, watch_log_retain: int = 4096,
                  validate_schemas: bool = True,
-                 admission_webhooks: dict[str, str] | None = None):
+                 admission_webhooks: dict[str, str] | None = None,
+                 admission_ca_file: str | None = None):
         store = self.store = _Store(watch_log_retain=watch_log_retain)
         schemas = _load_crd_schemas() if validate_schemas else {}
         # {resource plural -> webhook URL}: like a registered
         # ValidatingWebhookConfiguration (manifests/webhook.yaml), consulted
         # on create/update/patch AFTER schema validation, BEFORE storage.
+        # admission_ca_file plays clientConfig.caBundle: the CA the
+        # apiserver trusts when dialing an https:// webhook. Real apiservers
+        # REQUIRE https webhooks; an https URL with no (or the wrong) CA
+        # fails TLS verification and admission fails closed.
         webhooks = dict(admission_webhooks or {})
 
         def call_admission(res: str, operation: str, obj: dict):
@@ -247,8 +255,13 @@ class FakeApiServer:
                 url, data=json.dumps(review).encode(), method="POST",
                 headers={"Content-Type": "application/json"},
             )
+            ctx = None
+            if url.startswith("https"):
+                import ssl as _ssl
+
+                ctx = _ssl.create_default_context(cafile=admission_ca_file)
             try:
-                with _rq.urlopen(req, timeout=5.0) as r:
+                with _rq.urlopen(req, timeout=5.0, context=ctx) as r:
                     resp = (json.loads(r.read()) or {}).get("response") or {}
             except (OSError, ValueError) as exc:
                 return (500, f"admission webhook for {res} unreachable "
@@ -382,20 +395,6 @@ class FakeApiServer:
                         )
                     ) and _field_selector_match(o, field_selector)
 
-                # Membership set for selector transition synthesis (see the
-                # pending loop below). A selector watch from rv 0 builds it
-                # from the ADDED replay; one from rv > 0 is seeded from the
-                # CURRENT matching objects — the client is expected to have
-                # listed at that rv (reflector contract), and current state
-                # approximates state-at-rv well enough for a test double.
-                in_set: set = set()
-                if selecting and since_rv > 0:
-                    with store.lock:
-                        in_set = {
-                            k for k, o in store.objects.get(res, {}).items()
-                            if (ns is None or k[0] == ns)
-                            and _selector_match(o)
-                        }
                 sent = since_rv
                 try:
                     # History compaction, like etcd: a start rv older than
@@ -424,12 +423,14 @@ class FakeApiServer:
                             # too, not silently skip them.
                             mid_expired = store.expired(res, sent)
                             fresh = [] if mid_expired else [
-                                (rv, t, o) for rv, t, r, o in store.log
+                                (rv, t, o, prev)
+                                for rv, t, r, o, prev in store.log
                                 if r == res and rv > sent
                                 and (ns is None or o["metadata"].get("namespace") == ns)
                             ]
                             if not selecting:
-                                pending = fresh
+                                pending = [(rv, t, o)
+                                           for rv, t, o, _ in fresh]
                             else:
                                 # Selector semantics on a MUTABLE field: a
                                 # real apiserver synthesizes transitions —
@@ -437,28 +438,32 @@ class FakeApiServer:
                                 # DELETED, one entering it emits ADDED — so
                                 # informers never retain stale objects. A
                                 # plain filter (dropping non-matching
-                                # events) would do exactly that. `in_set`
-                                # tracks per-watch membership.
+                                # events) would do exactly that. The log
+                                # carries each event's REPLACED version, so
+                                # the transition is computed statelessly
+                                # (old-match vs new-match) and is correct
+                                # from any start rv — including replayed
+                                # DELETEDs a per-watch membership set
+                                # seeded from current state would drop.
                                 pending = []
-                                for rv, t, o in fresh:
-                                    key = (o["metadata"].get("namespace"),
-                                           o["metadata"].get("name"))
-                                    matches = _selector_match(o)
+                                for rv, t, o, prev in fresh:
+                                    old_m = (prev is not None
+                                             and _selector_match(prev))
                                     if t == "DELETED":
-                                        if key in in_set:
-                                            in_set.discard(key)
+                                        if old_m:
                                             pending.append((rv, t, o))
-                                    elif matches and key in in_set:
+                                        continue
+                                    new_m = _selector_match(o)
+                                    if old_m and new_m:
                                         pending.append((rv, "MODIFIED", o))
-                                    elif matches:
-                                        in_set.add(key)
+                                    elif new_m:      # entered the set
                                         pending.append((rv, "ADDED", o))
-                                    elif key in in_set:  # left selected set
-                                        in_set.discard(key)
+                                    elif old_m:      # left the set
                                         pending.append((rv, "DELETED", o))
                             # Watermark past selector-filtered events so the
                             # log isn't rescanned forever.
-                            watermark = max([sent] + [rv for rv, _, _ in fresh])
+                            watermark = max(
+                                [sent] + [rv for rv, _, _, _ in fresh])
                             if not pending:
                                 sent = watermark
                                 # On idle ticks an opted-in client gets a
@@ -538,7 +543,7 @@ class FakeApiServer:
                     meta["resourceVersion"] = str(rv)
                     meta.setdefault("uid", f"uid-{rv}")
                     objs[(ns, name)] = obj
-                    store.append_log((rv, "ADDED", res, obj))
+                    store.append_log((rv, "ADDED", res, obj, None))
                     store.lock.notify_all()
                 return self._send_json(obj, 201)
 
@@ -600,7 +605,7 @@ class FakeApiServer:
                     rv = store.bump()
                     new["metadata"]["resourceVersion"] = str(rv)
                     objs[(ns, name)] = new
-                    store.append_log((rv, "MODIFIED", res, new))
+                    store.append_log((rv, "MODIFIED", res, new, cur))
                     store.lock.notify_all()
                 return self._send_json(new)
 
@@ -681,7 +686,7 @@ class FakeApiServer:
                     rv = store.bump()
                     new["metadata"]["resourceVersion"] = str(rv)
                     objs[(ns, name)] = new
-                    store.append_log((rv, "MODIFIED", res, new))
+                    store.append_log((rv, "MODIFIED", res, new, cur))
                     store.lock.notify_all()
                 return self._send_json(new)
 
@@ -696,10 +701,11 @@ class FakeApiServer:
                     if obj is None:
                         return self._error(404, "NotFound", f"{res} {ns}/{name}")
                     rv = store.bump()
+                    prev = obj
                     obj = dict(obj)
                     obj["metadata"] = dict(obj["metadata"])
                     obj["metadata"]["resourceVersion"] = str(rv)
-                    store.append_log((rv, "DELETED", res, obj))
+                    store.append_log((rv, "DELETED", res, obj, prev))
                     store.lock.notify_all()
                 return self._send_json(obj)
 
@@ -756,6 +762,7 @@ class FakeApiServer:
             pod = self.store.objects.get("pods", {}).get((namespace, name))
             if pod is None:
                 raise KeyError(f"pod {namespace}/{name}")
+            prev = pod
             pod = dict(pod)
             state: dict = {"running": {}}
             if exit_code is not None:
@@ -771,5 +778,5 @@ class FakeApiServer:
             pod["metadata"] = dict(pod["metadata"])
             pod["metadata"]["resourceVersion"] = str(rv)
             self.store.objects["pods"][(namespace, name)] = pod
-            self.store.append_log((rv, "MODIFIED", "pods", pod))
+            self.store.append_log((rv, "MODIFIED", "pods", pod, prev))
             self.store.lock.notify_all()

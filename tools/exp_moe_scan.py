@@ -34,6 +34,7 @@ from tf_operator_tpu.parallel.train_step import (
 
 unroll_opt = {unroll}
 steps = {steps}
+chunk_opt = {chunk}
 seq, batch = 2048, 8
 cfg = moe_lib.MoEConfig(
     vocab_size=32000, num_layers=12, hidden=768, num_heads=6,
@@ -58,7 +59,7 @@ compile_scanned = make_scanned_train_step(
     loss_fn, tx, mesh, make_batch, rules=sharding_rules.MOE_RULES,
     compiler_options=opts, scan_unroll=unroll_opt,
 )
-chunk = max(1, min(5, steps // 2))
+chunk = min(chunk_opt, steps) if chunk_opt else max(1, min(5, steps // 2))
 t_c0 = time.perf_counter()
 step_chunk = compile_scanned(state, chunk)
 state, m = step_chunk(state)
@@ -75,7 +76,7 @@ peak = device_peak_tflops(kind)
 tps = batch * seq / dt
 ftok = moe_train_flops_per_token(12, 768, seq)
 print(json.dumps({{
-    "scan_unroll": unroll_opt, "step_ms": round(dt * 1e3, 2),
+    "scan_unroll": unroll_opt, "chunk": chunk, "step_ms": round(dt * 1e3, 2),
     "tokens_per_sec": round(tps, 1),
     "mfu": round(tps * ftok / (peak * 1e12), 4) if peak else None,
     "compile_s": round(compile_s, 1), "loss": round(loss, 3),
@@ -87,20 +88,25 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--unrolls", default="1,5")
+    ap.add_argument("--chunks", default="0",
+                    help="comma list; 0 = bench default min(5, steps//2)")
     args = ap.parse_args()
     rc = 0
     for unroll in args.unrolls.split(","):
-        r = subprocess.run(
-            [sys.executable, "-c",
-             CHILD.format(repo=REPO, unroll=int(unroll), steps=args.steps)],
-            capture_output=True, text=True, timeout=1800,
-        )
-        if r.returncode != 0:
-            print(json.dumps({"scan_unroll": unroll, "error":
-                              r.stderr.strip().splitlines()[-3:]}))
-            rc = 1
-            continue
-        print(r.stdout.strip().splitlines()[-1])
+        for chunk in args.chunks.split(","):
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 CHILD.format(repo=REPO, unroll=int(unroll),
+                              steps=args.steps, chunk=int(chunk))],
+                capture_output=True, text=True, timeout=1800,
+            )
+            if r.returncode != 0:
+                print(json.dumps({"scan_unroll": unroll, "chunk": chunk,
+                                  "error":
+                                  r.stderr.strip().splitlines()[-3:]}))
+                rc = 1
+                continue
+            print(r.stdout.strip().splitlines()[-1])
     return rc
 
 

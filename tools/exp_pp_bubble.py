@@ -1,0 +1,101 @@
+"""Measure the GPipe bubble fraction on the virtual CPU mesh (VERDICT r4 #8).
+
+Method (see tests/test_pipeline.py::TestBubbleFraction): the SPMD schedule
+executes m+p-1 ticks per step, so with microbatch SIZE held fixed, wall
+time is T(m) ~ (m + fill_drain) * tau with fill_drain = p-1 analytically.
+Fitting T over m yields measured fill_drain and hence the measured bubble
+fraction fill_drain/(m + fill_drain) per (p, m) point — the schedule-
+efficiency measurement this single-host environment can support (per-stage
+overlap timing needs real chips; docs/perf.md "Why MoE is perf-benched on
+one chip but pipeline parallelism is not").
+
+Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       python tools/exp_pp_bubble.py
+Prints one JSON line per p with the fit and the per-m measured vs analytic
+bubble table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Force the virtual CPU mesh the way tests/conftest.py does: the sandbox
+# sitecustomize pins the TPU plugin through jax.config at interpreter
+# startup, so the env vars alone are not enough.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+
+def main() -> int:
+    import jax
+
+    if getattr(jax.config, "jax_platforms", None) != "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.parallel import mesh as mesh_lib
+    from tf_operator_tpu.parallel.pipeline import (
+        pipeline_apply,
+        stack_stage_params,
+        stacked_shardings,
+    )
+
+    def mlp_stage(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def init_mlp(key, width):
+        kw, kb = jax.random.split(key)
+        return {"w": jax.random.normal(kw, (width, width)) * 0.3,
+                "b": jax.random.normal(kb, (width,)) * 0.1}
+
+    width, mb = 512, 16
+    ms = [2, 4, 8, 16]
+    for p in (2, 4, 8):
+        if p > len(jax.devices()):
+            continue
+        mesh = mesh_lib.make_mesh({"pp": p}, devices=jax.devices()[:p])
+        stacked = stack_stage_params(
+            lambda k: init_mlp(k, width), jax.random.key(0), p)
+        stacked = jax.device_put(stacked, stacked_shardings(stacked, mesh))
+
+        def timed(m, reps=8):
+            x = jnp.ones((mb * m, width))
+            fn = jax.jit(lambda s, x: pipeline_apply(
+                mlp_stage, s, x, mesh, num_microbatches=m))
+            fn(stacked, x).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                fn(stacked, x).block_until_ready()
+            return (time.perf_counter() - t0) / reps
+
+        ts = [timed(m) for m in ms]
+        n = len(ms)
+        mbar, tbar = sum(ms) / n, sum(ts) / n
+        slope = (sum((m - mbar) * (t - tbar) for m, t in zip(ms, ts))
+                 / sum((m - mbar) ** 2 for m in ms))
+        fill = (tbar - slope * mbar) / slope if slope > 0 else float("nan")
+        rows = [
+            {"m": m, "t_ms": round(t * 1e3, 2),
+             "bubble_measured": round(fill / (m + fill), 3),
+             "bubble_analytic": round((p - 1) / (m + p - 1), 3)}
+            for m, t in zip(ms, ts)
+        ]
+        print(json.dumps({
+            "p": p, "fill_drain_measured": round(fill, 2),
+            "fill_drain_analytic": p - 1, "rows": rows,
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
