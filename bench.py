@@ -34,6 +34,14 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _load_json_or_none(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def read_events(path: str) -> list[dict]:
     if not os.path.exists(path):
         return []
@@ -505,6 +513,47 @@ def _main() -> int:
         log(f"  roofline: bound_by={rn_roofline['bound_by_pct']} "
             f"hbm_bw={rn_roofline['hbm_bound_achieved_bw_gibps']}GiB/s")
 
+    # --- Workload 2b: ResNet-50 fed from the REAL data pipeline ---
+    # Same model/batch, but batches come from an on-disk sharded dataset
+    # through data/dataset.py (mmap shards) + data/prefetch.py (double-
+    # buffered host->device transfer) instead of on-device synthesis —
+    # measuring the host input path, the classic real-world ResNet
+    # bottleneck (VERDICT r4 #2). Images are uint8 (what real pipelines
+    # ship; 4x less transfer than f32), normalized on device.
+    log("bench: ResNet-50 through the data pipeline...")
+    import numpy as _np
+
+    from tf_operator_tpu.data.dataset import write_array_shards
+
+    rnd_dir = tempfile.mkdtemp(prefix="tpujob-bench-data-")
+    n_samples = 2048 if on_tpu else 64
+    rng_np = _np.random.default_rng(0)
+    write_array_shards(
+        rnd_dir,
+        {
+            "x": rng_np.integers(
+                0, 256, size=(n_samples, rn_size, rn_size, 3), dtype=_np.uint8
+            ),
+            "y": rng_np.integers(
+                0, 1000, size=(n_samples,), dtype=_np.int32
+            ),
+        },
+        num_shards=8,
+    )
+    rn_data = chip_job(
+        "resnet50", steps=40 if on_tpu else 10, batch=rn_batch,
+        extra=["--image-size", str(rn_size), "--data-dir", rnd_dir],
+        timeout=1800,
+    )
+    shutil.rmtree(rnd_dir, ignore_errors=True)
+    rdev = {e["event"]: e for e in rn_data["events"]}
+    rn_data_ips = rdev.get("done", {}).get("examples_per_sec")
+    rn_data_frac = (
+        round(rn_data_ips / rn_ips, 4) if rn_data_ips and rn_ips else None
+    )
+    log(f"  ok={rn_data['ok']} images/s={rn_data_ips} "
+        f"vs synthetic={rn_data_frac}")
+
     # --- Workload 3: long-context LM (pallas flash attention path) ---
     # seq 8192 is past the point where plain XLA attention fails to compile
     # on v5e — this measures the fused-kernel long-context capability the
@@ -537,8 +586,9 @@ def _main() -> int:
     # lm_loss_chunked) keeps the [B, T, vocab] logits out of the HBM peak,
     # so 16k (and, round 3, 32k) train first-class on one v5e chip.
     lm16_tps = lm16_mfu = lm32_tps = lm32_mfu = lm64_tps = lm64_mfu = None
-    lm16_ok = lm32_ok = lm64_ok = None
-    lm16_seg = lm32_seg = lm64_seg = None
+    lm128_tps = lm128_mfu = None
+    lm16_ok = lm32_ok = lm64_ok = lm128_ok = None
+    lm16_seg = lm32_seg = lm64_seg = lm128_seg = None
     if on_tpu:
         # seq 64k needs per-layer rematerialization (saved intermediates
         # alone exceed HBM — models/transformer.py remat_layers): --remat
@@ -546,9 +596,22 @@ def _main() -> int:
         # log-every stays at each config's proven value: 5 for 16k/32k
         # (two full green bench runs), 4 for the 64k point (validated
         # standalone; steps=8 needs a chunk that divides it).
+        # 64k: per-layer remat + ALL flash residuals saved
+        # (--remat-save-flash). Round 5's chunked-CE fix (the loss scan was
+        # stacking every chunk's logits as AD residuals — 7.8 GB at 64k)
+        # freed the HBM that made this OOM in round 4: measured 0.500 ->
+        # 0.591 MFU (docs/perf.md round-5 section).
+        # 128k (round 5): the chunked-CE fix is also what makes 131072
+        # FEASIBLE at all on one chip (the stacked-logits residual alone
+        # was 15.6 GB there). Flash residuals saved for 6 of 12 layers:
+        # the measured memory cliff is at K=10 (K=9 fits with <200 MB
+        # margin, 0.574 MFU) — K=6 keeps ~600 MB of margin for session
+        # variance at 0.549 MFU (docs/perf.md round-5 table).
         for seq_x, batch_x, steps_x, log_x, extra_x in (
                 (16384, 2, 10, 5, []), (32768, 1, 10, 5, []),
-                (65536, 1, 8, 4, ["--remat"])):
+                (65536, 1, 8, 4, ["--remat", "--remat-save-flash"]),
+                (131072, 1, 4, 2,
+                 ["--remat", "--remat-save-flash-layers", "6"])):
             log(f"bench: long-context seq {seq_x}...")
             lmx = chip_job(
                 "transformer-lm", steps=steps_x, batch=batch_x,
@@ -565,8 +628,10 @@ def _main() -> int:
                 lm16_ok, lm16_tps, lm16_seg = lmx["ok"], tpsx, lmx.get("segments")
             elif seq_x == 32768:
                 lm32_ok, lm32_tps, lm32_seg = lmx["ok"], tpsx, lmx.get("segments")
-            else:
+            elif seq_x == 65536:
                 lm64_ok, lm64_tps, lm64_seg = lmx["ok"], tpsx, lmx.get("segments")
+            else:
+                lm128_ok, lm128_tps, lm128_seg = lmx["ok"], tpsx, lmx.get("segments")
 
     # --- Workload 4 (round 3): MoE transformer on the chip (ep=1 dense
     # dispatch) — pins the MoE compute path's perf, not just correctness
@@ -619,6 +684,9 @@ def _main() -> int:
             # work (same rule as MoE capacity padding)
             ftok64 = lm_train_flops_per_token(lm_layers, lm_hidden, 65536)
             lm64_mfu = round(lm64_tps * ftok64 / (peak * 1e12), 4)
+        if lm128_tps:
+            ftok128 = lm_train_flops_per_token(lm_layers, lm_hidden, 131072)
+            lm128_mfu = round(lm128_tps * ftok128 / (peak * 1e12), 4)
         if moe_tps:
             moe_mfu = round(moe_tps * moe_ftok / (peak * 1e12), 4)
     mxu = measure_mxu_ceiling() if on_tpu and _state["tunnel_ok"] else None
@@ -649,6 +717,18 @@ def _main() -> int:
         "resnet50_batch": rn_batch,
         "resnet50_mfu": rn_mfu,
         "resnet50_mfu_macs_convention": rn_mfu_macs,  # = rounds 1-2 scale
+        "resnet50_data_pipeline_ok": rn_data["ok"],
+        "resnet50_data_pipeline_images_per_sec": rn_data_ips,
+        "resnet50_data_pipeline_vs_synthetic": rn_data_frac,
+        # Itemized standalone-vs-operator ladder (VERDICT r4 #3), measured
+        # by tools/exp_resnet_tax.py (too slow to re-run inside every
+        # bench) and loaded from its snapshot file so a stale measurement
+        # can't masquerade as fresh: the key is absent unless the snapshot
+        # exists, and the snapshot carries its own provenance.
+        "resnet50_scaffold_tax": _load_json_or_none(
+            os.path.join(REPO_ROOT, "artifacts", "resnet_tax.json"))
+        or _load_json_or_none(
+            os.path.join(REPO_ROOT, "docs", "resnet_tax_r05.json")),
         "longctx_ok": lm["ok"],
         "longctx_seq": lm_seq,
         "longctx_tokens_per_sec": lm_tps,
@@ -662,6 +742,9 @@ def _main() -> int:
         "longctx64k_ok": lm64_ok,
         "longctx64k_tokens_per_sec": lm64_tps,
         "longctx64k_mfu": lm64_mfu,
+        "longctx128k_ok": lm128_ok,
+        "longctx128k_tokens_per_sec": lm128_tps,
+        "longctx128k_mfu": lm128_mfu,
         "moe_ok": moe["ok"],
         "moe_tokens_per_sec": moe_tps,
         "moe_mfu": moe_mfu,
@@ -695,6 +778,8 @@ def _main() -> int:
             lm32_mfu, lm_layers, lm_hidden, 32768),
         "longctx64k_mfu_causal_discounted": _discount(
             lm64_mfu, lm_layers, lm_hidden, 65536),
+        "longctx128k_mfu_causal_discounted": _discount(
+            lm128_mfu, lm_layers, lm_hidden, 131072),
         "resnet50_wallclock_s": resnet.get("wallclock_s"),
         "resnet50_image_size": rn_size,
         "resnet50_roofline": rn_roofline,
@@ -711,6 +796,7 @@ def _main() -> int:
         "longctx16k_segments": lm16_seg,
         "longctx32k_segments": lm32_seg,
         "longctx64k_segments": lm64_seg,
+        "longctx128k_segments": lm128_seg,
         "moe_segments": moe.get("segments"),
     }
     # A failed side-file write must not discard 30 minutes of measurements.

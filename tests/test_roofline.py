@@ -20,6 +20,27 @@ def test_empty_dir_returns_none(tmp_path):
     assert summarize_trace(str(tmp_path)) is None
 
 
+def test_bound_of_reclassifies_known_pallas_customcalls():
+    """Unknown custom-calls matching known in-repo pallas kernels land in
+    a named compute bucket (round-5 attribution: BENCH_r04's 20% Unknown
+    was exactly the flash-attn kernels); everything else stays put."""
+    from tf_operator_tpu.utils.roofline import _bound_of
+
+    flash = {"HLO op name": "attn.504", "HLO op category": "custom-call",
+             "Bound by": "Unknown"}
+    assert _bound_of(flash) == "Compute (pallas flash-attn)"
+    # bound known -> untouched
+    assert _bound_of({"HLO op name": "attn.1", "HLO op category":
+                      "custom-call", "Bound by": "HBM"}) == "HBM"
+    # attn-named but NOT a custom-call (e.g. a fusion from the attention
+    # scope xprof genuinely could not place) -> stays Unknown
+    assert _bound_of({"HLO op name": "attn_fusion.2", "HLO op category":
+                      "loop fusion", "Bound by": "Unknown"}) == "Unknown"
+    # unknown custom-call with an unrecognized name -> stays Unknown
+    assert _bound_of({"HLO op name": "mystery.9", "HLO op category":
+                      "custom-call", "Bound by": None}) == "Unknown"
+
+
 def _chip_env() -> dict:
     """Subprocess env that can reach the real chip: drop the conftest CPU
     pin, restore the stashed axon pool registration (see conftest.py)."""

@@ -319,7 +319,7 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
             "t": time.time(),
             "steps": args.steps,
             "steady_steps_per_sec": sps,
-            "examples_per_sec": round(steady * args.batch / dt, 2) if steady > 0 else None,
+            "examples_per_sec": round(steady * args.batch / dt, 4) if steady > 0 else None,  # 4 dp: 2-dp quantized batch-1 long-context rows by +-2.6%
             "final_loss": float(metrics["loss"]),
             "total_s": round(time.time() - t_start, 3),
         }
@@ -383,6 +383,10 @@ def main(argv: list[str] | None = None) -> int:
                          "kernel. Costs ~[B,T,H] bf16 per layer of HBM — "
                          "use on sp-sharded multi-chip long-context jobs "
                          "(single-chip 64k does not fit with it)")
+    ap.add_argument("--remat-save-flash-layers", type=int, default=0,
+                    help="with --remat (transformer-lm): save the flash "
+                         "residuals for the FIRST K layers only (memory->"
+                         "speed dial where saving all layers OOMs)")
     ap.add_argument("--remat", action="store_true",
                     help="activation checkpointing: rematerialize the loss, "
                          "and (transformer-lm) each block — saves only "
@@ -422,9 +426,17 @@ def main(argv: list[str] | None = None) -> int:
     # Flag-only invariants fail HERE — before jax import, device dial, state
     # build, or checkpoint resume (minutes on a tunneled chip), and on every
     # path including --eval and resumed-complete early returns.
-    if args.remat_save_flash and not args.remat:
-        ap.error("--remat-save-flash requires --remat (it selects WHICH "
-                 "residuals per-layer remat keeps)")
+    if ((args.remat_save_flash or args.remat_save_flash_layers)
+            and not args.remat):
+        ap.error("--remat-save-flash[-layers] requires --remat (it selects "
+                 "WHICH residuals per-layer remat keeps)")
+    if args.remat_save_flash and args.remat_save_flash_layers:
+        ap.error("--remat-save-flash (all layers) conflicts with "
+                 "--remat-save-flash-layers K (a subset): pick one — the "
+                 "all-layers flag would silently win and can OOM exactly "
+                 "where the K dial was chosen to fit")
+    if args.remat_save_flash_layers < 0:
+        ap.error("--remat-save-flash-layers must be >= 0")
     for kv in args.xla_option:
         if "=" not in kv:
             ap.error(f"--xla-option must be KEY=VALUE, got {kv!r}")
@@ -522,8 +534,14 @@ def main(argv: list[str] | None = None) -> int:
             }
 
         def loss_fn(params, model_state, batch, rng):
+            x = batch["x"]
+            if x.dtype == jnp.uint8:
+                # Real pipelines ship uint8 pixels (4x less host->device
+                # transfer than f32); normalize on device where it fuses
+                # into the first conv's input read.
+                x = x.astype(jnp.float32) / 127.5 - 1.0
             logits, mut = model.apply(
-                {"params": params, **model_state}, batch["x"], train=True,
+                {"params": params, **model_state}, x, train=True,
                 mutable=["batch_stats"],
             )
             return M.cross_entropy_loss(logits, batch["y"]), dict(mut)
@@ -610,6 +628,10 @@ def main(argv: list[str] | None = None) -> int:
             # single-chip 64k bench point (see remat_save_flash note);
             # multi-chip sp jobs opt in.
             remat_save_flash=args.remat_save_flash,
+            # Layer-subset middle ground: first K layers keep their flash
+            # residuals (~100 MB each at 64k), dialing memory->speed where
+            # all-12 OOMs (VERDICT r4 #4).
+            remat_save_flash_layers=args.remat_save_flash_layers,
         )
         attn = make_attention_fn(mesh, causal=True)
         model = tfm.TransformerLM(cfg, attn_fn=attn)
@@ -853,7 +875,7 @@ def main(argv: list[str] | None = None) -> int:
             "t": time.time(),
             "steps": args.steps,
             "steady_steps_per_sec": sps,
-            "examples_per_sec": round(steady * args.batch / dt, 2) if steady > 0 else None,
+            "examples_per_sec": round(steady * args.batch / dt, 4) if steady > 0 else None,  # 4 dp: 2-dp quantized batch-1 long-context rows by +-2.6%
             "final_loss": float(metrics["loss"]),
             "total_s": round(time.time() - t_start, 3),
         }

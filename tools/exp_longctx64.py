@@ -1,0 +1,102 @@
+"""Close the 64k long-context book + 128k feasibility probe (VERDICT r4 #4).
+
+Round 4 stopped at 0.4996 MFU for seq-64k with full per-layer remat and a
+plausible-but-unmeasured "remat-bound ceiling" story. This tool:
+
+  1. re-measures the 64k bench point (baseline: --remat, the BENCH config);
+  2. sweeps --remat-save-flash-layers K: each saved layer costs ~100 MB of
+     HBM (bf16 [1, 65536, 768] o + f32 lse) and removes one layer's O(T^2)
+     flash replay from the backward. All-12 OOMed in round 4 (measured
+     16.84 G requested vs 15.75 G); the subset dial finds how many fit and
+     what each buys;
+  3. probes seq-128k feasibility (batch 1, same model, full remat).
+
+Every point is one trainer subprocess (the chip admits one process), the
+same CLI the bench uses, so numbers are bench-comparable. Prints one JSON
+line per point.
+
+Usage: python tools/exp_longctx64.py [--points base,k2,k4,k6,128k]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_point(name: str, seq: int, steps: int, log_every: int,
+              extra: list[str]) -> None:
+    args = [sys.executable, "-m", "tf_operator_tpu.models.train",
+            "--model", "transformer-lm", "--steps", str(steps),
+            "--batch", "1", "--seq", str(seq), "--layers", "12",
+            "--hidden", "768", "--heads", "6",
+            "--log-every", str(log_every), "--remat", *extra]
+    try:
+        r = subprocess.run(args, capture_output=True, text=True,
+                           timeout=1800, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"point": name, "error": "timeout"}))
+        return
+    done = {}
+    for line in r.stdout.splitlines():
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            continue
+        if ev.get("event") == "done":
+            done = ev
+    if r.returncode != 0 or not done:
+        err = r.stderr.strip().splitlines()
+        oom = [line for line in err if "RESOURCE_EXHAUSTED" in line
+               or "Out of memory" in line or "exceeds" in line]
+        print(json.dumps({"point": name, "rc": r.returncode,
+                          "oom": oom[:1], "error": err[-12:] if not oom
+                          else None}))
+        return
+    eps = done.get("examples_per_sec")
+    tps = round(eps * seq, 1) if eps else None
+    sys.path.insert(0, REPO)
+    from bench import device_peak_tflops, lm_train_flops_per_token
+    peak = device_peak_tflops("TPU v5 lite")
+    ftok = lm_train_flops_per_token(12, 768, seq)
+    print(json.dumps({
+        "point": name, "seq": seq, "tokens_per_sec": tps,
+        "mfu": round(tps * ftok / (peak * 1e12), 4) if tps else None,
+        "steps_per_sec": done.get("steady_steps_per_sec"),
+    }))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", default="base,k2,k4,k6,128k")
+    args = ap.parse_args()
+    points = args.points.split(",")
+    for p in points:
+        if p == "base":
+            run_point("64k-base", 65536, 8, 4, [])
+        elif p == "kall":
+            run_point("64k-saveflash-all", 65536, 8, 4,
+                      ["--remat-save-flash"])
+        elif p == "128k-kall":
+            run_point("128k-saveflash-all", 131072, 4, 2,
+                      ["--remat-save-flash"])
+        elif p.startswith("k") and p != "kall":
+            k = int(p[1:])
+            run_point(f"64k-saveflash-{k}", 65536, 8, 4,
+                      ["--remat-save-flash-layers", str(k)])
+        elif p == "128k":
+            run_point("128k-probe", 131072, 4, 2, [])
+        elif p.startswith("128k-k"):
+            k = int(p[6:])
+            run_point(f"128k-saveflash-{k}", 131072, 4, 2,
+                      ["--remat-save-flash-layers", str(k)])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

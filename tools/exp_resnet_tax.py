@@ -1,0 +1,230 @@
+"""Itemize the ResNet-50 standalone-vs-operator throughput gap (VERDICT r4 #3).
+
+Round 4 left two "canonical" ResNet headlines 5.5% apart: 2,525 img/s from
+the standalone kernel harness (tools/exp_resnet_flags.py) vs 2,394 img/s
+through the operator (BENCH_r04). This ladder measures where the delta
+actually lives by adding ONE ingredient per rung, every rung a fresh
+subprocess on the chip (one process per chip):
+
+  A standalone        per-step compiled call, ONE fixed device-resident
+                      batch, one closing sync        (the 2,525 number)
+  B +scan             same step inside the trainer's lax.scan chunks
+  C +batchgen         scan + fresh on-device RNG batch PER STEP (threefry
+                      for a [256,224,224,3] normal + labels — the trainer's
+                      synthetic data pipeline; suspected bulk of the gap)
+  D trainer-direct    python -m tf_operator_tpu.models.train (no operator):
+                      adds the trainer scaffold (events, async loss fetch)
+  E operator          bench's run_job_e2e, no profiling
+  F operator+profile  the exact BENCH config                (the 2,394)
+
+Prints one JSON line per rung; consecutive deltas are the itemized tax.
+
+Usage: python tools/exp_resnet_tax.py [--steps 60] [--batch 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import json, sys, time
+import jax, jax.numpy as jnp, optax
+
+sys.path.insert(0, {repo!r})
+from tf_operator_tpu.models.mnist import cross_entropy_loss
+from tf_operator_tpu.models.resnet import ResNet50, init_resnet
+
+rung = {rung!r}
+steps = {steps}
+batch = {batch}
+chunk = 20
+
+model = ResNet50(num_classes=1000)
+params, batch_stats = init_resnet(model, jax.random.key(0), image_size=224,
+                                  batch=2)
+tx = optax.sgd(0.1, momentum=0.9)
+opt_state = tx.init(params)
+x0 = jax.random.normal(jax.random.key(1), (batch, 224, 224, 3))
+y0 = jax.random.randint(jax.random.key(2), (batch,), 0, 1000)
+
+
+def step(params, batch_stats, opt_state, x, y):
+    def loss(p, bs):
+        logits, mut = model.apply(
+            {{"params": p, "batch_stats": bs}}, x, train=True,
+            mutable=["batch_stats"],
+        )
+        return cross_entropy_loss(logits, y), mut["batch_stats"]
+
+    (l, bs), grads = jax.value_and_grad(loss, has_aux=True)(
+        params, batch_stats
+    )
+    updates, opt_state = tx.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), bs, opt_state, l
+
+
+if rung == "A-standalone":
+    jitted = jax.jit(step, donate_argnums=(0, 1, 2))
+    params, batch_stats, opt_state, l = jitted(params, batch_stats,
+                                               opt_state, x0, y0)
+    float(l)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, batch_stats, opt_state, l = jitted(params, batch_stats,
+                                                   opt_state, x0, y0)
+    loss = float(l)
+    dt = (time.perf_counter() - t0) / steps
+else:  # B-scan / C-batchgen: trainer-shaped scanned chunks
+    fresh_batch = rung == "C-batchgen"
+
+    def many(params, batch_stats, opt_state):
+        def body(carry, i):
+            p, bs, o = carry
+            if fresh_batch:
+                r = jax.random.fold_in(jax.random.key(0), i)
+                x = jax.random.normal(jax.random.fold_in(r, 0),
+                                      (batch, 224, 224, 3))
+                y = jax.random.randint(jax.random.fold_in(r, 1),
+                                       (batch,), 0, 1000)
+            else:
+                x, y = x0, y0
+            p, bs, o, l = step(p, bs, o, x, y)
+            return (p, bs, o), l
+
+        (p, bs, o), ls = jax.lax.scan(body, (params, batch_stats, opt_state),
+                                      jnp.arange(chunk))
+        return p, bs, o, ls[-1]
+
+    jitted = jax.jit(many, donate_argnums=(0, 1, 2))
+    params, batch_stats, opt_state, l = jitted(params, batch_stats, opt_state)
+    float(l)
+    n_chunks = max(1, steps // chunk)
+    t0 = time.perf_counter()
+    for _ in range(n_chunks):
+        params, batch_stats, opt_state, l = jitted(params, batch_stats,
+                                                   opt_state)
+    loss = float(l)
+    dt = (time.perf_counter() - t0) / (n_chunks * chunk)
+
+ips = batch / dt
+from bench import RESNET50_TRAIN_FLOPS_PER_IMG, device_peak_tflops
+peak = device_peak_tflops(getattr(jax.devices()[0], "device_kind", ""))
+print(json.dumps({{
+    "rung": rung, "step_ms": round(dt * 1e3, 2),
+    "images_per_sec": round(ips, 1),
+    "mfu": round(ips * RESNET50_TRAIN_FLOPS_PER_IMG / (peak * 1e12), 4)
+    if peak else None, "loss": round(loss, 3),
+}}))
+"""
+
+
+RESULTS: dict[str, float | None] = {}
+
+
+def _record(rung_key: str, line: str) -> None:
+    print(line)
+    try:
+        RESULTS[rung_key] = json.loads(line).get("images_per_sec")
+    except ValueError:
+        pass
+
+
+def run_child(rung: str, steps: int, batch: int) -> None:
+    r = subprocess.run(
+        [sys.executable, "-c",
+         CHILD.format(repo=REPO, rung=rung, steps=steps, batch=batch)],
+        capture_output=True, text=True, timeout=1800,
+    )
+    if r.returncode != 0:
+        print(json.dumps({"rung": rung,
+                          "error": r.stderr.strip().splitlines()[-2:]}))
+    else:
+        _record(rung, r.stdout.strip().splitlines()[-1])
+
+
+def run_trainer_direct(steps: int, batch: int) -> None:
+    r = subprocess.run(
+        [sys.executable, "-m", "tf_operator_tpu.models.train",
+         "--model", "resnet50", "--steps", str(steps), "--batch", str(batch),
+         "--image-size", "224"],
+        capture_output=True, text=True, timeout=1800, cwd=REPO,
+    )
+    ips = None
+    for line in r.stdout.splitlines():
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            continue
+        if ev.get("event") == "done":
+            ips = ev.get("examples_per_sec")
+    _record("D-trainer-direct", json.dumps(
+        {"rung": "D-trainer-direct", "images_per_sec": ips,
+         **({} if r.returncode == 0 else
+            {"error": r.stderr.strip().splitlines()[-2:]})}))
+
+
+def run_operator(steps: int, batch: int, profile: bool) -> None:
+    sys.path.insert(0, REPO)
+    from bench import run_job_e2e
+
+    extra = ["--image-size", "224"]
+    prof_dir = None
+    if profile:
+        prof_dir = tempfile.mkdtemp(prefix="tpujob-tax-prof-")
+        extra += ["--profile-dir", prof_dir]
+    r = run_job_e2e("resnet50", steps=steps, batch=batch, extra=extra,
+                    timeout=1800)
+    ev = {e["event"]: e for e in r["events"]}
+    rung = "F-operator-profile" if profile else "E-operator"
+    _record(rung, json.dumps({
+        "rung": rung,
+        "images_per_sec": ev.get("done", {}).get("examples_per_sec"),
+        "ok": r["ok"],
+    }))
+    if prof_dir:
+        import shutil
+
+        shutil.rmtree(prof_dir, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--rungs", default="A,B,C,D,E,F")
+    args = ap.parse_args()
+    rungs = set(args.rungs.split(","))
+    if "A" in rungs:
+        run_child("A-standalone", args.steps, args.batch)
+    if "B" in rungs:
+        run_child("B-scan", args.steps, args.batch)
+    if "C" in rungs:
+        run_child("C-batchgen", args.steps, args.batch)
+    if "D" in rungs:
+        run_trainer_direct(args.steps, args.batch)
+    if "E" in rungs:
+        run_operator(args.steps, args.batch, profile=False)
+    if "F" in rungs:
+        run_operator(args.steps, args.batch, profile=True)
+    # Snapshot for bench.py's resnet50_scaffold_tax detail (the bench loads
+    # artifacts/resnet_tax.json so a stale hard-coded table can never
+    # masquerade as a fresh measurement).
+    if RESULTS:
+        os.makedirs(os.path.join(REPO, "artifacts"), exist_ok=True)
+        out = os.path.join(REPO, "artifacts", "resnet_tax.json")
+        with open(out, "w") as f:
+            json.dump({"measured_by": "tools/exp_resnet_tax.py",
+                       "rungs": RESULTS}, f, indent=1)
+        print(json.dumps({"snapshot": out}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
