@@ -380,9 +380,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="with --remat (transformer-lm): save the flash "
                          "kernel's (o, lse) residuals so the backward "
                          "replays only linear ops, never the O(T^2) "
-                         "kernel. Costs ~[B,T,H] bf16 per layer of HBM — "
-                         "use on sp-sharded multi-chip long-context jobs "
-                         "(single-chip 64k does not fit with it)")
+                         "kernel. Costs ~[B,T,H] bf16 per layer of HBM. "
+                         "Fits (and is the bench config) at single-chip "
+                         "64k since the round-5 chunked-CE fix; at 128k "
+                         "use --remat-save-flash-layers instead")
     ap.add_argument("--remat-save-flash-layers", type=int, default=0,
                     help="with --remat (transformer-lm): save the flash "
                          "residuals for the FIRST K layers only (memory->"
@@ -624,13 +625,14 @@ def main(argv: list[str] | None = None) -> int:
             # remat_layers note) — this is what makes 64k trainable.
             remat_layers=args.remat,
             # Selective policy: keep the flash (o, lse) residuals so the
-            # backward never replays the O(T^2) kernel. Doesn't fit the
-            # single-chip 64k bench point (see remat_save_flash note);
-            # multi-chip sp jobs opt in.
+            # backward never replays the O(T^2) kernel. Fits single-chip
+            # 64k since the chunked-CE fix freed the stacked-logits
+            # residuals (0.59 MFU, the bench config); sp-sharded
+            # multi-chip jobs benefit even more (T/n-sized residuals).
             remat_save_flash=args.remat_save_flash,
             # Layer-subset middle ground: first K layers keep their flash
-            # residuals (~100 MB each at 64k), dialing memory->speed where
-            # all-12 OOMs (VERDICT r4 #4).
+            # residuals (~100-200 MB each), dialing memory->speed where
+            # saving all layers still OOMs (128k: cliff at K=10).
             remat_save_flash_layers=args.remat_save_flash_layers,
         )
         attn = make_attention_fn(mesh, causal=True)

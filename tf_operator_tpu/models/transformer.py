@@ -49,11 +49,12 @@ class TransformerConfig:
     # (checkpoint_name tags in ops/flash_attention._fwd_rule): the backward
     # then replays only the linear ops (qkv/mlp/ln) and never re-runs the
     # O(T^2) flash forward — ~25% less backward device work at long seq.
-    # Default OFF because the 64k x 12L x 768h single-chip bench point runs
-    # at ~15.6 G of the 15.75 G HBM and the +1.2 GB of saved o tensors OOMs
-    # it (measured: 16.84 G requested). The win is real where the residuals
-    # are sharded: under sp=4 the per-device o is ~25 MB/layer, so
-    # multi-chip long-context jobs should turn this on.
+    # Fits the 64k x 12L x 768h single-chip bench point since round 5's
+    # chunked-CE fix (the apparent 15.6 G floor was mostly the loss scan's
+    # stacked logits residuals) and IS that point's bench config
+    # (0.59 MFU). At 128k the +200 MB/layer o tensors OOM past 9 layers —
+    # use remat_save_flash_layers there. Sharded sp jobs benefit even
+    # more (per-device o is T/n-sized).
     remat_save_flash: bool = False
     # Middle ground (VERDICT r4 #4): save the flash residuals for only the
     # FIRST K layers (0 = none unless remat_save_flash, which saves all).

@@ -213,16 +213,27 @@ def main() -> int:
         run_operator(args.steps, args.batch, profile=False)
     if "F" in rungs:
         run_operator(args.steps, args.batch, profile=True)
-    # Snapshot for bench.py's resnet50_scaffold_tax detail (the bench loads
-    # artifacts/resnet_tax.json so a stale hard-coded table can never
-    # masquerade as a fresh measurement).
-    if RESULTS:
+    # Snapshot for bench.py's resnet50_scaffold_tax detail. Written ONLY
+    # when the ladder is complete (all six rungs measured): bench prefers
+    # this file over the committed docs snapshot, and a partial table
+    # would shadow the complete one while supporting none of the ladder's
+    # conclusions (E-D ~ 0 needs both E and D).
+    all_rungs = {"A-standalone", "B-scan", "C-batchgen", "D-trainer-direct",
+                 "E-operator", "F-operator-profile"}
+    if set(k for k, v in RESULTS.items() if v) == all_rungs:
+        import time as _time
+
         os.makedirs(os.path.join(REPO, "artifacts"), exist_ok=True)
         out = os.path.join(REPO, "artifacts", "resnet_tax.json")
         with open(out, "w") as f:
             json.dump({"measured_by": "tools/exp_resnet_tax.py",
+                       "measured_at": _time.strftime("%Y-%m-%d %H:%M UTC",
+                                                     _time.gmtime()),
                        "rungs": RESULTS}, f, indent=1)
         print(json.dumps({"snapshot": out}))
+    elif RESULTS:
+        print(json.dumps({"snapshot": None,
+                          "reason": "incomplete ladder; not written"}))
     return 0
 
 
