@@ -1,0 +1,92 @@
+"""Probe larger LM batches at 8k/16k after the chunked-CE fix (round 5).
+
+Round 4 rejected batch 8 at seq 8192: "OOMs on saved activations (18.8 G)
+even with the chunked head" — but the chunked head of round 4 still
+stacked every chunk's logits as scan residuals (8.4 GB at 8k b8), which
+round 5's jax.checkpoint fix eliminates. This sweep re-tests the
+batch-scaling door that finding closed: b4 (bench baseline) vs b6/b8 at
+8k, b2 (baseline) vs b4 at 16k. Larger batch feeds the MXU better if it
+fits. One trainer subprocess per point (the bench CLI, so numbers are
+bench-comparable).
+
+Usage: python tools/exp_lm_batch.py [--points 8k-b4,8k-b8,16k-b2,16k-b4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_point(name: str, seq: int, batch: int, steps: int,
+              extra: list[str] | None = None) -> None:
+    args = [sys.executable, "-m", "tf_operator_tpu.models.train",
+            "--model", "transformer-lm", "--steps", str(steps),
+            "--batch", str(batch), "--seq", str(seq), "--layers", "12",
+            "--hidden", "768", "--heads", "6", "--log-every", "5",
+            *(extra or [])]
+    try:
+        r = subprocess.run(args, capture_output=True, text=True,
+                           timeout=1800, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"point": name, "error": "timeout"}))
+        return
+    done = {}
+    device_kind = None
+    for line in r.stdout.splitlines():
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            continue
+        if ev.get("event") == "first_step":
+            device_kind = ev.get("device_kind")
+        if ev.get("event") == "done":
+            done = ev
+    if r.returncode != 0 or not done:
+        err = r.stderr.strip().splitlines()
+        oom = [line for line in err if "Ran out of memory" in line
+               or "RESOURCE_EXHAUSTED" in line]
+        print(json.dumps({"point": name, "rc": r.returncode, "oom": oom[:1],
+                          "error": None if oom else err[-4:]}))
+        return
+    eps = done.get("examples_per_sec")
+    tps = round(eps * seq, 1) if eps else None
+    sys.path.insert(0, REPO)
+    from bench import device_peak_tflops, lm_train_flops_per_token
+    peak = device_peak_tflops(device_kind)  # from the run's own first_step
+    ftok = lm_train_flops_per_token(12, 768, seq)
+    print(json.dumps({
+        "point": name, "seq": seq, "batch": batch, "tokens_per_sec": tps,
+        "device_kind": device_kind,
+        "mfu": (round(tps * ftok / (peak * 1e12), 4)
+                if tps and peak else None),
+    }))
+
+
+POINTS = {
+    "8k-b4": (8192, 4, 25, None),
+    "8k-b6": (8192, 6, 25, None),
+    "8k-b8": (8192, 8, 25, None),
+    "16k-b2": (16384, 2, 10, None),
+    "16k-b4": (16384, 4, 10, None),
+    "32k-b2": (32768, 2, 10, None),
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", default="8k-b8,8k-b6,16k-b4,32k-b2")
+    args = ap.parse_args()
+    for p in args.points.split(","):
+        seq, batch, steps, extra = POINTS[p]
+        run_point(p, seq, batch, steps, extra)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
