@@ -1,0 +1,120 @@
+"""Re-test the chunked-CE cutover after the round-5 checkpoint fix.
+
+Round 4 measured the chunked head LOSING 2-17% below the 6 GB-logits
+cutover — but that chunked head stacked every chunk's logits as AD
+residuals (the round-5 bug). The fixed head has different economics
+(recomputes the chunk matmul in the backward, saves the HBM round-trip
+of the stacked residuals), so the cutover decision deserves a re-measure:
+one-shot lse head vs fixed chunked head at the bench's 8k b4 and 16k b2
+points. One subprocess per (seq, variant).
+
+Usage: python tools/exp_ce_cutover.py [--points 8k,16k]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import json, sys, time
+import jax, jax.numpy as jnp, optax
+
+sys.path.insert(0, {repo!r})
+from tf_operator_tpu.models import transformer as tfm
+from tf_operator_tpu.parallel import mesh as mesh_lib
+from tf_operator_tpu.parallel import sharding_rules
+from tf_operator_tpu.parallel.ring_attention import make_attention_fn
+from tf_operator_tpu.parallel.train_step import (
+    create_train_state, make_scanned_train_step, shard_state,
+)
+
+seq, batch, steps, chunked = {seq}, {batch}, {steps}, {chunked}
+cfg = tfm.TransformerConfig(
+    vocab_size=32000, num_layers=12, hidden=768, num_heads=6,
+    max_len=seq, causal=True,
+)
+mesh = mesh_lib.make_mesh({{"dp": 1}})
+model = tfm.TransformerLM(cfg, attn_fn=make_attention_fn(mesh, causal=True))
+params = model.init(jax.random.key(0), jnp.zeros((1, seq), jnp.int32))["params"]
+
+def loss_fn(params, model_state, batch, rng):
+    if chunked:
+        h = model.apply({{"params": params}}, batch["tokens"],
+                        method="hidden")
+        loss = tfm.lm_loss_chunked(h, params["lm_head"]["kernel"],
+                                   batch["tokens"])
+    else:
+        logits = model.apply({{"params": params}}, batch["tokens"])
+        loss = tfm.lm_loss(logits, batch["tokens"])
+    return loss, model_state
+
+def make_batch(rng):
+    return {{"tokens": jax.random.randint(rng, (batch, seq), 0,
+                                          cfg.vocab_size)}}
+
+tx = optax.adamw(1e-3)
+state = shard_state(create_train_state(params, tx), mesh,
+                    sharding_rules.TRANSFORMER_TP_RULES)
+compile_scanned = make_scanned_train_step(
+    loss_fn, tx, mesh, make_batch, rules=sharding_rules.TRANSFORMER_TP_RULES,
+)
+chunk = 5
+step_chunk = compile_scanned(state, chunk)
+state, m = step_chunk(state)
+float(m["loss"])
+t0 = time.perf_counter()
+for _ in range(steps // chunk):
+    state, m = step_chunk(state)
+loss = float(m["loss"])
+dt = (time.perf_counter() - t0) / (steps // chunk * chunk)
+from bench import device_peak_tflops, lm_train_flops_per_token
+peak = device_peak_tflops(getattr(jax.devices()[0], "device_kind", ""))
+tps = batch * seq / dt
+ftok = lm_train_flops_per_token(12, 768, seq)
+print(json.dumps({{
+    "seq": seq, "batch": batch, "head": "chunked" if chunked else "one-shot",
+    "step_ms": round(dt * 1e3, 2), "tokens_per_sec": round(tps, 1),
+    "mfu": round(tps * ftok / (peak * 1e12), 4) if peak else None,
+    "loss": round(loss, 3),
+}}))
+"""
+
+POINTS = {"8k": (8192, 4, 25), "16k": (16384, 2, 10)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", default="8k,16k")
+    args = ap.parse_args()
+    for p in args.points.split(","):
+        seq, batch, steps = POINTS[p]
+        for chunked in (False, True):
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c",
+                     CHILD.format(repo=REPO, seq=seq, batch=batch,
+                                  steps=steps, chunked=chunked)],
+                    capture_output=True, text=True, timeout=1800,
+                )
+            except subprocess.TimeoutExpired:
+                # One hung child (transient tunnel fault) must not abort
+                # the remaining points.
+                print(json.dumps({"point": p, "chunked": chunked,
+                                  "error": "timeout"}))
+                continue
+            if r.returncode != 0:
+                print(json.dumps({"point": p, "chunked": chunked, "error":
+                                  r.stderr.strip().splitlines()[-3:]}))
+                continue
+            print(r.stdout.strip().splitlines()[-1])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,9 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import device_peak_tflops, lm_train_flops_per_token  # noqa: E402
 
 
 def run_point(name: str, seq: int, batch: int, steps: int,
@@ -56,8 +59,6 @@ def run_point(name: str, seq: int, batch: int, steps: int,
         return
     eps = done.get("examples_per_sec")
     tps = round(eps * seq, 1) if eps else None
-    sys.path.insert(0, REPO)
-    from bench import device_peak_tflops, lm_train_flops_per_token
     peak = device_peak_tflops(device_kind)  # from the run's own first_step
     ftok = lm_train_flops_per_token(12, 768, seq)
     print(json.dumps({

@@ -220,13 +220,15 @@ def main() -> int:
     # conclusions (E-D ~ 0 needs both E and D).
     # Same key schema as the committed docs/resnet_tax_r05.json so the
     # bench's resnet50_scaffold_tax field has ONE shape regardless of
-    # which snapshot loads.
-    key_map = {"A-standalone": "A_kernel_only_ips",
-               "B-scan": "B_plus_scan_ips",
-               "C-batchgen": "C_plus_on_device_batchgen_ips",
-               "D-trainer-direct": "D_trainer_direct_ips",
-               "E-operator": "E_through_operator_ips",
-               "F-operator-profile": "F_operator_with_profiling_ips"}
+    # which snapshot loads. The canonical key list lives in bench._TAX_RUNGS
+    # (its read-side completeness gate) — derived here, not duplicated, so
+    # a rename can't silently make the gate reject every fresh snapshot.
+    sys.path.insert(0, REPO)
+    from bench import _TAX_RUNGS
+
+    key_map = dict(zip(["A-standalone", "B-scan", "C-batchgen",
+                        "D-trainer-direct", "E-operator",
+                        "F-operator-profile"], _TAX_RUNGS))
     if set(k for k, v in RESULTS.items() if v) == set(key_map):
         import time as _time
 
