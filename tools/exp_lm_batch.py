@@ -76,6 +76,11 @@ POINTS = {
     "16k-b2": (16384, 2, 10, None),
     "16k-b4": (16384, 4, 10, None),
     "32k-b2": (32768, 2, 10, None),
+    "64k-b2-kall": (65536, 2, 8, ["--remat", "--remat-save-flash",
+                                  "--log-every", "4"]),
+    "64k-b2-k4": (65536, 2, 8, ["--remat", "--remat-save-flash-layers", "4",
+                                "--log-every", "4"]),
+    "64k-b2": (65536, 2, 8, ["--remat", "--log-every", "4"]),
 }
 
 
