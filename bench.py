@@ -52,9 +52,14 @@ _TAX_RUNGS = ("A_kernel_only_ips", "B_plus_scan_ips",
 def _complete_tax_or_none(snap: dict | None) -> dict | None:
     """Accept a scaffold-tax snapshot only when every rung is present —
     a stale/partial artifacts file must not shadow the complete committed
-    one (the ladder's E-D ~ 0 conclusion needs both E and D)."""
-    if snap and all((snap.get("rungs") or {}).get(k) for k in _TAX_RUNGS):
-        return snap
+    one (the ladder's E-D ~ 0 conclusion needs both E and D). Presence, not
+    truthiness: a legitimately-zero rung value (a rung that measured 0.0
+    img/s, e.g. a wedged run that still completed) is still a MEASURED
+    rung — only a missing/None entry marks the snapshot incomplete."""
+    if snap:
+        rungs = snap.get("rungs") or {}
+        if all(k in rungs and rungs[k] is not None for k in _TAX_RUNGS):
+            return snap
     return None
 
 
@@ -567,8 +572,14 @@ def _main() -> int:
     rn_data_frac = (
         round(rn_data_ips / rn_ips, 4) if rn_data_ips and rn_ips else None
     )
+    # Measured (not asserted) prefetch overlap (VERDICT r5 weak-#4): the
+    # trainer's done event carries the prefetcher's own timers — what
+    # fraction of host batch production + host->device transfer rode
+    # under compute (1.0 = fully hidden; see data/prefetch.py).
+    rn_prefetch = rdev.get("done", {}).get("prefetch")
     log(f"  ok={rn_data['ok']} images/s={rn_data_ips} "
-        f"vs synthetic={rn_data_frac}")
+        f"vs synthetic={rn_data_frac} "
+        f"prefetch_overlap={(rn_prefetch or {}).get('overlap_efficiency')}")
     # Below-parity diagnosis (VERDICT r4 #2 "measured gap + explanation"):
     # split the input path into its two legs — host batch production
     # (mmap gather, no device) and host->device transfer — so the gap is
@@ -625,10 +636,24 @@ def _main() -> int:
                 round(batch_mb / put_s, 1) if put_s else None),
             "required_mb_per_s_for_parity": (
                 round(batch_mb * rn_ips / rn_batch, 1) if rn_ips else None),
+            # from the job's own prefetcher timers: fraction of the input
+            # path that hid under compute (the overlap double-buffering
+            # exists to provide — now measured, not asserted)
+            "prefetch_overlap_efficiency": (
+                (rn_prefetch or {}).get("overlap_efficiency")),
             "conclusion": "host->device transfer-bound (tunnel); host "
                           "pipeline exceeds the model's consumption rate",
         }
         log(f"  data-pipeline diagnosis: {rn_data_diag}")
+
+    # Mixed-precision optimizer state (round 6): every LM/MoE point runs
+    # bf16 Adam moments + f32 master weights by default — the largest
+    # remaining HBM slab in the round-5 roofline (~9.4 GB/step of f32
+    # moment traffic on MoE; docs/perf.md round-6 arithmetic). Numerics are
+    # parity-pinned on CPU (tests/test_optimizer.py); the knob is recorded
+    # in details so regressions attribute to it rather than reading as
+    # noise. The CPU smoke path runs the same flags.
+    OPT_FLAGS = ["--moment-dtype", "bf16", "--master-weights"]
 
     # --- Workload 3: long-context LM (pallas flash attention path) ---
     # seq 8192 is past the point where plain XLA attention fails to compile
@@ -649,7 +674,7 @@ def _main() -> int:
         "transformer-lm", steps=25 if on_tpu else 10, batch=lm_batch,
         extra=["--seq", str(lm_seq), "--layers", str(lm_layers),
                "--hidden", str(lm_hidden), "--heads", str(lm_heads),
-               "--log-every", "5"],
+               "--log-every", "5", *OPT_FLAGS],
         timeout=900,
     )
     lev = {e["event"]: e for e in lm["events"]}
@@ -665,6 +690,7 @@ def _main() -> int:
     lm128_tps = lm128_mfu = None
     lm16_ok = lm32_ok = lm64_ok = lm128_ok = None
     lm16_seg = lm32_seg = lm64_seg = lm128_seg = None
+    lm128_k = lm128_k9_attempt = None
     if on_tpu:
         # seq 64k needs per-layer rematerialization (saved intermediates
         # alone exceed HBM — models/transformer.py remat_layers): --remat
@@ -679,10 +705,16 @@ def _main() -> int:
         # 0.591 MFU (docs/perf.md round-5 section).
         # 128k (round 5): the chunked-CE fix is also what makes 131072
         # FEASIBLE at all on one chip (the stacked-logits residual alone
-        # was 15.6 GB there). Flash residuals saved for 6 of 12 layers:
-        # the measured memory cliff is at K=10 (K=9 fits with <200 MB
-        # margin, 0.574 MFU) — K=6 keeps ~600 MB of margin for session
-        # variance at 0.549 MFU (docs/perf.md round-5 table).
+        # was 15.6 GB there). Saved-flash-layer count (VERDICT r5 weak-#1):
+        # K=9 reproduced twice at 0.574 MFU vs K=6's 0.549, with the
+        # measured memory cliff at K=10. Round 6 PROBES K=9 first and backs
+        # off to the ~600 MB-margin K=6 only if the K=9 job fails with the
+        # tunnel still alive (an OOM-shaped failure) — the bench records
+        # the best point that fits instead of hard-pinning the
+        # conservative one, and longctx128k_saved_flash_layers says which
+        # ran. The bf16-moment optimizer (OPT_FLAGS) also frees ~0.3 GB
+        # net HBM at this model size (moments halve, params slab gains a
+        # bf16 copy), widening K=9's margin.
         # 32k at batch 2 (round 5): the fixed chunked-CE head makes the
         # 8.4 GB-logits b2 case fly — 0.694 (b1) -> 0.745-0.748 MFU,
         # measured twice (tools/exp_lm_batch.py). b4@16k and b6/b8@8k
@@ -691,15 +723,43 @@ def _main() -> int:
                 (16384, 2, 10, 5, []), (32768, 2, 10, 5, []),
                 (65536, 1, 8, 4, ["--remat", "--remat-save-flash"]),
                 (131072, 1, 4, 2,
-                 ["--remat", "--remat-save-flash-layers", "6"])):
+                 ["--remat", "--remat-save-flash-layers", "9"])):
             log(f"bench: long-context seq {seq_x}...")
             lmx = chip_job(
                 "transformer-lm", steps=steps_x, batch=batch_x,
                 extra=["--seq", str(seq_x), "--layers", str(lm_layers),
                        "--hidden", str(lm_hidden), "--heads", str(lm_heads),
-                       "--log-every", str(log_x), *extra_x],
+                       "--log-every", str(log_x), *OPT_FLAGS, *extra_x],
                 timeout=1200,
             )
+            if seq_x == 131072:
+                lm128_k = 9
+                if not lmx["ok"] and _state["tunnel_ok"]:
+                    # K=9 didn't fit this session (OOM-shaped: the job
+                    # failed but the tunnel answers) — back off to K=6.
+                    # The K=9 attempt's record is kept (bench_detail
+                    # longctx128k_k9_attempt) so a NON-memory failure that
+                    # this backoff absorbs is still visible as more than a
+                    # quiet K downgrade.
+                    lm128_k9_attempt = {
+                        "wallclock_s": lmx.get("wallclock_s"),
+                        "error": lmx.get("error"),
+                        "last_events": [e.get("event")
+                                        for e in lmx.get("events", [])][-5:],
+                    }
+                    log(f"bench: 128k K=9 failed with tunnel alive "
+                        f"({lm128_k9_attempt}); backing off to K=6...")
+                    lm128_k = 6
+                    lmx = chip_job(
+                        "transformer-lm", steps=steps_x, batch=batch_x,
+                        extra=["--seq", str(seq_x),
+                               "--layers", str(lm_layers),
+                               "--hidden", str(lm_hidden),
+                               "--heads", str(lm_heads),
+                               "--log-every", str(log_x), *OPT_FLAGS,
+                               "--remat", "--remat-save-flash-layers", "6"],
+                        timeout=1200,
+                    )
             lx = {e["event"]: e for e in lmx["events"]}
             epsx = lx.get("done", {}).get("examples_per_sec")
             tpsx = round(epsx * seq_x, 1) if epsx else None
@@ -729,7 +789,8 @@ def _main() -> int:
         extra=["--seq", str(moe_seq), "--layers", str(moe_layers_n),
                "--hidden", str(moe_hidden), "--heads", str(moe_heads),
                "--moe-dispatch", "sparse",
-               "--log-every", "5", "--profile-dir", moe_profile_dir],
+               "--log-every", "5", "--profile-dir", moe_profile_dir,
+               *OPT_FLAGS],
         timeout=1200,
     )
     mev = {e["event"]: e for e in moe["events"]}
@@ -800,6 +861,7 @@ def _main() -> int:
         "resnet50_data_pipeline_ok": rn_data["ok"],
         "resnet50_data_pipeline_images_per_sec": rn_data_ips,
         "resnet50_data_pipeline_vs_synthetic": rn_data_frac,
+        "resnet50_data_pipeline_prefetch": rn_prefetch,
         "resnet50_data_pipeline_diagnosis": rn_data_diag,
         # Itemized standalone-vs-operator ladder (VERDICT r4 #3), measured
         # by tools/exp_resnet_tax.py (too slow to re-run inside every
@@ -828,10 +890,20 @@ def _main() -> int:
         "longctx128k_ok": lm128_ok,
         "longctx128k_tokens_per_sec": lm128_tps,
         "longctx128k_mfu": lm128_mfu,
+        # which saved-flash-layer count actually ran: 9 (the probed best)
+        # or 6 (the OOM-backoff fallback); None off-TPU
+        "longctx128k_saved_flash_layers": lm128_k,
         "moe_ok": moe["ok"],
         "moe_tokens_per_sec": moe_tps,
         "moe_mfu": moe_mfu,
         "moe_dispatch": "sparse",
+        # Round-6 mixed-precision optimizer state, default-on for every
+        # LM/MoE point (NOT mnist/resnet: their optimizer slabs are noise):
+        # bf16 Adam moments (half the moment slab + traffic) + f32 master
+        # weights (bf16 compute params, halving fwd/bwd param reads).
+        # Numerics parity pinned on CPU by tests/test_optimizer.py.
+        "optimizer": {"moment_dtype": "bf16", "master_weights": True,
+                      "applies_to": "lm+moe points"},
         "bench_total_s": round(time.time() - t_total, 1),
         "detail_file": "artifacts/bench_detail.json",
     }
@@ -880,6 +952,9 @@ def _main() -> int:
         "longctx32k_segments": lm32_seg,
         "longctx64k_segments": lm64_seg,
         "longctx128k_segments": lm128_seg,
+        # what the K=9 probe saw when the bench had to back off to K=6
+        # (None when K=9 ran clean or the point didn't run)
+        "longctx128k_k9_attempt": lm128_k9_attempt,
         "moe_segments": moe.get("segments"),
     }
     # A failed side-file write must not discard 30 minutes of measurements.

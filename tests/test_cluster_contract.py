@@ -252,12 +252,28 @@ class TestJaxDistributedConsumption:
         assert "JAX_COORDINATOR_ADDRESS" in src
 
     def test_gke_parser_env_names_unchanged(self):
-        """If a jax upgrade renames the env vars our contract relies on,
-        fail loudly here rather than in a user's pod."""
+        """If a jax upgrade renames the env VARS our contract relies on,
+        fail loudly here rather than in a user's pod. The parser METHOD
+        holding the hostnames lookup has already been renamed across jax
+        versions (_get_worker_host_names_env_var ->
+        _get_worker_list_in_slice) while the env contract stayed put, so
+        probe whichever exists — the contract is the env names, not jax's
+        private method names."""
         import inspect
 
         gke = _import_gke_parser()
-        src = inspect.getsource(gke._get_worker_host_names_env_var)
-        assert "TPU_WORKER_HOSTNAMES" in src
+        hostnames_fn = next(
+            (getattr(gke, name)
+             for name in ("_get_worker_host_names_env_var",
+                          "_get_worker_list_in_slice")
+             if hasattr(gke, name)),
+            None,
+        )
+        assert hostnames_fn is not None, (
+            "jax's GkeTpuCluster no longer has a recognizable worker-"
+            "hostnames parser method — re-pin the env contract against "
+            "this jax version"
+        )
+        assert "TPU_WORKER_HOSTNAMES" in inspect.getsource(hostnames_fn)
         src_pid = inspect.getsource(gke._get_process_id_in_slice)
         assert "TPU_WORKER_ID" in src_pid

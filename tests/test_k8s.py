@@ -1188,10 +1188,18 @@ class TestAdmissionWebhook:
 
     @staticmethod
     def _self_signed_cert(tmp_path, tag: str = "tls"):
-        """PEM cert+key for 127.0.0.1 (SAN IP), 1-day validity."""
+        """PEM cert+key for 127.0.0.1 (SAN IP), 1-day validity. Skips the
+        calling test when `cryptography` isn't installed — cert generation
+        is test scaffolding, not product surface, and the TLS handshake
+        behavior under test can't run without a cert to serve."""
         import datetime
         import ipaddress
 
+        pytest.importorskip(
+            "cryptography",
+            reason="self-signed-cert scaffolding needs the cryptography "
+                   "package (absent from this environment)",
+        )
         from cryptography import x509
         from cryptography.hazmat.primitives import hashes, serialization
         from cryptography.hazmat.primitives.asymmetric import rsa

@@ -96,9 +96,12 @@ class TestForkedPods:
         assert "loop" in open(log).read()
 
     def test_nonzero_exit_code(self, sup, tmp_path):
-        # pydoc with a bogus name exits nonzero.
+        # json.tool on a missing file exits 2 on every supported Python
+        # (pydoc with a bogus name — the old probe — started exiting 0 in
+        # 3.10's CLI, which made this test assert on pydoc behavior rather
+        # than the fork server's exit-code propagation).
         p = sup.spawn(
-            [sys.executable, "-m", "pydoc", "no.such.module.exists"],
+            [sys.executable, "-m", "json.tool", str(tmp_path / "missing.json")],
             env=ENV, logfile=str(tmp_path / "p.log"),
         )
         assert p.wait(timeout=30) != 0

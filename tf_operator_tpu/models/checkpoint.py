@@ -10,6 +10,18 @@ is complete so it can exit cleanly.
 
 Layout:  <dir>/step_<N>/...   (orbax PyTree checkpoint, atomic rename)
          <dir>/FINAL          (text: last step number)
+
+Dtype contract (mixed-precision optimizer state, tf_operator_tpu/optim.py):
+trees save at their in-memory dtypes (bf16 Adam moments persist as bf16,
+the f32 master copy as f32 — a bf16-moment checkpoint is ~half the f32
+one's optimizer payload), and restore CASTS to the template's dtypes (a
+host-side cast in restore_named — see its docstring for why the orbax
+RestoreArgs path is avoided), so a legacy all-f32 trainstate loads under a
+bf16-moment config and vice versa. A template whose LEAF LIST doesn't
+match the saved tree (e.g. a trainstate written without master weights
+restored under a master-weights config) raises ValueError from the arity
+check; models/train._try_resume catches that and falls back to a
+params-only resume. Both behaviors are pinned by tests/test_optimizer.py.
 """
 
 from __future__ import annotations
@@ -36,16 +48,45 @@ def save_named(ckpt_dir: str, name: str, tree: Any) -> str:
 
 
 def restore_named(ckpt_dir: str, name: str, template: Any | None = None) -> Any:
+    """Restore <dir>/<name>. With a template, leaves come back at the
+    TEMPLATE's dtypes (the mixed-precision dtype contract in the module
+    docstring); without one, at their saved dtypes. Raises
+    FileNotFoundError when absent, ValueError when the template's tree
+    doesn't match the saved one.
+
+    The restore deliberately does NOT go through orbax's
+    construct_restore_args/RestoreArgs path: on this orbax/tensorstore
+    build, a restore_args-driven read of the trainer's aux tree (0-d step
+    scalar + flat opt-leaf list) corrupts the glibc heap — a later
+    unrelated malloc then aborts with 'corrupted double-linked list'
+    (reproduced: resume-restore, then jitted train steps, then any orbax
+    save). Restoring the raw saved tree and casting to the template's
+    dtypes host-side is equivalent for the numpy trees this repo
+    checkpoints, and sidesteps the crash; the tree-structure mismatch
+    still raises ValueError (from jax.tree.map arity checking), which
+    _try_resume's params-only fallback relies on."""
     path = os.path.join(os.path.abspath(ckpt_dir), name)
     if not os.path.isdir(path):
         raise FileNotFoundError(path)
-    if template is not None:
-        import orbax.checkpoint as ocp
+    restored = _checkpointer().restore(path)
+    if template is None:
+        return restored
+    import jax
+    import numpy as np
 
-        return _checkpointer().restore(
-            path, restore_args=ocp.checkpoint_utils.construct_restore_args(template)
-        )
-    return _checkpointer().restore(path)
+    def cast(raw, tmpl):
+        if hasattr(tmpl, "dtype"):
+            # ALWAYS copy (astype's default), even on dtype match: a
+            # copy=False cast hands out aliases of orbax/tensorstore-owned
+            # buffers, and an alias that later reaches XLA (donated train
+            # state) reproduces the heap-corruption abort this module
+            # exists to avoid. The transient second tree on the common
+            # same-dtype resume is host RAM, bounded by the checkpoint
+            # size — the safe trade.
+            return np.asarray(raw).astype(tmpl.dtype)
+        return raw
+
+    return jax.tree.map(cast, restored, template)
 
 
 def save(ckpt_dir: str, step: int, tree: Any) -> str:

@@ -245,7 +245,9 @@ def sparse_moe_ffn(
     k = cfg.top_k
     xf = x.reshape(n, h)
 
-    logits = xf.astype(jnp.float32) @ w_router                  # [N, E]
+    # Both operands up-cast: under master_weights the live router param is
+    # a bf16 compute copy, and routing decisions must stay f32 regardless.
+    logits = xf.astype(jnp.float32) @ w_router.astype(jnp.float32)  # [N, E]
     probs = jax.nn.softmax(logits, axis=-1)
     topv, topi = jax.lax.top_k(probs, k)                        # [N, K]
     if k > 1:
@@ -338,8 +340,11 @@ class MoEMlp(nn.Module):
             self.sow("moe_losses", "zloss", router_z_loss(aux))
             return y
 
-        # Router math in f32 (bf16 softmax over experts is too coarse).
-        logits = jnp.einsum("bth,he->bte", x.astype(jnp.float32), w_router)
+        # Router math in f32 (bf16 softmax over experts is too coarse);
+        # w_router is up-cast too — under master_weights the live param is
+        # a bf16 compute copy.
+        logits = jnp.einsum("bth,he->bte", x.astype(jnp.float32),
+                            w_router.astype(jnp.float32))
         combine, dispatch, aux = topk_routing(logits, cfg.top_k, capacity)
 
         self.sow("moe_losses", "balance",

@@ -64,6 +64,29 @@ class TransformerConfig:
     # bench point, where all-12 OOMs but a subset may fit.
     remat_save_flash_layers: int = 0
 
+    def __post_init__(self):
+        # Same invariants models/train.py enforces at the CLI (ap.error),
+        # so non-CLI callers (bench harnesses, notebooks, dryruns) get the
+        # signal at CONFIG CONSTRUCTION instead of a silently vacuous
+        # save-flash policy: the flags select which residuals per-layer
+        # remat keeps, so without remat_layers they do nothing.
+        if ((self.remat_save_flash or self.remat_save_flash_layers)
+                and not self.remat_layers):
+            raise ValueError(
+                "remat_save_flash[_layers] requires remat_layers=True (they "
+                "select WHICH residuals per-layer remat keeps; without "
+                "remat_layers the policy never applies)"
+            )
+        if self.remat_save_flash and self.remat_save_flash_layers:
+            raise ValueError(
+                "remat_save_flash (all layers) conflicts with "
+                "remat_save_flash_layers (a subset): pick one — all-layers "
+                "would silently win and can OOM exactly where the K dial "
+                "was chosen to fit"
+            )
+        if self.remat_save_flash_layers < 0:
+            raise ValueError("remat_save_flash_layers must be >= 0")
+
     @property
     def head_dim(self) -> int:
         return self.hidden // self.num_heads

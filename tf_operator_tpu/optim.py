@@ -1,0 +1,266 @@
+"""Mixed-precision optimizer state: dtype-configurable Adam/AdamW.
+
+The round-5 roofline closed with one HBM lever left standing: the f32 Adam
+moment slab (~9.4 GB/step of traffic on the MoE bench point, ~15% of step
+with the elementwise chains it fuses into — docs/perf.md). This module is
+that lever: ZeRO/DeepSpeed-style low-precision optimizer state, TPU-first.
+
+Two independent knobs on `OptimizerConfig`:
+
+  moment_dtype    — storage dtype of the Adam first/second moments (mu, nu).
+                    bf16 halves the moment slab (8 bytes/param -> 4) and its
+                    read+write traffic every step. The update math always
+                    runs in f32: moments are upcast, updated, and cast back
+                    for storage, so bf16 costs 8 mantissa bits of moment
+                    *memory*, never of moment *arithmetic*. bf16 shares
+                    f32's exponent range, so nu (a sum of squares) cannot
+                    overflow/underflow the way fp16 moments famously do —
+                    no loss scaling, no error compensation needed at these
+                    scales (pinned by the CPU parity tests).
+
+  master_weights  — keep the authoritative f32 parameter copy ("master")
+                    inside the optimizer state and hold bf16 *compute*
+                    params in `TrainState.params`, re-derived from the
+                    master each step. The fwd/bwd then read 2-byte params
+                    (half the param traffic); the update still accumulates
+                    into f32, so tiny per-step deltas are never lost to
+                    bf16 rounding of the weights themselves.
+
+Contract with parallel/train_step.py: a `MixedPrecisionTransformation`
+looks like an optax `GradientTransformation` (init/update pair) but its
+`update` returns the NEW params (replacement semantics) rather than an
+additive delta — deriving bf16 params from the f32 master is a cast, not
+an add, and `p + (new - p)` in low precision is not guaranteed to round
+back to `new`. `apply_updates(tx, params, updates)` below dispatches on
+the transformation type so plain optax optimizers keep working unchanged.
+
+State layout (`MixedAdamState`): field order (count, mu, nu, master) is
+deliberate — with master_weights off the flat leaf list is
+[count, *mu, *nu], identical to optax.adamw's
+(ScaleByAdamState(count, mu, nu), EmptyState()) flatten order, so legacy
+trainstate checkpoints (which store opt state as a flat leaf list,
+models/train._aux_tree) restore into the new optimizer unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+_DTYPE_ALIASES = {
+    "f32": jnp.float32, "float32": jnp.float32, "fp32": jnp.float32,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "f16": jnp.float16, "float16": jnp.float16, "fp16": jnp.float16,
+}
+
+
+def canonical_dtype(d) -> Any:
+    """Accept 'bf16'/'f32'-style strings or dtypes; None passes through
+    (meaning: keep each leaf's own dtype)."""
+    if d is None:
+        return None
+    if isinstance(d, str):
+        key = d.strip().lower()
+        if key in _DTYPE_ALIASES:
+            return _DTYPE_ALIASES[key]
+        raise ValueError(
+            f"unknown optimizer dtype {d!r} (use one of "
+            f"{sorted(_DTYPE_ALIASES)})"
+        )
+    return jnp.dtype(d).type
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    """Dtype-configurable Adam/AdamW (see module docstring).
+
+    Flows CLI -> models/train.py -> make_optimizer -> train_step; the
+    bench's MoE/LM points run moment_dtype=bf16 + master_weights."""
+
+    name: str = "adamw"              # "adam" | "adamw"
+    learning_rate: float = 1e-3
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 1e-4       # adamw only (optax.adamw's default)
+    moment_dtype: Any = None         # None = each param's own dtype
+    master_weights: bool = False
+    compute_dtype: Any = field(default=jnp.bfloat16)  # params dtype under master_weights
+
+    def __post_init__(self):
+        if self.name not in ("adam", "adamw"):
+            raise ValueError(f"optimizer must be adam|adamw, got {self.name!r}")
+        object.__setattr__(self, "moment_dtype",
+                           canonical_dtype(self.moment_dtype))
+        object.__setattr__(self, "compute_dtype",
+                           canonical_dtype(self.compute_dtype) or jnp.bfloat16)
+
+
+class MixedAdamState(NamedTuple):
+    """Field order (count, mu, nu, master) is a checkpoint contract — see
+    module docstring before reordering."""
+
+    count: jax.Array
+    mu: Any
+    nu: Any
+    master: Any  # f32 param copy when master_weights, else () (no leaves)
+
+
+class MixedPrecisionTransformation(NamedTuple):
+    """optax-shaped (init, update) pair with REPLACEMENT update semantics:
+    update() returns the new params, not a delta. Dispatch via
+    apply_updates/compute_params; carries its config for introspection."""
+
+    init: Callable[[Any], MixedAdamState]
+    update: Callable[..., tuple[Any, MixedAdamState]]
+    config: OptimizerConfig
+
+
+def make_optimizer(cfg: OptimizerConfig) -> MixedPrecisionTransformation:
+    """Build the transformation. All update arithmetic is f32 regardless of
+    storage dtypes; storage casts happen exactly once per step per slab."""
+
+    def init(params: Any) -> MixedAdamState:
+        def moments_like(p):
+            return jnp.zeros(jnp.shape(p), cfg.moment_dtype or p.dtype)
+
+        master = (
+            jax.tree.map(lambda p: p.astype(jnp.float32), params)
+            if cfg.master_weights else ()
+        )
+        return MixedAdamState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(moments_like, params),
+            nu=jax.tree.map(moments_like, params),
+            master=master,
+        )
+
+    def update(grads: Any, state: MixedAdamState, params: Any = None):
+        if params is None:
+            raise ValueError("mixed-precision optimizer needs params")
+        count = state.count + 1
+        c = count.astype(jnp.float32)
+        bc1 = 1.0 - cfg.b1 ** c
+        bc2 = 1.0 - cfg.b2 ** c
+
+        g_flat, treedef = jax.tree_util.tree_flatten(grads)
+        mu_flat = treedef.flatten_up_to(state.mu)
+        nu_flat = treedef.flatten_up_to(state.nu)
+        p_flat = treedef.flatten_up_to(params)
+        m_flat = (treedef.flatten_up_to(state.master)
+                  if cfg.master_weights else p_flat)
+
+        new_mu, new_nu, new_master, new_params = [], [], [], []
+        for g, mu, nu, p, m in zip(g_flat, mu_flat, nu_flat, p_flat, m_flat):
+            g32 = g.astype(jnp.float32)
+            mu32 = cfg.b1 * mu.astype(jnp.float32) + (1.0 - cfg.b1) * g32
+            nu32 = cfg.b2 * nu.astype(jnp.float32) + (1.0 - cfg.b2) * g32 * g32
+            step = (mu32 / bc1) / (jnp.sqrt(nu32 / bc2) + cfg.eps)
+            target = m.astype(jnp.float32)  # f32 master (== p when no master)
+            if cfg.name == "adamw" and cfg.weight_decay:
+                step = step + cfg.weight_decay * target
+            upd = target - cfg.learning_rate * step
+            new_mu.append(mu32.astype(mu.dtype))
+            new_nu.append(nu32.astype(nu.dtype))
+            if cfg.master_weights:
+                new_master.append(upd)
+                new_params.append(upd.astype(p.dtype))
+            else:
+                new_params.append(upd.astype(p.dtype))
+
+        unflatten = jax.tree_util.tree_unflatten
+        new_state = MixedAdamState(
+            count=count,
+            mu=unflatten(treedef, new_mu),
+            nu=unflatten(treedef, new_nu),
+            master=unflatten(treedef, new_master) if cfg.master_weights else (),
+        )
+        # REPLACEMENT semantics: the "updates" ARE the new params.
+        return unflatten(treedef, new_params), new_state
+
+    return MixedPrecisionTransformation(init=init, update=update, config=cfg)
+
+
+def apply_updates(tx, params: Any, updates: Any) -> Any:
+    """Dispatch point for train_step: replacement semantics for the mixed
+    optimizer, optax's additive semantics for everything else."""
+    if isinstance(tx, MixedPrecisionTransformation):
+        return updates
+    return optax.apply_updates(params, updates)
+
+
+def compute_params(tx, params: Any) -> Any:
+    """Params as the TrainState should hold them: the bf16 compute copy
+    under master_weights (the f32 master lives in the opt state), params
+    unchanged otherwise. Called once at state creation — thereafter each
+    update() re-derives the compute copy from the updated master."""
+    if (isinstance(tx, MixedPrecisionTransformation)
+            and tx.config.master_weights):
+        cd = tx.config.compute_dtype
+        return jax.tree.map(
+            lambda p: p.astype(cd)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            params,
+        )
+    return params
+
+
+def master_template(tx, params: Any) -> Any:
+    """Full-precision template for restoring a params-only checkpoint under
+    master_weights: restore at f32 (legacy f32 checkpoints keep their full
+    precision; new bf16 ones upcast exactly), then re-derive both copies.
+    Host-side numpy zeros — a restore template must never cost device HBM."""
+    if (isinstance(tx, MixedPrecisionTransformation)
+            and tx.config.master_weights):
+        import numpy as np
+
+        return jax.tree.map(
+            lambda p: np.zeros(jnp.shape(p), np.float32)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            params,
+        )
+    return params
+
+
+def _adam_moment_nodes(opt_state: Any) -> list:
+    """Find every (mu, nu)-carrying state node — ours (MixedAdamState) or
+    optax's (ScaleByAdamState inside a chain)."""
+    found = []
+
+    def rec(node):
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            if "mu" in node._fields and "nu" in node._fields:
+                found.append(node)
+                return
+            for child in node:
+                rec(child)
+        elif isinstance(node, (tuple, list)):
+            for child in node:
+                rec(child)
+        elif isinstance(node, dict):
+            for child in node.values():
+                rec(child)
+
+    rec(opt_state)
+    return found
+
+
+def moment_bytes(opt_state: Any) -> int:
+    """Bytes held by Adam first+second moments — the slab the bf16 knob
+    halves; the HBM accounting test pins this."""
+    total = 0
+    for node in _adam_moment_nodes(opt_state):
+        for leaf in jax.tree.leaves((node.mu, node.nu)):
+            total += leaf.size * jnp.dtype(leaf.dtype).itemsize
+    return total
+
+
+def optimizer_state_bytes(opt_state: Any) -> int:
+    """Total bytes of the optimizer state (moments + master + counters)."""
+    return sum(leaf.size * jnp.dtype(leaf.dtype).itemsize
+               for leaf in jax.tree.leaves(opt_state)
+               if hasattr(leaf, "size"))

@@ -29,6 +29,21 @@ def distributed_env() -> tuple[str | None, int, int]:
     return coord, pid, nprocs
 
 
+def ensure_cpu_collectives() -> None:
+    """Wire gloo into the CPU client BEFORE it is created: without it this
+    jax build fails the first multi-process sharded jit with "Multiprocess
+    computations aren't implemented on the CPU backend". Harmless for TPU
+    jobs (the option only affects the CPU client) and best-effort for jax
+    versions that rename/drop the knob. Shared by the trainer init path and
+    __graft_entry__'s 2-process dryrun children."""
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 - newer jax may rename/drop the option
+        pass
+
+
 def initialize_from_env(force: bool = False) -> bool:
     """Initialize jax.distributed when the operator wired a multi-process
     job; no-op (returns False) for single-process jobs."""
@@ -44,6 +59,7 @@ def initialize_from_env(force: bool = False) -> bool:
             coord = f"127.0.0.1:{listen}"
     import jax
 
+    ensure_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coord,
         num_processes=nprocs,

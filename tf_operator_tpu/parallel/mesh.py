@@ -100,3 +100,48 @@ def local_batch_size(mesh: Mesh, global_batch: int) -> int:
     if global_batch % denom:
         raise ValueError(f"global batch {global_batch} not divisible by dp size {denom}")
     return global_batch // denom
+
+
+def shard_map_compat(body, mesh: Mesh, in_specs, out_specs,
+                     check_vma: bool = True, axis_names=None):
+    """jax.shard_map across jax versions.
+
+    Newer jax exposes top-level jax.shard_map(check_vma=, axis_names=);
+    this build (0.4.x) still has only jax.experimental.shard_map.shard_map
+    with the older spelling (check_rep=, auto= — auto being the COMPLEMENT
+    of axis_names: the axes left under GSPMD). Without the shim every
+    sp/ring/ulysses attention path and the pipeline schedule raise
+    AttributeError at trace time.
+
+    axis_names=None means fully manual (all mesh axes), matching both
+    APIs' defaults. On the experimental path a partial-manual call forces
+    check_rep=False: older shard_map rejects auto with replication
+    checking on.
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return sm(body, **kw)
+    from jax.experimental.shard_map import shard_map
+
+    kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=check_vma)
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kw["auto"] = auto
+            kw["check_rep"] = False
+    return shard_map(body, **kw)
+
+
+def pcast_compat(x, axes, to="varying"):
+    """jax.lax.pcast where it exists (vma-typed shard_map builds); identity
+    on older jax — pre-vma shard_map has no varying-axes types to satisfy,
+    so the annotation is simply unnecessary there."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, axes, to=to)

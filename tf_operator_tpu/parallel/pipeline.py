@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tf_operator_tpu.parallel import mesh as mesh_lib
+
 StageFn = Callable[[Any, jax.Array], jax.Array]
 # stage_fn(stage_params, h) -> h, same activation shape in and out.
 
@@ -135,9 +137,13 @@ def pipeline_apply(
 
         # The carry mixes with axis_index-dependent values, so it is
         # pp-varying inside the scan; the initial value must carry the same
-        # varying-axes type (shard_map vma typing).
-        o0 = jax.lax.pcast(jnp.zeros_like(xs[0]), (pp_axis,), to="varying")
-        outs0 = jax.lax.pcast(jnp.zeros_like(xs), (pp_axis,), to="varying")
+        # varying-axes type (shard_map vma typing). On jax builds without
+        # lax.pcast (pre-vma-typing, e.g. 0.4.x) the annotation is
+        # unnecessary — the compat helper is the identity there.
+        o0 = mesh_lib.pcast_compat(jnp.zeros_like(xs[0]), (pp_axis,),
+                                   to="varying")
+        outs0 = mesh_lib.pcast_compat(jnp.zeros_like(xs), (pp_axis,),
+                                      to="varying")
         (_, outputs), _ = jax.lax.scan(
             tick, (o0, outs0), jnp.arange(m + mesh.shape[pp_axis] - 1)
         )
@@ -150,7 +156,7 @@ def pipeline_apply(
     # Partial-manual: only the schedule axes are manual; tp/sp stay under
     # GSPMD so tensor-parallel stage internals auto-partition (see header).
     manual = frozenset({pp_axis}) | frozenset(b_spec or ())
-    fn = jax.shard_map(
+    fn = mesh_lib.shard_map_compat(
         body, mesh=mesh, in_specs=(p_spec, x_spec), out_specs=x_spec,
         axis_names=manual,
     )

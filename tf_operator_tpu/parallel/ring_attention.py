@@ -174,10 +174,12 @@ def sp_shard_map(
     [B, H, T, D] with batch over dp/fsdp, heads over tp, sequence over sp.
     check_vma=False is required when the body contains pallas_call (its
     out-shapes carry no varying-axes annotation)."""
+    from tf_operator_tpu.parallel import mesh as mesh_lib
+
     b_spec = tuple(a for a in batch_axes if a in mesh.axis_names) or None
     h_spec = head_axis if head_axis in mesh.axis_names else None
     spec = P(b_spec, h_spec, axis_name, None)
-    return jax.shard_map(
+    return mesh_lib.shard_map_compat(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=check_vma,
     )

@@ -21,9 +21,11 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tf_operator_tpu import optim as optim_lib
 from tf_operator_tpu.parallel import mesh as mesh_lib
 from tf_operator_tpu.parallel import sharding_rules
 
@@ -45,10 +47,15 @@ def create_train_state(
     tx: optax.GradientTransformation,
     model_state: Any = None,
 ) -> TrainState:
+    # init BEFORE the compute cast: under master_weights the optimizer's
+    # f32 master copy must come from the full-precision init params, and
+    # TrainState.params becomes the bf16 compute copy (optim.compute_params
+    # is the identity for plain optax transformations).
+    opt_state = tx.init(params)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
-        params=params,
-        opt_state=tx.init(params),
+        params=optim_lib.compute_params(tx, params),
+        opt_state=opt_state,
         model_state=model_state if model_state is not None else {},
     )
 
@@ -60,11 +67,13 @@ def state_shardings(
     param rules (momentum shards like its param), the rest replicated."""
     param_sh = sharding_rules.tree_shardings(state.params, mesh, rules)
 
-    # Optimizer subtrees (adam mu/nu, trace, …) mirror the param tree
-    # structure, so an opt leaf's path *ends with* its param's path (e.g.
-    # "0/mu/layer_0/attn/query/kernel"). Match by path suffix — matching by
-    # shape would collide query/key/value with attn_out (both hidden×hidden)
-    # and hand momenta a transposed sharding.
+    # Optimizer subtrees (adam mu/nu, f32 master copies, trace, …) mirror
+    # the param tree structure, so an opt leaf's path *ends with* its
+    # param's path (e.g. "0/mu/layer_0/attn/query/kernel"). Match by path
+    # suffix — matching by shape would collide query/key/value with
+    # attn_out (both hidden×hidden) and hand momenta a transposed sharding.
+    # The match keys on (suffix, SHAPE) only, never dtype: bf16 moments and
+    # the f32 master inherit their param's sharding at their own dtype.
     flat_params = {
         sharding_rules.path_str(p): leaf
         for p, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]
@@ -94,7 +103,22 @@ def state_shardings(
 
 def shard_state(state: TrainState, mesh: Mesh, rules=None) -> TrainState:
     sh = state_shardings(state, mesh, rules)
-    return jax.tree.map(jax.device_put, state, sh)
+
+    def put(x, s):
+        # On the CPU backend jax.device_put of a host numpy array can
+        # ZERO-COPY alias the numpy buffer. The train step then DONATES
+        # these buffers, so XLA reuses memory glibc owns — heap corruption
+        # that aborts much later ('corrupted double-linked list', observed
+        # on checkpoint-resume: restored numpy leaves -> shard_state ->
+        # donated step; reproduced and pinned by
+        # tests/test_examples TestResume). Copy host arrays into XLA-owned
+        # storage first; device backends always copy host->HBM, so only
+        # the CPU path pays (small models by construction).
+        if isinstance(x, np.ndarray) and jax.default_backend() == "cpu":
+            x = jnp.array(x)
+        return jax.device_put(x, s)
+
+    return jax.tree.map(put, state, sh)
 
 
 LossFn = Callable[..., tuple[jax.Array, Any]]
@@ -121,7 +145,9 @@ def make_train_step(
             state.params, state.model_state, batch, rng
         )
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        # Mixed-precision optimizers return REPLACEMENT params (bf16 compute
+        # copy re-derived from the f32 master); optax ones return deltas.
+        new_params = optim_lib.apply_updates(tx, state.params, updates)
         gnorm = optax.global_norm(grads)
         new_state = TrainState(
             step=state.step + 1,
