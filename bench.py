@@ -79,8 +79,10 @@ def read_events(path: str) -> list[dict]:
 
 
 def run_job_e2e(model: str, steps: int, batch: int, extra: list[str],
-                timeout: float) -> dict:
-    """Submit one TrainJob through the operator; return timing + events."""
+                timeout: float, env: dict | None = None) -> dict:
+    """Submit one TrainJob through the operator; return timing + events.
+    env: extra pod environment (e.g. TPUJOB_COMPILE_CACHE for the
+    cold-vs-warm compile split)."""
     from tf_operator_tpu.api import defaults
     from tf_operator_tpu.api.types import (
         ContainerSpec,
@@ -139,6 +141,7 @@ def run_job_e2e(model: str, steps: int, batch: int, extra: list[str],
         env_overrides={
             "PYTHONPATH": pythonpath,
             "TPUJOB_METRICS_FILE": metrics_file,
+            **(env or {}),
         },
         log_dir=tempfile.mkdtemp(prefix="tpujob-bench-logs-"),
     )
@@ -189,6 +192,20 @@ def run_job_e2e(model: str, steps: int, batch: int, extra: list[str],
             os.unlink(metrics_file)
         except OSError:
             pass
+
+
+def _corrected_startup(events: list[dict]) -> float | None:
+    """startup->FIRST-step latency from a run's own events: the trainer's
+    first dispatch runs a whole chunk of steps, so subtract the extra
+    steps at that run's measured steady rate — keeps the metric comparable
+    across chunk configurations (and between the cold and warm runs)."""
+    ev = {e["event"]: e for e in events}
+    startup = ev.get("first_step", {}).get("startup_s")
+    sps = ev.get("done", {}).get("steady_steps_per_sec")
+    first_n = ev.get("first_step", {}).get("steps_in_first_call") or 1
+    if startup and sps and first_n > 1:
+        startup = round(startup - (first_n - 1) / sps, 3)
+    return startup
 
 
 def _segments(events: list[dict], t_submit: float, t_observed: float) -> dict:
@@ -444,33 +461,49 @@ def _main() -> int:
         return r
 
     # --- Workload 1 (north star): dist-MNIST through the operator ---
+    # Round 7: the two mnist runs now share a SESSION-FRESH persistent
+    # compile-cache dir (utils/compile_cache.py — the trainer already
+    # enables it; pointing both pods at a fresh dir is what makes the
+    # split measurable). Run 1 pays the real XLA compile (cold) and
+    # populates the cache; run 2 loads the compiled program from disk
+    # (warm). Round 5 reported warm == cold because those keys only
+    # carried the tunnel-dial delta — the cache's actual effect was never
+    # isolated against a cold compile.
     log("bench: dist-MNIST e2e through operator...")
-    mnist_args = dict(steps=200, batch=128, extra=[], timeout=600)
+    cc_dir = tempfile.mkdtemp(prefix="tpujob-bench-cc-")
+    mnist_args = dict(steps=200, batch=128, extra=[], timeout=600,
+                      env={"TPUJOB_COMPILE_CACHE": cc_dir})
     mnist = chip_job("mnist-mlp", **mnist_args)
     mnist_first_run = None
-    if on_tpu and mnist["ok"]:
+    cold_startup = None
+    warm_ran = False
+    if mnist["ok"]:
         # Headline = the SECOND run, measured UNCONDITIONALLY — not only
         # when the first looks slow. The old rule (re-measure iff startup
         # > 15 s) was one-sided outlier filtering: pathological first runs
         # were replaced but unusually fast ones never were, biasing the
         # headline toward the best case (round-4 advice). Both runs are
         # always recorded; the headline is the steady-state (warm) run by
-        # construction, and the first run carries any chip-session
-        # recovery / cold-path variance as an annotation.
-        _startup0 = next((e for e in mnist["events"]
-                          if e.get("event") == "first_step"),
-                         {}).get("startup_s")
+        # construction, and the first run carries the cold compile plus
+        # any chip-session recovery / cold-path variance as an annotation.
+        cold_startup = _corrected_startup(mnist["events"])
         mnist_first_run = {"wallclock_s": mnist["wallclock_s"],
-                           "startup_s": _startup0}
+                           "startup_s": cold_startup,
+                           "compile_cache": "cold (fresh cache dir)"}
         second = chip_job("mnist-mlp", **mnist_args)
         if second["ok"]:
             mnist = second
+            warm_ran = True
         else:
             # The first run WAS a complete successful measurement — keep
             # it rather than failing the bench on a second-run wedge.
             log("  second run failed; headline keeps the first run")
             mnist_first_run["second_run_error"] = second.get(
                 "error", "job failed")
+    try:
+        cc_entries = sum(1 for f in os.listdir(cc_dir) if f.endswith("-cache"))
+    except OSError:
+        cc_entries = None
     if not mnist["ok"]:
         log(f"MNIST job FAILED: {mnist}")
         tunnel_note = None if _state["tunnel_ok"] else "tunnel_down_midrun"
@@ -482,18 +515,13 @@ def _main() -> int:
         }))
         return 1
     ev = {e["event"]: e for e in mnist["events"]}
-    startup = ev.get("first_step", {}).get("startup_s")
+    startup = _corrected_startup(mnist["events"])
     mnist_sps = ev.get("done", {}).get("steady_steps_per_sec")
     backend = ev.get("first_step", {}).get("backend", "?")
     device_kind = ev.get("first_step", {}).get("device_kind")
     peak = device_peak_tflops(device_kind)
-    # The trainer's first dispatch runs a whole chunk of steps; correct the
-    # startup->FIRST-step latency by the extra steps at the measured steady
-    # rate so the metric stays comparable across chunk configurations.
-    first_n = ev.get("first_step", {}).get("steps_in_first_call") or 1
-    if startup and mnist_sps and first_n > 1:
-        startup = round(startup - (first_n - 1) / mnist_sps, 3)
     log(f"  wallclock={mnist['wallclock_s']}s startup->first-step={startup}s "
+        f"(cold={cold_startup}s, compile cache entries={cc_entries}) "
         f"steps/s={mnist_sps} backend={backend}")
 
     # --- Workload 2: ResNet-50 training throughput on the chip ---
@@ -566,7 +594,32 @@ def _main() -> int:
         extra=["--image-size", str(rn_size), "--data-dir", rnd_dir],
         timeout=1800,
     )
+    # --- Workload 2c (round 7): the same point through the staging ring ---
+    # data/staging.py: uint8 wire + K staged device batches fed by a
+    # background transfer thread + chunked puts, normalization on-device in
+    # the step's preprocess hook. The unstaged 2b point is KEPT for
+    # trajectory continuity; this one carries the round-7 target
+    # (0.062 -> >=0.5 vs synthetic) and the first-class transfer/overlap
+    # accounting the staged done event emits.
+    log("bench: ResNet-50 through the STAGED data pipeline...")
+    rn_staged = chip_job(
+        "resnet50", steps=40 if on_tpu else 10, batch=rn_batch,
+        extra=["--image-size", str(rn_size), "--data-dir", rnd_dir,
+               "--input-staging", "staged", "--staging-depth", "3",
+               "--staging-chunks", "4", "--wire-dtype", "uint8"],
+        timeout=1800,
+    )
     shutil.rmtree(rnd_dir, ignore_errors=True)
+    rsev = {e["event"]: e for e in rn_staged["events"]}
+    rn_staged_ips = rsev.get("done", {}).get("examples_per_sec")
+    rn_staged_frac = (
+        round(rn_staged_ips / rn_ips, 4) if rn_staged_ips and rn_ips else None
+    )
+    rn_staging = rsev.get("done", {}).get("staging")
+    log(f"  ok={rn_staged['ok']} images/s={rn_staged_ips} "
+        f"vs synthetic={rn_staged_frac} "
+        f"transfer_mb_per_s={(rn_staging or {}).get('transfer_mb_per_s')} "
+        f"overlap={(rn_staging or {}).get('input_overlap_fraction')}")
     rdev = {e["event"]: e for e in rn_data["events"]}
     rn_data_ips = rdev.get("done", {}).get("examples_per_sec")
     rn_data_frac = (
@@ -844,14 +897,33 @@ def _main() -> int:
         "device_peak_tflops": peak,
         "mxu_ceiling_tflops_measured": mxu,
         "mnist_wallclock_s": mnist["wallclock_s"],
-        # warm = steady-state (operator's prespawn + tunnel already up);
-        # cold = warm + the measured one-off tunnel establishment delta
-        # (first dial after idle). Docs quote warm, labeled as such.
-        "startup_to_first_step_s": startup,  # warm (kept key: round continuity)
-        "startup_to_first_step_warm_s": startup,
+        # warm = steady-state (operator's prespawn + tunnel up + persistent
+        # compile cache HIT — run 2 against the session-fresh cache dir);
+        # cold = run 1's startup (real XLA compile, cache miss-and-populate)
+        # + the measured one-off tunnel establishment delta. Round 5's
+        # cold key was warm + tunnel only — it never isolated the compile,
+        # which is why warm == cold read as "cache not hitting".
+        # If the warm (second) run failed, the headline keeps the cold
+        # measurement but the warm keys go None — publishing cold under
+        # the warm keys would recreate the exact warm==cold /
+        # compile_saved_s==0 misread this split exists to fix.
+        "startup_to_first_step_s": startup,  # headline (kept key: round continuity)
+        "startup_to_first_step_warm_s": startup if warm_ran else None,
         "startup_to_first_step_cold_s": (
-            round(startup + cold_extra, 3) if startup is not None else None),
+            round(cold_startup + cold_extra, 3)
+            if cold_startup is not None else None),
         "tunnel_establishment_s": cold_extra,
+        "compile_cache": {
+            "fresh_dir": True,
+            "entries": cc_entries,
+            "warm_ran": warm_ran,
+            "cold_startup_s": cold_startup,
+            "warm_startup_s": startup if warm_ran else None,
+            "compile_saved_s": (
+                round(cold_startup - startup, 3)
+                if warm_ran and cold_startup is not None
+                and startup is not None else None),
+        },
         "mnist_steps_per_sec": mnist_sps,
         "resnet50_ok": resnet["ok"],
         "resnet50_images_per_sec": rn_ips,
@@ -863,6 +935,16 @@ def _main() -> int:
         "resnet50_data_pipeline_vs_synthetic": rn_data_frac,
         "resnet50_data_pipeline_prefetch": rn_prefetch,
         "resnet50_data_pipeline_diagnosis": rn_data_diag,
+        # Round-7 staged ingest point (uint8 wire + staging ring + chunked
+        # puts; the unstaged point above is kept for trajectory
+        # continuity). transfer_mb_per_s / input_overlap_fraction are the
+        # ring's own timers, surfaced first-class.
+        "resnet50_data_pipeline_staged_ok": rn_staged["ok"],
+        "resnet50_data_pipeline_staged_images_per_sec": rn_staged_ips,
+        "resnet50_data_pipeline_staged_vs_synthetic": rn_staged_frac,
+        "transfer_mb_per_s": (rn_staging or {}).get("transfer_mb_per_s"),
+        "input_overlap_fraction": (
+            (rn_staging or {}).get("input_overlap_fraction")),
         # Itemized standalone-vs-operator ladder (VERDICT r4 #3), measured
         # by tools/exp_resnet_tax.py (too slow to re-run inside every
         # bench). Preference order: a FRESH complete run's snapshot
@@ -938,6 +1020,10 @@ def _main() -> int:
         "resnet50_wallclock_s": resnet.get("wallclock_s"),
         "resnet50_image_size": rn_size,
         "resnet50_roofline": rn_roofline,
+        # full staging diagnosis (ring depth, chunking, wire dtype, byte/
+        # time accounting) from the staged job's done event
+        "resnet50_data_pipeline_staged_staging": rn_staging,
+        "resnet50_data_pipeline_staged_segments": rn_staged.get("segments"),
         "moe_roofline": moe_roofline,
         # embed table + UNTIED lm_head are both vocab x hidden
         "longctx_params_m": round(

@@ -42,6 +42,37 @@ if not os.environ.get("TPUJOB_TEST_TPU"):
     except ImportError:
         pass
 
+# Retry-once for @pytest.mark.flaky tests (a minimal in-repo
+# pytest-rerunfailures: the image ships no plugin and tier-1 may not
+# install one). Timing-sensitive tests — wall-clock fits like the GPipe
+# bubble-fraction fit, overlap measurements — can fail under CI host load;
+# one retry distinguishes "loaded host this instant" from "actually
+# broken" without masking real regressions (a deterministic failure still
+# fails both attempts). The first attempt's failure is logged to stderr so
+# a retried pass is visible in the run, not silent.
+def pytest_runtest_protocol(item, nextitem):
+    if item.get_closest_marker("flaky") is None:
+        return None  # default protocol
+    import sys as _sys
+
+    from _pytest.runner import runtestprotocol
+
+    ihook = item.ihook
+    ihook.pytest_runtest_logstart(nodeid=item.nodeid, location=item.location)
+    reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    if any(r.failed for r in reports):
+        print(f"\nFLAKY RETRY: {item.nodeid} failed once, retrying...",
+              file=_sys.stderr)
+        # Fresh fixture state for the retry (what pytest-rerunfailures does).
+        if hasattr(item, "_initrequest"):
+            item._initrequest()
+        reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    for report in reports:
+        ihook.pytest_runtest_logreport(report=report)
+    ihook.pytest_runtest_logfinish(nodeid=item.nodeid, location=item.location)
+    return True  # protocol handled
+
+
 # Persistent XLA compilation cache for the IN-PROCESS test compiles — the
 # exact mechanism pod processes already use (utils/compile_cache.py; pods
 # default to the same directory). The data-plane tiers (parallel/moe/

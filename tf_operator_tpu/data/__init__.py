@@ -4,3 +4,12 @@ from tf_operator_tpu.data.dataset import (  # noqa: F401
     write_array_shards,
 )
 from tf_operator_tpu.data.prefetch import prefetch_to_device  # noqa: F401
+from tf_operator_tpu.data.staging import (  # noqa: F401
+    chunked_device_put,
+    input_overlap_fraction,
+    make_preprocess_fn,
+    normalize_uint8,
+    stage_to_device,
+    to_wire,
+    transfer_mb_per_s,
+)

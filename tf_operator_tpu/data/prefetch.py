@@ -6,6 +6,13 @@ every step. A small background thread keeps `depth` batches already resident
 on device (optionally sharded over the mesh's data axes), so the train loop
 dequeues device arrays and the transfer of batch i+depth rides under the
 compute of batch i.
+
+Round 7 adds data/staging.py on top of this measurement contract: the
+staging ring generalizes the same overlap idea with wire-dtype control
+(uint8 on the wire, normalize on device), chunked puts, and byte-level
+transfer accounting, populating the SAME stats keys overlap_efficiency
+reads — the trainer keeps this prefetcher as the `--input-staging
+prefetch` continuity baseline.
 """
 
 from __future__ import annotations

@@ -1,0 +1,458 @@
+"""Asynchronous host->device input staging: uint8 wire + buffered ring.
+
+The round-5 bench pinned the real-data ResNet point at 6.2% of synthetic
+throughput and attributed the whole gap to ingest: the host pipeline
+produced 2053 MB/s but serial f32 `device_put` moved ~52 MB/s against a
+361 MB/s parity requirement. This module is the classic training-stack
+answer, in two coordinated layers:
+
+  1. **Wire format** (`to_wire`, `make_preprocess_fn`): ship images
+     host->device as uint8 and cast/normalize on device *inside* the
+     jitted step, where the cast fuses into the first conv's input read.
+     4x fewer bytes on the wire drops the parity bar by 4x. Token batches
+     (int32, already minimal) pass through the same API unchanged.
+
+  2. **Staging ring** (`stage_to_device`): K device-batch slots fed by a
+     background transfer thread, so the transfer of batch N+1 overlaps the
+     compute of batch N. The ring bounds in-flight device memory to K
+     staged batches (+1 being consumed): a slot frees when the consumer
+     takes the next batch, and XLA's allocator recycles the freed arrays'
+     pages for the next transfer. Transfers can be *chunked* — split along
+     the batch dim into C concurrent `device_put` calls reassembled
+     on-device — which raises the effective rate on links where a single
+     serial put can't fill the pipe (the tunnel, PCIe with small copies).
+
+Accounting is explicit (the bench reports numbers, not assertions):
+`transfer_mb_per_s` from the producer's own put timers, and
+`input_overlap_fraction` — the share of steady-state input seconds that
+hid under compute — from stamps that telescope exactly to the consumer's
+wall-clock (wall_s == consumer_wait_s + consumer_busy_s by construction,
+which tests verify against a synthetic slow producer).
+
+Normalization math is defined ONCE here (multiply by a f32-rounded
+reciprocal) and used by both the host-side f32 wire path and the
+on-device preprocess hook: `--wire-dtype f32` and `--wire-dtype uint8`
+trajectories agree to FMA-contraction rounding (XLA fuses the mul-sub
+where numpy rounds twice; the CPU parity test pins the divergence at
+rtol 1e-3 over 6 optimizer steps, 1e-4 on the first). Staged vs prefetch
+ingest of the SAME wire — identical device ops — IS bit-identical, and
+tested as exact equality.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from tf_operator_tpu.data.prefetch import overlap_efficiency
+
+# f32-rounded reciprocal, multiplied (not divided) on BOTH host and device:
+# the same IEEE single-precision ops in the same order keeps the uint8-wire
+# and f32-wire trajectories together up to XLA's FMA contraction of the
+# mul-sub (the one rounding difference the parity test bounds).
+U8_SCALE = np.float32(1.0) / np.float32(127.5)
+U8_SHIFT = np.float32(1.0)
+
+WIRE_DTYPES = ("auto", "uint8", "f32")
+
+# Batch keys carrying images (the arrays the uint8 wire + on-device
+# normalize applies to). uint8 elsewhere — labels under 256 classes,
+# 0/1 masks — is DATA, not pixels: normalizing it would corrupt it
+# (float class indices crash take_along_axis; a {-1, -0.99} mask
+# silently wrecks the loss). Every model entry in models/train.py uses
+# "x" for its image tensor; extend here if that contract grows.
+IMAGE_KEYS = ("x",)
+
+
+class _Stop:
+    pass
+
+
+def normalize_uint8(x):
+    """uint8 pixels -> f32 in [-1, 1], on whichever backend `x` lives.
+
+    jnp arrays normalize on device (fused into the consuming op); numpy
+    arrays normalize on host (the f32 wire path) with the identical
+    constant and op order.
+    """
+    if isinstance(x, np.ndarray):
+        return x.astype(np.float32) * U8_SCALE - U8_SHIFT
+    import jax.numpy as jnp
+
+    return x.astype(jnp.float32) * U8_SCALE - U8_SHIFT
+
+
+def to_wire(batch: dict, wire_dtype: str = "auto",
+            image_keys: tuple[str, ...] = IMAGE_KEYS) -> dict:
+    """Host-side wire-format conversion of one dict batch. Only
+    `image_keys` entries are ever converted — uint8 labels/masks are data
+    and pass through under every wire dtype.
+
+    auto  — ship every array as stored (uint8 stays uint8: the cheap wire).
+    uint8 — contract check: image keys must already be uint8 (storing f32
+            and quantizing here would silently lose data); everything
+            else (labels, tokens, masks) passes through.
+    f32   — normalize uint8 image keys to f32 ON HOST (the 4x-wider wire,
+            kept as the parity reference for the on-device cast).
+    """
+    if wire_dtype not in WIRE_DTYPES:
+        raise ValueError(f"wire_dtype {wire_dtype!r} not in {WIRE_DTYPES}")
+    if wire_dtype == "auto":
+        return batch
+    out = {}
+    for k, v in batch.items():
+        if k not in image_keys:
+            out[k] = v
+        elif wire_dtype == "f32" and v.dtype == np.uint8:
+            out[k] = normalize_uint8(v)
+        elif wire_dtype == "uint8" and np.issubdtype(v.dtype, np.floating):
+            raise ValueError(
+                f"--wire-dtype uint8 needs uint8-stored images, but key "
+                f"{k!r} is {v.dtype} — re-shard the dataset as uint8 or "
+                f"use --wire-dtype auto/f32"
+            )
+        else:
+            out[k] = v
+    return out
+
+
+def make_preprocess_fn(
+    image_keys: tuple[str, ...] = IMAGE_KEYS,
+) -> Callable[[dict], dict]:
+    """On-device batch preprocessor for the train step's preprocess hook:
+    normalizes uint8 IMAGE entries (the uint8 wire) and passes everything
+    else (tokens, labels, masks, already-f32 images) through — uint8
+    outside `image_keys` is data, never pixels. Traced into the jitted
+    step, so the cast/normalize fuses with the first consumer of the
+    batch and never materializes a second f32 copy in the host->device
+    path."""
+    import jax.numpy as jnp
+
+    def preprocess(batch):
+        return {
+            k: normalize_uint8(v)
+            if k in image_keys and v.dtype == jnp.uint8 else v
+            for k, v in batch.items()
+        }
+
+    return preprocess
+
+
+class _Chunks:
+    """Opaque holder for one array staged as C chunk transfers, awaiting
+    consumer-side reassembly. Deliberately NOT a pytree container, so
+    jax.tree.map treats it as a leaf."""
+
+    __slots__ = ("parts",)
+
+    def __init__(self, parts):
+        self.parts = parts
+
+
+# Arrays under this size transfer in ONE put regardless of the chunks
+# knob: a label/mask vector is a few KB — splitting it buys nothing and
+# multiplies per-put dispatch overhead.
+MIN_CHUNK_BYTES = 1 << 20
+
+
+def _dim0_shards(sharding, shape) -> int:
+    """How many pieces the sharding splits dim 0 into (1 when unsharded or
+    unanswerable) — each chunk's leading dim must stay divisible by this."""
+    if sharding is None or not shape:
+        return 1
+    try:
+        return shape[0] // sharding.shard_shape(tuple(shape))[0]
+    except Exception:  # noqa: BLE001 — exotic shardings: just don't chunk
+        return 0
+
+
+def effective_chunks(x, sharding=None, chunks: int = 1) -> int:
+    """Largest feasible chunk count <= requested for THIS array: chunking
+    is a transfer-rate knob, not semantics, so infeasible configs degrade
+    instead of erroring — the requested count may not divide the leading
+    dim, and each chunk must itself remain shardable over the mesh's data
+    axes (a 24-row batch on dp=8 works unchunked but no 4-way split of it
+    leaves rows divisible by 8)."""
+    if (chunks <= 1 or x.ndim == 0 or x.shape[0] < chunks
+            or x.nbytes < MIN_CHUNK_BYTES):
+        return 1
+    nsh = _dim0_shards(sharding, x.shape)
+    if nsh == 0:
+        return 1
+    for c in range(chunks, 1, -1):
+        if x.shape[0] % c == 0 and (x.shape[0] // c) % nsh == 0:
+            return c
+    return 1
+
+
+def _put_chunks(x, sharding=None, chunks: int = 1, strict: bool = False):
+    """TRANSFERS ONLY — safe from a background thread.
+
+    device_put is async: issuing C smaller puts along the leading dim lets
+    the transfers stream back-to-back instead of serializing behind one
+    large copy, raising the effective rate on links a single put can't
+    fill. Returns a _Chunks awaiting reassembly, or a plain array when the
+    chunk count resolves to 1.
+
+    strict=False (the staging ring): chunking degrades per-array via
+    effective_chunks — a perf knob must not crash the transfer thread.
+    strict=True (the explicit chunked_device_put API, benchmarks/tests):
+    chunk exactly as asked, raising a clear error on an infeasible split.
+    """
+    import jax
+
+    def put(v):
+        return jax.device_put(v, sharding) if sharding is not None \
+            else jax.device_put(v)
+
+    if strict and chunks > 1:
+        if x.ndim == 0 or x.shape[0] < chunks:
+            chunks = 1  # nothing to split — documented fallback
+        elif x.shape[0] % chunks:
+            raise ValueError(
+                f"chunks {chunks} does not divide leading dim {x.shape[0]}"
+            )
+        else:
+            nsh = _dim0_shards(sharding, x.shape)
+            if nsh == 0 or (nsh > 1 and (x.shape[0] // chunks) % nsh):
+                raise ValueError(
+                    f"chunks {chunks} leaves {x.shape[0] // chunks}-row "
+                    f"chunks the sharding cannot split over its {nsh} "
+                    f"dim-0 shards"
+                )
+    else:
+        chunks = effective_chunks(x, sharding, chunks)
+    if chunks <= 1:
+        return put(x)
+    step = x.shape[0] // chunks
+    return _Chunks([put(x[i * step:(i + 1) * step]) for i in range(chunks)])
+
+
+def _assemble(tree, sharding=None):
+    """Consumer-side chunk reassembly: jnp.concatenate COMPILES A PROGRAM,
+    and on a multi-device mesh concurrently dispatched programs can enqueue
+    their collectives in different per-device orders and deadlock — so
+    reassembly must run on the thread that also dispatches the train step
+    (one dispatch order), never on the transfer thread. The transfer thread
+    only ever calls device_put (no program), which the prefetcher already
+    proved safe."""
+    import jax
+    import jax.numpy as jnp
+
+    def join(leaf):
+        if not isinstance(leaf, _Chunks):
+            return leaf
+        out = jnp.concatenate(leaf.parts, axis=0)
+        # Re-pin the step's expected batch sharding: the concat output's
+        # layout is XLA's choice, and jit(in_shardings=...) rejects
+        # mismatched committed arrays rather than resharding them.
+        return jax.device_put(out, sharding) if sharding is not None else out
+
+    return jax.tree.map(join, tree)
+
+
+def chunked_device_put(x, sharding=None, chunks: int = 1):
+    """Single-thread convenience: chunked transfer + immediate reassembly
+    (tools/exp_transfer.py and tests) — STRICT: chunks exactly as asked or
+    raises, so a benchmark never silently measures the unchunked path. The
+    staging ring itself degrades gracefully instead and keeps the two
+    phases on their proper threads — see _put_chunks/_assemble."""
+    return _assemble(_put_chunks(x, sharding, chunks, strict=True), sharding)
+
+
+def transfer_mb_per_s(stats: dict) -> float | None:
+    """Effective host->device transfer rate from the producer thread's own
+    put timers (wire bytes / seconds actually spent in device_put)."""
+    s = stats.get("transfer_s", 0.0)
+    b = stats.get("bytes_staged", 0)
+    if s <= 0 or b <= 0:
+        return None
+    return b / 1e6 / s
+
+
+def input_overlap_fraction(stats: dict) -> float | None:
+    """Share of the steady-state input path (host production + wire cast +
+    transfer of the consumed batches past pipeline fill) that hid under
+    compute. Same estimator as prefetch.overlap_efficiency — the staging
+    ring populates the identical keys, so the two pipelines' numbers are
+    directly comparable."""
+    return overlap_efficiency(stats)
+
+
+def stage_to_device(
+    it: Iterator[Any],
+    depth: int = 2,
+    sharding=None,
+    chunks: int = 1,
+    wire_dtype: str = "auto",
+    stats: dict | None = None,
+) -> Iterator[Any]:
+    """Wrap a host-batch iterator; yields batches staged on device through
+    a ring of `depth` slots fed by a background transfer thread.
+
+    depth      — ring size K: how many batches may be device-resident ahead
+                 of the consumer (2 = classic double buffering). In-flight
+                 device memory is bounded by K staged (+1 being consumed).
+    sharding   — optional jax.sharding.Sharding for the put (multi-process
+                 jobs assemble the global batch from local slices, like
+                 prefetch_to_device).
+    chunks     — concurrent device_put transfers per array, degraded
+                 per-array to the largest feasible count (effective_chunks:
+                 size threshold, leading-dim and shard divisibility) and
+                 NOT applied on the multi-process global-assembly path
+                 (sharding given AND process_count > 1 — that path owns
+                 its transfers); stats records the applied value as
+                 chunks_effective so reported numbers never claim chunking
+                 that didn't happen.
+    wire_dtype — host-side wire conversion (see to_wire). On-device
+                 normalization of the uint8 wire is the train step's
+                 preprocess hook, not the stager's job.
+    stats      — optional dict updated IN PLACE while the iterator is live:
+        batches_staged   — batches the producer finished transferring
+        bytes_staged     — wire bytes moved host->device
+        host_s           — producer seconds in next(it) + to_wire
+        transfer_s       — producer seconds in device_put (transfer
+                           complete: the producer blocks on readiness so
+                           a slot is always fully resident when yielded —
+                           and so this timer measures the wire, not the
+                           dispatch)
+        input_s          — host_s + transfer_s, per-batch total (raw)
+        steady_input_s   — input seconds of just the CONSUMED steady-state
+                           batches (input_overlap_fraction's denominator)
+        batches_consumed — batches the consumer took
+        consumer_wait_s  — consumer seconds blocked past the fill batch
+        consumer_busy_s  — consumer seconds NOT blocked (its compute)
+        wall_s           — consumer wall-clock from first to last take;
+                           equals consumer_wait_s + consumer_busy_s
+                           exactly (the stamps telescope)
+    """
+    import jax
+
+    if depth < 1:
+        raise ValueError("depth must be >= 1")
+    if chunks < 1:
+        raise ValueError("chunks must be >= 1")
+    if stats is not None:
+        for k in ("batches_staged", "batches_consumed"):
+            stats.setdefault(k, 0)
+        stats.setdefault("bytes_staged", 0)
+        for k in ("host_s", "transfer_s", "input_s", "steady_input_s",
+                  "consumer_wait_s", "consumer_busy_s", "wall_s"):
+            stats.setdefault(k, 0.0)
+
+    multiproc = jax.process_count() > 1
+    pending_times: collections.deque = collections.deque()
+    free = threading.Semaphore(depth)
+    q: queue.Queue = queue.Queue()
+    err: list[BaseException] = []
+    stop = threading.Event()
+
+    def put_tree(batch):
+        if sharding is not None and multiproc:
+            return jax.tree.map(
+                lambda x: jax.make_array_from_process_local_data(sharding, x),
+                batch,
+            )
+        return jax.tree.map(
+            lambda x: _put_chunks(x, sharding, chunks), batch
+        )
+
+    def worker():
+        try:
+            while True:
+                # A free ring slot gates the NEXT transfer — this is what
+                # bounds read-ahead to `depth` device batches.
+                while not free.acquire(timeout=0.1):
+                    if stop.is_set():
+                        return
+                t0 = time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                if stop.is_set():
+                    return
+                batch = to_wire(batch, wire_dtype)
+                if stats is not None and "chunks_effective" not in stats:
+                    # What the knob actually did for THIS job (leaf max):
+                    # 1 on the global-assembly path (the same condition
+                    # put_tree branches on) and whenever every leaf is
+                    # too small / indivisible — so a tuner reading
+                    # transfer_mb_per_s knows whether chunking was live.
+                    assembly = sharding is not None and multiproc
+                    stats["chunks_effective"] = 1 if assembly else max(
+                        (effective_chunks(leaf, sharding, chunks)
+                         for leaf in jax.tree.leaves(batch)), default=1)
+                t1 = time.perf_counter()
+                dev = put_tree(batch)
+                # Block on transfer completion: the slot must be resident
+                # before the consumer can see it, and transfer_s must time
+                # the wire rather than the async dispatch. (_Chunks is an
+                # opaque leaf — unwrap to its arrays for the wait.)
+                jax.block_until_ready([
+                    leaf.parts if isinstance(leaf, _Chunks) else leaf
+                    for leaf in jax.tree.leaves(dev)
+                ])
+                t2 = time.perf_counter()
+                if stats is not None:
+                    # One producer thread: plain += is safe. Per-batch time
+                    # queues BEFORE the batch so the consumer's popleft
+                    # pairs with the batch it just took.
+                    stats["batches_staged"] += 1
+                    stats["bytes_staged"] += sum(
+                        x.nbytes for x in jax.tree.leaves(batch)
+                    )
+                    stats["host_s"] += t1 - t0
+                    stats["transfer_s"] += t2 - t1
+                    stats["input_s"] += t2 - t0
+                    pending_times.append(t2 - t0)
+                q.put(dev)
+        except BaseException as e:  # noqa: BLE001 — surfaced to the consumer
+            err.append(e)
+        finally:
+            q.put(_Stop)  # unbounded queue: delivery never blocks
+
+    t = threading.Thread(target=worker, daemon=True, name="staging")
+    t.start()
+    # Consumer stamps telescope: busy_i = t_get_i - t_take_{i-1} (caller
+    # compute between takes), wait_i = t_item_i - t_get_i (blocked on the
+    # ring), so wall_s = t_item_last - t_item_first == sum(busy) + sum(wait).
+    t_prev_take = None
+    try:
+        while True:
+            t_get = time.perf_counter()
+            item = q.get()
+            t_item = time.perf_counter()
+            if item is _Stop:
+                if err:
+                    raise err[0]
+                return
+            if stats is not None:
+                produced_s = pending_times.popleft() if pending_times else 0.0
+                if t_prev_take is not None:
+                    stats["consumer_busy_s"] += t_get - t_prev_take
+                    stats["consumer_wait_s"] += t_item - t_get
+                    stats["wall_s"] += t_item - t_prev_take
+                    stats["steady_input_s"] += produced_s
+                stats["batches_consumed"] += 1
+            t_prev_take = t_item
+            # Taking batch i frees a slot: batch i's arrays now belong to
+            # the consumer/step, and the producer may overwrite the slot by
+            # staging batch i+depth.
+            free.release()
+            # Chunk reassembly dispatches a PROGRAM, so it must happen here
+            # on the consumer thread (see _assemble), async alongside the
+            # step the caller dispatches next.
+            yield _assemble(item, sharding)
+    finally:
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass

@@ -132,8 +132,18 @@ def make_train_step(
     rules: sharding_rules.Rules | None = None,
     remat: bool = False,
     seq_sharded_batch: bool = False,
+    preprocess_fn: Callable[[Any], Any] | None = None,
 ):
     """Build the jitted SPMD train step.
+
+    preprocess_fn: optional traceable batch hook applied INSIDE the jitted
+    step before the loss (e.g. data.staging.make_preprocess_fn's uint8->f32
+    normalize, which then fuses into the batch's first consumer). It runs on
+    the NON-donated batch argument — only the state is donated — so it is
+    safe against buffer aliasing even when the batch's host arrays were
+    zero-copied on the CPU backend (the restored-checkpoint copy rules in
+    shard_state cover the donated state; batches need no copy because
+    nothing overwrites them).
 
     Returns step(state, batch, rng) -> (state, metrics) with donated state.
     """
@@ -141,6 +151,8 @@ def make_train_step(
         loss_fn = jax.checkpoint(loss_fn)
 
     def _step(state: TrainState, batch, rng):
+        if preprocess_fn is not None:
+            batch = preprocess_fn(batch)
         (loss, new_model_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, state.model_state, batch, rng
         )
@@ -194,6 +206,7 @@ def make_scanned_train_step(
     seed: int = 0,
     compiler_options: dict[str, str] | None = None,
     scan_unroll: int = 1,
+    preprocess_fn: Callable[[Any], Any] | None = None,
 ):
     """On-device training loop: one jit call runs `unroll` optimizer steps.
 
@@ -215,7 +228,8 @@ def make_scanned_train_step(
     mosaic kernel, whose default tiling at MoE bench shapes needs >16M
     scoped VMEM.
     """
-    _step, _ = make_train_step(loss_fn, tx, mesh, rules=rules, remat=remat)
+    _step, _ = make_train_step(loss_fn, tx, mesh, rules=rules, remat=remat,
+                               preprocess_fn=preprocess_fn)
     batch_sh = mesh_lib.batch_sharding(mesh, extra_seq_axis=seq_sharded_batch)
     repl = mesh_lib.replicated(mesh)
 
