@@ -517,6 +517,12 @@ def _main() -> int:
     ev = {e["event"]: e for e in mnist["events"]}
     startup = _corrected_startup(mnist["events"])
     mnist_sps = ev.get("done", {}).get("steady_steps_per_sec")
+    # Round 8: bench points carry the per-step DISTRIBUTION + phase
+    # breakdown from the trainer's telemetry layer, not just the mean —
+    # a p99 stall (checkpoint save, transfer hiccup) is invisible in
+    # steady_steps_per_sec.
+    mnist_step_time = ev.get("done", {}).get("step_time_s")
+    mnist_phases = ev.get("done", {}).get("phase_breakdown")
     backend = ev.get("first_step", {}).get("backend", "?")
     device_kind = ev.get("first_step", {}).get("device_kind")
     peak = device_peak_tflops(device_kind)
@@ -925,6 +931,9 @@ def _main() -> int:
                 and startup is not None else None),
         },
         "mnist_steps_per_sec": mnist_sps,
+        # per-step wall-clock percentiles (p50/p95/p99/max/mean) from the
+        # headline mnist run's phase-accounting layer
+        "mnist_step_time_s": mnist_step_time,
         "resnet50_ok": resnet["ok"],
         "resnet50_images_per_sec": rn_ips,
         "resnet50_batch": rn_batch,
@@ -1032,6 +1041,20 @@ def _main() -> int:
         "longctx_flops_per_token": lm_ftok,
         "moe_flops_per_token": moe_ftok,
         "mnist_segments": mnist.get("segments"),
+        # telescoping phase breakdowns (data_wait/dispatch/device_blocked/
+        # checkpoint/other summing to the steady window's wall-clock) and
+        # per-step distributions for every workload's done event
+        "mnist_phase_breakdown": mnist_phases,
+        "resnet50_step_time_s": rev.get("done", {}).get("step_time_s"),
+        "resnet50_phase_breakdown": rev.get("done", {}).get("phase_breakdown"),
+        "resnet50_data_pipeline_step_time_s": rdev.get("done", {}).get("step_time_s"),
+        "resnet50_data_pipeline_phase_breakdown": rdev.get("done", {}).get("phase_breakdown"),
+        "resnet50_data_pipeline_staged_step_time_s": rsev.get("done", {}).get("step_time_s"),
+        "resnet50_data_pipeline_staged_phase_breakdown": rsev.get("done", {}).get("phase_breakdown"),
+        "longctx_step_time_s": lev.get("done", {}).get("step_time_s"),
+        "longctx_phase_breakdown": lev.get("done", {}).get("phase_breakdown"),
+        "moe_step_time_s": mev.get("done", {}).get("step_time_s"),
+        "moe_phase_breakdown": mev.get("done", {}).get("phase_breakdown"),
         "resnet50_segments": resnet.get("segments"),
         "longctx_segments": lm.get("segments"),
         "longctx16k_segments": lm16_seg,

@@ -375,3 +375,44 @@ class TestLint:
         import lint
 
         assert lint.main([]) == 0
+
+
+class TestMetricsDocGuard:
+    """tools/check_metrics_doc.py: every exposed metric family must appear
+    in docs/monitoring.md (the round-8 satellite — the doc once documented
+    tpujob_operator_sync_seconds while the code exposed
+    tpujob_operator_reconcile_duration_seconds, and nothing noticed)."""
+
+    def test_pipeline_runs_the_guard(self):
+        stages = ci.load_pipeline(str(REPO / "ci" / "pipeline.yaml"))
+        assert "check_metrics_doc.py" in stages["py-lint"]["cmd"]
+
+    def test_repo_doc_is_complete(self):
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "check_metrics_doc.py")],
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_missing_metric_fails(self, tmp_path):
+        doc = (REPO / "docs" / "monitoring.md").read_text()
+        stripped = doc.replace("tpujob_trainer_steps_per_sec", "REDACTED")
+        bad = tmp_path / "monitoring.md"
+        bad.write_text(stripped)
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "check_metrics_doc.py"),
+             "--doc", str(bad)],
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 1
+        assert "tpujob_trainer_steps_per_sec" in r.stdout
+
+    def test_operator_and_trainer_families_enumerated(self):
+        sys.path.insert(0, str(REPO / "tools"))
+        import check_metrics_doc
+
+        names = check_metrics_doc.exposed_metric_names()
+        assert "tpujob_operator_reconcile_duration_seconds" in names
+        assert "tpujob_trainer_steps_per_sec" in names
+        # the drifted name this satellite fixed must NOT be exposed
+        assert "tpujob_operator_sync_seconds" not in names

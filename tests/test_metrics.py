@@ -1,0 +1,459 @@
+"""Prometheus metrics layer (round 8): labels, normalized exposition,
+histogram semantics, and trainer telemetry surfaced through the operator.
+
+Satellite pins:
+  * exposition normalization — HELP always present (even empty help),
+    escaping per the text-format rules, verified with a parser roundtrip;
+  * Histogram — boundary values land in the correct `le` bucket,
+    cumulative monotonicity, `_sum`/`_count` consistency under concurrent
+    observe() from multiple threads;
+  * labels() child series on Counter/Gauge/Histogram;
+  * GET /metrics exposes labeled tpujob_trainer_* series in valid
+    Prometheus text format (the acceptance criterion), and the per-job
+    API payload carries the telemetry block.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+
+import pytest
+
+from tf_operator_tpu.status.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+
+# --------------------------------------------------------------- a parser
+# Minimal Prometheus text-format parser: enough grammar to prove the
+# exposition is well-formed (HELP/TYPE per family, one block per family,
+# parseable samples) and to round-trip values.
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>[^ ]+)$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    return (v.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def parse_exposition(text: str) -> dict:
+    """text -> {family: {"type", "help", "samples": {(name, labels): value}}}
+    Raises AssertionError on any grammar violation."""
+    families: dict[str, dict] = {}
+    cur = None
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            name = parts[2]
+            assert name not in families, f"family {name} re-opened"
+            cur = families[name] = {
+                "help": _unescape(parts[3]) if len(parts) > 3 else "",
+                "type": None,
+                "samples": {},
+            }
+        elif line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert cur is not None and name in families, \
+                f"TYPE before HELP for {name}"
+            assert families[name]["type"] is None, f"duplicate TYPE {name}"
+            assert kind in ("counter", "gauge", "histogram"), kind
+            families[name]["type"] = kind
+        elif line.startswith("#"):
+            raise AssertionError(f"unknown comment line: {line}")
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"unparseable sample: {line!r}"
+            sname = m.group("name")
+            family = next(
+                (f for f in families
+                 if sname == f or (families[f]["type"] == "histogram"
+                                   and sname in (f + "_bucket", f + "_sum",
+                                                 f + "_count"))),
+                None,
+            )
+            assert family is not None, f"sample {sname} outside any family"
+            assert families[family]["type"] is not None
+            labels = {}
+            raw = m.group("labels")
+            if raw:
+                labels = {k: _unescape(v)
+                          for k, v in _LABEL_RE.findall(raw)}
+            key = (sname, tuple(sorted(labels.items())))
+            samples = families[family]["samples"]
+            assert key not in samples, f"duplicate sample {key}"
+            samples[key] = float(m.group("value"))
+    for name, fam in families.items():
+        assert fam["type"] is not None, f"family {name} missing TYPE"
+    return families
+
+
+def _sample(fams: dict, family: str, name: str, **labels) -> float:
+    return fams[family]["samples"][(name, tuple(sorted(
+        (k, str(v)) for k, v in labels.items())))]
+
+
+class TestExposition:
+    def test_help_always_present_even_when_empty(self):
+        reg = Registry()
+        reg.counter("no_help_total")
+        reg.gauge("g_no_help")
+        text = reg.expose()
+        assert "# HELP no_help_total" in text
+        assert "# HELP g_no_help" in text
+        fams = parse_exposition(text)
+        assert fams["no_help_total"]["help"] == ""
+
+    def test_help_and_label_escaping_roundtrip(self):
+        reg = Registry()
+        c = reg.counter("esc_total", 'backslash \\ and\nnewline')
+        c.labels(path='a"b\\c\nd').inc(2)
+        fams = parse_exposition(reg.expose())
+        assert fams["esc_total"]["help"] == 'backslash \\ and\nnewline'
+        assert _sample(fams, "esc_total", "esc_total",
+                       path='a"b\\c\nd') == 2.0
+
+    def test_default_registry_exposition_parses(self):
+        from tf_operator_tpu.status import metrics as m
+
+        fams = parse_exposition(m.DEFAULT.expose())
+        assert fams["tpujob_operator_jobs_created_total"]["type"] == "counter"
+        assert fams["tpujob_operator_is_leader"]["type"] == "gauge"
+        assert fams["tpujob_operator_reconcile_duration_seconds"]["type"] \
+            == "histogram"
+
+    def test_parser_rejects_malformed(self):
+        with pytest.raises(AssertionError):
+            parse_exposition("orphan_sample 1\n")
+        with pytest.raises(AssertionError):
+            parse_exposition("# TYPE x counter\nx 1\n")  # TYPE before HELP
+
+
+class TestLabels:
+    def test_counter_label_children_accumulate(self):
+        c = Counter("jobs_total", "h")
+        c.labels(ns="a").inc()
+        c.labels(ns="a").inc()
+        c.labels(ns="b").inc(3)
+        assert c.labels(ns="a") is c.labels(ns="a")
+        lines = c.expose_lines()
+        assert 'jobs_total{ns="a"} 2.0' in lines
+        assert 'jobs_total{ns="b"} 3.0' in lines
+
+    def test_untouched_parent_with_children_emits_no_bare_sample(self):
+        c = Counter("only_labeled_total", "h")
+        c.labels(ns="a").inc()
+        assert "only_labeled_total 0.0" not in c.expose_lines()
+
+    def test_bare_and_labeled_coexist_when_parent_used(self):
+        g = Gauge("mixed", "h")
+        g.set(1)
+        g.labels(job="j").set(2)
+        lines = g.expose_lines()
+        assert "mixed 1" in lines
+        assert 'mixed{job="j"} 2' in lines
+
+    def test_multi_label_sorted_deterministic(self):
+        g = Gauge("m", "h")
+        g.labels(b="2", a="1").set(5)
+        g.labels(a="1", b="2").set(7)  # same set, either order
+        lines = g.expose_lines()
+        assert 'm{a="1",b="2"} 7' in lines
+        assert sum(1 for ln in lines if not ln.startswith("#")) == 1
+
+    def test_empty_labels_rejected(self):
+        with pytest.raises(ValueError):
+            Counter("c", "h").labels()
+
+    def test_job_counters_labeled_by_namespace(self):
+        """The relabeled control-plane path: the controller's created-hook
+        lands in a per-namespace child series."""
+        from tf_operator_tpu.core.trainjob_controller import TrainJobController
+        from tf_operator_tpu.status import metrics as m
+
+        class _J:
+            namespace = "telemetry-test-ns"
+
+        before = m.jobs_created.labels(namespace="telemetry-test-ns").value()
+        TrainJobController._count_created(_J())
+        fams = parse_exposition(m.DEFAULT.expose())
+        assert _sample(
+            fams, "tpujob_operator_jobs_created_total",
+            "tpujob_operator_jobs_created_total",
+            namespace="telemetry-test-ns",
+        ) == before + 1
+
+
+class TestHistogram:
+    def test_boundary_value_lands_in_its_le_bucket(self):
+        # Prometheus `le` is <=: an observation exactly AT a bound counts
+        # in that bound's bucket, not the next one up.
+        h = Histogram("h", "", buckets=(0.1, 1.0, 10.0))
+        h.observe(0.1)
+        h.observe(1.0)
+        h.observe(10.0)
+        lines = h.expose_lines()
+        assert 'h_bucket{le="0.1"} 1' in lines
+        assert 'h_bucket{le="1.0"} 2' in lines
+        assert 'h_bucket{le="10.0"} 3' in lines
+        assert 'h_bucket{le="+Inf"} 3' in lines
+
+    def test_cumulative_monotonic_and_inf_equals_count(self):
+        import random
+
+        h = Histogram("h", "", buckets=(0.01, 0.1, 1.0, 5.0))
+        rng = random.Random(0)
+        for _ in range(500):
+            h.observe(rng.random() * 8)
+        fams = parse_exposition("\n".join(h.expose_lines()) + "\n")
+        buckets = [(float("inf") if k[1][0][1] == "+Inf" else float(k[1][0][1]), v)
+                   for k, v in fams["h"]["samples"].items()
+                   if k[0] == "h_bucket"]
+        buckets.sort()
+        counts = [v for _, v in buckets]
+        assert counts == sorted(counts), "cumulative buckets must be monotone"
+        assert counts[-1] == _sample(fams, "h", "h_count") == 500
+
+    def test_sum_count_consistent_under_concurrent_observe(self):
+        h = Histogram("h", "", buckets=(0.5, 1.5, 2.5))
+        values = (0.25, 1.0, 2.0, 3.0)
+
+        def worker():
+            for _ in range(2000):
+                for v in values:
+                    h.observe(v)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        n = 8 * 2000 * len(values)
+        fams = parse_exposition("\n".join(h.expose_lines()) + "\n")
+        assert _sample(fams, "h", "h_count") == n
+        assert _sample(fams, "h", "h_sum") == pytest.approx(
+            8 * 2000 * sum(values), rel=1e-9)
+        # per-bucket exactness: each value's bucket saw exactly its share
+        assert _sample(fams, "h", "h_bucket", le="0.5") == n / 4
+        assert _sample(fams, "h", "h_bucket", le="1.5") == n / 2
+        assert _sample(fams, "h", "h_bucket", le="2.5") == 3 * n / 4
+
+    def test_labeled_histogram_children(self):
+        h = Histogram("lat", "help", buckets=(1.0,))
+        h.labels(job="a").observe(0.5)
+        h.labels(job="a").observe(2.0)
+        fams = parse_exposition("\n".join(h.expose_lines()) + "\n")
+        assert _sample(fams, "lat", "lat_bucket", job="a", le="1.0") == 1
+        assert _sample(fams, "lat", "lat_bucket", job="a", le="+Inf") == 2
+        assert _sample(fams, "lat", "lat_count", job="a") == 2
+
+
+class TestTrainerTelemetrySurfacing:
+    """The operator side of the tentpole: metrics files -> per-job API
+    telemetry block + labeled tpujob_trainer_* gauges on /metrics."""
+
+    @staticmethod
+    def _mk_job(cluster, name="tj", ns="default"):
+        from tf_operator_tpu.api import defaults
+        from tf_operator_tpu.api.types import (
+            ContainerSpec,
+            ObjectMeta,
+            PodTemplateSpec,
+            ReplicaSpec,
+            ReplicaType,
+            TrainJob,
+            TrainJobSpec,
+        )
+
+        job = TrainJob(
+            metadata=ObjectMeta(name=name, namespace=ns, uid=f"uid-{name}"),
+            spec=TrainJobSpec(replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1,
+                    template=PodTemplateSpec(containers=[
+                        ContainerSpec(name="tensorflow", image="img")
+                    ]),
+                )
+            }),
+        )
+        defaults.set_defaults(job)
+        return cluster.create_job(job)
+
+    @staticmethod
+    def _write_events(log_dir, ns, pod, events):
+        import os
+
+        with open(os.path.join(log_dir, f"{ns}_{pod}.metrics.jsonl"),
+                  "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+
+    DONE = {
+        "event": "done", "t": 9.0, "steps": 60,
+        "steady_steps_per_sec": 12.5, "examples_per_sec": 200.0,
+        "final_loss": 1.5, "total_s": 8.0,
+        "step_time_s": {"p50": 0.08, "p95": 0.1, "p99": 0.14,
+                        "max": 0.2, "mean": 0.09},
+        "phase_breakdown": {"wall_s": 4.5, "steps": 50,
+                            "dispatch": 4.4, "other": 0.1},
+    }
+
+    @pytest.fixture
+    def served(self, tmp_path):
+        from tf_operator_tpu.cli.server import ApiServer
+        from tf_operator_tpu.core.cluster import InMemoryCluster
+
+        cluster = InMemoryCluster()
+        srv = ApiServer(cluster, port=0, log_dir=str(tmp_path))
+        srv.start()
+        try:
+            yield cluster, srv, str(tmp_path)
+        finally:
+            srv.stop()
+
+    def test_api_job_payload_carries_telemetry_block(self, served):
+        import urllib.request
+
+        cluster, srv, log_dir = served
+        self._mk_job(cluster)
+        self._write_events(log_dir, "default", "tj-worker-0", [
+            {"event": "start", "t": 1.0, "model": "mnist-mlp"},
+            {"event": "first_step", "t": 2.0, "startup_s": 1.1, "loss": 2.5},
+            {"event": "progress", "step": 40, "loss": 2.0},
+            self.DONE,
+        ])
+        url = f"http://127.0.0.1:{srv.port}/api/trainjobs/default/tj"
+        payload = json.load(urllib.request.urlopen(url, timeout=5))
+        tel = payload["telemetry"]["replicas"]["tj-worker-0"]
+        assert tel["phase"] == "done"
+        assert tel["steady_steps_per_sec"] == 12.5
+        assert tel["startup_s"] == 1.1
+        assert tel["step_time_s"]["p99"] == 0.14
+        assert tel["phase_breakdown"]["dispatch"] == 4.4
+
+    def test_metrics_exposes_labeled_trainer_series(self, served):
+        """Acceptance: GET /metrics exposes at least one labeled series in
+        valid Prometheus text format, verified by parsing the exposition."""
+        import urllib.request
+
+        cluster, srv, log_dir = served
+        self._mk_job(cluster, name="labeled")
+        self._write_events(log_dir, "default", "labeled-worker-0", [
+            {"event": "start", "t": 1.0},
+            self.DONE,
+        ])
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        text = urllib.request.urlopen(url, timeout=5).read().decode()
+        fams = parse_exposition(text)
+        assert _sample(
+            fams, "tpujob_trainer_steps_per_sec",
+            "tpujob_trainer_steps_per_sec",
+            job="labeled", namespace="default",
+        ) == 12.5
+        assert _sample(
+            fams, "tpujob_trainer_step_time_p99_s",
+            "tpujob_trainer_step_time_p99_s",
+            job="labeled", namespace="default",
+        ) == 0.14
+
+    def test_telemetry_absent_without_files(self, served):
+        import urllib.request
+
+        cluster, srv, _ = served
+        self._mk_job(cluster, name="silent")
+        url = f"http://127.0.0.1:{srv.port}/api/trainjobs/default/silent"
+        payload = json.load(urllib.request.urlopen(url, timeout=5))
+        assert payload["telemetry"] is None
+
+    def test_restarted_pod_counts_attempts_and_uses_latest(self, tmp_path):
+        from tf_operator_tpu.telemetry.collector import summarize_events
+
+        s = summarize_events([
+            {"event": "start", "t": 1.0},
+            {"event": "progress", "step": 30, "loss": 3.0},
+            {"event": "start", "t": 5.0},  # pod restarted
+            {"event": "progress", "step": 10, "loss": 2.8},
+        ])
+        assert s["attempts"] == 2
+        assert s["step"] == 10 and s["loss"] == 2.8
+        assert s["phase"] == "starting"  # latest attempt has no first_step
+
+    def test_deleted_job_series_pruned_on_scrape(self, served):
+        """Label cardinality is bounded by LIVE jobs: a deleted job's
+        trainer gauges must disappear from the next scrape, not freeze at
+        their last value forever (weeks of job churn would otherwise grow
+        the exposition without bound)."""
+        import urllib.request
+
+        cluster, srv, log_dir = served
+        self._mk_job(cluster, name="ephemeral")
+        self._write_events(log_dir, "default", "ephemeral-worker-0", [
+            {"event": "start", "t": 1.0},
+            self.DONE,
+        ])
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        text = urllib.request.urlopen(url, timeout=5).read().decode()
+        assert 'tpujob_trainer_steps_per_sec{job="ephemeral"' in text
+        cluster.delete_job("default", "ephemeral")
+        text = urllib.request.urlopen(url, timeout=5).read().decode()
+        assert 'job="ephemeral"' not in text
+
+    def test_labels_only_family_never_exposes_bare_sample(self):
+        """A labels-only family (trainer gauges, per-namespace jobs_*)
+        must not expose a phantom unlabeled 0 before its first child —
+        that series would plot as a real job at value 0 and then vanish
+        (go stale) the moment a real child appears."""
+        from tf_operator_tpu.status.metrics import Registry
+
+        reg = Registry()
+        g = reg.gauge("only_labels", "h", labels_only=True)
+        lines = g.expose_lines()
+        assert lines == ["# HELP only_labels h", "# TYPE only_labels gauge"]
+        g.labels(job="j").set(1)
+        assert "only_labels 0.0" not in g.expose_lines()
+        assert 'only_labels{job="j"} 1' in g.expose_lines()
+
+    def test_fresh_default_registry_has_no_bare_jobs_samples(self):
+        from tf_operator_tpu.status.metrics import Registry
+
+        # Mirror of the module-level declarations: labels-only counters
+        # stay sample-free until the first namespace reports.
+        reg = Registry()
+        c = reg.counter("tpujob_x_jobs_created_total", "h", labels_only=True)
+        fams = parse_exposition(reg.expose())
+        assert fams["tpujob_x_jobs_created_total"]["samples"] == {}
+        c.labels(namespace="n").inc()
+        fams = parse_exposition(reg.expose())
+        assert len(fams["tpujob_x_jobs_created_total"]["samples"]) == 1
+
+    def test_counter_child_remove(self):
+        c = Counter("rm_total", "h")
+        c.labels(ns="a").inc()
+        c.labels(ns="b").inc()
+        c.remove(ns="a")
+        c.remove(ns="never-existed")  # no-op
+        lines = c.expose_lines()
+        assert not any('ns="a"' in ln for ln in lines)
+        assert any('ns="b"' in ln for ln in lines)
+        assert c.labelsets() == [{"ns": "b"}]
+
+    def test_job_name_prefix_cannot_claim_other_jobs_files(self, tmp_path):
+        from tf_operator_tpu.telemetry.collector import TelemetryCollector
+
+        self._write_events(str(tmp_path), "default", "a-worker-worker-0", [
+            {"event": "start", "t": 1.0},
+        ])
+        col = TelemetryCollector(str(tmp_path))
+        # job "a-worker" owns the file; job "a" must not see it
+        assert col.job_telemetry("default", "a-worker") is not None
+        assert col.job_telemetry("default", "a") is None

@@ -35,8 +35,9 @@ from tf_operator_tpu.core.cluster import InMemoryCluster
 from tf_operator_tpu.status import metrics
 
 
-def _job_payload(cluster: InMemoryCluster, job: TrainJob) -> dict:
-    return {
+def _job_payload(cluster: InMemoryCluster, job: TrainJob,
+                 telemetry=None) -> dict:
+    payload = {
         "manifest": compat.job_to_dict(job),
         "status": {
             "conditions": [
@@ -59,6 +60,14 @@ def _job_payload(cluster: InMemoryCluster, job: TrainJob) -> dict:
             for e in cluster.events_for(TrainJob.KIND, job.namespace, job.name)
         ],
     }
+    if telemetry is not None:
+        # Data-plane telemetry read back from the pods' trainer event
+        # files (telemetry/collector.py): per-replica step/loss/startup,
+        # steady rates, and the round-8 step_time_s percentiles +
+        # phase_breakdown. Single-job GETs only — list responses stay
+        # cheap (no file IO per job per list).
+        payload["telemetry"] = telemetry.job_telemetry(job.namespace, job.name)
+    return payload
 
 
 class ApiServer:
@@ -68,6 +77,13 @@ class ApiServer:
         self.cluster = cluster
         self.log_dir = log_dir
         self.runtime = runtime  # LocalProcessRuntime, for the endpoints view
+        # Trainer telemetry rides the same log_dir the runtime writes pod
+        # metrics files into; without a log_dir there is nothing to read.
+        self.telemetry = None
+        if log_dir:
+            from tf_operator_tpu.telemetry.collector import TelemetryCollector
+
+            self.telemetry = TelemetryCollector(log_dir)
         # Long-poll support (event-driven waits, VERDICT r3 next #3): any
         # job/pod change bumps a generation under the condition; waiters
         # re-check their predicate per bump instead of sleep-polling over
@@ -140,12 +156,12 @@ class ApiServer:
                         c.status and str(c.type) in wanted
                         for c in job.status.conditions
                     ):
-                        return self._send(_job_payload(outer.cluster, job))
+                        return self._send(_job_payload(outer.cluster, job, outer.telemetry))
                     remaining = deadline - _time.monotonic()
                     if remaining <= 0:
                         payload = {"timeout": True}
                         if job is not None:
-                            payload["job"] = _job_payload(outer.cluster, job)
+                            payload["job"] = _job_payload(outer.cluster, job, outer.telemetry)
                         return self._send(payload, 408)
                     with outer._events:
                         if outer._events_gen == gen:
@@ -167,6 +183,11 @@ class ApiServer:
                             self._send(f.read().decode(),
                                        content_type="text/html; charset=utf-8")
                     elif parts == ["metrics"]:
+                        if outer.telemetry is not None:
+                            # Pull-model: trainer gauges refresh from the
+                            # pods' metrics files on scrape, never on a
+                            # hot path (labels bounded by live jobs).
+                            outer.telemetry.refresh_gauges(outer.cluster)
                         self._send(metrics.DEFAULT.expose(), content_type="text/plain")
                     elif parts == ["healthz"]:
                         self._send({"ok": True})

@@ -87,11 +87,14 @@ class TrainJobController(ctrl.JobControllerBase):
 
     @staticmethod
     def _count_created(job: TrainJob) -> None:
-        metrics.jobs_created.inc()
+        # Labeled child series (round 8): per-namespace breakdowns are the
+        # difference between "a job failed somewhere" and "team X's
+        # namespace is failing" on one dashboard.
+        metrics.jobs_created.labels(namespace=job.namespace).inc()
 
     @staticmethod
     def _count_deleted(job: TrainJob) -> None:
-        metrics.jobs_deleted.inc()
+        metrics.jobs_deleted.labels(namespace=job.namespace).inc()
 
     # ------------------------------------------------------------------ sync
 
@@ -134,7 +137,7 @@ class TrainJobController(ctrl.JobControllerBase):
                 job.status.completion_time = self._now()
                 changed = True
             if changed:
-                metrics.jobs_failed.inc()
+                metrics.jobs_failed.labels(namespace=job.namespace).inc()
                 self.cluster.update_job_status(job)
             return
 
@@ -223,7 +226,7 @@ class TrainJobController(ctrl.JobControllerBase):
                 )
                 if job.status.completion_time is None:
                     job.status.completion_time = self._now()
-                metrics.jobs_failed.inc()
+                metrics.jobs_failed.labels(namespace=job.namespace).inc()
             self._delete_pods_and_services(job, pods, services)
             if self.enable_gang:
                 gang.delete_podgroup(self.cluster, job)

@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Any, Iterator
 
+from tf_operator_tpu import telemetry
+
 
 class _Stop:
     pass
@@ -111,13 +113,17 @@ def prefetch_to_device(
         try:
             while True:
                 t0 = time.perf_counter()
-                try:
-                    batch = next(it)
-                except StopIteration:
-                    return
-                if stop.is_set():
-                    return
-                batch = to_device(batch)
+                # One span per batch on the producer's own track (--trace):
+                # host production + device_put together — the leg the
+                # double-buffering exists to hide. No-op when tracing is off.
+                with telemetry.span("prefetch/input"):
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        return
+                    if stop.is_set():
+                        return
+                    batch = to_device(batch)
                 if stats is not None:
                     # One producer thread: plain += is safe. The per-batch
                     # time is queued BEFORE the batch itself, so the

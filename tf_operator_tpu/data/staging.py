@@ -49,6 +49,7 @@ from typing import Any, Callable, Iterator
 
 import numpy as np
 
+from tf_operator_tpu import telemetry
 from tf_operator_tpu.data.prefetch import overlap_efficiency
 
 # f32-rounded reciprocal, multiplied (not divided) on BOTH host and device:
@@ -371,13 +372,18 @@ def stage_to_device(
                     if stop.is_set():
                         return
                 t0 = time.perf_counter()
-                try:
-                    batch = next(it)
-                except StopIteration:
-                    return
-                if stop.is_set():
-                    return
-                batch = to_wire(batch, wire_dtype)
+                # Tracer spans (--trace): the transfer thread's host/wire
+                # and h2d legs land on their own track in the Chrome
+                # trace, so "did the transfer hide under compute" is
+                # visible, not inferred. No-ops when tracing is off.
+                with telemetry.span("staging/host_next"):
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        return
+                    if stop.is_set():
+                        return
+                    batch = to_wire(batch, wire_dtype)
                 if stats is not None and "chunks_effective" not in stats:
                     # What the knob actually did for THIS job (leaf max):
                     # 1 on the global-assembly path (the same condition
@@ -388,16 +394,27 @@ def stage_to_device(
                     stats["chunks_effective"] = 1 if assembly else max(
                         (effective_chunks(leaf, sharding, chunks)
                          for leaf in jax.tree.leaves(batch)), default=1)
+                # (attr computed only when tracing — span() evaluates its
+                # kwargs at the call site and a per-batch tree reduction
+                # is not "near-zero cost when disabled" — and BEFORE t1,
+                # so it charges to the host leg, never to transfer_s: the
+                # wire timer's accuracy is a pinned PR-2 contract)
+                _attrs = (
+                    {"bytes": sum(x.nbytes for x in jax.tree.leaves(batch))}
+                    if telemetry.get_tracer().enabled else {}
+                )
                 t1 = time.perf_counter()
-                dev = put_tree(batch)
-                # Block on transfer completion: the slot must be resident
-                # before the consumer can see it, and transfer_s must time
-                # the wire rather than the async dispatch. (_Chunks is an
-                # opaque leaf — unwrap to its arrays for the wait.)
-                jax.block_until_ready([
-                    leaf.parts if isinstance(leaf, _Chunks) else leaf
-                    for leaf in jax.tree.leaves(dev)
-                ])
+                with telemetry.span("staging/h2d_transfer", **_attrs):
+                    dev = put_tree(batch)
+                    # Block on transfer completion: the slot must be
+                    # resident before the consumer can see it, and
+                    # transfer_s must time the wire rather than the async
+                    # dispatch. (_Chunks is an opaque leaf — unwrap to its
+                    # arrays for the wait.)
+                    jax.block_until_ready([
+                        leaf.parts if isinstance(leaf, _Chunks) else leaf
+                        for leaf in jax.tree.leaves(dev)
+                    ])
                 t2 = time.perf_counter()
                 if stats is not None:
                     # One producer thread: plain += is safe. Per-batch time

@@ -31,6 +31,8 @@ import re
 import time
 from typing import Any
 
+from tf_operator_tpu import telemetry
+
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
 
@@ -43,7 +45,10 @@ def _checkpointer():
 def save_named(ckpt_dir: str, name: str, tree: Any) -> str:
     """Atomically persist `tree` under <dir>/<name>; returns the path."""
     path = os.path.join(os.path.abspath(ckpt_dir), name)
-    _checkpointer().save(path, tree, force=True)
+    # Checkpoint IO is the canonical p99 step stall; the span makes a save
+    # that blocked the step loop visible on the --trace timeline.
+    with telemetry.span("checkpoint/save", ckpt=name):
+        _checkpointer().save(path, tree, force=True)
     return path
 
 
@@ -68,7 +73,8 @@ def restore_named(ckpt_dir: str, name: str, template: Any | None = None) -> Any:
     path = os.path.join(os.path.abspath(ckpt_dir), name)
     if not os.path.isdir(path):
         raise FileNotFoundError(path)
-    restored = _checkpointer().restore(path)
+    with telemetry.span("checkpoint/restore", ckpt=name):
+        restored = _checkpointer().restore(path)
     if template is None:
         return restored
     import jax

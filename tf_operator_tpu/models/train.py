@@ -22,6 +22,10 @@ import os
 import sys
 import time
 
+# Stdlib-only (tracer + phase accounting): safe before the jax import and
+# cheap enough that the disabled path costs one attribute read per call.
+from tf_operator_tpu import telemetry
+
 
 def _emit(event: dict) -> None:
     line = json.dumps(event)
@@ -48,6 +52,35 @@ def _start_profile(profile_dir: str) -> None:
     trace_dir = os.path.join(profile_dir, rank)
     jax.profiler.start_trace(trace_dir)
     _emit({"event": "profile_start", "dir": trace_dir})
+
+
+def _trace_rank() -> str:
+    """Replica identity for per-pod trace files — same naming as the
+    jax.profiler dirs (_start_profile), so the two trace kinds pair up."""
+    return (f"{os.environ.get('TPUJOB_REPLICA_TYPE') or 'local'}-"
+            f"{os.environ.get('TPUJOB_REPLICA_INDEX', '0')}")
+
+
+def _trace_window_check(args, steps_done: int) -> None:
+    """Close the --trace-steps window: once N steps are recorded the
+    tracer disables, so the rest of a long run costs nothing and the ring
+    holds the WINDOW, not the last `capacity` events of the tail."""
+    if args.trace and args.trace_steps and steps_done >= args.trace_steps:
+        telemetry.get_tracer().enabled = False
+
+
+def _maybe_export_trace(args) -> None:
+    """Write the Chrome trace-event JSON (load it in Perfetto or
+    chrome://tracing) and emit trace_done with its path."""
+    if not getattr(args, "trace", False):
+        return
+    tracer = telemetry.get_tracer()
+    tracer.enabled = False  # export is not part of the trace
+    path = os.path.join(args.trace_dir or "traces",
+                        f"{_trace_rank()}.trace.json")
+    n = tracer.export(path)
+    _emit({"event": "trace_done", "path": path, "events": n,
+           "dropped_events": tracer.dropped_events})
 
 
 def _is_checkpoint_writer() -> bool:
@@ -226,10 +259,11 @@ def _run_evaluator(args, model, params_template, make_batch, loss_fn) -> int:
         params = ckpt.restore(args.checkpoint_dir, step, template=params_template)
         # Fixed keys -> the same eval batches every round, generated lazily
         # (materializing all of them up front would hold steps×batch arrays).
-        losses = [
-            float(eval_loss(params, make_batch(jax.random.key(10_000 + i))))
-            for i in range(args.steps)
-        ]
+        with telemetry.span("eval", checkpoint_step=step, n_batches=args.steps):
+            losses = [
+                float(eval_loss(params, make_batch(jax.random.key(10_000 + i))))
+                for i in range(args.steps)
+            ]
         evaluated += 1
         _emit({
             "event": "eval",
@@ -333,20 +367,33 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
     # dispatching step i+1 so the transfer rides under compute (the
     # immediate fetch otherwise idles the chip one full tunnel round trip
     # per emit). Only the window-closing fetch blocks.
+    # Phase accounting (telemetry/phases.py): every steady step decomposes
+    # into data_wait / dispatch / device_blocked / checkpoint (+ "other"
+    # residual) telescoping exactly to the step's wall-clock; the done
+    # event carries the per-step distribution, not just the mean.
     t0 = time.time()
     pending = None
+    acct = telemetry.make_step_accounting()
     while done < args.steps:
-        state, metrics = step(state, next(it), jax.random.key(done))
-        done += 1
-        if pending is not None:
-            pstep, pmetrics = pending
-            if pstep % args.log_every == 0:
-                _emit({"event": "progress", "step": pstep,
-                       "loss": float(pmetrics["loss"])})
-        pending = (done, metrics)
-        if (saver and args.checkpoint_every and done < args.steps
-                and done % args.checkpoint_every == 0):
-            _save_checkpoint(args.checkpoint_dir, done, state)
+        _trace_window_check(args, done - start_step - 1)
+        with acct.step(done + 1) as st:
+            with st.phase("data_wait"):
+                batch = next(it)
+            with st.phase("dispatch"):
+                state, metrics = step(state, batch, jax.random.key(done))
+            done += 1
+            if pending is not None:
+                pstep, pmetrics = pending
+                if pstep % args.log_every == 0:
+                    with st.phase("device_blocked"):
+                        ploss = float(pmetrics["loss"])
+                    _emit({"event": "progress", "step": pstep,
+                           "loss": ploss})
+            pending = (done, metrics)
+            if (saver and args.checkpoint_every and done < args.steps
+                    and done % args.checkpoint_every == 0):
+                with st.phase("checkpoint"):
+                    _save_checkpoint(args.checkpoint_dir, done, state)
     if pending is not None:
         # Real window closure: a host transfer (block_until_ready is a
         # no-op through the axon tunnel).
@@ -367,6 +414,7 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
     sps = round(steady / dt, 4) if steady > 0 else None
     from tf_operator_tpu.data.prefetch import overlap_efficiency
 
+    telem = acct.summary()
     done_event = {
         "event": "done",
         "t": time.time(),
@@ -375,6 +423,10 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
         "examples_per_sec": round(steady * args.batch / dt, 4) if steady > 0 else None,  # 4 dp: 2-dp quantized batch-1 long-context rows by +-2.6%
         "final_loss": float(metrics["loss"]),
         "total_s": round(time.time() - t_start, 3),
+        # Per-step wall-clock distribution + telescoping phase breakdown
+        # (telemetry/phases.py): p99 stalls are invisible in the mean.
+        "step_time_s": telem["step_time_s"] if telem else None,
+        "phase_breakdown": telem["phase_breakdown"] if telem else None,
     }
     if args.input_staging == "staged":
         # First-class transfer + overlap accounting from the staging ring's
@@ -423,6 +475,7 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
                 round(overlap, 4) if overlap is not None else None),
         }
     _emit(done_event)
+    _maybe_export_trace(args)
     # Synchronized multi-process exit (no-op single-process): see
     # parallel.distributed.distributed_goodbye.
     from tf_operator_tpu.parallel.distributed import distributed_goodbye
@@ -522,6 +575,20 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler (XProf/TensorBoard) trace of "
                          "the steady-state window to this directory")
+    ap.add_argument("--trace", action="store_true",
+                    help="record host-side spans (step phases, input "
+                         "staging, checkpoint IO) in the in-process tracer "
+                         "and export Chrome trace-event JSON at exit "
+                         "(Perfetto / chrome://tracing). Composes with "
+                         "--profile-dir: this is the host timeline, XProf "
+                         "is the device one")
+    ap.add_argument("--trace-dir", default=None,
+                    help="directory for the trace file "
+                         "(<replica rank>.trace.json; default ./traces)")
+    ap.add_argument("--trace-steps", type=int, default=0,
+                    help="stop recording after this many steady steps "
+                         "(0 = the whole run, bounded by the tracer's "
+                         "ring buffer)")
     ap.add_argument("--xla-option", action="append", default=[],
                     metavar="KEY=VALUE",
                     help="per-executable XLA compiler option (repeatable), "
@@ -601,6 +668,17 @@ def main(argv: list[str] | None = None) -> int:
         ap.error("--staging-depth/--staging-chunks configure the staging "
                  "RING; with --input-staging prefetch they would be "
                  "silently ignored — pass --input-staging staged")
+    if (args.trace_dir is not None or args.trace_steps) and not args.trace:
+        ap.error("--trace-dir/--trace-steps shape the span trace; pass "
+                 "--trace to enable it (they would otherwise be silently "
+                 "ignored)")
+    if args.trace_steps < 0:
+        ap.error("--trace-steps must be >= 0")
+    if args.trace:
+        # Fresh window: clear() also restarts the ts epoch, so in-process
+        # re-runs (tests, notebooks) don't leak a prior run's spans into
+        # this run's export.
+        telemetry.configure(enabled=True).clear()
 
     t_start = time.time()
     _emit({"event": "start", "t": t_start, "model": args.model})
@@ -848,7 +926,12 @@ def main(argv: list[str] | None = None) -> int:
         template = jax.tree.map(
             lambda s: np.zeros(s.shape, s.dtype), abstract_p
         )
-        return _run_evaluator(args, model, template, make_batch, loss_fn)
+        rc = _run_evaluator(args, model, template, make_batch, loss_fn)
+        # The evaluator records eval + checkpoint/restore spans; export
+        # them on every exit path (timeout included — rc != 0 traces are
+        # the interesting ones).
+        _maybe_export_trace(args)
+        return rc
 
     # Single-writer semantics differ by runtime shape. Independent
     # processes (PS-strategy: each worker is its own jax runtime): only the
@@ -940,14 +1023,21 @@ def main(argv: list[str] | None = None) -> int:
     step_chunk = compile_scanned(state, chunk)
     ckpt_marks = (start_step // args.checkpoint_every) if args.checkpoint_every else 0
 
-    def maybe_checkpoint(done: int) -> None:
+    def maybe_checkpoint(done: int, st=None) -> None:
         nonlocal ckpt_marks
         if not (saver and args.checkpoint_every) or done >= args.steps:
             return  # the final save (marked FINAL) happens after the loop
         marks = done // args.checkpoint_every
         if marks > ckpt_marks:
             ckpt_marks = marks
-            _save_checkpoint(args.checkpoint_dir, done, state)
+            if st is not None:
+                # The phase opens only around an ACTUAL save: timing the
+                # no-op calls too would report a nonzero checkpoint phase
+                # for runs that never saved in the window.
+                with st.phase("checkpoint"):
+                    _save_checkpoint(args.checkpoint_dir, done, state)
+            else:
+                _save_checkpoint(args.checkpoint_dir, done, state)
 
     state, metrics = step_chunk(state)
     # Host transfer, not block_until_ready (a no-op through the axon
@@ -991,20 +1081,29 @@ def main(argv: list[str] | None = None) -> int:
     # then fetch chunk i's loss while i+1 computes — the transfer rides
     # under compute and only the window-closing fetch blocks. Progress
     # events lag one chunk; each carries its own step number.
+    # Phase accounting at chunk granularity: one dispatch covers `chunk`
+    # steps, so each chunk records ONE sample weighted as `chunk` per-step
+    # samples (telemetry/phases.py) — the done event's step_time_s stays a
+    # per-STEP distribution whatever the dispatch granularity.
     t0 = time.time()
     pending = None  # (step count at fetch, metrics of that chunk)
+    acct = telemetry.make_step_accounting()
     for _ in range(timed_chunks):
-        state, metrics = step_chunk(state)
-        done += chunk
-        if pending is not None:
-            pstep, pmetrics = pending
-            # Throttle to the requested cadence: emitting every
-            # sub-log_every chunk would reintroduce per-step round-trips.
-            if pstep % args.log_every == 0:
-                _emit({"event": "progress", "step": pstep,
-                       "loss": float(pmetrics["loss"])})
-        pending = (done, metrics)
-        maybe_checkpoint(done)
+        _trace_window_check(args, done - start_step - chunk)
+        with acct.step(done + chunk, n_steps=chunk) as st:
+            with st.phase("dispatch"):
+                state, metrics = step_chunk(state)
+            done += chunk
+            if pending is not None:
+                pstep, pmetrics = pending
+                # Throttle to the requested cadence: emitting every
+                # sub-log_every chunk would reintroduce per-step round-trips.
+                if pstep % args.log_every == 0:
+                    with st.phase("device_blocked"):
+                        ploss = float(pmetrics["loss"])
+                    _emit({"event": "progress", "step": pstep, "loss": ploss})
+            pending = (done, metrics)
+            maybe_checkpoint(done, st)
     if pending is not None:
         # The last chunk's fetch is the REAL window closure —
         # block_until_ready is a no-op through the axon tunnel.
@@ -1046,6 +1145,7 @@ def main(argv: list[str] | None = None) -> int:
     # compile call ran); report null throughput rather than a
     # microseconds-denominator lie.
     sps = round(steady / dt, 4) if steady > 0 else None
+    telem = acct.summary()
     _emit(
         {
             "event": "done",
@@ -1055,8 +1155,14 @@ def main(argv: list[str] | None = None) -> int:
             "examples_per_sec": round(steady * args.batch / dt, 4) if steady > 0 else None,  # 4 dp: 2-dp quantized batch-1 long-context rows by +-2.6%
             "final_loss": float(metrics["loss"]),
             "total_s": round(time.time() - t_start, 3),
+            # Per-step distribution + telescoping phase breakdown over the
+            # steady window (telemetry/phases.py); None when the run had
+            # no steady chunks, same rule as steady_steps_per_sec.
+            "step_time_s": telem["step_time_s"] if telem else None,
+            "phase_breakdown": telem["phase_breakdown"] if telem else None,
         }
     )
+    _maybe_export_trace(args)
     # Synchronized multi-process exit (no-op single-process): see
     # parallel.distributed.distributed_goodbye.
     from tf_operator_tpu.parallel.distributed import distributed_goodbye

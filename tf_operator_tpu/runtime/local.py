@@ -353,6 +353,15 @@ class LocalProcessRuntime:
             if coord_local is not None:
                 env["TPUJOB_COORD_LISTEN_PORT"] = str(coord_local)
         env.update(self.env_overrides)
+        # Per-pod trainer event file beside the pod's log: the operator's
+        # telemetry collector reads it back into the job's API `telemetry`
+        # block and the labeled tpujob_trainer_* gauges. Anything already
+        # set (bench/tests via env_overrides, an inherited env) wins — the
+        # runtime only fills the gap.
+        if self.log_dir and not env.get("TPUJOB_METRICS_FILE"):
+            env["TPUJOB_METRICS_FILE"] = os.path.join(
+                self.log_dir, f"{pod.namespace}_{pod.name}.metrics.jsonl"
+            )
         return env
 
     def _own_host(self, pod: Pod, pm: PortMap) -> tuple[str | None, dict[str, int]]:

@@ -139,7 +139,7 @@ def update_status_single(
                     status, JobConditionType.SUCCEEDED, REASON_SUCCEEDED,
                     f"TrainJob {name} successfully completed.", now,
                 ):
-                    metrics.jobs_successful.inc()
+                    metrics.jobs_successful.labels(namespace=job.namespace).inc()
                 if status.completion_time is None:
                     status.completion_time = now
     else:
@@ -151,7 +151,7 @@ def update_status_single(
                     status, JobConditionType.SUCCEEDED, REASON_SUCCEEDED,
                     f"TrainJob {name} successfully completed.", now,
                 ):
-                    metrics.jobs_successful.inc()
+                    metrics.jobs_successful.labels(namespace=job.namespace).inc()
                 if status.completion_time is None:
                     status.completion_time = now
             elif running > 0:
@@ -167,13 +167,13 @@ def update_status_single(
                 f"TrainJob {name} is restarting because {failed} {rtype} "
                 "replica(s) failed.", now,
             ):
-                metrics.jobs_restarted.inc()
+                metrics.jobs_restarted.labels(namespace=job.namespace).inc()
         else:
             if set_condition(
                 status, JobConditionType.FAILED, REASON_FAILED,
                 f"TrainJob {name} has failed because {failed} {rtype} "
                 "replica(s) failed.", now,
             ):
-                metrics.jobs_failed.inc()
+                metrics.jobs_failed.labels(namespace=job.namespace).inc()
             if status.completion_time is None:
                 status.completion_time = now

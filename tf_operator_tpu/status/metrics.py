@@ -1,9 +1,25 @@
-"""Operator metrics: Prometheus-compatible counters/gauges.
+"""Operator metrics: Prometheus-compatible counters/gauges/histograms.
 
 Capability parity with the reference's prometheus client usage:
 tpujob_operator_jobs_{created,deleted,successful,failed,restarted}_total
 (ref job.go:30-34, controller.go:68-72, status.go:46-58) and the leader gauge
-(server.go:62-66). Exposed in Prometheus text format by cli.metrics_server.
+(server.go:62-66), exposed in Prometheus text format by cli/server.py.
+
+Round 8 adds the two pieces the reference's client had that the parity
+port lacked:
+
+  * **Labels**: `Counter/Gauge/Histogram.labels(**kv)` returns a child
+    series keyed by the label set (the prometheus_client `labels()`
+    contract), so per-namespace job counts and per-job trainer gauges
+    (telemetry/collector.py's tpujob_trainer_*) are possible at all.
+    A metric used both bare and labeled exposes both series under one
+    family; a metric that only ever handed out children exposes no bare
+    sample (a spurious 0-valued aggregate would double-count in sum()).
+  * **Normalized exposition**: every family emits `# HELP` (even when
+    the help text is empty) then `# TYPE` then its samples, with label
+    values and help text escaped per the Prometheus text-format rules
+    (backslash, double-quote, newline). tests/test_metrics.py pins the
+    format with a parser round-trip.
 """
 
 from __future__ import annotations
@@ -11,26 +27,111 @@ from __future__ import annotations
 import threading
 
 
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label_value(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    """{} -> "", else {a="x",b="y"} sorted by key (deterministic output;
+    Prometheus treats label order as insignificant)."""
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label_value(str(v))}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _labelset_key(kv: dict) -> tuple:
+    return tuple(sorted((k, str(v)) for k, v in kv.items()))
+
+
 class Counter:
-    def __init__(self, name: str, help_text: str):
+    _kind = "counter"
+
+    def __init__(self, name: str, help_text: str,
+                 label_values: dict[str, str] | None = None,
+                 labels_only: bool = False):
         self.name = name
         self.help = help_text
         self._v = 0.0
         self._lock = threading.Lock()
+        self._label_values = dict(label_values or {})
+        self._children: dict[tuple, Counter] = {}
+        # Whether the bare (parent) series was ever written. A family
+        # with labeled children exposes its bare sample only if someone
+        # actually inc()/set() it directly — never a phantom 0. A family
+        # declared labels_only never exposes a bare sample at all (it
+        # would otherwise show a meaningless 0 until the first child
+        # exists, then vanish mid-life — a spurious stale series).
+        self._touched = False
+        self._labels_only = labels_only
+
+    def labels(self, **kv) -> "Counter":
+        """Child series for this label set (created on first use, cached:
+        repeated labels(...) with the same values returns the same child,
+        so increments accumulate)."""
+        if not kv:
+            raise ValueError("labels() requires at least one label")
+        key = _labelset_key(kv)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = type(self)(self.name, self.help, label_values=dict(key))
+                self._children[key] = child
+            return child
+
+    def remove(self, **kv) -> None:
+        """Drop the child series for this label set (no-op when absent).
+        Prometheus clients expose this for bounded cardinality: series
+        keyed by a finite-lifetime entity (a job) must disappear when the
+        entity does, or the family grows without bound."""
+        with self._lock:
+            self._children.pop(_labelset_key(kv), None)
+
+    def labelsets(self) -> list[dict]:
+        """The label sets of every live child series (for pruning)."""
+        with self._lock:
+            return [dict(k) for k in self._children]
 
     def inc(self, n: float = 1.0) -> None:
         with self._lock:
             self._v += n
+            self._touched = True
 
     def value(self) -> float:
         with self._lock:
             return self._v
 
+    def _sample_lines(self) -> list[str]:
+        return [f"{self.name}{_fmt_labels(self._label_values)} {self.value()}"]
+
+    def expose_lines(self) -> list[str]:
+        lines = [
+            f"# HELP {self.name} {_escape_help(self.help)}".rstrip(),
+            f"# TYPE {self.name} {self._kind}",
+        ]
+        with self._lock:
+            children = list(self._children.values())
+            touched = self._touched
+        if touched or (not children and not self._labels_only):
+            lines.extend(self._sample_lines())
+        for c in children:
+            lines.extend(c._sample_lines())
+        return lines
+
 
 class Gauge(Counter):
+    _kind = "gauge"
+
     def set(self, v: float) -> None:
         with self._lock:
             self._v = v
+            self._touched = True
 
 
 class Histogram:
@@ -39,43 +140,91 @@ class Histogram:
     The reference logs per-reconcile sync latency (controller.go:289-291);
     this surfaces the same signal as a scrapeable distribution."""
 
+    _kind = "histogram"
+
     # Reconcile passes are ms-scale in-memory and seconds-scale against a
     # real apiserver; buckets span both.
     DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
 
     def __init__(self, name: str, help_text: str,
-                 buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+                 label_values: dict[str, str] | None = None,
+                 labels_only: bool = False):
         self.name = name
         self.help = help_text
         self.buckets = tuple(sorted(buckets))
         self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
         self._sum = 0.0
         self._lock = threading.Lock()
+        self._label_values = dict(label_values or {})
+        self._children: dict[tuple, Histogram] = {}
+        self._touched = False
+        self._labels_only = labels_only
+
+    def labels(self, **kv) -> "Histogram":
+        """Child histogram for this label set (shares the bucket layout)."""
+        if not kv:
+            raise ValueError("labels() requires at least one label")
+        key = _labelset_key(kv)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = Histogram(self.name, self.help, buckets=self.buckets,
+                                  label_values=dict(key))
+                self._children[key] = child
+            return child
+
+    def remove(self, **kv) -> None:
+        """Drop the child series for this label set (see Counter.remove)."""
+        with self._lock:
+            self._children.pop(_labelset_key(kv), None)
+
+    def labelsets(self) -> list[dict]:
+        with self._lock:
+            return [dict(k) for k in self._children]
 
     def observe(self, v: float) -> None:
         with self._lock:
             self._sum += v
+            self._touched = True
             for i, b in enumerate(self.buckets):
                 if v <= b:
                     self._counts[i] += 1
                     return
             self._counts[-1] += 1
 
-    def expose_lines(self) -> list[str]:
+    def _sample_lines(self) -> list[str]:
         with self._lock:
-            lines = []
-            if self.help:
-                lines.append(f"# HELP {self.name} {self.help}")
-            lines.append(f"# TYPE {self.name} histogram")
-            cum = 0
-            for b, c in zip(self.buckets, self._counts):
-                cum += c
-                lines.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
-            cum += self._counts[-1]
-            lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-            lines.append(f"{self.name}_sum {self._sum}")
-            lines.append(f"{self.name}_count {cum}")
-            return lines
+            counts = list(self._counts)
+            total = self._sum
+        base = dict(self._label_values)
+        lines = []
+        cum = 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            lines.append(
+                f"{self.name}_bucket{_fmt_labels({**base, 'le': str(b)})} {cum}")
+        cum += counts[-1]
+        lines.append(
+            f"{self.name}_bucket{_fmt_labels({**base, 'le': '+Inf'})} {cum}")
+        suffix = _fmt_labels(base)
+        lines.append(f"{self.name}_sum{suffix} {total}")
+        lines.append(f"{self.name}_count{suffix} {cum}")
+        return lines
+
+    def expose_lines(self) -> list[str]:
+        lines = [
+            f"# HELP {self.name} {_escape_help(self.help)}".rstrip(),
+            f"# TYPE {self.name} {self._kind}",
+        ]
+        with self._lock:
+            children = list(self._children.values())
+            touched = self._touched
+        if touched or (not children and not self._labels_only):
+            lines.extend(self._sample_lines())
+        for c in children:
+            lines.extend(c._sample_lines())
+        return lines
 
 
 class Registry:
@@ -83,60 +232,75 @@ class Registry:
         self._metrics: dict[str, Counter | Histogram] = {}
         self._lock = threading.Lock()
 
-    def counter(self, name: str, help_text: str = "") -> Counter:
+    def counter(self, name: str, help_text: str = "",
+                labels_only: bool = False) -> Counter:
         with self._lock:
             if name not in self._metrics:
-                self._metrics[name] = Counter(name, help_text)
+                self._metrics[name] = Counter(name, help_text,
+                                              labels_only=labels_only)
             return self._metrics[name]
 
-    def gauge(self, name: str, help_text: str = "") -> Gauge:
+    def gauge(self, name: str, help_text: str = "",
+              labels_only: bool = False) -> Gauge:
         with self._lock:
             if name not in self._metrics:
-                self._metrics[name] = Gauge(name, help_text)
+                self._metrics[name] = Gauge(name, help_text,
+                                            labels_only=labels_only)
             m = self._metrics[name]
             assert isinstance(m, Gauge)
             return m
 
-    def histogram(self, name: str, help_text: str = "") -> Histogram:
+    def histogram(self, name: str, help_text: str = "",
+                  labels_only: bool = False) -> Histogram:
         with self._lock:
             if name not in self._metrics:
-                self._metrics[name] = Histogram(name, help_text)
+                self._metrics[name] = Histogram(name, help_text,
+                                                labels_only=labels_only)
             m = self._metrics[name]
             assert isinstance(m, Histogram)
             return m
 
+    def names(self) -> list[str]:
+        """Every registered metric family name (tools/check_metrics_doc.py
+        audits docs/monitoring.md against this)."""
+        with self._lock:
+            return sorted(self._metrics)
+
     def expose(self) -> str:
         """Prometheus text exposition format."""
         with self._lock:
-            lines = []
-            for m in self._metrics.values():
-                if isinstance(m, Histogram):
-                    lines.extend(m.expose_lines())
-                    continue
-                kind = "gauge" if isinstance(m, Gauge) else "counter"
-                if m.help:
-                    lines.append(f"# HELP {m.name} {m.help}")
-                lines.append(f"# TYPE {m.name} {kind}")
-                lines.append(f"{m.name} {m.value()}")
-            return "\n".join(lines) + "\n"
+            metrics = list(self._metrics.values())
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.expose_lines())
+        return "\n".join(lines) + "\n"
 
 
 DEFAULT = Registry()
 
 jobs_created = DEFAULT.counter(
-    "tpujob_operator_jobs_created_total", "Total TrainJobs observed as created"
+    "tpujob_operator_jobs_created_total",
+    "Total TrainJobs observed as created (by namespace)",
+    labels_only=True,
 )
 jobs_deleted = DEFAULT.counter(
-    "tpujob_operator_jobs_deleted_total", "Total TrainJobs deleted"
+    "tpujob_operator_jobs_deleted_total", "Total TrainJobs deleted (by namespace)",
+    labels_only=True,
 )
 jobs_successful = DEFAULT.counter(
-    "tpujob_operator_jobs_successful_total", "Total TrainJobs that succeeded"
+    "tpujob_operator_jobs_successful_total",
+    "Total TrainJobs that succeeded (by namespace)",
+    labels_only=True,
 )
 jobs_failed = DEFAULT.counter(
-    "tpujob_operator_jobs_failed_total", "Total TrainJobs that failed"
+    "tpujob_operator_jobs_failed_total",
+    "Total TrainJobs that failed (by namespace)",
+    labels_only=True,
 )
 jobs_restarted = DEFAULT.counter(
-    "tpujob_operator_jobs_restarted_total", "Total TrainJobs that entered Restarting"
+    "tpujob_operator_jobs_restarted_total",
+    "Total TrainJobs that entered Restarting (by namespace)",
+    labels_only=True,
 )
 is_leader = DEFAULT.gauge(
     "tpujob_operator_is_leader", "1 when this operator instance holds leadership"

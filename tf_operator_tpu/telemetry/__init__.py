@@ -1,0 +1,38 @@
+"""Unified telemetry: span tracer, per-step phase accounting, collector.
+
+Three layers, one substrate (ROADMAP round 8):
+
+  * tracer.py — low-overhead in-process span tracer (`span()` context
+    manager, cross-thread `begin`/`end`, bounded ring buffer, near-zero
+    cost disabled) exporting Chrome trace-event JSON for Perfetto /
+    chrome://tracing. The trainer's `--trace` flag drives it.
+  * phases.py — per-step phase accounting on top of the tracer:
+    data_wait / h2d_transfer / dispatch / device_blocked / checkpoint /
+    eval / other, telescoping exactly to step wall-clock, with weighted
+    per-step percentiles for the done event's `step_time_s`.
+  * collector.py — control-plane side: reads the pods' trainer event
+    files back into per-job API `telemetry` blocks and labeled
+    `tpujob_trainer_*` gauges on /metrics (imported by cli/server.py;
+    not re-exported here to keep data-plane imports stdlib-only).
+
+Import cost matters: models/train.py imports this before jax, and the
+staging/prefetch transfer threads call `span()` per batch — everything
+here is stdlib.
+"""
+
+from tf_operator_tpu.telemetry.phases import (  # noqa: F401
+    PHASES,
+    NullStepAccounting,
+    StepAccounting,
+    make_step_accounting,
+    weighted_percentile,
+)
+from tf_operator_tpu.telemetry.tracer import (  # noqa: F401
+    Tracer,
+    begin,
+    configure,
+    end,
+    get_tracer,
+    instant,
+    span,
+)

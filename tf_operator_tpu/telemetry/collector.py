@@ -1,0 +1,208 @@
+"""Trainer-telemetry collector: metrics files -> API + /metrics.
+
+The trainer emits JSON event lines (start/first_step/progress/checkpoint/
+done — models/train.py) to TPUJOB_METRICS_FILE. The local runtime points
+each pod at `<log_dir>/{ns}_{pod}.metrics.jsonl` (runtime/local.py), and
+this collector reads those files back on demand to surface the data
+plane's telemetry through the control plane:
+
+  * `GET /api/trainjobs/{ns}/{name}` carries a per-job `telemetry` block
+    (per-replica: latest step/loss, startup_s, steady steps/sec, the
+    round-8 step_time_s percentiles and phase_breakdown, staging/
+    prefetch accounting) — cli/server.py calls `job_telemetry`.
+  * `GET /metrics` exposes labeled `tpujob_trainer_*` gauges
+    ({namespace=...,job=...} child series, status/metrics.py labels) —
+    cli/server.py calls `refresh_gauges` per scrape (pull model: files
+    are read when someone looks, never on a hot path).
+
+Files are re-read per request rather than tailed: trainer event files
+are a few KB (one line per log_every steps), and a stateless read makes
+the collector correct across pod restarts and operator failover.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+from tf_operator_tpu.status import metrics as metrics_mod
+
+__all__ = ["TRAINER_GAUGES", "TelemetryCollector", "summarize_events"]
+
+# Every trainer gauge this collector can expose, name -> help text.
+# tools/check_metrics_doc.py audits docs/monitoring.md against this dict,
+# so a gauge added here without a doc row fails CI.
+TRAINER_GAUGES = {
+    "tpujob_trainer_steps_per_sec":
+        "Steady-state training steps/sec from the trainer's done event",
+    "tpujob_trainer_examples_per_sec":
+        "Steady-state examples/sec from the trainer's done event",
+    "tpujob_trainer_last_step":
+        "Latest step the trainer reported (progress/done events)",
+    "tpujob_trainer_loss":
+        "Latest training loss the trainer reported",
+    "tpujob_trainer_startup_s":
+        "Pod start -> first optimizer step, seconds (first_step event)",
+    "tpujob_trainer_step_time_p50_s":
+        "Median per-step wall-clock from the done event's step_time_s",
+    "tpujob_trainer_step_time_p99_s":
+        "p99 per-step wall-clock from the done event's step_time_s",
+}
+
+# Pod names are {job}-{type}-{index} (utils/naming.py); anchoring on the
+# replica-type vocabulary keeps job "a" from claiming job "a-worker"'s
+# files.
+_REPLICA_RE = r"(chief|master|worker|ps|evaluator)-\d+"
+
+
+def _read_events(path: str) -> list[dict]:
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        pass  # torn write mid-append: skip the line
+    except OSError:
+        pass
+    return out
+
+
+def summarize_events(events: list[dict]) -> dict | None:
+    """One replica's event stream -> the telemetry block the API serves.
+    Restart-safe: a restarted pod appends a second start event to the
+    same file; the summary reflects the LATEST attempt's events while
+    counting attempts."""
+    if not events:
+        return None
+    attempts = sum(1 for e in events if e.get("event") == "start") or 1
+    last_start = max((i for i, e in enumerate(events)
+                      if e.get("event") == "start"), default=0)
+    cur = events[last_start:]
+    by = {}
+    for e in cur:
+        by[e.get("event")] = e  # last occurrence wins
+    out: dict = {
+        "last_event": cur[-1].get("event"),
+        "attempts": attempts,
+        "phase": "done" if "done" in by else (
+            "training" if "first_step" in by else "starting"),
+    }
+    first = by.get("first_step", {})
+    if first.get("startup_s") is not None:
+        out["startup_s"] = first["startup_s"]
+    prog = by.get("progress") or {}
+    done = by.get("done") or {}
+    step = done.get("steps", prog.get("step"))
+    if step is not None:
+        out["step"] = step
+    loss = done.get("final_loss", prog.get("loss", first.get("loss")))
+    if loss is not None:
+        out["loss"] = loss
+    for k in ("steady_steps_per_sec", "examples_per_sec", "total_s",
+              "step_time_s", "phase_breakdown", "staging", "prefetch"):
+        if done.get(k) is not None:
+            out[k] = done[k]
+    if by.get("trace_done"):
+        out["trace_path"] = by["trace_done"].get("path")
+    return out
+
+
+class TelemetryCollector:
+    def __init__(self, log_dir: str, registry: metrics_mod.Registry | None = None):
+        self.log_dir = log_dir
+        self.registry = registry or metrics_mod.DEFAULT
+        # labels_only: these families exist purely as per-job child
+        # series — a bare 0-valued sample before the first job reported
+        # would plot as a phantom job on every dashboard.
+        self._gauges = {
+            name: self.registry.gauge(name, help_text, labels_only=True)
+            for name, help_text in TRAINER_GAUGES.items()
+        }
+
+    # ------------------------------------------------------------- reading
+
+    def _job_files(self, namespace: str, job: str) -> dict[str, str]:
+        """pod name -> metrics-file path, for every replica of the job
+        that ever wrote one (globbing the log_dir covers pods that have
+        already been deleted — their last telemetry outlives them)."""
+        # Filename layout mirrors the runtime's log files ({ns}_{pod}.log).
+        pat = re.compile(
+            rf"^{re.escape(namespace)}_({re.escape(job)}-{_REPLICA_RE})"
+            rf"\.metrics\.jsonl$"
+        )
+        out: dict[str, str] = {}
+        try:
+            names = os.listdir(self.log_dir)
+        except OSError:
+            return out
+        for fn in names:
+            m = pat.match(fn)
+            if m:
+                out[m.group(1)] = os.path.join(self.log_dir, fn)
+        return out
+
+    def job_telemetry(self, namespace: str, job: str) -> dict | None:
+        """The per-job `telemetry` block for GET /api/trainjobs/{ns}/{name}:
+        {"replicas": {pod: summary}} or None when no replica reported."""
+        replicas = {}
+        for pod, path in sorted(self._job_files(namespace, job).items()):
+            summary = summarize_events(_read_events(path))
+            if summary:
+                replicas[pod] = summary
+        return {"replicas": replicas} if replicas else None
+
+    # -------------------------------------------------------------- gauges
+
+    @staticmethod
+    def _primary(replicas: dict[str, dict]) -> dict | None:
+        """The replica whose numbers represent the job on /metrics: the
+        writer role (chief/master, else worker-0 — the same replica the
+        checkpoint contract elects), falling back to the furthest-along
+        replica."""
+        for pod, s in replicas.items():
+            if re.search(r"-(chief|master)-0$", pod):
+                return s
+        for pod, s in replicas.items():
+            if pod.endswith("-worker-0"):
+                return s
+        return max(replicas.values(),
+                   key=lambda s: s.get("step", -1), default=None)
+
+    def refresh_gauges(self, cluster) -> None:
+        """Pull-model update: called per /metrics scrape. Jobs come from
+        the cluster substrate and child series of jobs no longer in it
+        are REMOVED, so label cardinality is bounded by live jobs — a
+        weeks-long operator with job churn must not accumulate a frozen
+        gauge per deleted job."""
+        live = {(job.namespace, job.name) for job in cluster.list_jobs()}
+        for gauge in self._gauges.values():
+            for ls in gauge.labelsets():
+                if (ls.get("namespace"), ls.get("job")) not in live:
+                    gauge.remove(**ls)
+        for job in cluster.list_jobs():
+            tel = self.job_telemetry(job.namespace, job.name)
+            if not tel:
+                continue
+            primary = self._primary(tel["replicas"])
+            if not primary:
+                continue
+            labels = {"namespace": job.namespace, "job": job.name}
+            step_time = primary.get("step_time_s") or {}
+            for gauge_name, value in (
+                ("tpujob_trainer_steps_per_sec",
+                 primary.get("steady_steps_per_sec")),
+                ("tpujob_trainer_examples_per_sec",
+                 primary.get("examples_per_sec")),
+                ("tpujob_trainer_last_step", primary.get("step")),
+                ("tpujob_trainer_loss", primary.get("loss")),
+                ("tpujob_trainer_startup_s", primary.get("startup_s")),
+                ("tpujob_trainer_step_time_p50_s", step_time.get("p50")),
+                ("tpujob_trainer_step_time_p99_s", step_time.get("p99")),
+            ):
+                if value is not None:
+                    self._gauges[gauge_name].labels(**labels).set(float(value))

@@ -1,0 +1,66 @@
+#!/usr/bin/env python3
+"""CI guard: every exposed metric name must appear in docs/monitoring.md.
+
+Round 8 found the doc documenting `tpujob_operator_sync_seconds` while the
+code exposed `tpujob_operator_reconcile_duration_seconds` — name drift a
+reader only discovers when their PromQL returns nothing. This check makes
+that class of drift a CI failure:
+
+  * operator metrics: every family registered in status.metrics.DEFAULT
+    (registered at import time, so importing the module is enumeration)
+  * trainer gauges: telemetry.collector.TRAINER_GAUGES (created lazily by
+    the collector, so the registry alone would miss them)
+
+A name "appears" when the doc contains it verbatim (typically as a table
+row). Run from CI's py-lint stage (ci/pipeline.yaml) and directly:
+
+  python tools/check_metrics_doc.py [--doc docs/monitoring.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_DOC = os.path.join(REPO, "docs", "monitoring.md")
+
+
+def exposed_metric_names() -> list[str]:
+    sys.path.insert(0, REPO)
+    from tf_operator_tpu.status import metrics
+    from tf_operator_tpu.telemetry import collector
+
+    return sorted(set(metrics.DEFAULT.names()) | set(collector.TRAINER_GAUGES))
+
+
+def missing_from_doc(doc_text: str) -> list[str]:
+    return [n for n in exposed_metric_names() if n not in doc_text]
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="check_metrics_doc.py",
+                                 description=__doc__)
+    ap.add_argument("--doc", default=DEFAULT_DOC,
+                    help="markdown file that must mention every metric")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.doc) as f:
+            doc = f.read()
+    except OSError as e:
+        print(f"check_metrics_doc: cannot read {args.doc}: {e}",
+              file=sys.stderr)
+        return 1
+    missing = missing_from_doc(doc)
+    for name in missing:
+        print(f"check_metrics_doc: {name} is exposed but not documented "
+              f"in {args.doc}")
+    n = len(exposed_metric_names())
+    print(f"check_metrics_doc: {n} metric families, {len(missing)} missing",
+          file=sys.stderr)
+    return 1 if missing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
