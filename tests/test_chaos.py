@@ -1,0 +1,608 @@
+"""Chaos-injection harness: kill→restart→resume proven end-to-end.
+
+The capstone of the robustness story (ISSUE 4): a deterministic fault
+spec (TPUJOB_CHAOS / --chaos) SIGTERMs a real trainer mid-run inside the
+local-process runtime, the operator's EXIT_CODE policy restarts the pod,
+and the resumed trainer continues from the emergency checkpoint to the
+exact requested final step on the uninterrupted loss trajectory. Around
+it: the preemption guard, checkpoint manifest validation + backward-walk
+resume fallback, retention/sweep, staging stalls, and backoff-limit
+exhaustion. (Control-plane chaos — apiserver faults + client retry —
+lives in tests/test_k8s_retry.py.)
+
+The e2e tests run trainer pods as 1-device CPU subprocesses (the 8-device
+virtual mesh pays ~100 ms of collective latency per step — PR-8's
+discipline); the longer multi-kill variant is marked slow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tf_operator_tpu import chaos as chaos_lib
+from tf_operator_tpu.api import defaults
+from tf_operator_tpu.api.types import (
+    ContainerSpec,
+    JobConditionType,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    TrainJob,
+    TrainJobSpec,
+    is_succeeded,
+)
+from tf_operator_tpu.runtime.session import LocalSession
+from tf_operator_tpu.utils import preemption
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+PY = sys.executable
+DONE = (JobConditionType.SUCCEEDED, JobConditionType.FAILED)
+
+# Trainer pods run on a 1-device CPU mesh regardless of the suite's
+# 8-device XLA_FLAGS (overrides are applied after the inherited env).
+ONE_DEV = {
+    "PYTHONPATH": REPO_ROOT,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+STEPS = 24
+
+
+def trainer_cmd(*extra: str) -> list[str]:
+    return [PY, "-m", "tf_operator_tpu.models.train", "--model", "mnist-mlp",
+            "--steps", str(STEPS), "--batch", "16", "--log-every", "4",
+            *extra]
+
+
+def make_job(name: str, cmd: list[str], restart=None,
+             backoff_limit: int | None = None) -> TrainJob:
+    job = TrainJob(
+        metadata=ObjectMeta(name=name),
+        spec=TrainJobSpec(replica_specs={
+            defaults.canonical_replica_type("worker"): ReplicaSpec(
+                replicas=1,
+                restart_policy=restart,
+                template=PodTemplateSpec(containers=[
+                    ContainerSpec(name="tensorflow", image="local", command=cmd)
+                ]),
+            ),
+        }),
+    )
+    job.spec.run_policy.scheduling.gang = False
+    if backoff_limit is not None:
+        job.spec.run_policy.backoff_limit = backoff_limit
+    return defaults.set_defaults(job)
+
+
+def read_events(path) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def progress_losses(events: list[dict]) -> dict[int, float]:
+    return {e["step"]: e["loss"] for e in events if e["event"] == "progress"}
+
+
+# --------------------------------------------------------------- spec units
+
+
+class TestChaosSpec:
+    def test_parse_roundtrip(self):
+        ds = chaos_lib.parse_chaos(
+            "kill:step=5,signal=TERM; torn:step=8,mode=unlink;"
+            "stall:every=3,delay=0.25; apiserver:errors=2,code=503"
+        )
+        assert [d.kind for d in ds] == ["kill", "torn", "stall", "apiserver"]
+        assert ds[0].params == {"step": 5, "signal": "TERM"}
+        assert ds[1].params["mode"] == "unlink"
+        assert ds[2].params == {"every": 3, "delay": 0.25}
+        assert ds[3].params == {"errors": 2, "code": 503}
+
+    def test_empty_and_blank(self):
+        assert chaos_lib.parse_chaos("") == []
+        assert chaos_lib.parse_chaos(" ; ") == []
+        assert chaos_lib.from_env({}) == []
+
+    @pytest.mark.parametrize("bad", [
+        "boom:step=1",                # unknown kind
+        "kill:signal=TERM",           # kill without step
+        "kill:step=x",                # non-integer
+        "kill:step=5,when=now",       # unknown key
+        "kill:step=5,signal=NOPE",    # unknown signal
+        "torn:step=3,mode=shred",     # unknown tear mode
+        "stall:delay=0.1",            # neither batch nor every
+        "stall:batch=1,every=2,delay=0.1",  # both
+        "stall:batch=1",              # no delay
+        "apiserver:errors=-1",        # negative budget
+    ])
+    def test_strict_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            chaos_lib.parse_chaos(bad)
+
+    def test_signal_forms(self):
+        assert chaos_lib.parse_signal("TERM") == signal.SIGTERM
+        assert chaos_lib.parse_signal("SIGKILL") == signal.SIGKILL
+        assert chaos_lib.parse_signal("10") == 10
+
+    def test_one_shot_state_survives_processes(self, tmp_path):
+        d = chaos_lib.parse_chaos("kill:step=5")[0]
+        s1 = chaos_lib.OneShotState(str(tmp_path))
+        assert not s1.fired(d)
+        s1.mark(d)
+        # A fresh instance (a restarted process) still sees the marker.
+        s2 = chaos_lib.OneShotState(str(tmp_path))
+        assert s2.fired(d)
+        # Without a state dir, memory is process-local.
+        s3 = chaos_lib.OneShotState()
+        assert not s3.fired(d)
+
+    def test_trainer_chaos_no_refire_past_resume(self):
+        """Without a state dir, a kill directive never fires in a process
+        that RESUMED at/past its step — the property the e2e restart
+        depends on (checked without delivering a real signal)."""
+        tc = chaos_lib.TrainerChaos(chaos_lib.parse_chaos("kill:step=12"))
+        d = tc.kills[0]
+        # Resumed at 12: the directive is skipped, not marked.
+        tc.maybe_kill(done=16, start_step=12)
+        assert not tc.state.fired(d)
+
+    def test_staging_stall_delay(self):
+        stalls = chaos_lib.parse_chaos("stall:batch=2,delay=0.5;"
+                                       "stall:every=3,delay=0.25")
+        f = chaos_lib.staging_stall_delay
+        assert f(0, stalls) == 0.25   # every=3 hits 0
+        assert f(1, stalls) == 0.0
+        assert f(2, stalls) == 0.5    # batch=2
+        assert f(3, stalls) == 0.25
+
+
+# ---------------------------------------------------------- guard units
+
+
+class TestPreemptionGuard:
+    def test_latches_first_signal_only(self):
+        saved = {s: signal.getsignal(s) for s in preemption.HANDLED_SIGNALS}
+        try:
+            g = preemption.PreemptionGuard()
+            assert g.install()
+            assert not g.triggered
+            os.kill(os.getpid(), signal.SIGUSR1)
+            deadline = time.monotonic() + 5
+            while not g.triggered and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert g.triggered
+            assert g.signal_name == "SIGUSR1"
+            assert g.exit_code == 138  # the user-declared-retryable code
+            os.kill(os.getpid(), signal.SIGTERM)  # latched: must not re-arm
+            time.sleep(0.05)
+            assert g.exit_code == 138
+        finally:
+            for s, h in saved.items():
+                signal.signal(s, h)
+
+    def test_uninstall_restores_displaced_handlers(self):
+        """An in-process caller of the trainer's main() must get its
+        SIGINT semantics back (main's finally calls this)."""
+        saved = {s: signal.getsignal(s) for s in preemption.HANDLED_SIGNALS}
+        g = preemption.PreemptionGuard()
+        assert g.install()
+        assert signal.getsignal(signal.SIGTERM) == g._handler
+        g.uninstall()
+        for s in preemption.HANDLED_SIGNALS:
+            assert signal.getsignal(s) == saved[s]
+        assert not g.installed
+
+    def test_grace_budget(self):
+        g = preemption.PreemptionGuard()
+        g._signum = signal.SIGTERM
+        g._t = time.monotonic()
+        assert g.within_grace(est_save_s=0.1, grace_s=30.0)
+        assert not g.within_grace(est_save_s=1000.0, grace_s=30.0)
+        assert not g.within_grace(est_save_s=0.0, grace_s=0.0)  # no budget
+        assert g.exit_code == 143
+
+
+# -------------------------------------------- checkpoint hardening units
+
+
+@pytest.fixture
+def tiny_state():
+    """A real (tiny) TrainState + optimizer, host-side — enough for the
+    full save/validate/resume machinery without a model or a compile."""
+    import jax.numpy as jnp
+
+    from tf_operator_tpu import optim as optim_lib
+    from tf_operator_tpu.parallel.train_step import create_train_state
+
+    tx = optim_lib.make_optimizer(optim_lib.OptimizerConfig(
+        name="adamw", learning_rate=1e-3))
+    params = {"dense": {"kernel": jnp.arange(16, dtype=jnp.float32).reshape(4, 4),
+                        "bias": jnp.zeros((4,), jnp.float32)}}
+    return create_train_state(params, tx, {}), tx
+
+
+def save_at(ckpt_dir: str, step: int, state) -> None:
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models import train as train_mod
+
+    train_mod._save_checkpoint(
+        ckpt_dir, step,
+        dataclasses.replace(state, step=jnp.asarray(step, jnp.int32)))
+
+
+class TestCheckpointHardening:
+    def test_manifest_written_and_validates(self, tmp_path, tiny_state):
+        from tf_operator_tpu.models import checkpoint as ckpt
+
+        state, _ = tiny_state
+        save_at(str(tmp_path), 4, state)
+        assert (tmp_path / "step_4.manifest.json").exists()
+        assert ckpt.validate_step(str(tmp_path), 4)
+        assert ckpt.validate_named(str(tmp_path), "trainstate_4")
+
+    def test_truncated_file_fails_validation(self, tmp_path, tiny_state):
+        from tf_operator_tpu.models import checkpoint as ckpt
+
+        state, _ = tiny_state
+        save_at(str(tmp_path), 4, state)
+        chaos_lib.tear_checkpoint(str(tmp_path), 4, mode="truncate")
+        assert not ckpt.validate_step(str(tmp_path), 4)
+
+    def test_missing_leaf_fails_validation(self, tmp_path, tiny_state):
+        from tf_operator_tpu.models import checkpoint as ckpt
+
+        state, _ = tiny_state
+        save_at(str(tmp_path), 4, state)
+        chaos_lib.tear_checkpoint(str(tmp_path), 4, mode="unlink")
+        assert not ckpt.validate_step(str(tmp_path), 4)
+
+    def test_missing_manifest_is_legacy_valid(self, tmp_path, tiny_state):
+        from tf_operator_tpu.models import checkpoint as ckpt
+
+        state, _ = tiny_state
+        save_at(str(tmp_path), 4, state)
+        os.unlink(tmp_path / "step_4.manifest.json")
+        assert ckpt.validate_step(str(tmp_path), 4)  # unverifiable != torn
+
+    def test_torn_manifest_fails_validation(self, tmp_path, tiny_state):
+        from tf_operator_tpu.models import checkpoint as ckpt
+
+        state, _ = tiny_state
+        save_at(str(tmp_path), 4, state)
+        (tmp_path / "step_4.manifest.json").write_text('{"files": {"x"')
+        assert not ckpt.validate_step(str(tmp_path), 4)
+
+    def test_prune_keeps_newest_k(self, tmp_path, tiny_state):
+        from tf_operator_tpu.models import checkpoint as ckpt
+
+        state, _ = tiny_state
+        for s in (4, 8, 12, 16):
+            save_at(str(tmp_path), s, state)
+        pruned = ckpt.prune_checkpoints(str(tmp_path), keep=2)
+        assert pruned == [4, 8]
+        assert ckpt.list_steps(str(tmp_path)) == [12, 16]
+        names = set(os.listdir(tmp_path))
+        # params, trainstate AND manifests of pruned steps are gone
+        assert not any("_4" in n or "_8" in n for n in names), names
+        assert ckpt.prune_checkpoints(str(tmp_path), keep=0) == []  # 0 = keep all
+
+    def test_sweep_tmp_dirs(self, tmp_path, tiny_state):
+        from tf_operator_tpu.models import checkpoint as ckpt
+
+        state, _ = tiny_state
+        save_at(str(tmp_path), 4, state)
+        (tmp_path / "step_8.orbax-checkpoint-tmp-1234").mkdir()
+        (tmp_path / "step_8.orbax-checkpoint-tmp-1234" / "leaf").write_text("x")
+        (tmp_path / ".FINAL.tmp").write_text("9")
+        removed = ckpt.sweep_tmp_dirs(str(tmp_path))
+        assert set(removed) == {"step_8.orbax-checkpoint-tmp-1234", ".FINAL.tmp"}
+        assert ckpt.validate_step(str(tmp_path), 4)  # finished ckpts untouched
+
+
+# ------------------------------------------------- resume-fallback units
+
+
+@pytest.fixture
+def emit_capture(tmp_path, monkeypatch):
+    """Route the trainer's _emit stream to a file we can assert on."""
+    path = tmp_path / "emit.jsonl"
+    monkeypatch.setenv("TPUJOB_METRICS_FILE", str(path))
+    return path
+
+
+class TestResumeFallback:
+    def _resume(self, ckpt_dir, tiny_state):
+        from tf_operator_tpu.models import train as train_mod
+
+        state, tx = tiny_state
+        return train_mod._try_resume(str(ckpt_dir), state, tx)
+
+    def test_fresh_dir_cold_starts(self, tmp_path, tiny_state, emit_capture):
+        _, start = self._resume(tmp_path / "none", tiny_state)
+        assert start == 0
+        assert read_events(emit_capture) == []
+
+    def test_torn_latest_falls_back(self, tmp_path, tiny_state, emit_capture):
+        state, _ = tiny_state
+        save_at(str(tmp_path), 8, state)
+        save_at(str(tmp_path), 16, state)
+        chaos_lib.tear_checkpoint(str(tmp_path), 16, mode="truncate")
+        new_state, start = self._resume(tmp_path, tiny_state)
+        assert start == 8
+        assert int(new_state.step) == 8
+        ev = read_events(emit_capture)
+        falls = [e for e in ev if e["event"] == "resume_fallback"]
+        assert falls and falls[0]["skipped_step"] == 16
+        assert falls[0]["reason"] == "invalid_checkpoint"
+        assert any(e["event"] == "resumed" and e["from_step"] == 8
+                   for e in ev)
+
+    def test_missing_leaf_falls_back(self, tmp_path, tiny_state, emit_capture):
+        state, _ = tiny_state
+        save_at(str(tmp_path), 8, state)
+        save_at(str(tmp_path), 16, state)
+        chaos_lib.tear_checkpoint(str(tmp_path), 16, mode="unlink")
+        _, start = self._resume(tmp_path, tiny_state)
+        assert start == 8
+
+    def test_all_corrupt_degrades_to_zero(self, tmp_path, tiny_state,
+                                          emit_capture):
+        state, _ = tiny_state
+        save_at(str(tmp_path), 8, state)
+        save_at(str(tmp_path), 16, state)
+        chaos_lib.tear_checkpoint(str(tmp_path), 8, mode="truncate")
+        chaos_lib.tear_checkpoint(str(tmp_path), 16, mode="unlink")
+        _, start = self._resume(tmp_path, tiny_state)  # never crash-loops
+        assert start == 0
+        ev = read_events(emit_capture)
+        assert any(e["event"] == "resume_fallback"
+                   and e.get("reason") == "no_valid_checkpoint" for e in ev)
+
+    def test_torn_trainstate_resumes_params_only(self, tmp_path, tiny_state,
+                                                 emit_capture):
+        state, _ = tiny_state
+        save_at(str(tmp_path), 8, state)
+        # Tear the AUX payload only: params stay intact, so the right
+        # degradation is params-only at step 8, not walking further back.
+        aux_root = tmp_path / "trainstate_8"
+        files = sorted(
+            p for p in aux_root.rglob("*") if p.is_file()
+        )
+        biggest = max(files, key=lambda p: p.stat().st_size)
+        with open(biggest, "r+b") as f:
+            f.truncate(biggest.stat().st_size // 2)
+        _, start = self._resume(tmp_path, tiny_state)
+        assert start == 8
+        ev = read_events(emit_capture)
+        resumed = [e for e in ev if e["event"] == "resumed"]
+        assert resumed and resumed[0]["params_only"] is True
+
+
+# --------------------------------------------------- staging stall unit
+
+
+class TestStagingStall:
+    def test_stall_charged_to_transfer(self, monkeypatch):
+        monkeypatch.setenv("TPUJOB_CHAOS", "stall:batch=1,delay=0.3")
+        from tf_operator_tpu.data.staging import stage_to_device
+
+        stats: dict = {}
+        batches = ({"x": np.full((8, 4), i, np.float32)} for i in range(3))
+        out = list(stage_to_device(batches, depth=1, stats=stats))
+        assert len(out) == 3  # the stalled batch still arrives, late
+        assert stats["batches_staged"] == 3
+        assert stats["transfer_s"] >= 0.25  # the injected stall is visible
+
+    def test_no_chaos_no_stall_path(self, monkeypatch):
+        monkeypatch.delenv("TPUJOB_CHAOS", raising=False)
+        assert chaos_lib.staging_stalls_from_env() == []
+
+
+# ------------------------------------------------------------ e2e capstone
+
+
+@pytest.fixture
+def session(tmp_path, monkeypatch):
+    # Prespawn forks pods from an image whose jax initialized on the
+    # suite's 8-device mesh; these tests need honest 1-device subprocesses.
+    monkeypatch.setenv("TPUJOB_PRESPAWN", "0")
+    s = LocalSession(env_overrides=dict(ONE_DEV),
+                     log_dir=str(tmp_path / "logs"))
+    yield s
+    s.close()
+
+
+def pod_events(tmp_path, pod: str, ns: str = "default") -> list[dict]:
+    return read_events(tmp_path / "logs" / f"{ns}_{pod}.metrics.jsonl")
+
+
+def run_uninterrupted(tmp_path) -> list[dict]:
+    """The parity reference: the identical trainer run with no chaos and
+    no operator, in a 1-device subprocess."""
+    metrics = tmp_path / "reference.jsonl"
+    env = dict(os.environ, **ONE_DEV, TPUJOB_METRICS_FILE=str(metrics))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("TPUJOB_MESH", None)
+    r = subprocess.run(trainer_cmd(), capture_output=True, text=True,
+                       timeout=240, env=env, cwd=REPO_ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return read_events(metrics)
+
+
+class TestKillRestartResumeE2E:
+    """The acceptance capstone: SIGTERM injected mid-training -> emergency
+    checkpoint within the grace budget -> operator restarts the pod under
+    EXIT_CODE policy -> resumed run completes at the exact final step with
+    the uninterrupted run's loss trajectory."""
+
+    def test_kill_restart_resume(self, session, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        job = make_job(
+            "chaos-e2e",
+            trainer_cmd("--checkpoint-dir", ckpt, "--checkpoint-every", "8",
+                        "--keep-checkpoints", "2", "--preempt-grace", "60",
+                        "--chaos", "kill:step=12,signal=TERM"),
+            restart=RestartPolicy.EXIT_CODE,
+        )
+        session.submit(job)
+        job = session.wait_for_condition("default", "chaos-e2e", DONE,
+                                         timeout=240)
+        assert is_succeeded(job.status), [
+            (str(c.type), c.reason, c.message) for c in job.status.conditions
+        ]
+
+        ev = pod_events(tmp_path, "chaos-e2e-worker-0")
+        # One preemption, graceful: in-flight step finished, emergency
+        # checkpoint written inside the grace budget, exit 143.
+        pre = [e for e in ev if e["event"] == "preempted"]
+        assert len(pre) == 1
+        assert pre[0]["step"] == 12
+        assert pre[0]["exit_code"] == 143
+        assert pre[0]["signal"] == "SIGTERM"
+        assert pre[0]["emergency_checkpoint"] is True
+        # The replacement pod resumed from the emergency checkpoint...
+        resumed = [e for e in ev if e["event"] == "resumed"]
+        assert len(resumed) == 1 and resumed[0]["from_step"] == 12
+        # ...and finished at the EXACT requested step.
+        dones = [e for e in ev if e["event"] == "done"]
+        assert dones and dones[-1]["steps"] == STEPS
+
+        # Operator view: restart came from the exit-code policy and was
+        # counted as a preemption.
+        events = session.cluster.events_for("TrainJob", "default", "chaos-e2e")
+        assert any(e.reason == "ExitedWithCode" and "143" in e.message
+                   for e in events)
+        from tf_operator_tpu.status import metrics as status_metrics
+
+        assert 'tpujob_restarts_total{namespace="default",reason="preempt"}' \
+            in status_metrics.DEFAULT.expose()
+
+        # Retention held through the preempt/retry loop: at most K=2 step
+        # dirs, the final one present + FINAL marker.
+        from tf_operator_tpu.models import checkpoint as ckpt_lib
+
+        steps_kept = ckpt_lib.list_steps(ckpt)
+        assert len(steps_kept) <= 2 and steps_kept[-1] == STEPS
+        assert ckpt_lib.final_step(ckpt) == STEPS
+
+        # Loss trajectory matches an uninterrupted run (rtol 1e-3 per the
+        # acceptance bar; in practice the resume is bit-exact).
+        ref_events = run_uninterrupted(tmp_path)
+        ref = progress_losses(ref_events)
+        got = progress_losses(ev)
+        common = sorted(set(ref) & set(got))
+        assert STEPS in common and len(common) >= 2, (ref, got)
+        for s in common:
+            assert got[s] == pytest.approx(ref[s], rel=1e-3), (s, got, ref)
+        ref_done = [e for e in ref_events if e["event"] == "done"][-1]
+        assert dones[-1]["final_loss"] == pytest.approx(
+            ref_done["final_loss"], rel=1e-3)
+
+
+class TestBackoffExhaustion:
+    def test_backoff_limit_lands_failed_with_condition(self, session):
+        """Chaos flavor two: a replica that dies retryably EVERY time
+        exhausts backoffLimit and the job must land Failed with the
+        BackoffLimitExceeded condition — not restart forever."""
+        job = make_job(
+            "boom",
+            [PY, "-c", "import sys, time; time.sleep(0.1); sys.exit(137)"],
+            restart=RestartPolicy.ON_FAILURE,
+            backoff_limit=2,
+        )
+        session.submit(job)
+        job = session.wait_for_condition("default", "boom", DONE, timeout=60)
+        assert not is_succeeded(job.status)
+        failed = [c for c in job.status.conditions
+                  if c.type == JobConditionType.FAILED and c.status]
+        assert failed and failed[0].reason == "BackoffLimitExceeded", [
+            (str(c.type), c.reason) for c in job.status.conditions
+        ]
+        from tf_operator_tpu.status import metrics as status_metrics
+
+        assert 'tpujob_restarts_total{namespace="default",reason="backoff"}' \
+            in status_metrics.DEFAULT.expose()
+
+
+class TestRestartReasonLabels:
+    def test_user_declared_138_counts_as_exit_code(self, session, tmp_path):
+        """Exit 138 (128+SIGUSR1) is the app ASKING for a restart — it
+        must label tpujob_restarts_total reason=exit_code, not preempt
+        (numerically a signal exit, semantically user-declared)."""
+        marker = tmp_path / "usr1-fired"
+        code = (
+            "import os, sys\n"
+            f"p = {str(marker)!r}\n"
+            "if not os.path.exists(p):\n"
+            "    open(p, 'w').write('x'); sys.exit(138)\n"
+            "sys.exit(0)"
+        )
+        job = make_job("usr1", [PY, "-c", code],
+                       restart=RestartPolicy.EXIT_CODE)
+        session.submit(job)
+        job = session.wait_for_condition("default", "usr1", DONE, timeout=60)
+        assert is_succeeded(job.status)
+        from tf_operator_tpu.status import metrics as status_metrics
+
+        assert ('tpujob_restarts_total{namespace="default",'
+                'reason="exit_code"}') in status_metrics.DEFAULT.expose()
+
+
+@pytest.mark.slow
+class TestMultiKillResume:
+    def test_two_kills_still_complete(self, tmp_path, monkeypatch):
+        """The longer variant: SIGKILL (no grace, resume from the periodic
+        checkpoint) then SIGTERM (graceful, resume from the emergency
+        checkpoint), one-shot markers carrying fired state across the
+        three process generations."""
+        monkeypatch.setenv("TPUJOB_PRESPAWN", "0")
+        state_dir = tmp_path / "chaos-state"
+        s = LocalSession(
+            env_overrides={**ONE_DEV,
+                           "TPUJOB_CHAOS_STATE": str(state_dir)},
+            log_dir=str(tmp_path / "logs"),
+        )
+        try:
+            ckpt = str(tmp_path / "ckpt")
+            job = make_job(
+                "multikill",
+                [PY, "-m", "tf_operator_tpu.models.train", "--model",
+                 "mnist-mlp", "--steps", str(STEPS), "--batch", "16",
+                 "--log-every", "2", "--checkpoint-dir", ckpt,
+                 "--checkpoint-every", "4", "--preempt-grace", "60",
+                 "--chaos",
+                 "kill:step=6,signal=KILL;kill:step=14,signal=TERM"],
+                restart=RestartPolicy.EXIT_CODE,
+            )
+            s.submit(job)
+            job = s.wait_for_condition("default", "multikill", DONE,
+                                       timeout=360)
+            assert is_succeeded(job.status), [
+                (str(c.type), c.reason) for c in job.status.conditions
+            ]
+            ev = pod_events(tmp_path, "multikill-worker-0")
+            resumed = [e["from_step"] for e in ev if e["event"] == "resumed"]
+            # Gen 2 resumed from the periodic save before the SIGKILL,
+            # gen 3 from the SIGTERM's emergency checkpoint.
+            assert resumed == [4, 14], resumed
+            pre = [e for e in ev if e["event"] == "preempted"]
+            assert len(pre) == 1 and pre[0]["step"] == 14  # KILL has no event
+            dones = [e for e in ev if e["event"] == "done"]
+            assert dones and dones[-1]["steps"] == STEPS
+        finally:
+            s.close()

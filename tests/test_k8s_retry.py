@@ -1,0 +1,160 @@
+"""Transient-apiserver-failure handling: fault injection + bounded retry.
+
+The chaos subsystem's control-plane leg: the fake apiserver's
+inject_faults hook (testing/fake_apiserver.py) simulates a flaky/
+overloaded server — 5xx storms, write-contention 409s, added latency —
+and core/k8s.py's capped jittered retry must absorb the transients while
+still surfacing semantic answers (AlreadyExists, NotFound) immediately
+and giving up once the budget is spent.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from tf_operator_tpu.api.types import ContainerSpec, ObjectMeta, PodTemplateSpec
+from tf_operator_tpu.core.cluster import (
+    AlreadyExistsError,
+    ApiError,
+    ConflictError,
+    Pod,
+)
+from tf_operator_tpu.core.k8s import K8sApi, K8sCluster
+from tf_operator_tpu.testing.fake_apiserver import FakeApiServer
+
+
+def _mk_pod(name: str) -> Pod:
+    return Pod(
+        metadata=ObjectMeta(name=name, labels={"job-name": "j"}),
+        spec=PodTemplateSpec(
+            containers=[ContainerSpec(name="tensorflow", image="i",
+                                      command=["run"])],
+            restart_policy="Never",
+        ),
+    )
+
+
+@pytest.fixture
+def server():
+    with FakeApiServer() as s:
+        yield s
+
+
+def _cluster(server, **api_kw) -> K8sCluster:
+    api_kw.setdefault("retries", 3)
+    api_kw.setdefault("retry_base", 0.02)
+    api_kw.setdefault("retry_cap", 0.1)
+    return K8sCluster(K8sApi(server.url, **api_kw))
+
+
+class TestFaultInjection:
+    def test_injected_5xx_consumed_by_retry(self, server):
+        cluster = _cluster(server)
+        server.inject_faults(count=2, code=503, match="POST /api/v1")
+        pod = cluster.create_pod(_mk_pod("p0"))  # 3rd attempt lands
+        assert pod.name == "p0"
+        assert server.pending_faults() == 0
+        assert cluster.get_pod("default", "p0").name == "p0"
+
+    def test_retries_exhausted_surfaces_the_5xx(self, server):
+        cluster = _cluster(server, retries=2)
+        server.inject_faults(count=10, code=500)
+        with pytest.raises(ApiError) as ei:
+            cluster.create_pod(_mk_pod("p1"))
+        assert getattr(ei.value, "code", None) == 500
+        # 1 original + 2 retries consumed exactly 3 of the budget.
+        assert server.pending_faults() == 10 - 3
+
+    def test_retries_zero_disables(self, server):
+        cluster = _cluster(server, retries=0)
+        server.inject_faults(count=1, code=503)
+        with pytest.raises(ApiError):
+            cluster.list_pods("default")
+        assert server.pending_faults() == 0
+
+    def test_injected_conflict_retried(self, server):
+        cluster = _cluster(server)
+        server.inject_faults(count=1, code=409, match="GET")
+        assert cluster.list_pods("default") == []  # retried through the 409
+
+    def test_conflict_exhaustion_raises_conflict(self, server):
+        cluster = _cluster(server, retries=1)
+        server.inject_faults(count=5, code=409)
+        with pytest.raises(ConflictError):
+            cluster.list_pods("default")
+
+    def test_already_exists_is_semantic_never_retried(self, server):
+        cluster = _cluster(server)
+        cluster.create_pod(_mk_pod("dup"))
+        t0 = time.monotonic()
+        with pytest.raises(AlreadyExistsError):
+            cluster.create_pod(_mk_pod("dup"))
+        # No backoff was burned: a retried AlreadyExists would sleep
+        # ~3 * retry_base at minimum.
+        assert time.monotonic() - t0 < 0.5
+
+    def test_latency_only_fault(self, server):
+        cluster = _cluster(server)
+        server.inject_faults(count=1, code=0, latency=0.25)
+        t0 = time.monotonic()
+        assert cluster.list_pods("default") == []
+        assert time.monotonic() - t0 >= 0.2
+        assert server.pending_faults() == 0
+
+    def test_match_filters_requests(self, server):
+        cluster = _cluster(server, retries=0)
+        server.inject_faults(count=1, code=503, match="POST /api/v1/namespaces/default/pods")
+        assert cluster.list_pods("default") == []  # GET unaffected
+        assert server.pending_faults() == 1
+        with pytest.raises(ApiError):
+            cluster.create_pod(_mk_pod("px"))
+
+    def test_chaos_env_arms_apiserver_faults(self, monkeypatch):
+        monkeypatch.setenv("TPUJOB_CHAOS",
+                           "apiserver:errors=1,code=503,match=GET")
+        with FakeApiServer() as s:
+            assert s.pending_faults() == 1
+            cluster = _cluster(s)
+            assert cluster.list_pods("default") == []  # retry absorbs it
+            assert s.pending_faults() == 0
+
+    def test_jittered_backoff_is_capped(self, server):
+        """The retry budget is bounded in TIME, not just attempts: worst
+        case here is 3 sleeps of <= cap (0.1 s) each."""
+        cluster = _cluster(server)
+        server.inject_faults(count=10, code=503)
+        t0 = time.monotonic()
+        with pytest.raises(ApiError):
+            cluster.list_pods("default")
+        assert time.monotonic() - t0 < 2.0
+
+
+class TestReconcileThroughFaults:
+    def test_controller_converges_despite_503_burst(self, server):
+        """The whole reconcile loop rides the retry: a 503 burst at
+        submit time delays pod creation instead of dropping it."""
+        import tests.test_k8s as tk
+        from tf_operator_tpu.core.trainjob_controller import TrainJobController
+
+        cluster = _cluster(server)
+        cluster.start()
+        assert cluster.wait_synced(10)
+        ctl = TrainJobController(cluster, enable_gang=False)
+        ctl.run(workers=1)
+        try:
+            server.inject_faults(count=3, code=503, match="POST")
+            cluster.create_job(tk._mk_job("flaky", workers=1))
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                pods = cluster.list_pods("default",
+                                         selector={"job-name": "flaky"})
+                if len(pods) == 1:
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail("pod never created through the 503 burst")
+        finally:
+            ctl.stop()
+            cluster.stop()

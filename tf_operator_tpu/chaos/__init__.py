@@ -1,0 +1,164 @@
+"""Fault injection for the full stack, driven by one deterministic spec.
+
+The reference's robustness story was tested with a controllable fake
+workload (`/exit?exitCode=N`); real TPU fleets fail in richer ways —
+preemption signals mid-step, torn checkpoint writes, flaky apiservers,
+stalled host->device links. This package turns each of those into a
+declarative, reproducible fault (spec grammar in `chaos/spec.py`):
+
+    TPUJOB_CHAOS="kill:step=12,signal=TERM"            # preempt at step 12
+    TPUJOB_CHAOS="torn:step=8;kill:step=8,signal=KILL" # tear then die
+    TPUJOB_CHAOS="stall:every=3,delay=0.2"             # slow transfer link
+    TPUJOB_CHAOS="apiserver:errors=2,code=503"         # flaky control plane
+
+Injection points (each a one-line hook at the subsystem's natural
+boundary, zero-cost when TPUJOB_CHAOS is unset):
+
+  * trainer step boundary       models/train.py  -> TrainerChaos.maybe_kill
+  * checkpoint write            models/train.py  -> tear_checkpoint
+  * staging-ring transfer leg   data/staging.py  -> staging_stall_delay
+  * apiserver request handling  testing/fake_apiserver.py inject_faults
+    (the fake reads `apiserver:` directives; core/k8s.py's bounded
+    jittered retry is what the injected 5xx/409s exercise)
+
+tests/test_chaos.py drives the capstone: chaos SIGTERMs a trainer
+mid-run, the operator's EXIT_CODE policy restarts the pod, and the
+resumed run finishes at the exact final step on the uninterrupted loss
+trajectory.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tf_operator_tpu.chaos.spec import (
+    ENV_CHAOS,
+    ENV_CHAOS_STATE,
+    Directive,
+    OneShotState,
+    from_env,
+    parse_chaos,
+    parse_signal,
+)
+
+__all__ = [
+    "ENV_CHAOS", "ENV_CHAOS_STATE", "Directive", "OneShotState",
+    "from_env", "parse_chaos", "parse_signal",
+    "TrainerChaos", "tear_checkpoint", "staging_stalls_from_env",
+    "staging_stall_delay", "apiserver_directives",
+]
+
+
+class TrainerChaos:
+    """Trainer-side directives (kill / torn), evaluated at step boundaries.
+
+    Kill semantics without a one-shot state dir: fire when this process
+    both STARTED before the target step and has now completed it
+    (start_step < step <= done) — a run resumed at/past the kill step
+    never re-fires, which is exactly the preempt->restart->resume e2e
+    shape. With TPUJOB_CHAOS_STATE set, fired directives drop markers and
+    the start_step guard is unnecessary (multi-kill scripts work)."""
+
+    def __init__(self, directives: list[Directive],
+                 state: OneShotState | None = None):
+        self.kills = [d for d in directives if d.kind == "kill"]
+        self.tears = [d for d in directives if d.kind == "torn"]
+        self.state = state or OneShotState()
+
+    @classmethod
+    def from_env(cls, env: dict | None = None) -> "TrainerChaos | None":
+        """None when TPUJOB_CHAOS is unset/empty — the no-chaos fast path
+        (one dict lookup; no object, no per-step work)."""
+        directives = from_env(env)
+        if not any(d.kind in ("kill", "torn") for d in directives):
+            return None
+        return cls(directives, OneShotState.from_env(env))
+
+    def maybe_kill(self, done: int, start_step: int) -> None:
+        """Deliver the configured signal to THIS process once step
+        `done` >= the directive's step. Called after a step/chunk
+        completes; a caught signal (TERM/INT/USR1 under the preemption
+        guard) returns here and the caller's boundary check handles it —
+        an uncaught one (KILL) never returns."""
+        for d in self.kills:
+            step = d.params["step"]
+            if done < step or self.state.fired(d):
+                continue
+            if not self.state.state_dir and start_step >= step:
+                continue  # resumed past the kill point: never re-fire
+            self.state.mark(d)
+            os.kill(os.getpid(), parse_signal(d.params.get("signal", "TERM")))
+            return
+
+    def tear_for_step(self, step: int) -> Directive | None:
+        """The torn-checkpoint directive for `step`, if any unfired."""
+        for d in self.tears:
+            if d.params["step"] == step and not self.state.fired(d):
+                return d
+        return None
+
+
+def tear_checkpoint(ckpt_dir: str, step: int, mode: str = "truncate") -> str:
+    """Corrupt the finished checkpoint for `step` the way real storage
+    failures do: `truncate` halves the largest file (a torn write the
+    manifest's size census catches); `unlink` removes a leaf (a lost
+    object / missing directory). Returns the damaged path."""
+    import shutil
+
+    root = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+    if not os.path.isdir(root):
+        raise FileNotFoundError(root)
+    files: list[tuple[int, str]] = []
+    subdirs: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        for d in dirnames:
+            subdirs.append(os.path.join(dirpath, d))
+        for f in filenames:
+            p = os.path.join(dirpath, f)
+            files.append((os.path.getsize(p), p))
+    if mode == "unlink":
+        if subdirs:
+            target = sorted(subdirs)[0]
+            shutil.rmtree(target)
+            return target
+        if files:
+            target = max(files)[1]
+            os.unlink(target)
+            return target
+        raise FileNotFoundError(f"nothing to unlink under {root}")
+    # truncate (default): the largest file torn to half its bytes.
+    if not files:
+        raise FileNotFoundError(f"nothing to truncate under {root}")
+    size, target = max(files)
+    with open(target, "r+b") as f:
+        f.truncate(size // 2)
+    return target
+
+
+def staging_stalls_from_env(env: dict | None = None) -> list[Directive]:
+    """`stall:` directives for data/staging.py's transfer thread; [] on
+    the (overwhelmingly common) no-chaos path."""
+    e = os.environ if env is None else env
+    if not e.get(ENV_CHAOS):
+        return []
+    return [d for d in from_env(e) if d.kind == "stall"]
+
+
+def staging_stall_delay(index: int, stalls: list[Directive]) -> float:
+    """Total injected sleep for staged batch `index` (0-based)."""
+    total = 0.0
+    for d in stalls:
+        if "batch" in d.params:
+            if index == d.params["batch"]:
+                total += d.params["delay"]
+        elif index % d.params["every"] == 0:
+            total += d.params["delay"]
+    return total
+
+
+def apiserver_directives(env: dict | None = None) -> list[Directive]:
+    """`apiserver:` directives (the fake apiserver's inject_faults feed)."""
+    e = os.environ if env is None else env
+    if not e.get(ENV_CHAOS):
+        return []
+    return [d for d in from_env(e) if d.kind == "apiserver"]

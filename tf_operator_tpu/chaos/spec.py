@@ -1,0 +1,205 @@
+"""Deterministic fault-spec parsing for the chaos-injection subsystem.
+
+One string — the `TPUJOB_CHAOS` env var or the trainer's `--chaos` flag —
+declares every fault a run should suffer, so a failure scenario is
+reproducible from the job spec alone (the same philosophy as the fake
+workload's `/exit?exitCode=N` hook, scaled to the whole stack).
+
+Grammar (whitespace-insensitive):
+
+    spec       := directive (";" directive)*
+    directive  := kind (":" kv ("," kv)*)?
+    kv         := key "=" value
+
+Directive kinds and their keys (all integers/floats unless noted):
+
+    kill       step=N signal=NAME     SIGTERM the trainer once it completes
+                                      step N (signal: TERM/INT/USR1/KILL/
+                                      SEGV..., bare name, SIG-prefixed, or
+                                      a number). Without a one-shot state
+                                      dir the directive only fires in a
+                                      process that STARTED before step N,
+                                      so a resumed run past N never
+                                      re-fires.
+    torn       step=N mode=truncate   corrupt the just-written checkpoint
+                    |unlink           for step N (truncate the largest
+                                      file to half, or unlink a leaf) —
+                                      the resume-fallback scenario.
+    stall      delay=S batch=N        sleep S seconds in the staging
+                    | every=K         ring's transfer leg for batch N
+                                      (or every Kth batch).
+    apiserver  errors=N code=C        the fake apiserver fails the next N
+               latency=S match=SUB    matched requests with HTTP C
+                                      (code=0: latency only), sleeping S
+                                      first; match is a substring of
+                                      "METHOD /path".
+
+One-shot semantics across restarts: when `TPUJOB_CHAOS_STATE` names a
+directory, each fired directive drops a marker file there and never fires
+again — `kill:step=5;kill:step=12` then kills a job exactly twice across
+three process generations.
+
+Parsing is strict (unknown kinds/keys and malformed values raise
+ValueError with the offending token) so a typo'd fault spec fails the run
+immediately instead of silently injecting nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import signal as _signal
+from dataclasses import dataclass, field
+
+ENV_CHAOS = "TPUJOB_CHAOS"
+ENV_CHAOS_STATE = "TPUJOB_CHAOS_STATE"
+
+KINDS = ("kill", "torn", "stall", "apiserver")
+
+_KEYS: dict[str, dict[str, type]] = {
+    "kill": {"step": int, "signal": str},
+    "torn": {"step": int, "mode": str},
+    "stall": {"delay": float, "batch": int, "every": int},
+    "apiserver": {"errors": int, "code": int, "latency": float,
+                  "match": str},
+}
+
+TORN_MODES = ("truncate", "unlink")
+
+
+def parse_signal(name: str) -> int:
+    """'TERM' / 'SIGTERM' / '15' -> 15. Raises ValueError on unknowns."""
+    s = name.strip().upper()
+    if s.isdigit():
+        return int(s)
+    if not s.startswith("SIG"):
+        s = "SIG" + s
+    try:
+        return int(getattr(_signal, s))
+    except AttributeError:
+        raise ValueError(f"unknown signal {name!r}") from None
+
+
+@dataclass(frozen=True)
+class Directive:
+    kind: str
+    params: dict = field(default_factory=dict)
+
+    @property
+    def id(self) -> str:
+        """Stable identity for one-shot markers: kind plus its sorted
+        params ('kill.signal=TERM.step=5')."""
+        parts = [self.kind] + [
+            f"{k}={self.params[k]}" for k in sorted(self.params)
+        ]
+        return ".".join(parts)
+
+
+def parse_chaos(text: str) -> list[Directive]:
+    """Parse a chaos spec string; [] for empty/blank input."""
+    out: list[Directive] = []
+    for raw in (text or "").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        kind, _, rest = raw.partition(":")
+        kind = kind.strip()
+        if kind not in KINDS:
+            raise ValueError(
+                f"chaos: unknown directive kind {kind!r} (not in {KINDS})"
+            )
+        params: dict = {}
+        for kv in rest.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            key, sep, value = kv.partition("=")
+            key = key.strip()
+            if not sep:
+                raise ValueError(f"chaos: {kind}: expected key=value, got {kv!r}")
+            typ = _KEYS[kind].get(key)
+            if typ is None:
+                raise ValueError(
+                    f"chaos: {kind}: unknown key {key!r} "
+                    f"(valid: {sorted(_KEYS[kind])})"
+                )
+            try:
+                params[key] = typ(value.strip())
+            except ValueError:
+                raise ValueError(
+                    f"chaos: {kind}: {key}={value.strip()!r} is not a "
+                    f"valid {typ.__name__}"
+                ) from None
+        _validate(kind, params)
+        out.append(Directive(kind, params))
+    return out
+
+
+def _validate(kind: str, params: dict) -> None:
+    if kind == "kill":
+        if "step" not in params:
+            raise ValueError("chaos: kill requires step=N")
+        parse_signal(params.get("signal", "TERM"))  # fail fast on typos
+    elif kind == "torn":
+        if "step" not in params:
+            raise ValueError("chaos: torn requires step=N")
+        mode = params.get("mode", "truncate")
+        if mode not in TORN_MODES:
+            raise ValueError(
+                f"chaos: torn: mode {mode!r} not in {TORN_MODES}"
+            )
+    elif kind == "stall":
+        if "delay" not in params or params["delay"] < 0:
+            raise ValueError("chaos: stall requires delay=SECONDS >= 0")
+        if ("batch" in params) == ("every" in params):
+            raise ValueError(
+                "chaos: stall takes exactly one of batch=N or every=K"
+            )
+        if params.get("every", 1) < 1:
+            raise ValueError("chaos: stall: every must be >= 1")
+    elif kind == "apiserver":
+        if params.get("errors", 1) < 0:
+            raise ValueError("chaos: apiserver: errors must be >= 0")
+        if params.get("latency", 0.0) < 0:
+            raise ValueError("chaos: apiserver: latency must be >= 0")
+
+
+def from_env(env: dict | None = None) -> list[Directive]:
+    """Directives from TPUJOB_CHAOS; [] when unset. Strict parse: a bad
+    spec raises rather than running the job un-faulted."""
+    e = os.environ if env is None else env
+    return parse_chaos(e.get(ENV_CHAOS, ""))
+
+
+class OneShotState:
+    """Marker-file store making directives fire once across process
+    restarts (TPUJOB_CHAOS_STATE). Without a configured directory, fired()
+    is process-local memory — each new process starts fresh."""
+
+    def __init__(self, state_dir: str | None = None):
+        self.state_dir = state_dir
+        self._fired: set[str] = set()
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+
+    @classmethod
+    def from_env(cls, env: dict | None = None) -> "OneShotState":
+        e = os.environ if env is None else env
+        return cls(e.get(ENV_CHAOS_STATE) or None)
+
+    def _path(self, directive_id: str) -> str:
+        # Marker names must be filesystem-safe; directive ids are
+        # [a-z0-9.=_-] by construction (kind + key=value tokens).
+        return os.path.join(self.state_dir or "", directive_id + ".fired")
+
+    def fired(self, directive: Directive) -> bool:
+        if directive.id in self._fired:
+            return True
+        return bool(self.state_dir) and os.path.exists(
+            self._path(directive.id)
+        )
+
+    def mark(self, directive: Directive) -> None:
+        self._fired.add(directive.id)
+        if self.state_dir:
+            with open(self._path(directive.id), "w") as f:
+                f.write("1")

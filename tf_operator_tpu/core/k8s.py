@@ -23,6 +23,7 @@ Tier-2 wire-protocol tests without a cluster.
 
 from __future__ import annotations
 
+import http.client
 import json
 import ssl
 import threading
@@ -451,7 +452,16 @@ class K8sApi:
 
     qps/burst (reference: options.go:40-46, client-go DefaultQPS=5 /
     DefaultBurst=10) apply a client-side token-bucket throttle to every
-    request, watches included; qps <= 0 disables throttling."""
+    request, watches included; qps <= 0 disables throttling.
+
+    Transient failures retry with capped jittered exponential backoff
+    (client-go's retry.OnError shape): 409 Conflict (not AlreadyExists —
+    that one is a semantic answer), 5xx, and network/timeout errors, on
+    unary requests only (watch streams have the informer's own recovery
+    loop). `retries` bounds the EXTRA attempts; 0 disables. A real
+    apiserver behind a flapping LB turns every controller write into a
+    coin flip without this; with it, a burst of 503s costs milliseconds
+    instead of a dropped status transition."""
 
     def __init__(
         self,
@@ -462,10 +472,16 @@ class K8sApi:
         timeout: float = 30.0,
         qps: float = 0.0,
         burst: int = 10,
+        retries: int = 3,
+        retry_base: float = 0.05,
+        retry_cap: float = 1.0,
     ):
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
+        self.retries = max(0, int(retries))
+        self.retry_base = retry_base
+        self.retry_cap = retry_cap
         self._limiter = _TokenBucket(qps, burst) if qps > 0 else None
         if base_url.startswith("https"):
             if insecure:
@@ -519,20 +535,70 @@ class K8sApi:
         reason = payload.get("reason", "")
         msg = payload.get("message", str(e))
         if e.code == 404:
-            return NotFoundError(msg)
-        if e.code == 409:
+            err: ApiError = NotFoundError(msg)
+        elif e.code == 409:
             if reason == "AlreadyExists":
-                return AlreadyExistsError(msg)
-            return ConflictError(msg)
-        if e.code == 410:
-            return GoneError(msg)
-        return ApiError(f"HTTP {e.code}: {msg}")
+                err = AlreadyExistsError(msg)
+            else:
+                err = ConflictError(msg)
+        elif e.code == 410:
+            err = GoneError(msg)
+        else:
+            err = ApiError(f"HTTP {e.code}: {msg}")
+        err.code = e.code  # retry classification reads the raw status
+        return err
+
+    @staticmethod
+    def _retryable(err: Exception) -> bool:
+        """Transient per client-go's shouldRetry: raw 409 write contention
+        (a re-read-and-retry upstream still benefits from the wait) and
+        any 5xx. AlreadyExists/404/410 are semantic answers, never retried
+        (410 drives the informer's relist protocol)."""
+        if isinstance(err, (AlreadyExistsError, NotFoundError, GoneError)):
+            return False
+        if isinstance(err, ConflictError):
+            return True
+        code = getattr(err, "code", None)
+        return code is not None and 500 <= code <= 599
+
+    def _retry_sleep(self, attempt: int) -> None:
+        import random
+
+        delay = min(self.retry_cap, self.retry_base * (2 ** attempt))
+        # Full-ish jitter (0.5x-1x): retries from many controller workers
+        # must not re-converge on the struggling server in lockstep.
+        time.sleep(delay * (0.5 + random.random() * 0.5))
+
+    def _do(self, method: str, path: str, body: dict | None,
+            params: dict | None, timeout: float | None = None,
+            content_type: str = "application/json") -> str:
+        """Open AND read one unary request under the retry policy (the
+        read is inside the loop: a connection dropped mid-body is the same
+        transient as one dropped pre-status)."""
+        attempt = 0
+        while True:
+            try:
+                with self._open(method, path, body, params, timeout=timeout,
+                                content_type=content_type) as r:
+                    return r.read().decode(errors="replace")
+            except ApiError as e:
+                if attempt >= self.retries or not self._retryable(e):
+                    raise
+            except (urllib.error.URLError, TimeoutError, OSError,
+                    http.client.HTTPException):
+                # DNS/conn-reset/timeout — and HTTPException for the
+                # mid-body drops (IncompleteRead is NOT an OSError: a
+                # server closing cleanly before Content-Length bytes
+                # arrive raises it from r.read()).
+                if attempt >= self.retries:
+                    raise
+            self._retry_sleep(attempt)
+            attempt += 1
 
     def request(self, method: str, path: str, body: dict | None = None,
                 params: dict | None = None,
                 timeout: float | None = None) -> dict:
-        with self._open(method, path, body, params, timeout=timeout) as r:
-            text = r.read().decode()
+        text = self._do(method, path, body, params, timeout=timeout)
         return json.loads(text) if text else {}
 
     def merge_patch(self, path: str, patch: dict,
@@ -544,16 +610,14 @@ class K8sApi:
         (controller: job status; kubelet: pod status) never conflict —
         the reason the reference client patches pods
         (pkg/control/pod_control.go:104-126 PatchPod)."""
-        with self._open("PATCH", path, patch, None, timeout=timeout,
-                        content_type="application/merge-patch+json") as r:
-            text = r.read().decode()
+        text = self._do("PATCH", path, patch, None, timeout=timeout,
+                        content_type="application/merge-patch+json")
         return json.loads(text) if text else {}
 
     def request_text(self, method: str, path: str,
                      params: dict | None = None) -> str:
         """Raw-text request for non-JSON subresources (pod logs)."""
-        with self._open(method, path, None, params) as r:
-            return r.read().decode(errors="replace")
+        return self._do(method, path, None, params)
 
     def stream(self, path: str, params: dict | None = None,
                on_response: Callable | None = None):

@@ -52,7 +52,11 @@ from tf_operator_tpu.status import engine as status_engine
 from tf_operator_tpu.status import metrics
 from tf_operator_tpu.utils import naming
 from tf_operator_tpu.utils.env import getenv_int
-from tf_operator_tpu.utils.exit_codes import is_retryable_exit_code
+from tf_operator_tpu.utils.exit_codes import (
+    EXIT_USER_RETRYABLE,
+    is_retryable_exit_code,
+    is_signal_exit,
+)
 
 # Fork TTL defaults (ref job.go:25-26,183-202): a finished job with no
 # explicit TTL is GC'd after 15min ONLY when cleanPodPolicy==All and the job
@@ -518,6 +522,18 @@ class TrainJobController(ctrl.JobControllerBase):
                         "ExitedWithCode",
                         f"Pod {pod.name} exited with code {code}; restarting",
                     )
+                    # Cause-labeled restart accounting: 128+signum means
+                    # the infrastructure killed it (preemption/eviction —
+                    # the trainer's graceful-SIGTERM path exits 143 here),
+                    # EXCEPT 138 (SIGUSR1), which is the app asking for its
+                    # own restart; unknown retryable non-signal codes land
+                    # as exit_code too.
+                    infra = (is_signal_exit(code)
+                             and code != EXIT_USER_RETRYABLE)
+                    metrics.restarts_total.labels(
+                        namespace=job.namespace,
+                        reason="preempt" if infra else "exit_code",
+                    ).inc()
                     # The restart decision stands even if the delete races a
                     # concurrent out-of-band removal: either way the replica
                     # is being replaced, not permanently failed.

@@ -352,6 +352,12 @@ def stage_to_device(
     q: queue.Queue = queue.Queue()
     err: list[BaseException] = []
     stop = threading.Event()
+    # Chaos stall directives (TPUJOB_CHAOS "stall:..."): deterministic
+    # transfer-leg delays for fault-injection tests. Parsed once here; []
+    # (the no-chaos path) costs nothing per batch.
+    from tf_operator_tpu.chaos import staging_stall_delay, staging_stalls_from_env
+
+    stalls = staging_stalls_from_env()
 
     def put_tree(batch):
         if sharding is not None and multiproc:
@@ -364,6 +370,7 @@ def stage_to_device(
         )
 
     def worker():
+        staged_idx = 0
         try:
             while True:
                 # A free ring slot gates the NEXT transfer — this is what
@@ -405,6 +412,13 @@ def stage_to_device(
                 )
                 t1 = time.perf_counter()
                 with telemetry.span("staging/h2d_transfer", **_attrs):
+                    if stalls:
+                        # Injected link stall: charged to transfer_s like
+                        # the real slow-wire failure it simulates.
+                        delay = staging_stall_delay(staged_idx, stalls)
+                        if delay > 0:
+                            time.sleep(delay)
+                    staged_idx += 1
                     dev = put_tree(batch)
                     # Block on transfer completion: the slot must be
                     # resident before the consumer can see it, and

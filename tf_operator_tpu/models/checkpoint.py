@@ -26,8 +26,10 @@ params-only resume. Both behaviors are pinned by tests/test_optimizer.py.
 
 from __future__ import annotations
 
+import json
 import os
 import re
+import shutil
 import time
 from typing import Any
 
@@ -35,11 +37,79 @@ from tf_operator_tpu import telemetry
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
+# Sibling manifest, written AFTER the orbax save completes: a file census
+# ({relative path: byte size}) of the finished checkpoint. Its presence
+# means "the save ran to completion"; a size/membership mismatch means a
+# torn write (truncated metadata, lost leaf dir) — the resume walk skips
+# such steps instead of crash-looping on them. It lives BESIDE the orbax
+# dir (never inside: orbax owns that layout), and the name can't collide
+# with list_steps' `^step_<N>$` directory match.
+MANIFEST_SUFFIX = ".manifest.json"
+
 
 def _checkpointer():
     import orbax.checkpoint as ocp
 
     return ocp.PyTreeCheckpointer()
+
+
+def _manifest_path(ckpt_dir: str, name: str) -> str:
+    return os.path.join(os.path.abspath(ckpt_dir), name + MANIFEST_SUFFIX)
+
+
+def _file_census(root: str) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for dirpath, _, filenames in os.walk(root):
+        for f in filenames:
+            p = os.path.join(dirpath, f)
+            out[os.path.relpath(p, root)] = os.path.getsize(p)
+    return out
+
+
+def write_manifest(ckpt_dir: str, name: str) -> str:
+    """Census the finished checkpoint <dir>/<name> into its manifest
+    (tmp+rename, so a half-written manifest never validates)."""
+    root = os.path.join(os.path.abspath(ckpt_dir), name)
+    census = _file_census(root)
+    path = _manifest_path(ckpt_dir, name)
+    tmp = f"{path}.tmp{os.getpid()}"  # unique per writer: replace is atomic
+    with open(tmp, "w") as f:
+        json.dump({"name": name, "files": census,
+                   "total_bytes": sum(census.values())}, f)
+    os.replace(tmp, path)
+    return path
+
+
+def validate_named(ckpt_dir: str, name: str) -> bool:
+    """Is <dir>/<name> a complete checkpoint? With a manifest: every
+    censused file must exist at its recorded size (a torn/truncated or
+    missing file fails). Without one (legacy/external checkpoints that
+    predate manifests): optimistically True — the resume walk's
+    restore-with-fallback still catches an unreadable tree."""
+    root = os.path.join(os.path.abspath(ckpt_dir), name)
+    if not os.path.isdir(root):
+        return False
+    mpath = _manifest_path(ckpt_dir, name)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except FileNotFoundError:
+        return True  # pre-manifest checkpoint: unverifiable, not invalid
+    except (OSError, ValueError, KeyError, TypeError):
+        return False  # torn manifest: the save did not finish cleanly
+    for rel, size in files.items():
+        p = os.path.join(root, rel)
+        try:
+            if os.path.getsize(p) != int(size):
+                return False
+        except OSError:
+            return False
+    return True
+
+
+def validate_step(ckpt_dir: str, step: int) -> bool:
+    return validate_named(ckpt_dir, f"step_{step}")
 
 
 def save_named(ckpt_dir: str, name: str, tree: Any) -> str:
@@ -49,6 +119,13 @@ def save_named(ckpt_dir: str, name: str, tree: Any) -> str:
     # that blocked the step loop visible on the --trace timeline.
     with telemetry.span("checkpoint/save", ckpt=name):
         _checkpointer().save(path, tree, force=True)
+        # Manifest from process 0 only (orbax writes from process 0 too;
+        # per-writer tmp names keep even a misconfigured double-writer
+        # safe, since os.replace is atomic).
+        import jax
+
+        if jax.process_index() == 0:
+            write_manifest(ckpt_dir, name)
     return path
 
 
@@ -138,13 +215,68 @@ def final_step(ckpt_dir: str) -> int | None:
         return None
 
 
+def prune_checkpoints(ckpt_dir: str, keep: int) -> list[int]:
+    """Retention: delete all but the newest `keep` step checkpoints
+    (each step's params dir, its trainstate aux dir, and both manifests).
+    Returns the pruned step numbers. keep < 1 keeps everything — the
+    historical unbounded behavior stays opt-in-able."""
+    if keep < 1:
+        return []
+    steps = list_steps(ckpt_dir)
+    pruned: list[int] = []
+    root = os.path.abspath(ckpt_dir)
+    for s in steps[:-keep]:
+        for name in (f"step_{s}", f"trainstate_{s}"):
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+            try:
+                os.unlink(_manifest_path(ckpt_dir, name))
+            except OSError:
+                pass
+        pruned.append(s)
+    return pruned
+
+
+def sweep_tmp_dirs(ckpt_dir: str) -> list[str]:
+    """Startup sweep of write leftovers a kill can strand: orbax's
+    `*.orbax-checkpoint-tmp-*` staging dirs (a preempted save that never
+    reached its rename), our manifest `.tmp*` files, and `.FINAL.tmp`.
+    Never touches finished checkpoints (final names carry none of these
+    markers). Returns the removed entry names."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    removed: list[str] = []
+    for name in os.listdir(ckpt_dir):
+        path = os.path.join(ckpt_dir, name)
+        is_tmp = (
+            ".orbax-checkpoint-tmp" in name
+            or name == ".FINAL.tmp"
+            or (MANIFEST_SUFFIX + ".tmp") in name
+        )
+        if not is_tmp:
+            continue
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            else:
+                os.unlink(path)
+            removed.append(name)
+        except OSError:
+            continue  # best-effort: a sweep must never fail a startup
+    return removed
+
+
 def wait_for_new_step(
-    ckpt_dir: str, seen: set[int], timeout: float, poll: float = 0.2
+    ckpt_dir: str, seen: set[int], timeout: float, poll: float = 0.2,
+    should_stop=None,
 ) -> int | None:
-    """Block until a checkpoint not in `seen` appears; None on timeout or when
-    the FINAL marker is set and every step has been consumed."""
+    """Block until a checkpoint not in `seen` appears; None on timeout,
+    when the FINAL marker is set and every step has been consumed, or when
+    `should_stop()` turns true (the evaluator's preemption latch — a
+    SIGTERM must not sit out the full eval timeout)."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
+        if should_stop is not None and should_stop():
+            return None
         for s in list_steps(ckpt_dir):
             if s not in seen:
                 return s

@@ -121,34 +121,70 @@ def _aux_tree(state) -> dict:
     return tree
 
 
-def _save_checkpoint(ckpt_dir: str, step: int, state, final: bool = False) -> None:
+# Trainer-side chaos directives (kill-at-step / torn-checkpoint), set once
+# per main() from TPUJOB_CHAOS / --chaos; None — the default — costs one
+# `is None` check per boundary.
+_chaos = None
+
+
+def _save_checkpoint(ckpt_dir: str, step: int, state, final: bool = False,
+                     keep: int = 0) -> float:
     """step_<N> holds params ONLY (the evaluator/external contract — cheap
     to restore, format-compatible with hand-written checkpoints);
     trainstate_<N> holds the resume payload. The aux dir is written first
-    so any visible step_<N> has its trainstate beside it."""
+    so any visible step_<N> has its trainstate beside it. Returns the
+    save's wall-clock seconds — the preemption guard's estimate of what an
+    emergency save will cost against the grace budget."""
     import jax
 
     from tf_operator_tpu.models import checkpoint as ckpt
 
+    t0 = time.monotonic()
     ckpt.save_named(ckpt_dir, f"trainstate_{step}", jax.device_get(_aux_tree(state)))
     path = ckpt.save(ckpt_dir, step, jax.device_get(state.params))
-    # orbax coordinates the collective save, but mark_final/_emit are plain
-    # file IO: one writer only, or concurrent os.replace of the shared
-    # .FINAL.tmp races (loser raises, failing a finished job).
+    # orbax coordinates the collective save, but mark_final/_emit/prune are
+    # plain file IO: one writer only, or concurrent os.replace of the
+    # shared .FINAL.tmp races (loser raises, failing a finished job).
     if jax.process_index() == 0:
         if final:
             ckpt.mark_final(ckpt_dir, step)
         _emit({"event": "checkpoint", "step": step, "path": path, "final": final})
+        if keep:
+            pruned = ckpt.prune_checkpoints(ckpt_dir, keep)
+            if pruned:
+                _emit({"event": "checkpoint_pruned", "steps": pruned,
+                       "keep": keep})
+        if _chaos is not None:
+            torn = _chaos.tear_for_step(step)
+            if torn is not None:
+                from tf_operator_tpu import chaos as chaos_lib
+
+                _chaos.state.mark(torn)
+                damaged = chaos_lib.tear_checkpoint(
+                    ckpt_dir, step, torn.params.get("mode", "truncate")
+                )
+                _emit({"event": "chaos_torn_checkpoint", "step": step,
+                       "path": damaged})
+    return time.monotonic() - t0
 
 
 def _try_resume(ckpt_dir: str | None, state, tx):
-    """Restore the latest checkpoint, if any. Returns (state, start_step).
+    """Restore the newest RESTORABLE checkpoint, if any. Returns
+    (state, start_step).
     The reference's contract was 'stable pod identity + restart semantics so
     TF can resume from its own checkpoints' (SURVEY.md §5); here the trainer
     itself resumes, so a pod restarted by the operator's restart policy
     continues the trajectory instead of starting over. A step_<N> without a
     trainstate_<N> (external/hand-written checkpoint) resumes params-only
     with a fresh optimizer.
+
+    Torn-checkpoint hardening (the preemption scenario's second half): the
+    walk goes BACKWARD through list_steps past steps whose manifest census
+    fails (checkpoint.validate_step) or whose restore raises — each skip
+    emits a `resume_fallback` event — so one corrupt latest checkpoint
+    costs the steps since the previous valid one instead of turning a
+    retryable failure into a permanent crash-loop. All-corrupt (and
+    fresh-dir) degrade to a step-0 cold start with a warning.
 
     Mixed-precision state restores at each slab's CONFIGURED dtype (orbax
     casts to the restore template, so a legacy all-f32 trainstate also loads
@@ -167,14 +203,36 @@ def _try_resume(ckpt_dir: str | None, state, tx):
 
     if not ckpt_dir:
         return state, 0
-    last = ckpt.latest_step(ckpt_dir)
+    all_steps = ckpt.list_steps(ckpt_dir)
+    ordered = list(reversed(all_steps))  # newest first
+
+    def next_restorable(start_idx: int) -> tuple[int, int | None]:
+        """(index, step) of the first census-valid candidate at/after
+        start_idx. Lazy on purpose: only checkpoints actually walked PAST
+        are validated (and get a resume_fallback event) — a stale torn
+        step older than the chosen candidate costs nothing and emits
+        nothing, and a long-retention dir is never fully os.walk'd inside
+        the restart path."""
+        i = start_idx
+        while i < len(ordered):
+            s = ordered[i]
+            if ckpt.validate_step(ckpt_dir, s):
+                return i, s
+            _emit({"event": "resume_fallback", "skipped_step": s,
+                   "reason": "invalid_checkpoint"})
+            i += 1
+        return len(ordered), None
+
+    idx, last = next_restorable(0)
     if jax.process_count() > 1:
         # Every replica independently reads the checkpoint dir; if visibility
         # differs (non-shared volume, storage lag) the replicas would resume
         # divergent states AND compile different scan unrolls — mismatched
         # collectives hang the job. The agreement collective must run on
         # EVERY process (sentinel -1 = sees nothing) BEFORE any early
-        # return, else the check itself deadlocks.
+        # return, else the check itself deadlocks. (Validation is a
+        # deterministic read of the shared volume, so agreeing on the
+        # chosen candidate subsumes agreeing on latest_step.)
         from jax.experimental import multihost_utils
         import numpy as np
 
@@ -187,23 +245,60 @@ def _try_resume(ckpt_dir: str | None, state, tx):
                 f"shared --checkpoint-dir volume"
             )
     if last is None:  # step_0 is a valid (externally seeded) checkpoint
+        if all_steps:
+            print(
+                f"warning: no restorable checkpoint under {ckpt_dir} "
+                f"(all {len(all_steps)} step dirs failed validation) — "
+                f"cold-starting from step 0",
+                file=sys.stderr,
+            )
+            _emit({"event": "resume_fallback", "to_step": 0,
+                   "reason": "no_valid_checkpoint",
+                   "steps_seen": len(all_steps)})
         return state, 0
     p_template = jax.device_get(
         optim_lib.master_template(tx, jax.device_get(state.params))
     )
-    params = ckpt.restore(ckpt_dir, last, template=p_template)
+    params = None
+    while last is not None:
+        try:
+            params = ckpt.restore(ckpt_dir, last, template=p_template)
+            break
+        except Exception as e:  # noqa: BLE001 — a torn tree raises anything
+            if jax.process_count() > 1:
+                # The replicas agreed on `last` only; silently walking
+                # further here could diverge — fail loud, retry the pod.
+                raise
+            _emit({"event": "resume_fallback", "skipped_step": last,
+                   "reason": f"restore_error: {type(e).__name__}: {e}"})
+            idx, last = next_restorable(idx + 1)
+    if params is None:
+        print(
+            f"warning: every checkpoint under {ckpt_dir} failed to "
+            f"restore — cold-starting from step 0",
+            file=sys.stderr,
+        )
+        _emit({"event": "resume_fallback", "to_step": 0,
+               "reason": "no_valid_checkpoint", "steps_seen": len(all_steps)})
+        return state, 0
     step_arr = jnp.asarray(last, jnp.int32)
     opt_state, model_state, partial = state.opt_state, state.model_state, True
     try:
+        if not ckpt.validate_named(ckpt_dir, f"trainstate_{last}"):
+            # Torn aux payload with an intact params dir: params-only
+            # resume (fresh optimizer) beats walking further back.
+            _emit({"event": "resume_fallback", "skipped_step": last,
+                   "reason": "invalid_trainstate", "params_only": True})
+            raise FileNotFoundError(f"trainstate_{last}")
         aux = ckpt.restore_named(
             ckpt_dir, f"trainstate_{last}", template=jax.device_get(_aux_tree(state))
         )
-    except (FileNotFoundError, ValueError):
+    except Exception:  # noqa: BLE001 — any unreadable aux degrades, below
         # params-only checkpoint (or a trainstate written under a different
         # optimizer layout — orbax raises ValueError on the leaf-list arity
-        # mismatch): fresh optimizer, step from the dir name. Under
-        # master_weights the fresh f32 master must mirror the restored
-        # params, not the session's random init.
+        # mismatch — or torn past its manifest): fresh optimizer, step from
+        # the dir name. Under master_weights the fresh f32 master must
+        # mirror the restored params, not the session's random init.
         if isinstance(tx, optim_lib.MixedPrecisionTransformation) \
                 and tx.config.master_weights:
             opt_state = tx.init(params)
@@ -214,6 +309,26 @@ def _try_resume(ckpt_dir: str | None, state, tx):
         )
         model_state = aux.get("model_state", state.model_state)
         partial = False
+    if jax.process_count() > 1:
+        # The replicas already agreed on the STEP; they must also agree on
+        # full-vs-params-only, or one replica trains with restored Adam
+        # moments while another re-initialized them — shapes match, the
+        # collectives run, and the model silently diverges. Runs on every
+        # process (same rule as the step agreement above).
+        from jax.experimental import multihost_utils
+        import numpy as np
+
+        mine = 1 if partial else 0
+        agreed_partial = int(
+            multihost_utils.broadcast_one_to_all(np.int32(mine))
+        )
+        if agreed_partial != mine:
+            raise RuntimeError(
+                f"trainstate_{last} visibility differs across replicas "
+                f"(this process resumes {'params-only' if mine else 'full'}"
+                f", process 0 {'params-only' if agreed_partial else 'full'})"
+                f" — shared --checkpoint-dir volume lagging; retrying"
+            )
     state = TrainState(
         step=step_arr, params=optim_lib.compute_params(tx, params),
         opt_state=opt_state, model_state=model_state,
@@ -223,7 +338,50 @@ def _try_resume(ckpt_dir: str | None, state, tx):
     return state, start
 
 
-def _run_evaluator(args, model, params_template, make_batch, loss_fn) -> int:
+def _preempt_exit(args, guard, state, done, saver, last_save_s,
+                  last_ckpt_step, st=None) -> int:
+    """Graceful-preemption teardown at a step boundary: write an emergency
+    checkpoint when the grace budget still covers the estimated save cost
+    (skip it when the boundary already has a periodic save), emit the
+    `preempted` event, export any trace, and hand back 128+signum for the
+    operator's EXIT_CODE policy to classify as retryable."""
+    saved = False
+    skipped = None
+    if saver and args.checkpoint_dir:
+        if done == last_ckpt_step:
+            saved = True  # this boundary's periodic save already landed
+        elif guard.within_grace(last_save_s, args.preempt_grace):
+            if st is not None:
+                with st.phase("checkpoint"):
+                    _save_checkpoint(args.checkpoint_dir, done, state,
+                                     keep=args.keep_checkpoints)
+            else:
+                _save_checkpoint(args.checkpoint_dir, done, state,
+                                 keep=args.keep_checkpoints)
+            saved = True
+        else:
+            skipped = "grace_budget"
+    event = {
+        "event": "preempted",
+        "step": done,
+        "signal": guard.signal_name,
+        "exit_code": guard.exit_code,
+        "emergency_checkpoint": saved,
+        "grace_s": args.preempt_grace,
+        "elapsed_s": round(guard.elapsed(), 3),
+    }
+    if skipped:
+        event["save_skipped"] = skipped
+    _emit(event)
+    _maybe_export_trace(args)
+    # No distributed_goodbye: in a real eviction every replica got the
+    # signal; synchronizing a teardown barrier against dying peers would
+    # burn the grace window.
+    return guard.exit_code
+
+
+def _run_evaluator(args, model, params_template, make_batch, loss_fn,
+                   guard) -> int:
     """Evaluator replica: follow the checkpoint stream until FINAL
     (the reference's Evaluator role, excluded from the ClusterSpec)."""
     import jax
@@ -243,8 +401,17 @@ def _run_evaluator(args, model, params_template, make_batch, loss_fn) -> int:
     evaluated = 0
     while True:
         step = ckpt.wait_for_new_step(
-            args.checkpoint_dir, seen, timeout=args.eval_timeout
+            args.checkpoint_dir, seen, timeout=args.eval_timeout,
+            # The guard only LATCHES signals now, so without this check an
+            # evaluator would sit out the whole eval timeout under SIGTERM
+            # and die by the kubelet's SIGKILL instead of exiting cleanly.
+            should_stop=lambda: guard.triggered,
         )
+        if guard.triggered:
+            _emit({"event": "preempted", "role": "evaluator",
+                   "signal": guard.signal_name, "exit_code": guard.exit_code,
+                   "checkpoints_evaluated": evaluated})
+            return guard.exit_code
         if step is None:
             final = ckpt.final_step(args.checkpoint_dir)
             if final is not None and final in seen:
@@ -276,7 +443,7 @@ def _run_evaluator(args, model, params_template, make_batch, loss_fn) -> int:
 
 
 def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
-                      saver, t_start, xla_options=None) -> int:
+                      saver, t_start, guard, xla_options=None) -> int:
     """Real-data loop: host batches from the sharded dataset, staged onto
     the device so the transfer of batch i+K rides under the compute of
     batch i. Each process reads its own shards (shard_from_env) and feeds
@@ -373,6 +540,7 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
     # event carries the per-step distribution, not just the mean.
     t0 = time.time()
     pending = None
+    last_save_s, last_ckpt_step = 0.0, -1
     acct = telemetry.make_step_accounting()
     while done < args.steps:
         _trace_window_check(args, done - start_step - 1)
@@ -393,7 +561,18 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
             if (saver and args.checkpoint_every and done < args.steps
                     and done % args.checkpoint_every == 0):
                 with st.phase("checkpoint"):
-                    _save_checkpoint(args.checkpoint_dir, done, state)
+                    last_save_s = _save_checkpoint(
+                        args.checkpoint_dir, done, state,
+                        keep=args.keep_checkpoints)
+                    last_ckpt_step = done
+            # Step boundary: chaos kill-at-step fires here, and a latched
+            # preemption signal (SIGTERM/SIGINT/SIGUSR1 — real or chaos-
+            # injected) turns into emergency-checkpoint + exit 128+signum.
+            if _chaos is not None:
+                _chaos.maybe_kill(done, start_step)
+            if guard.triggered:
+                return _preempt_exit(args, guard, state, done, saver,
+                                     last_save_s, last_ckpt_step, st)
     if pending is not None:
         # Real window closure: a host transfer (block_until_ready is a
         # no-op through the axon tunnel).
@@ -409,7 +588,8 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
         _emit({"event": "profile_done", "dir": args.profile_dir,
                "steps_traced": args.steps - start_step - 1})
     if saver:
-        _save_checkpoint(args.checkpoint_dir, args.steps, state, final=True)
+        _save_checkpoint(args.checkpoint_dir, args.steps, state, final=True,
+                         keep=args.keep_checkpoints)
     steady = args.steps - start_step - 1
     sps = round(steady / dt, 4) if steady > 0 else None
     from tf_operator_tpu.data.prefetch import overlap_efficiency
@@ -568,6 +748,27 @@ def main(argv: list[str] | None = None) -> int:
                          "Evaluator replica follows them (--eval)")
     ap.add_argument("--checkpoint-every", type=int, default=0,
                     help="save every N steps (default: once at the end)")
+    ap.add_argument("--keep-checkpoints", type=int, default=0,
+                    help="retention: after each save keep only the newest K "
+                         "step checkpoints (params + trainstate + manifests) "
+                         "and prune the rest; 0 (default) keeps everything. "
+                         "Orphaned orbax tmp dirs are swept at startup "
+                         "either way")
+    ap.add_argument("--preempt-grace", type=float, default=30.0,
+                    help="graceful-preemption budget in seconds, measured "
+                         "from SIGTERM/SIGINT/SIGUSR1 receipt (the window "
+                         "before the kubelet's SIGKILL): the trainer "
+                         "finishes the in-flight step and writes an "
+                         "emergency checkpoint only when the estimated "
+                         "save still fits the budget; 0 never attempts "
+                         "the emergency save. Exit is 128+signum either "
+                         "way (143/130/138 — retryable under EXIT_CODE)")
+    ap.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="fault-injection spec (same grammar as "
+                         "TPUJOB_CHAOS, which it overrides): e.g. "
+                         "'kill:step=12,signal=TERM' or "
+                         "'torn:step=8;stall:every=3,delay=0.2' — see "
+                         "docs/robustness.md")
     ap.add_argument("--eval", action="store_true",
                     help="evaluator mode: poll --checkpoint-dir, restore and "
                          "evaluate each new checkpoint until FINAL")
@@ -674,11 +875,66 @@ def main(argv: list[str] | None = None) -> int:
                  "ignored)")
     if args.trace_steps < 0:
         ap.error("--trace-steps must be >= 0")
+    if args.preempt_grace < 0:
+        ap.error("--preempt-grace must be >= 0")
+    if args.keep_checkpoints < 0:
+        ap.error("--keep-checkpoints must be >= 0")
+    if args.keep_checkpoints and not args.checkpoint_dir:
+        ap.error("--keep-checkpoints prunes --checkpoint-dir; without one "
+                 "there is nothing to retain")
+    from tf_operator_tpu import chaos as chaos_lib
+
+    global _chaos
+    chaos_env_prev = os.environ.get(chaos_lib.ENV_CHAOS)
+    try:
+        if args.chaos is not None:
+            # Validate BEFORE mutating the env — a typo'd spec must fail
+            # here without leaking into os.environ. The env write is the
+            # one cross-layer channel (the staging ring and the fake
+            # apiserver read it); main's finally restores it.
+            chaos_lib.parse_chaos(args.chaos)
+            os.environ[chaos_lib.ENV_CHAOS] = args.chaos
+        _chaos = chaos_lib.TrainerChaos.from_env()
+    except ValueError as e:
+        ap.error(str(e))
     if args.trace:
         # Fresh window: clear() also restarts the ts epoch, so in-process
         # re-runs (tests, notebooks) don't leak a prior run's spans into
         # this run's export.
         telemetry.configure(enabled=True).clear()
+
+    # Graceful preemption: handlers latch SIGTERM/SIGINT/SIGUSR1; the train
+    # loops poll at step boundaries. Installed before the (slow) jax import
+    # so a signal during startup is latched rather than fatal, and after
+    # flag validation so ap.error paths never touch process-wide signal
+    # disposition (in-process CLI tests included).
+    from tf_operator_tpu.utils.preemption import PreemptionGuard
+
+    guard = PreemptionGuard()
+    guard.install()
+
+    try:
+        return _run_trainer(args, guard)
+    finally:
+        # In-process-caller hygiene: hand back signal disposition and the
+        # chaos env exactly as we found them, and drop the chaos state, so
+        # a later chaos-free run in the same process stays chaos-free and
+        # the host's Ctrl-C semantics survive this function.
+        guard.uninstall()
+        _chaos = None
+        if args.chaos is not None:
+            if chaos_env_prev is None:
+                os.environ.pop(chaos_lib.ENV_CHAOS, None)
+            else:
+                os.environ[chaos_lib.ENV_CHAOS] = chaos_env_prev
+
+
+
+def _run_trainer(args, guard) -> int:
+    """Everything after flag validation and signal-guard install: device
+    dial, model/optimizer build, resume, and the training loops. Split
+    from main() so its MANY return paths share main's one finally (guard
+    uninstall + chaos-env restore)."""
 
     t_start = time.time()
     _emit({"event": "start", "t": t_start, "model": args.model})
@@ -926,7 +1182,8 @@ def main(argv: list[str] | None = None) -> int:
         template = jax.tree.map(
             lambda s: np.zeros(s.shape, s.dtype), abstract_p
         )
-        rc = _run_evaluator(args, model, template, make_batch, loss_fn)
+        rc = _run_evaluator(args, model, template, make_batch, loss_fn,
+                            guard)
         # The evaluator records eval + checkpoint/restore spans; export
         # them on every exit path (timeout included — rc != 0 traces are
         # the interesting ones).
@@ -943,6 +1200,17 @@ def main(argv: list[str] | None = None) -> int:
     saver = args.checkpoint_dir and (
         _is_checkpoint_writer() or jax.process_count() > 1
     )
+
+    if args.checkpoint_dir and jax.process_index() == 0 \
+            and _is_checkpoint_writer():
+        # A preempt/retry loop strands orbax tmp dirs (a save killed before
+        # its rename) in the shared dir; sweep them before resume so disk
+        # stops leaking one partial checkpoint per kill.
+        from tf_operator_tpu.models import checkpoint as _ckpt_sweep
+
+        swept = _ckpt_sweep.sweep_tmp_dirs(args.checkpoint_dir)
+        if swept:
+            _emit({"event": "checkpoint_tmp_swept", "entries": swept})
 
     from tf_operator_tpu import optim as optim_lib
 
@@ -997,7 +1265,7 @@ def main(argv: list[str] | None = None) -> int:
         xla_options.setdefault("xla_tpu_scoped_vmem_limit_kib", "49152")
     if args.data_dir:
         return _train_on_dataset(args, state, start_step, loss_fn, tx, mesh,
-                                 rules, saver, t_start,
+                                 rules, saver, t_start, guard,
                                  xla_options=xla_options or None)
 
     compile_scanned = make_scanned_train_step(
@@ -1022,9 +1290,10 @@ def main(argv: list[str] | None = None) -> int:
         chunk = max(1, math.gcd(chunk, args.checkpoint_every))
     step_chunk = compile_scanned(state, chunk)
     ckpt_marks = (start_step // args.checkpoint_every) if args.checkpoint_every else 0
+    last_save_s, last_ckpt_step = 0.0, -1
 
     def maybe_checkpoint(done: int, st=None) -> None:
-        nonlocal ckpt_marks
+        nonlocal ckpt_marks, last_save_s, last_ckpt_step
         if not (saver and args.checkpoint_every) or done >= args.steps:
             return  # the final save (marked FINAL) happens after the loop
         marks = done // args.checkpoint_every
@@ -1035,9 +1304,24 @@ def main(argv: list[str] | None = None) -> int:
                 # no-op calls too would report a nonzero checkpoint phase
                 # for runs that never saved in the window.
                 with st.phase("checkpoint"):
-                    _save_checkpoint(args.checkpoint_dir, done, state)
+                    last_save_s = _save_checkpoint(
+                        args.checkpoint_dir, done, state,
+                        keep=args.keep_checkpoints)
             else:
-                _save_checkpoint(args.checkpoint_dir, done, state)
+                last_save_s = _save_checkpoint(
+                    args.checkpoint_dir, done, state,
+                    keep=args.keep_checkpoints)
+            last_ckpt_step = done
+
+    def check_boundary(done: int, st=None) -> int | None:
+        """Chaos kill-at-step + preemption handling after a chunk: returns
+        the exit code to leave with, or None to continue training."""
+        if _chaos is not None:
+            _chaos.maybe_kill(done, start_step)
+        if guard.triggered:
+            return _preempt_exit(args, guard, state, done, saver,
+                                 last_save_s, last_ckpt_step, st)
+        return None
 
     state, metrics = step_chunk(state)
     # Host transfer, not block_until_ready (a no-op through the axon
@@ -1059,6 +1343,9 @@ def main(argv: list[str] | None = None) -> int:
         }
     )
     maybe_checkpoint(done)
+    rc = check_boundary(done)
+    if rc is not None:
+        return rc
 
     # Steady-state window: full chunks only (every dispatch reuses the one
     # compiled program). The tail chunk, if any, needs its own compile and
@@ -1104,6 +1391,9 @@ def main(argv: list[str] | None = None) -> int:
                     _emit({"event": "progress", "step": pstep, "loss": ploss})
             pending = (done, metrics)
             maybe_checkpoint(done, st)
+            rc = check_boundary(done, st)
+            if rc is not None:
+                return rc
     if pending is not None:
         # The last chunk's fetch is the REAL window closure —
         # block_until_ready is a no-op through the axon tunnel.
@@ -1133,6 +1423,9 @@ def main(argv: list[str] | None = None) -> int:
         _emit({"event": "profile_done", "dir": args.profile_dir,
                "steps_traced": chunk, "in_timed_window": False})
         maybe_checkpoint(done)
+        rc = check_boundary(done)
+        if rc is not None:
+            return rc
 
     if tail:
         state, metrics = compile_scanned(state, tail)(state)
@@ -1140,7 +1433,8 @@ def main(argv: list[str] | None = None) -> int:
         _emit({"event": "progress", "step": done,
                "loss": float(metrics["loss"])})
     if saver:
-        _save_checkpoint(args.checkpoint_dir, args.steps, state, final=True)
+        _save_checkpoint(args.checkpoint_dir, args.steps, state, final=True,
+                         keep=args.keep_checkpoints)
     # With steps <= one chunk there is no steady-state window (only the
     # compile call ran); report null throughput rather than a
     # microseconds-denominator lie.

@@ -38,6 +38,7 @@ from tf_operator_tpu.core.cluster import (
     Pod,
     PodPhase,
 )
+from tf_operator_tpu.status import metrics as status_metrics
 from tf_operator_tpu.utils.logging import logger_for_pod
 
 
@@ -453,6 +454,12 @@ class LocalProcessRuntime:
             )
             if should_restart:
                 restart_count += 1
+                # The in-place kubelet restart: the kind the controller's
+                # pastBackoffLimit sums (vs EXIT_CODE pod replacement,
+                # counted at the controller with reason preempt/exit_code).
+                status_metrics.restarts_total.labels(
+                    namespace=pod.namespace, reason="backoff"
+                ).inc()
                 self._set_status(pod, PodPhase.RUNNING, code, restart_count)
                 time.sleep(min(0.1 * restart_count, 2.0))
                 # The pod may have been deleted during the backoff sleep —

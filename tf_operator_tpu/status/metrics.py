@@ -302,6 +302,17 @@ jobs_restarted = DEFAULT.counter(
     "Total TrainJobs that entered Restarting (by namespace)",
     labels_only=True,
 )
+# Per-REPLICA restarts by cause — the jobs_restarted condition counter
+# can't distinguish a preempted fleet (normal on TPUs, scale capacity)
+# from a crash-looping image (page someone): reason=preempt (killed by an
+# infrastructure signal: 130/137/143...), exit_code (retryable
+# app-declared code, e.g. 138), backoff (kubelet in-place Always/
+# OnFailure restart, the kind pastBackoffLimit counts).
+restarts_total = DEFAULT.counter(
+    "tpujob_restarts_total",
+    "Replica restarts by cause (reason: preempt | exit_code | backoff)",
+    labels_only=True,
+)
 is_leader = DEFAULT.gauge(
     "tpujob_operator_is_leader", "1 when this operator instance holds leadership"
 )

@@ -31,6 +31,15 @@ Round-5: fieldSelector on lists and watches (`metadata.name=x`,
 subset real apiservers accept, generalized to any dotted path since a test
 double need not replicate the per-resource allowlist).
 
+Round-9 (chaos): transient-fault injection — `inject_faults(count, code,
+match, latency)` fails the next `count` matched requests with HTTP `code`
+(409/500/503/...) after sleeping `latency` seconds (code=0: latency only);
+`match` is a substring of "METHOD /path". Watch streams are exempt (they
+have their own failure modeling via 410/compaction). `apiserver:`
+directives in TPUJOB_CHAOS arm the same hook at construction, so one
+chaos spec drives the control plane and the data plane together. This is
+the surface core/k8s.py's bounded jittered retry is tested against.
+
 Not modeled: auth, json-patch/strategic-merge patch types.
 """
 
@@ -235,6 +244,48 @@ class FakeApiServer:
         # fails TLS verification and admission fails closed.
         webhooks = dict(admission_webhooks or {})
 
+        # Transient-fault injection (chaos): armed via inject_faults() or
+        # `apiserver:` directives in TPUJOB_CHAOS; consulted first by every
+        # non-watch handler.
+        self._faults: list[dict] = []
+        self._faults_lock = threading.Lock()
+
+        def check_fault(method: str, path: str):
+            """(code, message) to fail this request with, or None. The
+            fault's latency is slept either way (code=0 = latency only)."""
+            delay, hit = 0.0, None
+            with self._faults_lock:
+                for f in self._faults:
+                    if f["count"] <= 0:
+                        continue
+                    if f["match"] and f["match"] not in f"{method} {path}":
+                        continue
+                    f["count"] -= 1
+                    delay = f["latency"]
+                    if f["code"]:
+                        hit = (f["code"],
+                               f"chaos-injected fault ({f['code']}) for "
+                               f"{method} {path}")
+                    break
+                self._faults = [f for f in self._faults if f["count"] > 0]
+            if delay > 0:
+                time.sleep(delay)
+            return hit
+
+        self._check_fault = check_fault
+        # One chaos spec drives the whole stack: `apiserver:` directives in
+        # TPUJOB_CHAOS arm the injector at construction (a typo'd spec
+        # raises here rather than running un-faulted).
+        from tf_operator_tpu.chaos import apiserver_directives
+
+        for d in apiserver_directives():
+            self.inject_faults(
+                count=d.params.get("errors", 1),
+                code=d.params.get("code", 500),
+                match=d.params.get("match"),
+                latency=d.params.get("latency", 0.0),
+            )
+
         def call_admission(res: str, operation: str, obj: dict):
             """None if allowed; else (http_code, message): (400, ...) for a
             webhook denial, (500, ...) when the webhook is unreachable —
@@ -311,6 +362,13 @@ class FakeApiServer:
                 m, q = self._parse()
                 if m is None:
                     return self._error(404, "NotFound", self.path)
+                if q.get("watch") != "true":
+                    # Watch streams are exempt: they model their own
+                    # failures (410/compaction) and an injected error would
+                    # race the informer's resume logic nondeterministically.
+                    fault = check_fault("GET", self.path)
+                    if fault:
+                        return self._error(fault[0], "ChaosInjected", fault[1])
                 res, ns, name = m["resource"], m["ns"], m["name"]
                 if res == "pods" and name and m["sub"] == "log":
                     with store.lock:
@@ -508,6 +566,9 @@ class FakeApiServer:
                     return
 
             def do_POST(self):  # noqa: N802
+                fault = check_fault("POST", self.path)
+                if fault:
+                    return self._error(fault[0], "ChaosInjected", fault[1])
                 m, _ = self._parse()
                 if m is None or m["name"]:
                     return self._error(404, "NotFound", self.path)
@@ -548,6 +609,9 @@ class FakeApiServer:
                 return self._send_json(obj, 201)
 
             def do_PUT(self):  # noqa: N802
+                fault = check_fault("PUT", self.path)
+                if fault:
+                    return self._error(fault[0], "ChaosInjected", fault[1])
                 m, _ = self._parse()
                 if m is None or not m["name"]:
                     return self._error(404, "NotFound", self.path)
@@ -616,6 +680,9 @@ class FakeApiServer:
                 precondition unless the patch itself carries one — that is
                 what makes PATCH safe for two writers owning disjoint
                 fields where PUT would 409 (pod_control.go PatchPod)."""
+                fault = check_fault("PATCH", self.path)
+                if fault:
+                    return self._error(fault[0], "ChaosInjected", fault[1])
                 ctype = (self.headers.get("Content-Type") or "").split(";")[0]
                 if ctype != "application/merge-patch+json":
                     return self._error(
@@ -691,6 +758,9 @@ class FakeApiServer:
                 return self._send_json(new)
 
             def do_DELETE(self):  # noqa: N802
+                fault = check_fault("DELETE", self.path)
+                if fault:
+                    return self._error(fault[0], "ChaosInjected", fault[1])
                 m, _ = self._parse()
                 if m is None or not m["name"]:
                     return self._error(404, "NotFound", self.path)
@@ -739,6 +809,29 @@ class FakeApiServer:
         self.stop()
 
     # ------------------------------------------------------- test conveniences
+
+    def inject_faults(self, count: int = 1, code: int = 500,
+                      match: str | None = None, latency: float = 0.0) -> None:
+        """Arm transient-fault injection: the next `count` requests whose
+        "METHOD /path" contains `match` (None = every request) sleep
+        `latency` seconds, then fail with HTTP `code` — the conformance
+        shape of a flaky/overloaded apiserver (503 storms, LB resets
+        surfacing as 5xx, write contention as 409). code=0 injects the
+        latency only. Watch streams are exempt. Entries drain as they
+        fire; arming is cumulative."""
+        if count < 0 or latency < 0:
+            raise ValueError("inject_faults: count and latency must be >= 0")
+        with self._faults_lock:
+            self._faults.append({
+                "count": int(count), "code": int(code),
+                "match": match or "", "latency": float(latency),
+            })
+
+    def pending_faults(self) -> int:
+        """Injected faults not yet consumed (a retry test's exhaustion
+        assertion)."""
+        with self._faults_lock:
+            return sum(f["count"] for f in self._faults)
 
     def get_object(self, resource: str, namespace: str, name: str) -> dict | None:
         with self.store.lock:

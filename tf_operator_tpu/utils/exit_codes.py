@@ -18,6 +18,11 @@ from __future__ import annotations
 RETRYABLE_EXIT_CODES = frozenset({130, 137, 138, 143})
 PERMANENT_EXIT_CODES = frozenset({1, 2, 126, 127, 128, 139})
 
+# 128+SIGUSR1: the workload ASKING for its own restart — numerically in the
+# signal range but semantically an app-declared retryable, not an
+# infrastructure kill (restart metrics label it exit_code, not preempt).
+EXIT_USER_RETRYABLE = 138
+
 
 def is_retryable_exit_code(exit_code: int) -> bool:
     if exit_code in RETRYABLE_EXIT_CODES:

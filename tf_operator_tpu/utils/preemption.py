@@ -1,0 +1,115 @@
+"""Graceful-preemption signal handling for the trainer.
+
+The dominant real-world failure on TPU fleets is preemption/eviction:
+the kubelet (or the cloud provider) delivers SIGTERM and SIGKILLs after
+the pod's grace period. Without a handler, SIGTERM kills the trainer
+mid-step and every step since the last periodic checkpoint is lost; with
+this guard, the signal only sets a flag, the trainer finishes the
+in-flight step at the next boundary, writes an emergency checkpoint if
+the grace budget allows, emits a `preempted` event, and exits 128+signum
+— exactly the exit codes utils/exit_codes.py classifies as retryable, so
+the operator's EXIT_CODE restart policy brings the pod back and
+auto-resume continues from the emergency checkpoint.
+
+Signals handled:
+
+    SIGTERM -> exit 143   infrastructure preemption/eviction (retryable)
+    SIGINT  -> exit 130   operator/ctrl-C interruption       (retryable)
+    SIGUSR1 -> exit 138   user-declared retryable restart request
+                          (the code exit_codes.py reserves for exactly
+                          this)
+
+Only the FIRST signal is latched (a second SIGTERM during the grace
+window must not re-enter teardown); the handler itself is async-signal
+safe — it records (signum, monotonic time) and returns.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+
+HANDLED_SIGNALS = (signal.SIGTERM, signal.SIGINT, signal.SIGUSR1)
+
+
+class PreemptionGuard:
+    """Latches the first delivery of a handled signal; the training loop
+    polls `triggered` at step boundaries."""
+
+    def __init__(self) -> None:
+        self._signum: int | None = None
+        self._t: float | None = None
+        self._saved: dict[int, object] = {}
+        self.installed = False
+
+    def install(self) -> bool:
+        """Install handlers (main thread only — the interpreter rejects
+        signal.signal elsewhere). Returns False when not installed; the
+        trainer then runs exactly as before this feature existed. The
+        displaced handlers are remembered for uninstall()."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        try:
+            for sig in HANDLED_SIGNALS:
+                self._saved[sig] = signal.signal(sig, self._handler)
+        except (ValueError, OSError):
+            self.uninstall()  # partial install: roll back what landed
+            return False
+        self.installed = True
+        return True
+
+    def uninstall(self) -> None:
+        """Restore the displaced handlers. An in-process caller of the
+        trainer's main() (tests, notebooks) must get its SIGINT semantics
+        back — a stale guard latching Ctrl-C would make the host process
+        uninterruptible."""
+        for sig, h in list(self._saved.items()):
+            try:
+                signal.signal(sig, h)
+            except (ValueError, OSError, TypeError):
+                pass
+            del self._saved[sig]
+        self.installed = False
+
+    def _handler(self, signum, frame) -> None:
+        if self._signum is None:  # latch the first signal only
+            self._signum = signum
+            self._t = time.monotonic()
+
+    @property
+    def triggered(self) -> bool:
+        return self._signum is not None
+
+    @property
+    def signum(self) -> int | None:
+        return self._signum
+
+    @property
+    def signal_name(self) -> str | None:
+        if self._signum is None:
+            return None
+        try:
+            return signal.Signals(self._signum).name
+        except ValueError:
+            return str(self._signum)
+
+    @property
+    def exit_code(self) -> int:
+        """128+signum, the shell convention the operator's exit-code
+        policy classifies (143/130/138 are all retryable)."""
+        return 128 + (self._signum or signal.SIGTERM)
+
+    def elapsed(self) -> float:
+        """Seconds since the latched signal arrived (0.0 if none)."""
+        return 0.0 if self._t is None else time.monotonic() - self._t
+
+    def within_grace(self, est_save_s: float, grace_s: float) -> bool:
+        """Would an emergency save of ~est_save_s still fit the grace
+        budget? The budget is measured from signal receipt (the kubelet
+        SIGKILLs grace_s after SIGTERM, whatever we are doing), so time
+        already burned finishing the in-flight step counts against it.
+        grace_s <= 0 means no budget: never attempt the save."""
+        if grace_s <= 0:
+            return False
+        return self.elapsed() + max(0.0, est_save_s) < grace_s
