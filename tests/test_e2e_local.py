@@ -232,9 +232,14 @@ class TestCleanPodPolicy:
         session.submit(job)
         job = session.wait_for_condition("default", "cleanall", DONE, timeout=30)
         assert is_succeeded(job.status)
+        # Poll pods AND services together: cleanup deletes pods first then
+        # services inside one sync, so a poll that only waits for pods can
+        # land in the microseconds between the two loops under heavy
+        # co-located load and flake on the services assertion.
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline:
-            if not session.cluster.list_pods("default"):
+            if (not session.cluster.list_pods("default")
+                    and not session.cluster.list_services("default")):
                 break
             time.sleep(0.1)
         assert session.cluster.list_pods("default") == []

@@ -476,3 +476,123 @@ GANG_SEEDS = list(range(int(os.environ.get("TPUJOB_FUZZ_GANG_SEEDS", "3"))))
 @pytest.mark.parametrize("seed", GANG_SEEDS)
 def test_gang_fuzz(seed):
     _run_gang_seed(seed)
+
+
+# ---------------------------------------------------------------------------
+# Gang-coherent RECOVERY chaos (round 10): random retryable peer kills under
+# `recovery.policy: gang` — every member failure rolls the whole gang, yet
+# the three invariants must still hold. The interesting new interleavings:
+# a second member failing WHILE the gang restart's deletions are in flight,
+# an operator restart between the restart decision and the recreations, and
+# 410 relists replaying FAILED phases for pods the roll already deleted.
+# ---------------------------------------------------------------------------
+
+
+def _run_gang_recovery_seed(seed: int) -> None:
+    rng = random.Random(seed)
+    name = f"gangrec-{seed}"
+    with FakeApiServer(watch_log_retain=16) as server:
+        op = _Operator(server)
+        op.start()
+        workers = rng.randint(2, 3)
+        job = TrainJob(
+            metadata=ObjectMeta(name=name),
+            spec=TrainJobSpec(replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers,
+                    restart_policy=RestartPolicy.EXIT_CODE,
+                    template=PodTemplateSpec(containers=[ContainerSpec(
+                        name="tensorflow", image="img:1")]),
+                )
+            }),
+        )
+        defaults.set_defaults(job)
+        job.spec.run_policy.scheduling.gang = False
+        job.spec.run_policy.recovery.policy = "gang"
+        allowed = _allowed_pod_names(job)
+        _post_job(server, job)
+
+        violations: list[str] = []
+
+        def check_bounded():
+            _check_bounded(server, name, allowed, violations,
+                           f"gangrec seed {seed}")
+
+        deadline = time.time() + 25
+        for tick in range(rng.randint(12, 24)):
+            if time.time() > deadline:
+                break
+            check_bounded()
+            if _conditions(server, name) & {"Succeeded", "Failed"}:
+                break
+            action = rng.random()
+            pods = _job_pod_names(server, name)
+            try:
+                if action < 0.40 and pods:
+                    # Retryable peer kill — the gang-roll trigger. Only
+                    # retryable codes: convergence must come from gang
+                    # restarts, not from a permanent-failure short-circuit.
+                    p = rng.choice(pods)
+                    server.set_pod_status(
+                        "default", p, "Failed",
+                        exit_code=rng.choice([RETRYABLE_EXIT, 143]))
+                elif action < 0.55 and pods:
+                    p = rng.choice(pods)
+                    server.set_pod_status("default", p, "Running")
+                    if rng.random() < 0.5:
+                        server.set_pod_status("default", p, "Running")
+                elif action < 0.70 and pods:
+                    for _ in range(20):  # 410 storm past retain=16
+                        server.set_pod_status(
+                            "default", rng.choice(pods), "Running")
+                elif action < 0.85:
+                    op.restart()
+            except KeyError:
+                pass  # raced a gang-roll deletion: exactly the point
+            time.sleep(rng.uniform(0.01, 0.12))
+
+        # End game (same no-masking argument as _run_one_seed): drive
+        # every surviving/recreated pod to success until the job converges.
+        end_deadline = time.time() + 60
+        while time.time() < end_deadline:
+            check_bounded()
+            if _conditions(server, name) & {"Succeeded", "Failed"}:
+                break
+            _drive_pods_once(server, name)
+            time.sleep(0.1)
+
+        conds = _conditions(server, name)
+        assert conds & {"Succeeded", "Failed"}, (
+            f"gangrec seed {seed}: no terminal condition (I1); conds={conds}"
+        )
+        assert not violations, violations
+
+        # I3: terminal idempotency across extra syncs + operator restart.
+        def snapshot():
+            pods = sorted(
+                p["metadata"]["name"] for p in server.list_objects("pods")
+                if p["metadata"]["name"].startswith(name + "-")
+            )
+            return pods, _conditions(server, name) & {"Succeeded", "Failed"}
+
+        before = snapshot()
+        assert op.controller is not None
+        op.controller.enqueue(f"default/{name}")
+        time.sleep(0.5)
+        op.restart()
+        time.sleep(1.0)
+        after = snapshot()
+        op.stop()
+        assert before == after, (
+            f"gangrec seed {seed}: terminal state not idempotent (I3): "
+            f"{before} != {after}"
+        )
+
+
+GANG_RECOVERY_SEEDS = list(
+    range(int(os.environ.get("TPUJOB_FUZZ_GANG_RECOVERY_SEEDS", "2"))))
+
+
+@pytest.mark.parametrize("seed", GANG_RECOVERY_SEEDS)
+def test_gang_recovery_fuzz(seed):
+    _run_gang_recovery_seed(seed)

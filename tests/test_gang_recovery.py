@@ -1,0 +1,1149 @@
+"""Gang-coherent failure recovery (ISSUE 5): slice-wide restart +
+progress-heartbeat hang watchdog.
+
+The reference restarts a failed replica alone (pod.go:135-156) — wrong on
+a TPU slice, where the survivors wedge in collectives and a lone
+replacement cannot rejoin the live jax.distributed generation. Units here
+pin the control-plane machinery (RecoveryPolicy defaulting/validation,
+gang restart, consecutive-backoff reset, hang watchdog, stuck-Pending);
+the e2e capstones run REAL 2-process jax.distributed trainers through the
+local runtime: chaos-SIGKILL of worker 1 rolls BOTH pods exactly once and
+the job finishes at the exact final step on the uninterrupted loss
+trajectory; a chaos `hang:` job is detected via heartbeat staleness,
+gang-restarted with restarts_total{reason="hang"}, and completes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tf_operator_tpu import chaos as chaos_lib
+from tf_operator_tpu.api import compat, defaults, validation
+from tf_operator_tpu.api.types import (
+    ContainerSpec,
+    JobConditionType,
+    MeshSpec,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUSpec,
+    TrainJob,
+    TrainJobSpec,
+    is_failed,
+    is_succeeded,
+)
+from tf_operator_tpu.core.cluster import InMemoryCluster, PodPhase
+from tf_operator_tpu.core.trainjob_controller import TrainJobController
+from tf_operator_tpu.runtime.session import LocalSession
+from tf_operator_tpu.status import metrics as status_metrics
+from tf_operator_tpu.utils.preemption import HeartbeatWriter, read_heartbeat
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+PY = sys.executable
+DONE = (JobConditionType.SUCCEEDED, JobConditionType.FAILED)
+
+ONE_DEV = {
+    "PYTHONPATH": REPO_ROOT,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+STEPS = 24
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def make_gang_job(name: str, workers: int = 2, policy: str = "gang",
+                  restart=RestartPolicy.EXIT_CODE, evaluator: bool = False,
+                  backoff_limit: int | None = None,
+                  heartbeat_timeout: float | None = None,
+                  pending_timeout: float | None = None,
+                  cmd: list[str] | None = None) -> TrainJob:
+    def tmpl():
+        return PodTemplateSpec(containers=[
+            ContainerSpec(name="tensorflow", image="local",
+                          command=list(cmd) if cmd else [])
+        ])
+
+    specs = {
+        ReplicaType.WORKER: ReplicaSpec(
+            replicas=workers, restart_policy=restart, template=tmpl()),
+    }
+    if evaluator:
+        specs[ReplicaType.EVALUATOR] = ReplicaSpec(
+            replicas=1, restart_policy=RestartPolicy.NEVER, template=tmpl())
+    job = TrainJob(metadata=ObjectMeta(name=name),
+                   spec=TrainJobSpec(replica_specs=specs))
+    job.spec.run_policy.scheduling.gang = False
+    job.spec.run_policy.recovery.policy = policy
+    job.spec.run_policy.recovery.heartbeat_timeout_seconds = heartbeat_timeout
+    job.spec.run_policy.recovery.pending_timeout_seconds = pending_timeout
+    if backoff_limit is not None:
+        job.spec.run_policy.backoff_limit = backoff_limit
+    return defaults.set_defaults(job)
+
+
+class StubHeartbeat:
+    """Controller heartbeat_source stand-in for units."""
+
+    def __init__(self):
+        self.hb: dict | None = None
+
+    def job_heartbeat(self, ns: str, name: str) -> dict | None:
+        return self.hb
+
+
+@pytest.fixture
+def env():
+    cluster = InMemoryCluster()
+    hb = StubHeartbeat()
+    controller = TrainJobController(cluster, enable_gang=False,
+                                    heartbeat_source=hb)
+    return cluster, controller, hb
+
+
+def submit_and_sync(cluster, controller, job):
+    cluster.create_job(job)
+    assert controller.run_until_idle(10.0)
+    return cluster.get_job(job.namespace, job.name)
+
+
+def reason_value(reason: str) -> float:
+    return status_metrics.restarts_total.labels(
+        namespace="default", reason=reason).value()
+
+
+def events_with(cluster, name, reason):
+    return [e for e in cluster.events_for(TrainJob.KIND, "default", name)
+            if e.reason == reason]
+
+
+def read_events(path) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+# --------------------------------------------- API: defaults / validation
+
+
+class TestRecoveryApi:
+    def test_default_pod_without_tpu(self):
+        job = make_gang_job("a", policy="")
+        assert job.spec.run_policy.recovery.policy == "pod"
+
+    def test_default_gang_with_tpu(self):
+        job = TrainJob(
+            metadata=ObjectMeta(name="b"),
+            spec=TrainJobSpec(
+                replica_specs={ReplicaType.WORKER: ReplicaSpec(
+                    replicas=2,
+                    template=PodTemplateSpec(containers=[
+                        ContainerSpec(name="tensorflow", image="img:1")]),
+                )},
+                tpu=TPUSpec(topology="v5e-8"),
+            ),
+        )
+        defaults.set_defaults(job)
+        assert job.spec.run_policy.recovery.policy == "gang"
+
+    def test_explicit_policy_respected(self):
+        job = TrainJob(
+            metadata=ObjectMeta(name="c"),
+            spec=TrainJobSpec(
+                replica_specs={ReplicaType.WORKER: ReplicaSpec(
+                    replicas=2,
+                    template=PodTemplateSpec(containers=[
+                        ContainerSpec(name="tensorflow", image="img:1")]),
+                )},
+                tpu=TPUSpec(topology="v5e-8"),
+            ),
+        )
+        job.spec.run_policy.recovery.policy = "pod"
+        defaults.set_defaults(job)
+        assert job.spec.run_policy.recovery.policy == "pod"
+
+    @pytest.mark.parametrize("mutate,needle", [
+        (lambda r: setattr(r, "policy", "slice"), "recovery.policy"),
+        (lambda r: setattr(r, "heartbeat_timeout_seconds", 0),
+         "heartbeatTimeoutSeconds"),
+        (lambda r: setattr(r, "pending_timeout_seconds", -1),
+         "pendingTimeoutSeconds"),
+        (lambda r: setattr(r, "progress_threshold_steps", 0),
+         "progressThresholdSteps"),
+    ])
+    def test_validation_rejects(self, mutate, needle):
+        job = make_gang_job("v")
+        mutate(job.spec.run_policy.recovery)
+        problems = validation.validate_job(job)
+        assert any(needle in p for p in problems), problems
+
+    def test_compat_roundtrip(self):
+        job = make_gang_job("rt", heartbeat_timeout=45.0, pending_timeout=120.0)
+        job.spec.run_policy.recovery.progress_threshold_steps = 7
+        d = compat.job_to_dict(job)
+        rec = d["spec"]["runPolicy"]["recovery"]
+        assert rec == {
+            "policy": "gang",
+            "heartbeatTimeoutSeconds": 45.0,
+            "pendingTimeoutSeconds": 120.0,
+            "progressThresholdSteps": 7,
+        }
+        back = compat.job_from_dict(d)
+        assert back.spec.run_policy.recovery == job.spec.run_policy.recovery
+
+    def test_explicit_null_recovery_fields_tolerated(self):
+        """A manifest serializing unset fields as null (kubectl-applied
+        JSON, omitempty-less emitters) must parse, not TypeError."""
+        d = compat.job_to_dict(make_gang_job("nul"))
+        rec = d["spec"]["runPolicy"]["recovery"]
+        rec["progressThresholdSteps"] = None
+        rec["heartbeatTimeoutSeconds"] = None
+        job = compat.job_from_dict(d)
+        assert job.spec.run_policy.recovery.progress_threshold_steps == 1
+        assert job.spec.run_policy.recovery.heartbeat_timeout_seconds is None
+
+    def test_explicit_zero_threshold_reaches_validation(self):
+        """An explicit progressThresholdSteps: 0 must parse as 0 and be
+        REJECTED by validation (the CRD promises minimum: 1), not be
+        silently rewritten to the default like a null would."""
+        d = compat.job_to_dict(make_gang_job("zt"))
+        d["spec"]["runPolicy"]["recovery"]["progressThresholdSteps"] = 0
+        job = compat.job_from_dict(d)
+        assert job.spec.run_policy.recovery.progress_threshold_steps == 0
+        problems = validation.validate_job(job)
+        assert any("progressThresholdSteps" in p for p in problems), problems
+
+    def test_zero_timeout_422s_at_the_fake_apiserver(self):
+        """The CRD declares the timeouts with the apiextensions/v1 boolean
+        `exclusiveMinimum: true` form: a manifest with
+        heartbeatTimeoutSeconds: 0 must 422 at the (structural) fake
+        apiserver exactly like a real admission check — the fake honoring
+        only `minimum` would let test and production admission drift."""
+        import urllib.error
+        import urllib.request
+
+        from tf_operator_tpu.core.k8s import job_to_k8s
+        from tf_operator_tpu.testing.fake_apiserver import FakeApiServer
+
+        job = make_gang_job("zhb")
+        job.spec.run_policy.recovery.heartbeat_timeout_seconds = 0
+        with FakeApiServer() as server:
+            req = urllib.request.Request(
+                f"{server.url}/apis/{TrainJob.API_VERSION}"
+                f"/namespaces/default/{TrainJob.PLURAL}",
+                data=json.dumps(job_to_k8s(job)).encode(), method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 422
+            assert "exclusive minimum" in json.loads(
+                exc.value.read())["message"]
+
+    def test_status_wire_roundtrip(self):
+        from tf_operator_tpu.core.k8s import (job_status_from_dict,
+                                              job_status_to_dict)
+
+        job = make_gang_job("w")
+        job.status.gang_restarts = 3
+        job.status.consecutive_restarts = 2
+        job.status.restart_heartbeat_step = 120
+        job.status.pending_gang_roll_uids = ["uid-a", "uid-b"]
+        job.status.stuck_pending_pods = ["w-worker-1"]
+        back = job_status_from_dict(job_status_to_dict(job.status))
+        assert back.gang_restarts == 3
+        assert back.consecutive_restarts == 2
+        assert back.restart_heartbeat_step == 120
+        assert back.pending_gang_roll_uids == ["uid-a", "uid-b"]
+        assert back.stuck_pending_pods == ["w-worker-1"]
+
+
+# -------------------------------------------------- controller unit tests
+
+
+class TestGangRestart:
+    def test_retryable_failure_rolls_whole_gang_once(self, env):
+        cluster, controller, _ = env
+        job = make_gang_job("g1", workers=2)
+        submit_and_sync(cluster, controller, job)
+        uids_before = {p.name: p.metadata.uid
+                       for p in cluster.list_pods("default")}
+        assert len(uids_before) == 2
+        before = reason_value("preempt")
+
+        cluster.set_pod_phase("default", "g1-worker-1", PodPhase.FAILED,
+                              exit_code=143)
+        assert controller.run_until_idle(10.0)
+
+        # Both pods were replaced (fresh uids), in ONE gang restart.
+        pods = {p.name: p.metadata.uid for p in cluster.list_pods("default")}
+        assert set(pods) == set(uids_before)
+        for name, uid in pods.items():
+            assert uid != uids_before[name], f"{name} was not replaced"
+        assert len(events_with(cluster, "g1", "GangRestart")) == 1
+        assert reason_value("preempt") == before + 1
+
+        job = cluster.get_job("default", "g1")
+        assert job.status.gang_restarts == 1
+        assert job.status.consecutive_restarts == 1
+        restarting = [c for c in job.status.conditions
+                      if c.type == JobConditionType.RESTARTING and c.status]
+        assert restarting and restarting[0].reason == "GangRestart"
+
+    def test_permanent_failure_fails_job(self, env):
+        cluster, controller, _ = env
+        job = make_gang_job("g2", workers=2)
+        submit_and_sync(cluster, controller, job)
+        w0_uid = cluster.get_pod("default", "g2-worker-0").metadata.uid
+
+        cluster.set_pod_phase("default", "g2-worker-1", PodPhase.FAILED,
+                              exit_code=1)
+        assert controller.run_until_idle(10.0)
+        job = cluster.get_job("default", "g2")
+        assert is_failed(job.status)
+        assert not events_with(cluster, "g2", "GangRestart")
+        assert job.status.gang_restarts == 0
+        # keep_failed_pods: worker-0 survives for debugging, un-replaced.
+        w0 = cluster.try_get_pod("default", "g2-worker-0")
+        assert w0 is not None and w0.metadata.uid == w0_uid
+
+    def test_evaluator_exempt_from_gang_roll(self, env):
+        cluster, controller, _ = env
+        job = make_gang_job("g3", workers=2, evaluator=True)
+        submit_and_sync(cluster, controller, job)
+        ev_uid = cluster.get_pod("default", "g3-evaluator-0").metadata.uid
+
+        cluster.set_pod_phase("default", "g3-worker-0", PodPhase.FAILED,
+                              exit_code=137)
+        assert controller.run_until_idle(10.0)
+        ev = cluster.get_pod("default", "g3-evaluator-0")
+        assert ev.metadata.uid == ev_uid  # the evaluator never rolled
+        assert len(events_with(cluster, "g3", "GangRestart")) == 1
+
+    def test_pod_policy_replaces_single_pod(self, env):
+        """`policy: pod` preserves the reference's per-pod replacement:
+        the healthy peer is untouched."""
+        cluster, controller, _ = env
+        job = make_gang_job("g4", workers=2, policy="pod")
+        submit_and_sync(cluster, controller, job)
+        w0_uid = cluster.get_pod("default", "g4-worker-0").metadata.uid
+        w1_uid = cluster.get_pod("default", "g4-worker-1").metadata.uid
+
+        cluster.set_pod_phase("default", "g4-worker-1", PodPhase.FAILED,
+                              exit_code=143)
+        assert controller.run_until_idle(10.0)
+        assert not events_with(cluster, "g4", "GangRestart")
+        assert events_with(cluster, "g4", "ExitedWithCode")
+        w0 = cluster.get_pod("default", "g4-worker-0")
+        w1 = cluster.get_pod("default", "g4-worker-1")
+        assert w0.metadata.uid == w0_uid      # survivor untouched
+        assert w1.metadata.uid != w1_uid      # failed pod replaced
+        assert cluster.get_job("default", "g4").status.gang_restarts == 0
+
+    def test_consecutive_backoff_exhaustion(self, env):
+        cluster, controller, _ = env
+        job = make_gang_job("g5", workers=1, backoff_limit=1)
+        submit_and_sync(cluster, controller, job)
+
+        cluster.set_pod_phase("default", "g5-worker-0", PodPhase.FAILED,
+                              exit_code=143)
+        assert controller.run_until_idle(10.0)
+        assert cluster.get_job(
+            "default", "g5").status.consecutive_restarts == 1
+
+        cluster.set_pod_phase("default", "g5-worker-0", PodPhase.FAILED,
+                              exit_code=143)
+        assert controller.run_until_idle(10.0)
+        job = cluster.get_job("default", "g5")
+        assert is_failed(job.status)
+        failed = [c for c in job.status.conditions
+                  if c.type == JobConditionType.FAILED and c.status]
+        assert failed[0].reason == "BackoffLimitExceeded"
+        assert len(events_with(cluster, "g5", "GangRestart")) == 1
+
+    def test_flaky_delete_does_not_inflate_tally(self, env):
+        """Deletions the apiserver rejects must not re-count the same roll
+        on every sync: limit=N means N REAL gang restarts (the doomed-uid
+        latch in _gang_recovery_tick), and the tally resumes counting only
+        for genuinely new failures once the roll drains."""
+        cluster, controller, _ = env
+        job = make_gang_job("g7", workers=2, backoff_limit=3)
+        submit_and_sync(cluster, controller, job)
+        before = reason_value("preempt")
+
+        real_delete = controller.pod_control.delete_pod
+        controller.pod_control.delete_pod = lambda ns, name, j: False
+        cluster.set_pod_phase("default", "g7-worker-1", PodPhase.FAILED,
+                              exit_code=143)
+        assert controller.run_until_idle(10.0)
+        for _ in range(3):  # re-syncs while the apiserver keeps rejecting
+            controller.enqueue("default/g7")
+            assert controller.run_until_idle(10.0)
+        job = cluster.get_job("default", "g7")
+        assert job.status.consecutive_restarts == 1
+        assert job.status.gang_restarts == 1
+        assert reason_value("preempt") == before + 1
+        assert len(events_with(cluster, "g7", "GangRestart")) == 1
+        assert not is_failed(job.status)
+
+        # The apiserver heals: the counted roll drains and the gang is
+        # recreated — still just the one restart on the books.
+        controller.pod_control.delete_pod = real_delete
+        controller.enqueue("default/g7")
+        assert controller.run_until_idle(10.0)
+        assert {p.name for p in cluster.list_pods("default")
+                if p.name.startswith("g7-")} == {"g7-worker-0",
+                                                 "g7-worker-1"}
+        job = cluster.get_job("default", "g7")
+        assert job.status.gang_restarts == 1
+
+    def test_partial_delete_drains_before_recreation(self, env):
+        """A roll whose deletions PARTIALLY fail must finish deleting the
+        doomed survivor even once the triggering failed pod is gone (no
+        trigger on the next sync): recreating peers next to an
+        old-generation pod would build exactly the mixed-generation gang
+        gang recovery exists to prevent."""
+        cluster, controller, _ = env
+        job = make_gang_job("g8", workers=2, backoff_limit=3)
+        submit_and_sync(cluster, controller, job)
+        survivor_uid = cluster.get_pod("default", "g8-worker-0").metadata.uid
+
+        # Delete succeeds for the failed pod, fails for the survivor.
+        real_delete = controller.pod_control.delete_pod
+        controller.pod_control.delete_pod = (
+            lambda ns, name, j: real_delete(ns, name, j)
+            if name == "g8-worker-1" else False)
+        cluster.set_pod_phase("default", "g8-worker-1", PodPhase.FAILED,
+                              exit_code=143)
+        assert controller.run_until_idle(10.0)
+        assert cluster.try_get_pod("default", "g8-worker-1") is None
+        assert cluster.try_get_pod("default", "g8-worker-0") is not None
+
+        # Triggering pod gone, survivor lingering: the next syncs must
+        # keep re-issuing its delete (and NOT recreate worker-1 beside
+        # it) until the apiserver heals, without re-counting the roll.
+        for _ in range(2):
+            controller.enqueue("default/g8")
+            assert controller.run_until_idle(10.0)
+        assert cluster.try_get_pod("default", "g8-worker-1") is None
+        controller.pod_control.delete_pod = real_delete
+        controller.enqueue("default/g8")
+        assert controller.run_until_idle(10.0)
+        pods = {p.name: p for p in cluster.list_pods("default")
+                if p.name.startswith("g8-")}
+        assert set(pods) == {"g8-worker-0", "g8-worker-1"}
+        assert pods["g8-worker-0"].metadata.uid != survivor_uid
+        job = cluster.get_job("default", "g8")
+        assert job.status.gang_restarts == 1
+        assert job.status.consecutive_restarts == 1
+        assert len(events_with(cluster, "g8", "GangRestart")) == 1
+
+    def test_failover_mid_roll_does_not_recount(self, env):
+        """The roll latch is PERSISTED (status.pending_gang_roll_uids),
+        not operator memory: a failover between the count and the drain —
+        the tally increment landed, the deletions 5xx'd — must re-issue
+        the deletes on the new leader WITHOUT re-entering the trigger
+        path on the still-Failed pod. With backoffLimit=1 a re-count
+        would exhaust the limit and Fail a job after ONE real incident
+        whose roll never completed."""
+        cluster, controller, _ = env
+        job = make_gang_job("g9", workers=2, backoff_limit=1)
+        submit_and_sync(cluster, controller, job)
+
+        controller.pod_control.delete_pod = lambda ns, name, j: False
+        cluster.set_pod_phase("default", "g9-worker-1", PodPhase.FAILED,
+                              exit_code=143)
+        assert controller.run_until_idle(10.0)
+        job = cluster.get_job("default", "g9")
+        assert job.status.consecutive_restarts == 1
+        assert job.status.pending_gang_roll_uids
+
+        # Failover: a fresh controller (empty in-memory state) over the
+        # same cluster, with a healed apiserver.
+        successor = TrainJobController(cluster, enable_gang=False,
+                                       heartbeat_source=StubHeartbeat())
+        successor.enqueue("default/g9")
+        assert successor.run_until_idle(10.0)
+        successor.enqueue("default/g9")
+        assert successor.run_until_idle(10.0)
+
+        job = cluster.get_job("default", "g9")
+        assert not is_failed(job.status), [
+            (str(c.type), c.reason) for c in job.status.conditions]
+        assert job.status.consecutive_restarts == 1
+        assert job.status.gang_restarts == 1
+        assert len(events_with(cluster, "g9", "GangRestart")) == 1
+        assert {p.name for p in cluster.list_pods("default")
+                if p.name.startswith("g9-")} == {"g9-worker-0",
+                                                 "g9-worker-1"}
+        assert not cluster.get_job(
+            "default", "g9").status.pending_gang_roll_uids
+
+    def test_sustained_runtime_resets_tally_without_heartbeat(self, env):
+        """Heartbeat-less deployments (no shared log volume on K8s) must
+        not creep toward backoffLimit on occasional preemptions — the
+        per-pod path never counted EXIT_CODE restarts at all. With no
+        step signal, a generation that stayed up past the fallback
+        runtime window counts as progress and resets the tally."""
+        from tf_operator_tpu.core import trainjob_controller as tc
+
+        cluster, controller, hb = env
+        hb.hb = None  # no heartbeat source signal
+        job = make_gang_job("g9", workers=1, backoff_limit=1)
+        submit_and_sync(cluster, controller, job)
+        cluster.set_pod_phase("default", "g9-worker-0", PodPhase.FAILED,
+                              exit_code=143)
+        assert controller.run_until_idle(10.0)
+        assert cluster.get_job(
+            "default", "g9").status.consecutive_restarts == 1
+
+        # Recreated pod runs past the fallback window -> tally resets ->
+        # the NEXT preemption rolls again instead of exhausting limit=1.
+        cluster.set_pod_phase("default", "g9-worker-0", PodPhase.RUNNING)
+        controller._now = (
+            lambda: time.time() + tc.GANG_PROGRESS_FALLBACK_RUNTIME_S + 5)
+        controller.enqueue("default/g9")
+        assert controller.run_until_idle(10.0)
+        job = cluster.get_job("default", "g9")
+        assert job.status.consecutive_restarts == 0
+        assert len(events_with(cluster, "g9", "RestartTallyReset")) == 1
+
+        cluster.set_pod_phase("default", "g9-worker-0", PodPhase.FAILED,
+                              exit_code=143)
+        assert controller.run_until_idle(10.0)
+        job = cluster.get_job("default", "g9")
+        assert not is_failed(job.status)
+        assert job.status.gang_restarts == 2
+        assert job.status.consecutive_restarts == 1
+
+    def test_young_generation_does_not_reset_tally(self, env):
+        """A crash-looping gang (generations dying far inside the
+        fallback window) must still exhaust backoffLimit."""
+        cluster, controller, hb = env
+        hb.hb = None
+        job = make_gang_job("g10", workers=1, backoff_limit=1)
+        submit_and_sync(cluster, controller, job)
+        for _ in range(2):  # fail fast, twice, well inside the window
+            cluster.set_pod_phase("default", "g10-worker-0", PodPhase.FAILED,
+                                  exit_code=143)
+            assert controller.run_until_idle(10.0)
+        job = cluster.get_job("default", "g10")
+        assert is_failed(job.status)
+        failed = [c for c in job.status.conditions
+                  if c.type == JobConditionType.FAILED and c.status]
+        assert failed[0].reason == "BackoffLimitExceeded"
+
+    def test_heartbeat_progress_resets_tally(self, env):
+        cluster, controller, hb = env
+        job = make_gang_job("g6", workers=1, backoff_limit=1)
+        submit_and_sync(cluster, controller, job)
+        cluster.set_pod_phase("default", "g6-worker-0", PodPhase.FAILED,
+                              exit_code=143)
+        assert controller.run_until_idle(10.0)
+        assert cluster.get_job(
+            "default", "g6").status.consecutive_restarts == 1
+
+        # The restart was counted while no heartbeat was readable
+        # (baseline None): the trainer's forced {step: 0} startup write
+        # must not establish a baseline (it precedes checkpoint resume,
+        # so the resume write would spuriously "advance" past it), and
+        # the first readable step > 0 only ESTABLISHES the baseline —
+        # treating either as an advance past an implicit 0 would let a
+        # job crash-looping at a fixed step reset its tally every lap
+        # and never exhaust backoffLimit.
+        hb.hb = {"step": 0, "t": time.time()}
+        controller.enqueue("default/g6")
+        assert controller.run_until_idle(10.0)
+        job = cluster.get_job("default", "g6")
+        assert job.status.consecutive_restarts == 1
+        assert job.status.restart_heartbeat_step is None
+
+        hb.hb = {"step": 50, "t": time.time()}
+        controller.enqueue("default/g6")
+        assert controller.run_until_idle(10.0)
+        job = cluster.get_job("default", "g6")
+        assert job.status.consecutive_restarts == 1
+        assert job.status.restart_heartbeat_step == 50
+        assert not events_with(cluster, "g6", "RestartTallyReset")
+
+        # Sustained progress: the heartbeat advances past the established
+        # baseline -> the tally resets -> a later failure restarts again
+        # instead of exhausting the limit.
+        hb.hb = {"step": 51, "t": time.time()}
+        controller.enqueue("default/g6")
+        assert controller.run_until_idle(10.0)
+        job = cluster.get_job("default", "g6")
+        assert job.status.consecutive_restarts == 0
+        assert job.status.gang_restarts == 1
+        assert events_with(cluster, "g6", "RestartTallyReset")
+
+        cluster.set_pod_phase("default", "g6-worker-0", PodPhase.FAILED,
+                              exit_code=143)
+        assert controller.run_until_idle(10.0)
+        job = cluster.get_job("default", "g6")
+        assert not is_failed(job.status)
+        assert job.status.gang_restarts == 2
+
+    def test_fixed_step_crash_loop_exhausts_despite_heartbeat(self, env):
+        """A job that dies at the same step every generation makes no
+        progress even though its heartbeat is perfectly readable between
+        laps: the tally must reach backoffLimit, not reset each lap."""
+        cluster, controller, hb = env
+        job = make_gang_job("g11", workers=1, backoff_limit=1)
+        submit_and_sync(cluster, controller, job)
+        cluster.set_pod_phase("default", "g11-worker-0", PodPhase.FAILED,
+                              exit_code=143)
+        assert controller.run_until_idle(10.0)
+
+        # Between generations the heartbeat reads back the step the crash
+        # keeps landing on — no advance, no reset.
+        hb.hb = {"step": 50, "t": time.time()}
+        controller.enqueue("default/g11")
+        assert controller.run_until_idle(10.0)
+        cluster.set_pod_phase("default", "g11-worker-0", PodPhase.FAILED,
+                              exit_code=143)
+        assert controller.run_until_idle(10.0)
+        job = cluster.get_job("default", "g11")
+        assert is_failed(job.status)
+        failed = [c for c in job.status.conditions
+                  if c.type == JobConditionType.FAILED and c.status]
+        assert failed[0].reason == "BackoffLimitExceeded"
+        assert job.status.gang_restarts == 1
+
+
+class TestHangWatchdog:
+    def _running_job(self, cluster, controller, name, **kw):
+        job = make_gang_job(name, workers=1, **kw)
+        submit_and_sync(cluster, controller, job)
+        cluster.set_pod_phase("default", f"{name}-worker-0", PodPhase.RUNNING)
+        assert controller.run_until_idle(10.0)
+        return cluster.get_job("default", name)
+
+    def test_stale_heartbeat_triggers_gang_restart(self, env):
+        cluster, controller, hb = env
+        self._running_job(cluster, controller, "h1", heartbeat_timeout=10.0)
+        uid = cluster.get_pod("default", "h1-worker-0").metadata.uid
+        before = reason_value("hang")
+
+        hb.hb = {"step": 12, "t": time.time()}
+        controller._now = lambda: time.time() + 100  # heartbeat now 100s old
+        controller.enqueue("default/h1")
+        assert controller.run_until_idle(10.0)
+
+        assert events_with(cluster, "h1", "HeartbeatStale")
+        assert len(events_with(cluster, "h1", "GangRestart")) == 1
+        assert reason_value("hang") == before + 1
+        job = cluster.get_job("default", "h1")
+        assert job.status.gang_restarts == 1
+        assert job.status.restart_heartbeat_step == 12
+        new = cluster.get_pod("default", "h1-worker-0")
+        assert new.metadata.uid != uid
+
+    def test_fresh_heartbeat_does_not_fire(self, env):
+        cluster, controller, hb = env
+        self._running_job(cluster, controller, "h2", heartbeat_timeout=10.0)
+        uid = cluster.get_pod("default", "h2-worker-0").metadata.uid
+        hb.hb = {"step": 5, "t": time.time() + 95}  # 5s old under the fake clock
+        controller._now = lambda: time.time() + 100
+        controller.enqueue("default/h2")
+        assert controller.run_until_idle(10.0)
+        assert not events_with(cluster, "h2", "HeartbeatStale")
+        assert cluster.get_pod("default", "h2-worker-0").metadata.uid == uid
+
+    def test_no_heartbeat_never_fires(self, env):
+        """The watchdog arms only once a heartbeat EXISTS — a workload
+        that never writes one (non-trainer image) must never be declared
+        hung."""
+        cluster, controller, hb = env
+        self._running_job(cluster, controller, "h3", heartbeat_timeout=10.0)
+        uid = cluster.get_pod("default", "h3-worker-0").metadata.uid
+        hb.hb = None
+        controller._now = lambda: time.time() + 1000
+        controller.enqueue("default/h3")
+        assert controller.run_until_idle(10.0)
+        assert not events_with(cluster, "h3", "HeartbeatStale")
+        assert cluster.get_pod("default", "h3-worker-0").metadata.uid == uid
+
+    def test_fresh_pod_start_suppresses_refire(self, env):
+        """After a roll the heartbeat file still holds the old
+        generation's stale write; the freshest-of(heartbeat, pod start)
+        rule gives the new generation a full quiet window."""
+        cluster, controller, hb = env
+        self._running_job(cluster, controller, "h4", heartbeat_timeout=10.0)
+        hb.hb = {"step": 12, "t": time.time() - 3600}  # ancient heartbeat
+        # Pod started "now" (set_pod_phase stamped real time), clock real:
+        # staleness is measured from the pod start, not the old heartbeat.
+        controller.enqueue("default/h4")
+        assert controller.run_until_idle(10.0)
+        assert not events_with(cluster, "h4", "HeartbeatStale")
+
+
+class TestStuckPending:
+    def test_pending_past_deadline_warns_and_surfaces(self, env):
+        cluster, controller, _ = env
+        job = make_gang_job("p1", workers=2, pending_timeout=30.0)
+        submit_and_sync(cluster, controller, job)
+        # No runtime: pods sit Pending. Advance the clock past the deadline.
+        controller._now = lambda: time.time() + 100
+        controller.enqueue("default/p1")
+        assert controller.run_until_idle(10.0)
+
+        warned = events_with(cluster, "p1", "StuckPending")
+        assert len(warned) == 2  # one per pod
+        job = cluster.get_job("default", "p1")
+        assert job.status.stuck_pending_pods == ["p1-worker-0", "p1-worker-1"]
+
+        # Level-triggered resyncs must not spam: still one warning per pod.
+        controller.enqueue("default/p1")
+        assert controller.run_until_idle(10.0)
+        assert len(events_with(cluster, "p1", "StuckPending")) == 2
+
+        # A pod that starts running leaves the stuck list.
+        cluster.set_pod_phase("default", "p1-worker-0", PodPhase.RUNNING)
+        assert controller.run_until_idle(10.0)
+        job = cluster.get_job("default", "p1")
+        assert job.status.stuck_pending_pods == ["p1-worker-1"]
+
+    def test_disabled_by_default(self, env):
+        cluster, controller, _ = env
+        job = make_gang_job("p2", workers=1)  # no pendingTimeoutSeconds
+        submit_and_sync(cluster, controller, job)
+        controller._now = lambda: time.time() + 10_000
+        controller.enqueue("default/p2")
+        assert controller.run_until_idle(10.0)
+        assert not events_with(cluster, "p2", "StuckPending")
+        assert cluster.get_job("default", "p2").status.stuck_pending_pods == []
+
+
+# ----------------------------------------------------- heartbeat plumbing
+
+
+class TestHeartbeatPlumbing:
+    def test_writer_noop_without_path(self):
+        w = HeartbeatWriter(None)
+        assert w.write(5) is False
+
+    def test_write_read_roundtrip_and_throttle(self, tmp_path):
+        path = str(tmp_path / "hb.json")
+        w = HeartbeatWriter(path, min_interval_s=10.0)
+        assert w.write(3) is True
+        hb = read_heartbeat(path)
+        assert hb["step"] == 3 and hb["t"] <= time.time()
+        assert w.write(4) is False          # throttled
+        assert read_heartbeat(path)["step"] == 3
+        assert w.write(4, force=True) is True
+        assert read_heartbeat(path)["step"] == 4
+
+    def test_torn_heartbeat_reads_none(self, tmp_path):
+        path = tmp_path / "hb.json"
+        path.write_text('{"step": 3, "t"')
+        assert read_heartbeat(str(path)) is None
+        assert read_heartbeat(str(tmp_path / "absent.json")) is None
+
+    def test_collector_aggregates_freshest(self, tmp_path):
+        from tf_operator_tpu.telemetry.collector import TelemetryCollector
+
+        now = time.time()
+        (tmp_path / "default_j1-worker-0.heartbeat.json").write_text(
+            json.dumps({"step": 10, "t": now - 30}))
+        (tmp_path / "default_j1-worker-1.heartbeat.json").write_text(
+            json.dumps({"step": 12, "t": now - 5}))
+        (tmp_path / "default_j1extra-worker-0.heartbeat.json").write_text(
+            json.dumps({"step": 99, "t": now}))  # different job: excluded
+        c = TelemetryCollector(str(tmp_path))
+        hb = c.job_heartbeat("default", "j1")
+        assert hb["step"] == 12                  # high-water step
+        assert hb["t"] == pytest.approx(now - 5)  # freshest write
+        assert 2 <= hb["age_seconds"] < 30
+        assert set(hb["replicas"]) == {"j1-worker-0", "j1-worker-1"}
+        assert c.job_heartbeat("default", "nosuch") is None
+        # The API telemetry block carries it too.
+        tel = c.job_telemetry("default", "j1")
+        assert tel["heartbeat"]["step"] == 12
+
+    def test_collector_excludes_evaluator_heartbeats(self, tmp_path):
+        """Evaluators sit outside the collective (same exemption as the
+        controller's gang roll) and only force-write heartbeats at
+        startup: their permanently-stale file must neither arm the
+        watchdog for a never-heartbeating worker gang nor drag the
+        aggregate age stale."""
+        from tf_operator_tpu.telemetry.collector import TelemetryCollector
+
+        now = time.time()
+        (tmp_path / "default_j3-evaluator-0.heartbeat.json").write_text(
+            json.dumps({"step": 0, "t": now - 3600}))
+        c = TelemetryCollector(str(tmp_path))
+        assert c.job_heartbeat("default", "j3") is None
+        (tmp_path / "default_j3-worker-0.heartbeat.json").write_text(
+            json.dumps({"step": 5, "t": now - 2}))
+        hb = c.job_heartbeat("default", "j3")
+        assert set(hb["replicas"]) == {"j3-worker-0"}
+        assert hb["step"] == 5 and hb["age_seconds"] < 30
+
+    def test_refresh_gauges_exposes_age(self, tmp_path):
+        from tf_operator_tpu.telemetry.collector import TelemetryCollector
+
+        cluster = InMemoryCluster()
+        cluster.create_job(make_gang_job("j2"))
+        (tmp_path / "default_j2-worker-0.heartbeat.json").write_text(
+            json.dumps({"step": 7, "t": time.time() - 42}))
+        c = TelemetryCollector(str(tmp_path))
+        c.refresh_gauges(cluster)
+        text = status_metrics.DEFAULT.expose()
+        assert ('tpujob_heartbeat_age_seconds{job="j2",namespace="default"}'
+                in text)
+
+    def test_runtime_drops_stale_heartbeat_files(self, tmp_path, monkeypatch):
+        """The heartbeat drives control decisions, so the runtime wipes a
+        pod's heartbeat file at spawn (a recreated pod must not inherit a
+        dead run's liveness) and at pod deletion (a resubmitted same-name
+        job must not inherit the old run's step high-water and heartbeat
+        existence through the collector's job-name glob)."""
+        monkeypatch.setenv("TPUJOB_PRESPAWN", "0")
+        logs = tmp_path / "logs"
+        logs.mkdir()
+        # A dead previous run left a heartbeat under the same log_dir for
+        # the pod name the new job reuses.
+        (logs / "default_hbdrop-worker-0.heartbeat.json").write_text(
+            json.dumps({"step": 999, "t": time.time()}))
+        s = LocalSession(env_overrides={"PYTHONPATH": REPO_ROOT},
+                         log_dir=str(logs))
+        try:
+            # Spawn-side: this pod never writes a heartbeat, so any signal
+            # the collector sees can only be the stale seed.
+            job = make_gang_job("hbdrop", workers=1,
+                                cmd=[PY, "-c", "pass"])
+            s.submit(job)
+            done = s.wait_for_condition("default", "hbdrop", DONE,
+                                        timeout=60)
+            assert is_succeeded(done.status)
+            assert s.telemetry.job_heartbeat("default", "hbdrop") is None
+
+            # Delete-side: a job that DID write a heartbeat loses the file
+            # when its pods are deleted with the job.
+            job = make_gang_job(
+                "hbkeep", workers=1,
+                cmd=[PY, "-c",
+                     "from tf_operator_tpu.utils.preemption import "
+                     "HeartbeatWriter; "
+                     "HeartbeatWriter.from_env().write(7, force=True)"])
+            s.submit(job)
+            done = s.wait_for_condition("default", "hbkeep", DONE,
+                                        timeout=60)
+            assert is_succeeded(done.status)
+            hb = s.telemetry.job_heartbeat("default", "hbkeep")
+            assert hb is not None and hb["step"] == 7
+            s.delete("default", "hbkeep")
+            s.wait_for_delete("default", "hbkeep", timeout=30)
+            deadline = time.time() + 10  # pod cascade lags the job delete
+            while (s.telemetry.job_heartbeat("default", "hbkeep") is not None
+                   and time.time() < deadline):
+                time.sleep(0.1)
+            assert s.telemetry.job_heartbeat("default", "hbkeep") is None
+
+            # Graceful-shutdown resurrection: pod deletion only SIGTERMs,
+            # and a latching trainer writes one last heartbeat at its
+            # final boundary — AFTER the delete-time unlink. The runtime
+            # must drop the file again once the process is dead, or a
+            # never-respawned pod (scale-down, deleted job) leaves the
+            # resurrected file for the collector glob.
+            job = make_gang_job(
+                "hbterm", workers=1,
+                cmd=[PY, "-c",
+                     "import signal, sys, time\n"
+                     "from tf_operator_tpu.utils.preemption import "
+                     "HeartbeatWriter\n"
+                     "w = HeartbeatWriter.from_env()\n"
+                     "w.write(5, force=True)\n"
+                     "def h(sig, f):\n"
+                     "    w.write(6, force=True)\n"
+                     "    sys.exit(143)\n"
+                     "signal.signal(signal.SIGTERM, h)\n"
+                     "while True:\n"
+                     "    time.sleep(0.05)\n"])
+            s.submit(job)
+            deadline = time.time() + 30
+            while (s.telemetry.job_heartbeat("default", "hbterm") is None
+                   and time.time() < deadline):
+                time.sleep(0.1)
+            assert s.telemetry.job_heartbeat("default", "hbterm") is not None
+            s.delete("default", "hbterm")
+            s.wait_for_delete("default", "hbterm", timeout=30)
+            deadline = time.time() + 15
+            while (s.telemetry.job_heartbeat("default", "hbterm") is not None
+                   and time.time() < deadline):
+                time.sleep(0.1)
+            assert s.telemetry.job_heartbeat("default", "hbterm") is None
+        finally:
+            s.close()
+
+
+# ------------------------------------------------------- chaos hang units
+
+
+class TestChaosHang:
+    def test_parse(self):
+        ds = chaos_lib.parse_chaos(
+            "hang:step=10,duration=2.5,replica=worker,index=1")
+        assert ds[0].kind == "hang"
+        assert ds[0].params == {"step": 10, "duration": 2.5,
+                                "replica": "worker", "index": 1}
+
+    @pytest.mark.parametrize("bad", [
+        "hang:duration=2",            # no step
+        "hang:step=5,duration=0",     # non-positive duration
+        "hang:step=5,index=-1",       # negative index
+        "hang:step=5,when=now",       # unknown key
+        "kill:step=5,index=-2",       # negative index on kill too
+    ])
+    def test_strict_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            chaos_lib.parse_chaos(bad)
+
+    def test_replica_matching(self):
+        d = chaos_lib.parse_chaos("kill:step=5,replica=worker,index=1")[0]
+        match = chaos_lib.replica_matches
+        assert match(d, {"TPUJOB_REPLICA_TYPE": "worker",
+                         "TPUJOB_REPLICA_INDEX": "1"})
+        assert match(d, {"TPUJOB_REPLICA_TYPE": "Worker",
+                         "TPUJOB_REPLICA_INDEX": "1"})  # case-insensitive
+        assert not match(d, {"TPUJOB_REPLICA_TYPE": "worker",
+                             "TPUJOB_REPLICA_INDEX": "0"})
+        assert not match(d, {"TPUJOB_REPLICA_TYPE": "evaluator",
+                             "TPUJOB_REPLICA_INDEX": "1"})
+        assert not match(d, {})  # unlabeled process never matches a filter
+        bare = chaos_lib.parse_chaos("kill:step=5")[0]
+        assert match(bare, {})   # no filter: everyone matches
+
+    def test_hang_at_one_shot_and_resume_guard(self):
+        tc = chaos_lib.TrainerChaos(chaos_lib.parse_chaos("hang:step=12"))
+        d = tc.hangs[0]
+        # Resumed at/past 12 without a state dir: never fires.
+        assert tc.hang_at(done=16, start_step=12) is None
+        assert not tc.state.fired(d)
+        # Fresh run crossing 12: fires exactly once.
+        tc2 = chaos_lib.TrainerChaos(chaos_lib.parse_chaos("hang:step=12"))
+        got = tc2.hang_at(done=12, start_step=0)
+        assert got is not None and got.params["step"] == 12
+        assert tc2.hang_at(done=13, start_step=0) is None  # marked
+
+    def test_hang_helper_times_out(self):
+        t0 = time.monotonic()
+        chaos_lib.hang(0.3)
+        assert 0.25 <= time.monotonic() - t0 < 5.0
+
+    def test_kill_index_filter_skips_other_replica(self, monkeypatch):
+        monkeypatch.setenv("TPUJOB_REPLICA_TYPE", "worker")
+        monkeypatch.setenv("TPUJOB_REPLICA_INDEX", "0")
+        tc = chaos_lib.TrainerChaos(
+            chaos_lib.parse_chaos("kill:step=5,index=1"))
+        tc.maybe_kill(done=10, start_step=0)  # must NOT signal this process
+        assert not tc.state.fired(tc.kills[0])
+
+
+# ------------------------------------------------------------ e2e capstones
+
+
+@pytest.fixture
+def session(tmp_path, monkeypatch):
+    # Honest 1-device subprocess pods (prespawn would fork the suite's
+    # 8-device warm image); the shared chaos-state dir carries one-shot
+    # markers across generations (a gang restart resumes BEFORE the fault
+    # step, so the start_step guard alone cannot prevent refire).
+    monkeypatch.setenv("TPUJOB_PRESPAWN", "0")
+    s = LocalSession(
+        env_overrides={**ONE_DEV,
+                       "TPUJOB_CHAOS_STATE": str(tmp_path / "chaos-state")},
+        log_dir=str(tmp_path / "logs"),
+    )
+    yield s
+    s.close()
+
+
+def pod_events(tmp_path, pod: str, ns: str = "default") -> list[dict]:
+    return read_events(tmp_path / "logs" / f"{ns}_{pod}.metrics.jsonl")
+
+
+def progress_losses(events: list[dict]) -> dict[int, float]:
+    return {e["step"]: e["loss"] for e in events if e["event"] == "progress"}
+
+
+def dist_trainer_cmd(ckpt: str, *extra: str) -> list[str]:
+    return [PY, "-m", "tf_operator_tpu.models.train", "--model", "mnist-mlp",
+            "--steps", str(STEPS), "--batch", "16", "--log-every", "4",
+            "--checkpoint-dir", ckpt, "--checkpoint-every", "8", *extra]
+
+
+def make_dist_job(name: str, cmd: list[str], **kw) -> TrainJob:
+    job = make_gang_job(name, workers=2, cmd=cmd, **kw)
+    job.spec.mesh = MeshSpec(axes={"dp": 2})
+    return job
+
+
+class TestGangKillRestartResumeE2E:
+    """The acceptance capstone: chaos-SIGKILL of worker 1 in a 2-worker
+    jax.distributed gang -> the controller rolls BOTH pods exactly once
+    (one GangRestart, one restarts_total{reason="preempt"} sample) -> both
+    resume from the shared step-8 checkpoint -> the job reaches the exact
+    final step with losses matching an uninterrupted 2-worker reference
+    run (rtol 1e-3). The reference job runs concurrently in the same
+    session (wall-clock discipline; on a 2-core host, overlapping MORE
+    than these two jobs thrashes the box and flakes the trajectory — a
+    three-job merge of this test with the hang e2e was tried and
+    REVERTED).
+
+    flaky: standalone the two trajectories are bit-identical (resume
+    correctness is pinned by the step-8/16 losses matching exactly), but
+    under co-located full-suite load the 2-process CPU collective path
+    occasionally drifts a late-window loss past rtol — same class as the
+    bubble-fraction and elastic deflakes; the conftest rerun-once
+    protocol retries, deterministic failures still fail."""
+
+    @pytest.mark.flaky
+    def test_kill_one_worker_rolls_both(self, session, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        ref_ckpt = str(tmp_path / "ref-ckpt")
+        chaos_job = make_dist_job(
+            "gangkill",
+            dist_trainer_cmd(ckpt, "--chaos",
+                             "kill:step=12,signal=KILL,index=1"),
+        )
+        ref_job = make_dist_job("gangref", dist_trainer_cmd(ref_ckpt))
+        session.submit(chaos_job)
+        session.submit(ref_job)
+
+        job = session.wait_for_condition("default", "gangkill", DONE,
+                                         timeout=480)
+        assert is_succeeded(job.status), [
+            (str(c.type), c.reason, c.message) for c in job.status.conditions
+        ]
+        ref = session.wait_for_condition("default", "gangref", DONE,
+                                         timeout=480)
+        assert is_succeeded(ref.status), [
+            (str(c.type), c.reason) for c in ref.status.conditions
+        ]
+
+        # BOTH pods rolled exactly once: two process generations each.
+        for idx in (0, 1):
+            ev = pod_events(tmp_path, f"gangkill-worker-{idx}")
+            starts = [e for e in ev if e["event"] == "start"]
+            assert len(starts) == 2, (idx, [e["event"] for e in ev])
+        assert len([e for e in session.cluster.events_for(
+            "TrainJob", "default", "gangkill")
+            if e.reason == "GangRestart"]) == 1
+        assert job.status.gang_restarts == 1
+        # SIGKILL (137) is an infrastructure signal: counted as preempt.
+        assert ('tpujob_restarts_total{namespace="default",reason="preempt"}'
+                in status_metrics.DEFAULT.expose())
+
+        # Both generations resumed from the step-8 periodic checkpoint and
+        # finished at the EXACT requested step.
+        ev0 = pod_events(tmp_path, "gangkill-worker-0")
+        resumed = [e for e in ev0 if e["event"] == "resumed"]
+        assert resumed and resumed[-1]["from_step"] == 8
+        dones = [e for e in ev0 if e["event"] == "done"]
+        assert dones and dones[-1]["steps"] == STEPS
+
+        # Loss trajectory == the uninterrupted 2-worker reference run.
+        ref0 = progress_losses(pod_events(tmp_path, "gangref-worker-0"))
+        got = progress_losses(ev0)
+        common = sorted(set(ref0) & set(got))
+        assert STEPS in common and len(common) >= 2, (ref0, got)
+        for s in common:
+            assert got[s] == pytest.approx(ref0[s], rel=1e-3), (s, got, ref0)
+        ref_done = [e for e in pod_events(tmp_path, "gangref-worker-0")
+                    if e["event"] == "done"][-1]
+        assert dones[-1]["final_loss"] == pytest.approx(
+            ref_done["final_loss"], rel=1e-3)
+
+
+class TestHangWatchdogE2E:
+    """Heartbeat hang watchdog end-to-end: a chaos `hang:` trainer stops
+    stepping without exiting; the controller detects the stale heartbeat,
+    gang-restarts with restarts_total{reason="hang"}, and the resumed run
+    completes at the exact final step."""
+
+    def test_hang_detected_and_recovered(self, session, tmp_path):
+        ckpt = str(tmp_path / "ckpt-hang")
+        job = make_gang_job(
+            "ganghang", workers=1,
+            # Generous vs startup gaps (heartbeat milestones bracket the
+            # jax import / compiles, but the gaps grow under suite load).
+            heartbeat_timeout=15.0,
+            cmd=[PY, "-m", "tf_operator_tpu.models.train", "--model",
+                 "mnist-mlp", "--steps", str(STEPS), "--batch", "16",
+                 "--log-every", "4", "--checkpoint-dir", ckpt,
+                 "--checkpoint-every", "8", "--chaos", "hang:step=12"],
+        )
+        session.submit(job)
+        job = session.wait_for_condition("default", "ganghang", DONE,
+                                         timeout=420)
+        assert is_succeeded(job.status), [
+            (str(c.type), c.reason, c.message) for c in job.status.conditions
+        ]
+
+        ev = pod_events(tmp_path, "ganghang-worker-0")
+        hangs = [e for e in ev if e["event"] == "chaos_hang"]
+        assert hangs and hangs[0]["step"] == 12
+        events = session.cluster.events_for("TrainJob", "default", "ganghang")
+        assert any(e.reason == "HeartbeatStale" and e.type == "Warning"
+                   for e in events)
+        assert any(e.reason == "GangRestart" for e in events)
+        assert job.status.gang_restarts >= 1
+        assert ('tpujob_restarts_total{namespace="default",reason="hang"}'
+                in status_metrics.DEFAULT.expose())
+
+        # Recovered past the hang to the exact requested step.
+        dones = [e for e in ev if e["event"] == "done"]
+        assert dones and dones[-1]["steps"] == STEPS
+        resumed = [e for e in ev if e["event"] == "resumed"]
+        assert resumed and resumed[-1]["from_step"] >= 8
+
+        # The collector surfaces the heartbeat on /metrics and the API.
+        session.telemetry.refresh_gauges(session.cluster)
+        assert ('tpujob_heartbeat_age_seconds{job="ganghang",'
+                'namespace="default"}' in status_metrics.DEFAULT.expose())
+        tel = session.telemetry.job_telemetry("default", "ganghang")
+        assert tel["heartbeat"]["step"] == STEPS
+
+
+@pytest.mark.slow
+class TestMultiGenerationHangKillCombo:
+    def test_hang_then_kill_across_three_generations(self, session, tmp_path):
+        """Gen 1 hangs at step 6 (watchdog roll), gen 2 is SIGKILLed at
+        step 14 (exit-code roll), gen 3 completes — one-shot markers carry
+        fired state across all three generations and the two restarts are
+        labeled hang + preempt."""
+        ckpt = str(tmp_path / "ckpt-combo")
+        job = make_gang_job(
+            "gangcombo", workers=1, heartbeat_timeout=15.0,
+            cmd=[PY, "-m", "tf_operator_tpu.models.train", "--model",
+                 "mnist-mlp", "--steps", str(STEPS), "--batch", "16",
+                 "--log-every", "2", "--checkpoint-dir", ckpt,
+                 "--checkpoint-every", "4", "--chaos",
+                 "hang:step=6;kill:step=14,signal=KILL"],
+        )
+        session.submit(job)
+        job = session.wait_for_condition("default", "gangcombo", DONE,
+                                         timeout=600)
+        assert is_succeeded(job.status), [
+            (str(c.type), c.reason, c.message) for c in job.status.conditions
+        ]
+        assert job.status.gang_restarts >= 2
+        ev = pod_events(tmp_path, "gangcombo-worker-0")
+        assert [e for e in ev if e["event"] == "chaos_hang"]
+        dones = [e for e in ev if e["event"] == "done"]
+        assert dones and dones[-1]["steps"] == STEPS
+        text = status_metrics.DEFAULT.expose()
+        assert 'reason="hang"' in text and 'reason="preempt"' in text

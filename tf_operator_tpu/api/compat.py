@@ -28,6 +28,7 @@ from tf_operator_tpu.api.types import (
     MeshSpec,
     ObjectMeta,
     PodTemplateSpec,
+    RecoveryPolicy,
     ReplicaSpec,
     RestartPolicy,
     RunPolicy,
@@ -131,6 +132,7 @@ def job_from_dict(manifest: dict[str, Any], apply_defaults: bool = True) -> Trai
     # Wire name is schedulingPolicy (what job_to_dict emits and the CRD
     # schema declares); "scheduling" is accepted as a legacy manifest alias.
     sched_d = rp_d.get("schedulingPolicy") or rp_d.get("scheduling") or {}
+    rec_d = rp_d.get("recovery") or {}
     run_policy = RunPolicy(
         clean_pod_policy=CleanPodPolicy(cpp) if cpp else None,
         ttl_seconds_after_finished=policy_field("ttlSecondsAfterFinished"),
@@ -142,6 +144,19 @@ def job_from_dict(manifest: dict[str, Any], apply_defaults: bool = True) -> Trai
             queue=sched_d.get("queue", ""),
             priority_class=sched_d.get("priorityClass", ""),
             min_available=sched_d.get("minAvailable"),
+        ),
+        recovery=RecoveryPolicy(
+            # `or ""`: an explicit null (legacy emitters) means unresolved,
+            # same as absent — RecoveryPolicy.policy is a str contract.
+            policy=rec_d.get("policy") or "",
+            heartbeat_timeout_seconds=rec_d.get("heartbeatTimeoutSeconds"),
+            pending_timeout_seconds=rec_d.get("pendingTimeoutSeconds"),
+            # None-only default: an explicit null in the manifest means
+            # "unset", but an explicit 0 must survive to validate_spec
+            # (which rejects values < 1) instead of being rewritten to 1.
+            progress_threshold_steps=(
+                1 if rec_d.get("progressThresholdSteps") is None
+                else int(rec_d["progressThresholdSteps"])),
         ),
     )
 
@@ -245,6 +260,21 @@ def job_to_dict(job: TrainJob) -> dict[str, Any]:
                     "gang": rp.scheduling.gang,
                     "queue": rp.scheduling.queue,
                     "minAvailable": rp.scheduling.min_available,
+                },
+                "recovery": {
+                    # omitempty: an unresolved policy serializes as ABSENT
+                    # — key dropped, not "policy": null — so round-trip
+                    # consumers that don't null-strip still parse a valid
+                    # job (the CRD enum admits only gang|pod; "" means
+                    # "let defaulting decide" and must not hit the schema).
+                    **({"policy": rp.recovery.policy}
+                       if rp.recovery.policy else {}),
+                    "heartbeatTimeoutSeconds":
+                        rp.recovery.heartbeat_timeout_seconds,
+                    "pendingTimeoutSeconds":
+                        rp.recovery.pending_timeout_seconds,
+                    "progressThresholdSteps":
+                        rp.recovery.progress_threshold_steps,
                 },
             },
         },

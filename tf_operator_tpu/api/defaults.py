@@ -92,6 +92,14 @@ def set_defaults_spec(spec: TrainJobSpec) -> None:
     if spec.run_policy.clean_pod_policy is None:
         spec.run_policy.clean_pod_policy = CleanPodPolicy.RUNNING
 
+    # Recovery policy: a TPU-slice job is inherently gang (one host dying
+    # wedges the survivors in ICI collectives; a lone replacement cannot
+    # rejoin the live jax.distributed generation), so slice jobs default to
+    # gang-coherent restart; everything else keeps the reference's per-pod
+    # replacement for back-compat.
+    if not spec.run_policy.recovery.policy:
+        spec.run_policy.recovery.policy = "gang" if spec.tpu is not None else "pod"
+
     if spec.tpu is not None and spec.tpu.topology:
         try:
             topo = parse_topology(

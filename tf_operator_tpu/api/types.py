@@ -199,6 +199,48 @@ class SchedulingPolicy:
 
 
 @dataclass
+class RecoveryPolicy:
+    """How replica failure propagates through the gang (beyond the
+    reference, whose exit-code policy always restarted a failed replica
+    ALONE — pod.go:135-156 — which is wrong on a TPU slice: the survivors
+    wedge in ICI/collective ops and a lone restarted pod cannot rejoin the
+    live jax.distributed coordinator generation).
+
+    policy:
+      "gang"  any retryable gang-member failure rolls EVERY non-finished
+              pod of the job (evaluators exempt — they sit outside the
+              collective), counted as ONE restart against backoffLimit;
+              the tally is CONSECUTIVE (sustained heartbeat progress
+              resets it, so week-long jobs with occasional preemptions
+              don't exhaust the limit). Default when spec.tpu is set.
+      "pod"   the reference's per-pod replacement, bit-for-bit. Default
+              otherwise (back-compat).
+      ""      unresolved; defaulting picks per the rule above.
+
+    heartbeat_timeout_seconds: with a value set, a Running job whose
+    freshest trainer heartbeat (TPUJOB_HEARTBEAT_FILE) is older than this
+    is declared hung -> warning event -> gang restart with
+    restarts_total{reason="hang"}. Must exceed worst-case startup/compile
+    gaps between heartbeat milestones. None (default) disables the
+    watchdog.
+
+    pending_timeout_seconds: a pod Pending longer than this (unschedulable
+    slice, image pull failure) gets a Warning event and is surfaced in
+    status.stuck_pending_pods instead of the job sitting silently in
+    Created forever. None (default) disables.
+
+    progress_threshold_steps: how far the heartbeat step must advance past
+    the step recorded at the last gang restart before the consecutive
+    tally resets.
+    """
+
+    policy: str = ""
+    heartbeat_timeout_seconds: float | None = None
+    pending_timeout_seconds: float | None = None
+    progress_threshold_steps: int = 1
+
+
+@dataclass
 class RunPolicy:
     """Job-level lifecycle policy (ref common/v1 RunPolicy fields spread over
     TFJobSpec in types.go:43-72)."""
@@ -212,6 +254,7 @@ class RunPolicy:
     # checkpoints. The active-deadline clock keeps running while suspended.
     suspend: bool = False
     scheduling: SchedulingPolicy = field(default_factory=SchedulingPolicy)
+    recovery: RecoveryPolicy = field(default_factory=RecoveryPolicy)
 
 
 @dataclass
@@ -259,6 +302,22 @@ class JobStatus:
     start_time: float | None = None
     completion_time: float | None = None
     last_reconcile_time: float | None = None
+    # Gang-coherent recovery bookkeeping (RecoveryPolicy "gang"):
+    # gang_restarts is the lifetime total (visibility); consecutive_restarts
+    # is the tally counted against backoffLimit — reset to 0 once the
+    # heartbeat step advances progress_threshold_steps past
+    # restart_heartbeat_step (the heartbeat high-water at the last restart).
+    gang_restarts: int = 0
+    consecutive_restarts: int = 0
+    restart_heartbeat_step: int | None = None
+    # Uids of pods a counted gang restart doomed whose deletions may still
+    # be in flight. Persisted (not operator memory) so a failover between
+    # the count and the drain re-issues the deletes WITHOUT re-counting
+    # the same incident against backoffLimit.
+    pending_gang_roll_uids: list[str] = field(default_factory=list)
+    # Pods Pending past recovery.pending_timeout_seconds (stuck-Pending
+    # detection): surfaced here so the API shows WHY a job sits in Created.
+    stuck_pending_pods: list[str] = field(default_factory=list)
 
 
 @dataclass

@@ -70,6 +70,19 @@ def validate_spec(spec: TrainJobSpec) -> list[str]:
     if ReplicaType.CHIEF in spec.replica_specs and ReplicaType.MASTER in spec.replica_specs:
         problems.append("job may have Chief or Master, not both")
 
+    rec = spec.run_policy.recovery
+    if rec.policy not in ("", "gang", "pod"):
+        problems.append(
+            f"runPolicy.recovery.policy must be 'gang' or 'pod', "
+            f"got {rec.policy!r}"
+        )
+    if rec.heartbeat_timeout_seconds is not None and rec.heartbeat_timeout_seconds <= 0:
+        problems.append("runPolicy.recovery.heartbeatTimeoutSeconds must be > 0")
+    if rec.pending_timeout_seconds is not None and rec.pending_timeout_seconds <= 0:
+        problems.append("runPolicy.recovery.pendingTimeoutSeconds must be > 0")
+    if rec.progress_threshold_steps < 1:
+        problems.append("runPolicy.recovery.progressThresholdSteps must be >= 1")
+
     if spec.tpu is not None and spec.tpu.topology:
         try:
             topo = parse_topology(

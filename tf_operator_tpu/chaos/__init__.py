@@ -44,24 +44,62 @@ from tf_operator_tpu.chaos.spec import (
 __all__ = [
     "ENV_CHAOS", "ENV_CHAOS_STATE", "Directive", "OneShotState",
     "from_env", "parse_chaos", "parse_signal",
-    "TrainerChaos", "tear_checkpoint", "staging_stalls_from_env",
+    "TrainerChaos", "hang", "tear_checkpoint", "staging_stalls_from_env",
     "staging_stall_delay", "apiserver_directives",
 ]
 
 
-class TrainerChaos:
-    """Trainer-side directives (kill / torn), evaluated at step boundaries.
+def replica_matches(directive: Directive, env: dict | None = None) -> bool:
+    """Whether a kill/hang directive targets THIS replica. Directives may
+    carry `replica=TYPE` / `index=I` to name one gang member (how a
+    multi-worker job kills exactly one peer); without them every process
+    matches. A directive that names a replica never fires in a process the
+    operator didn't label (standalone runs have no TPUJOB_REPLICA_* env)."""
+    e = os.environ if env is None else env
+    want_type = directive.params.get("replica")
+    if want_type is not None:
+        if e.get("TPUJOB_REPLICA_TYPE", "").lower() != want_type.lower():
+            return False
+    want_idx = directive.params.get("index")
+    if want_idx is not None:
+        try:
+            if int(e.get("TPUJOB_REPLICA_INDEX", "")) != want_idx:
+                return False
+        except ValueError:
+            return False
+    return True
 
-    Kill semantics without a one-shot state dir: fire when this process
-    both STARTED before the target step and has now completed it
-    (start_step < step <= done) — a run resumed at/past the kill step
+
+def hang(duration: float | None) -> None:
+    """Stop making progress without exiting — the wedged-collective
+    simulation. Sleeps in short slices; duration=None hangs until killed
+    from outside (SIGTERM only latches under the preemption guard — a real
+    wedge never reaches its graceful path, so neither does this one; the
+    runtime's drain discipline escalates to SIGKILL)."""
+    import time as _time
+
+    deadline = None if duration is None else _time.monotonic() + duration
+    while deadline is None or _time.monotonic() < deadline:
+        _time.sleep(0.25)
+
+
+class TrainerChaos:
+    """Trainer-side directives (kill / hang / torn), evaluated at step
+    boundaries.
+
+    Kill/hang semantics without a one-shot state dir: fire when this
+    process both STARTED before the target step and has now completed it
+    (start_step < step <= done) — a run resumed at/past the target step
     never re-fires, which is exactly the preempt->restart->resume e2e
     shape. With TPUJOB_CHAOS_STATE set, fired directives drop markers and
-    the start_step guard is unnecessary (multi-kill scripts work)."""
+    the start_step guard is unnecessary (multi-kill scripts work; a hang
+    job resumed from a checkpoint BEFORE the hang step needs the markers,
+    since the gang restart replays those steps)."""
 
     def __init__(self, directives: list[Directive],
                  state: OneShotState | None = None):
         self.kills = [d for d in directives if d.kind == "kill"]
+        self.hangs = [d for d in directives if d.kind == "hang"]
         self.tears = [d for d in directives if d.kind == "torn"]
         self.state = state or OneShotState()
 
@@ -70,9 +108,25 @@ class TrainerChaos:
         """None when TPUJOB_CHAOS is unset/empty — the no-chaos fast path
         (one dict lookup; no object, no per-step work)."""
         directives = from_env(env)
-        if not any(d.kind in ("kill", "torn") for d in directives):
+        if not any(d.kind in ("kill", "hang", "torn") for d in directives):
             return None
         return cls(directives, OneShotState.from_env(env))
+
+    def _due(self, directives: list[Directive], done: int,
+             start_step: int) -> Directive | None:
+        """First unfired directive whose step this boundary crossed and
+        whose replica filter matches this process; marks it fired."""
+        for d in directives:
+            step = d.params["step"]
+            if done < step or self.state.fired(d):
+                continue
+            if not self.state.state_dir and start_step >= step:
+                continue  # resumed past the target point: never re-fire
+            if not replica_matches(d):
+                continue
+            self.state.mark(d)
+            return d
+        return None
 
     def maybe_kill(self, done: int, start_step: int) -> None:
         """Deliver the configured signal to THIS process once step
@@ -80,15 +134,15 @@ class TrainerChaos:
         completes; a caught signal (TERM/INT/USR1 under the preemption
         guard) returns here and the caller's boundary check handles it —
         an uncaught one (KILL) never returns."""
-        for d in self.kills:
-            step = d.params["step"]
-            if done < step or self.state.fired(d):
-                continue
-            if not self.state.state_dir and start_step >= step:
-                continue  # resumed past the kill point: never re-fire
-            self.state.mark(d)
+        d = self._due(self.kills, done, start_step)
+        if d is not None:
             os.kill(os.getpid(), parse_signal(d.params.get("signal", "TERM")))
-            return
+
+    def hang_at(self, done: int, start_step: int) -> Directive | None:
+        """The hang directive this boundary triggers, if any (marked
+        fired). The caller emits its event and calls hang() — kept apart
+        so the trainer can record the hang in its metrics stream first."""
+        return self._due(self.hangs, done, start_step)
 
     def tear_for_step(self, step: int) -> Directive | None:
         """The torn-checkpoint directive for `step`, if any unfired."""

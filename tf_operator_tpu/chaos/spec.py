@@ -14,13 +14,25 @@ Grammar (whitespace-insensitive):
 Directive kinds and their keys (all integers/floats unless noted):
 
     kill       step=N signal=NAME     SIGTERM the trainer once it completes
-                                      step N (signal: TERM/INT/USR1/KILL/
-                                      SEGV..., bare name, SIG-prefixed, or
+               [replica=TYPE]         step N (signal: TERM/INT/USR1/KILL/
+               [index=I]              SEGV..., bare name, SIG-prefixed, or
                                       a number). Without a one-shot state
                                       dir the directive only fires in a
                                       process that STARTED before step N,
                                       so a resumed run past N never
-                                      re-fires.
+                                      re-fires. replica/index restrict the
+                                      directive to the pod whose
+                                      TPUJOB_REPLICA_TYPE / _INDEX match —
+                                      how a multi-worker job kills exactly
+                                      one gang member.
+    hang       step=N [duration=S]    stop stepping WITHOUT exiting after
+               [replica=TYPE]         step N (the wedged-collective
+               [index=I]              failure mode exit codes can never
+                                      see — drives the heartbeat hang
+                                      watchdog). No duration = hang until
+                                      killed; with duration=S stepping
+                                      resumes after S seconds. Same
+                                      one-shot/replica semantics as kill.
     torn       step=N mode=truncate   corrupt the just-written checkpoint
                     |unlink           for step N (truncate the largest
                                       file to half, or unlink a leaf) —
@@ -53,10 +65,11 @@ from dataclasses import dataclass, field
 ENV_CHAOS = "TPUJOB_CHAOS"
 ENV_CHAOS_STATE = "TPUJOB_CHAOS_STATE"
 
-KINDS = ("kill", "torn", "stall", "apiserver")
+KINDS = ("kill", "hang", "torn", "stall", "apiserver")
 
 _KEYS: dict[str, dict[str, type]] = {
-    "kill": {"step": int, "signal": str},
+    "kill": {"step": int, "signal": str, "replica": str, "index": int},
+    "hang": {"step": int, "duration": float, "replica": str, "index": int},
     "torn": {"step": int, "mode": str},
     "stall": {"delay": float, "batch": int, "every": int},
     "apiserver": {"errors": int, "code": int, "latency": float,
@@ -135,10 +148,17 @@ def parse_chaos(text: str) -> list[Directive]:
 
 
 def _validate(kind: str, params: dict) -> None:
+    if kind in ("kill", "hang") and params.get("index", 0) < 0:
+        raise ValueError(f"chaos: {kind}: index must be >= 0")
     if kind == "kill":
         if "step" not in params:
             raise ValueError("chaos: kill requires step=N")
         parse_signal(params.get("signal", "TERM"))  # fail fast on typos
+    elif kind == "hang":
+        if "step" not in params:
+            raise ValueError("chaos: hang requires step=N")
+        if params.get("duration", 1.0) <= 0:
+            raise ValueError("chaos: hang: duration must be > 0")
     elif kind == "torn":
         if "step" not in params:
             raise ValueError("chaos: torn requires step=N")

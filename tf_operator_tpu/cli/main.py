@@ -150,11 +150,21 @@ def cmd_operator(args) -> int:
     failed = threading.Event()  # startup failures must exit non-zero
 
     def lead() -> None:
+        # Heartbeat source for the hang watchdog: the same log_dir the
+        # local runtime injects TPUJOB_HEARTBEAT_FILE under. (On K8s the
+        # pods' heartbeat files only exist where a shared log volume is
+        # mounted; without one the watchdog simply never arms.)
+        heartbeat_source = None
+        if args.log_dir:
+            from tf_operator_tpu.telemetry.collector import TelemetryCollector
+
+            heartbeat_source = TelemetryCollector(args.log_dir)
         controller = TrainJobController(
             cluster,
             enable_gang=args.enable_gang_scheduling,
             gang_scheduler_name=args.gang_scheduler_name,
             slice_allocator=allocator,
+            heartbeat_source=heartbeat_source,
         )
         runtime = None
         if on_k8s:
@@ -180,7 +190,8 @@ def cmd_operator(args) -> int:
         # opt-in (--bind), not a side effect of --in-cluster (probes and
         # kubectl port-forward both enter via the pod's loopback).
         api = ApiServer(cluster, port=args.monitoring_port, log_dir=args.log_dir,
-                        runtime=runtime, bind=args.bind)
+                        runtime=runtime, bind=args.bind,
+                        telemetry=heartbeat_source)
         api.start()
         log.info("REST/metrics API on %s:%d", args.bind, api.port)
         controller.run(workers=args.threadiness)

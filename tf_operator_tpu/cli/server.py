@@ -54,6 +54,13 @@ def _job_payload(cluster: InMemoryCluster, job: TrainJob,
             },
             "startTime": job.status.start_time,
             "completionTime": job.status.completion_time,
+            # Gang-recovery visibility: how many slice-wide restarts the
+            # job has eaten, how many count against backoffLimit right
+            # now (consecutive, reset by heartbeat progress), and any
+            # pods stuck Pending past recovery.pendingTimeoutSeconds.
+            "gangRestarts": job.status.gang_restarts,
+            "consecutiveRestarts": job.status.consecutive_restarts,
+            "stuckPendingPods": list(job.status.stuck_pending_pods),
         },
         "events": [
             {"type": e.type, "reason": e.reason, "message": e.message, "ts": e.timestamp}
@@ -73,14 +80,17 @@ def _job_payload(cluster: InMemoryCluster, job: TrainJob,
 class ApiServer:
     def __init__(self, cluster: InMemoryCluster, port: int = 8443,
                  log_dir: str | None = None, runtime=None,
-                 bind: str = "127.0.0.1"):
+                 bind: str = "127.0.0.1", telemetry=None):
         self.cluster = cluster
         self.log_dir = log_dir
         self.runtime = runtime  # LocalProcessRuntime, for the endpoints view
         # Trainer telemetry rides the same log_dir the runtime writes pod
         # metrics files into; without a log_dir there is nothing to read.
-        self.telemetry = None
-        if log_dir:
+        # Callers that already own a collector for the same log_dir (the
+        # operator's hang-watchdog heartbeat source) pass it in so one
+        # instance serves both reads.
+        self.telemetry = telemetry
+        if self.telemetry is None and log_dir:
             from tf_operator_tpu.telemetry.collector import TelemetryCollector
 
             self.telemetry = TelemetryCollector(log_dir)

@@ -167,6 +167,15 @@ def job_status_to_dict(status: JobStatus) -> dict:
         },
         "startTime": status.start_time,
         "completionTime": status.completion_time,
+        # Gang-recovery bookkeeping: the consecutive tally/heartbeat
+        # baseline must survive operator failover (the whole point of a
+        # CONSECUTIVE counter is that it persists until progress, not
+        # until the next leader election).
+        "gangRestarts": status.gang_restarts,
+        "consecutiveRestarts": status.consecutive_restarts,
+        "restartHeartbeatStep": status.restart_heartbeat_step,
+        "pendingGangRollUids": list(status.pending_gang_roll_uids),
+        "stuckPendingPods": list(status.stuck_pending_pods),
     }
 
 
@@ -176,6 +185,11 @@ def job_status_from_dict(d: dict) -> JobStatus:
     status = JobStatus(
         start_time=d.get("startTime"),
         completion_time=d.get("completionTime"),
+        gang_restarts=int(d.get("gangRestarts") or 0),
+        consecutive_restarts=int(d.get("consecutiveRestarts") or 0),
+        restart_heartbeat_step=d.get("restartHeartbeatStep"),
+        pending_gang_roll_uids=list(d.get("pendingGangRollUids") or []),
+        stuck_pending_pods=list(d.get("stuckPendingPods") or []),
     )
     for c in d.get("conditions") or []:
         status.conditions.append(

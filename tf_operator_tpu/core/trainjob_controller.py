@@ -34,6 +34,7 @@ from tf_operator_tpu.api.types import (
     ReplicaType,
     RestartPolicy,
     TrainJob,
+    has_condition,
     is_failed,
     is_terminal,
 )
@@ -70,6 +71,17 @@ ANNOTATION_SLICE = "tpujob.dev/slice"
 
 SLICE_RETRY_DELAY_S = 15.0
 
+# Progress proxy for deployments with no heartbeat signal (no shared
+# log volume): a gang generation that stayed up this long before failing
+# was working, so its failure is a fresh incident, not the next lap of a
+# crash-loop — the consecutive-restart tally resets. Rapid crash-loops
+# (startup import errors, bad checkpoints) die far inside this window
+# and still exhaust backoffLimit. A deterministic mid-training failure
+# that takes longer than this each lap is indistinguishable from
+# occasional preemptions without step data — the fallback favors keeping
+# long-running jobs alive; wire a heartbeat for exact semantics.
+GANG_PROGRESS_FALLBACK_RUNTIME_S = 600.0
+
 
 class TrainJobController(ctrl.JobControllerBase):
     def __init__(
@@ -79,15 +91,34 @@ class TrainJobController(ctrl.JobControllerBase):
         gang_scheduler_name: str = gang.DEFAULT_GANG_SCHEDULER,
         slice_allocator: gang.SliceAllocator | None = None,
         keep_failed_pods: bool = True,
+        heartbeat_source=None,
     ):
         super().__init__(cluster)
         self.enable_gang = enable_gang
         self.gang_scheduler_name = gang_scheduler_name
         self.slice_allocator = slice_allocator
         self.keep_failed_pods = keep_failed_pods
+        # Anything with `job_heartbeat(ns, name) -> {"step", "t", ...} | None`
+        # (telemetry.collector.TelemetryCollector). Drives the hang watchdog
+        # and the consecutive-restart reset; None disables both (the
+        # EXIT-CODE half of gang recovery still works — it needs only pod
+        # phases).
+        self.heartbeat_source = heartbeat_source
         self._now = time.time  # injectable clock for TTL/deadline tests
+        # Stuck-Pending warnings already emitted, as "{job key}:{pod uid}"
+        # (dedup: one Warning per pod, re-armed only by pod replacement or
+        # operator restart — level-triggered reconcile would otherwise spam
+        # one event per sync). Job-scoped keys let each sync AND the
+        # job-deletion hook purge their own entries, so pod/job churn
+        # can't grow the set without bound.
+        self._stuck_pending_warned: set[str] = set()
+        # The counted-but-not-yet-drained gang-roll latch lives in
+        # status.pending_gang_roll_uids (persisted, not here): an operator
+        # failover between the count and the drain must re-issue the
+        # deletes WITHOUT re-counting the same incident.
         self.cluster.on_add("TrainJob", self._count_created)
         self.cluster.on_delete("TrainJob", self._count_deleted)
+        self.cluster.on_delete("TrainJob", self._purge_job_state)
 
     @staticmethod
     def _count_created(job: TrainJob) -> None:
@@ -279,6 +310,25 @@ class TrainJobController(ctrl.JobControllerBase):
                 ):
                     self.expectations.deletion_observed(exp_key)
 
+        # Stuck-Pending detection (recovery.pendingTimeoutSeconds): a pod
+        # wedged in Pending — unschedulable slice, image pull failure —
+        # gets a Warning event and lands in status.stuck_pending_pods
+        # instead of the job sitting silently in Created forever.
+        self._check_stuck_pending(job, pods, key)
+
+        # Gang-coherent recovery (recovery.policy=gang): a retryable
+        # gang-member failure (or a heartbeat-stale hang) rolls the WHOLE
+        # gang instead of one pod. When this sync initiated (or
+        # backoff-failed) a gang restart, the per-type loop is skipped —
+        # the deletions' events drive the next sync, which recreates the
+        # gang through the normal creation path once the old generation is
+        # fully drained (same two-phase discipline as the elastic roll).
+        if self._gang_recovery_tick(job, pods, key):
+            if job.status != old_status:
+                job.status.last_reconcile_time = self._now()
+                self.cluster.update_job_status(job)
+            return
+
         for rtype, spec in sorted(
             job.spec.replica_specs.items(), key=lambda kv: str(kv[0])
         ):
@@ -316,6 +366,321 @@ class TrainJobController(ctrl.JobControllerBase):
         if job.metadata.annotations.get(ANNOTATION_SLICE) != slice_id:
             job.metadata.annotations[ANNOTATION_SLICE] = slice_id
         return True
+
+    # ------------------------------------------------- gang-coherent recovery
+
+    @staticmethod
+    def _gang_members(pods: list[Pod]) -> list[Pod]:
+        """Pods participating in the collective: everything except
+        Evaluators (they follow the checkpoint stream from OUTSIDE the
+        SPMD world — cluster_spec never enrolls them — so a gang roll
+        neither needs nor wants to kill them)."""
+        return [
+            p for p in pods
+            if p.metadata.labels.get(ctrl.LABEL_REPLICA_TYPE, "")
+            != str(ReplicaType.EVALUATOR).lower()
+        ]
+
+    def _job_heartbeat(self, job: TrainJob) -> dict | None:
+        if self.heartbeat_source is None:
+            return None
+        try:
+            return self.heartbeat_source.job_heartbeat(job.namespace, job.name)
+        except Exception:
+            return None  # a torn/unreadable heartbeat is "no signal", never a crash
+
+    def _purge_job_state(self, job: TrainJob) -> None:
+        """Job deleted: drop its stuck-Pending dedup entries (they would
+        otherwise linger for the operator's lifetime)."""
+        key = f"{job.namespace}/{job.name}"
+        self._stuck_pending_warned = {
+            e for e in self._stuck_pending_warned
+            if not e.startswith(key + ":")
+        }
+
+    def _check_stuck_pending(self, job: TrainJob, pods: list[Pod], key: str) -> None:
+        """recovery.pendingTimeoutSeconds: surface pods wedged in Pending
+        (Warning event once per pod + status.stuck_pending_pods)."""
+        timeout = job.spec.run_policy.recovery.pending_timeout_seconds
+        if timeout is None:
+            if job.status.stuck_pending_pods:
+                job.status.stuck_pending_pods = []
+            return
+        now = self._now()
+        stuck: list[str] = []
+        soonest: float | None = None
+        pending_uids: set[str] = set()
+        for pod in pods:
+            if pod.status.phase != PodPhase.PENDING:
+                continue
+            pending_uids.add(pod.metadata.uid)
+            waited = now - pod.metadata.creation_timestamp
+            if waited >= timeout:
+                stuck.append(pod.name)
+                if f"{key}:{pod.metadata.uid}" not in self._stuck_pending_warned:
+                    self._stuck_pending_warned.add(f"{key}:{pod.metadata.uid}")
+                    self.cluster.record_event(
+                        TrainJob.KIND, job.namespace, job.name, "Warning",
+                        status_engine.REASON_STUCK_PENDING,
+                        f"Pod {pod.name} has been Pending for {int(waited)}s "
+                        f"(pendingTimeoutSeconds={timeout:g}): unschedulable "
+                        f"slice, image pull failure, or scheduler outage",
+                    )
+            else:
+                remaining = timeout - waited
+                soonest = remaining if soonest is None else min(soonest, remaining)
+        if soonest is not None:
+            # Wake up when the youngest Pending pod crosses the deadline —
+            # stuck detection must not depend on an unrelated pod event.
+            self.queue.add_after(key, soonest + 0.25)
+        stuck.sort()
+        if stuck != job.status.stuck_pending_pods:
+            job.status.stuck_pending_pods = stuck
+        # Bound the warned set: every entry of THIS job whose pod is no
+        # longer Pending — left the phase, replaced, or deleted outright
+        # (deleted pods aren't in `pods` at all, so an is-listed check
+        # would leak their uids) — frees its entry.
+        self._stuck_pending_warned -= {
+            e for e in self._stuck_pending_warned
+            if e.startswith(f"{key}:")
+            and e.split(":", 1)[1] not in pending_uids
+        }
+
+    def _gang_recovery_tick(self, job: TrainJob, pods: list[Pod], key: str) -> bool:
+        """One gang-recovery pass: consecutive-tally reset on heartbeat
+        progress, then the two triggers — (a) a gang member failed with a
+        retryable exit code under EXIT_CODE policy, (b) the hang watchdog
+        (Running job whose freshest heartbeat is older than
+        recovery.heartbeatTimeoutSeconds). Returns True when this sync
+        initiated a gang restart or backoff-failed the job (the caller
+        then skips the per-type loop; deletions drive the next sync)."""
+        rec = job.spec.run_policy.recovery
+        if rec.policy != "gang":
+            return False  # per-pod replacement: today's path, bit-for-bit
+        now = self._now()
+        # Heartbeat aggregation hits per-pod files on disk: read at most
+        # once per tick, and ONLY on the branches that consume it — a
+        # healthy job with no watchdog and a clean tally pays zero
+        # heartbeat I/O per sync.
+        hb_memo: list[dict | None] = []
+
+        def heartbeat() -> dict | None:
+            if not hb_memo:
+                hb_memo.append(self._job_heartbeat(job))
+            return hb_memo[0]
+
+        # Sustained progress resets the consecutive tally: a week-long job
+        # eating occasional preemptions must not creep toward its
+        # backoffLimit (the limit exists to stop futile crash-loops, and a
+        # job that ADVANCES between failures is not looping).
+        if job.status.consecutive_restarts > 0:
+            hb = heartbeat()
+            if hb is not None and hb.get("step") is not None:
+                baseline = job.status.restart_heartbeat_step
+                if baseline is None:
+                    # The last counted restart couldn't read a heartbeat
+                    # (torn file, collector hiccup): establish the baseline
+                    # at the first readable step instead of treating it as
+                    # 0 — a job crash-looping at step N would otherwise
+                    # "advance" past the implicit 0 every lap and reset its
+                    # tally forever, never exhausting backoffLimit. Step-0
+                    # writes don't qualify: the trainer force-writes
+                    # {step: 0} at startup BEFORE resuming its checkpoint,
+                    # so a post-roll 0 is a generation marker, not a
+                    # progress high-water — establishing on it would let
+                    # the resume write (back at the checkpoint step, still
+                    # short of the crash point) spuriously reset the tally.
+                    if int(hb["step"]) > 0:
+                        job.status.restart_heartbeat_step = int(hb["step"])
+                elif hb["step"] >= baseline + max(
+                        1, rec.progress_threshold_steps):
+                    self.cluster.record_event(
+                        TrainJob.KIND, job.namespace, job.name, "Normal",
+                        "RestartTallyReset",
+                        f"Heartbeat advanced to step {hb['step']} (past "
+                        f"{baseline}+{rec.progress_threshold_steps}): "
+                        f"resetting consecutive restart count from "
+                        f"{job.status.consecutive_restarts}",
+                    )
+                    job.status.consecutive_restarts = 0
+                    job.status.restart_heartbeat_step = None
+            else:
+                # No step signal (heartbeat-less deployment): sustained
+                # runtime is the progress proxy, or EXIT_CODE preemptions —
+                # which the per-pod path never counted — would creep toward
+                # backoffLimit forever. Youngest member's age, so a stray
+                # older pod can't inflate the generation's runtime.
+                started = [p.status.start_time
+                           for p in self._gang_members(pods)
+                           if p.status.start_time]
+                if (started and now - max(started)
+                        >= GANG_PROGRESS_FALLBACK_RUNTIME_S):
+                    self.cluster.record_event(
+                        TrainJob.KIND, job.namespace, job.name, "Normal",
+                        "RestartTallyReset",
+                        f"Gang ran {int(now - max(started))}s without a "
+                        f"heartbeat signal (fallback progress threshold "
+                        f"{GANG_PROGRESS_FALLBACK_RUNTIME_S:g}s): resetting "
+                        f"consecutive restart count from "
+                        f"{job.status.consecutive_restarts}",
+                    )
+                    job.status.consecutive_restarts = 0
+                    job.status.restart_heartbeat_step = None
+
+        # A counted roll whose deletions are still in flight (apiserver
+        # rejected some last pass; informer cache still lists a doomed
+        # pod) is drained BEFORE any trigger logic: the triggering failed
+        # pod may already be gone while a doomed survivor lingers, and
+        # recreating peers next to an old-generation pod would build
+        # exactly the mixed-generation gang this policy exists to prevent.
+        # Re-issuing the deletes without re-counting also keeps flaky
+        # deletes from inflating the tally/metric toward backoffLimit
+        # (limit=N must mean N real gang restarts). The latch is the
+        # doomed pods' uids, NOT the Restarting condition: a recreated
+        # gang member failing anew (fresh uid) is a genuinely new failure
+        # and must count, or a job crash-looping before ever reaching
+        # Running would roll forever past its limit. It lives in status
+        # (persisted with the tally in the same update) so an operator
+        # failover mid-roll drains the survivors instead of re-entering
+        # the trigger path on the still-Failed pod and re-counting the
+        # same incident toward backoffLimit.
+        pending = set(job.status.pending_gang_roll_uids)
+        if pending:
+            left = [p for p in pods if p.metadata.uid in pending]
+            if left:
+                self._delete_gang_pods(job, key, left)
+                return True
+            job.status.pending_gang_roll_uids = []  # roll fully drained
+
+        members = self._gang_members(pods)
+        live = [p for p in members if not p.is_finished()]
+
+        # Trigger (a): retryable gang-member failure. A NON-retryable
+        # failure wins — fall through to the normal status machine, which
+        # marks the job Failed (gang restarting around a permanent error
+        # would just crash-loop the whole slice).
+        trigger: tuple[str, str] | None = None  # (metric reason, detail)
+        failed_retryable: list[Pod] = []
+        for pod in members:
+            if pod.status.phase != PodPhase.FAILED:
+                continue
+            rt = api_defaults.canonical_replica_type(
+                pod.metadata.labels.get(ctrl.LABEL_REPLICA_TYPE, "")
+            )
+            spec = job.spec.replica_specs.get(rt) if rt is not None else None
+            if spec is None or spec.restart_policy != RestartPolicy.EXIT_CODE:
+                continue
+            code = pod.main_exit_code()
+            if code is None or not is_retryable_exit_code(code):
+                return False  # permanent failure: normal path fails the job
+            failed_retryable.append(pod)
+            if trigger is None:
+                # Same cause taxonomy as the per-pod path: 128+signum is
+                # infrastructure (preemption/eviction) EXCEPT 138, the
+                # app-declared restart request.
+                infra = is_signal_exit(code) and code != EXIT_USER_RETRYABLE
+                trigger = (
+                    "preempt" if infra else "exit_code",
+                    f"pod {pod.name} exited with retryable code {code}",
+                )
+
+        # Trigger (b): the hang watchdog. Armed only once a heartbeat
+        # exists; staleness is measured against the freshest of (heartbeat
+        # write, live pod start) so a just-rolled gang gets a full quiet
+        # window to import/compile/resume before the clock can fire again.
+        if (trigger is None and rec.heartbeat_timeout_seconds
+                and live and has_condition(job.status, JobConditionType.RUNNING)):
+            hb = heartbeat()
+            if hb is None:
+                self.queue.add_after(key, rec.heartbeat_timeout_seconds)
+            else:
+                freshest = max(
+                    [float(hb.get("t") or 0.0)]
+                    + [p.status.start_time or p.metadata.creation_timestamp
+                       for p in live]
+                )
+                age = now - freshest
+                if age >= rec.heartbeat_timeout_seconds:
+                    self.cluster.record_event(
+                        TrainJob.KIND, job.namespace, job.name, "Warning",
+                        status_engine.REASON_HEARTBEAT_STALE,
+                        f"No trainer progress for {int(age)}s (heartbeat at "
+                        f"step {hb.get('step')}, "
+                        f"heartbeatTimeoutSeconds="
+                        f"{rec.heartbeat_timeout_seconds:g}): treating the "
+                        f"job as hung",
+                    )
+                    trigger = (
+                        "hang",
+                        f"heartbeat stale for {int(age)}s at step "
+                        f"{hb.get('step')}",
+                    )
+                else:
+                    self.queue.add_after(
+                        key, rec.heartbeat_timeout_seconds - age + 0.25
+                    )
+
+        if trigger is None:
+            return False
+
+        reason, detail = trigger
+        limit = job.spec.run_policy.backoff_limit
+        if limit is not None and job.status.consecutive_restarts >= limit:
+            msg = (
+                f"TrainJob {key} has exceeded its backoffLimit ({limit} "
+                f"consecutive gang restarts without progress; last: {detail})"
+            )
+            self.cluster.record_event(
+                TrainJob.KIND, job.namespace, job.name, "Warning",
+                status_engine.REASON_BACKOFF_EXCEEDED, msg,
+            )
+            if status_engine.set_condition(
+                job.status, JobConditionType.FAILED,
+                status_engine.REASON_BACKOFF_EXCEEDED, msg, now,
+            ):
+                metrics.jobs_failed.labels(namespace=job.namespace).inc()
+            if job.status.completion_time is None:
+                job.status.completion_time = now
+            return True
+
+        # The restart: ONE tally increment and ONE restarts_total sample
+        # however many pods roll, heartbeat high-water recorded as the
+        # progress baseline the reset above compares against.
+        job.status.consecutive_restarts += 1
+        job.status.gang_restarts += 1
+        hb = heartbeat()
+        if hb is not None and hb.get("step") is not None:
+            job.status.restart_heartbeat_step = int(hb["step"])
+        metrics.restarts_total.labels(
+            namespace=job.namespace, reason=reason
+        ).inc()
+        doomed = live + failed_retryable
+        self.cluster.record_event(
+            TrainJob.KIND, job.namespace, job.name, "Normal",
+            status_engine.REASON_GANG_RESTART,
+            f"Gang restart #{job.status.gang_restarts} ({detail}): deleting "
+            f"{len(doomed)} pod(s); consecutive restarts without progress: "
+            f"{job.status.consecutive_restarts}",
+        )
+        status_engine.record_gang_restart(
+            job,
+            f"TrainJob {key} is gang-restarting: {detail}.",
+            now,
+        )
+        job.status.pending_gang_roll_uids = sorted(
+            p.metadata.uid for p in doomed
+        )
+        self._delete_gang_pods(job, key, doomed)
+        return True
+
+    def _delete_gang_pods(self, job: TrainJob, key: str,
+                          doomed: list[Pod]) -> None:
+        for pod in doomed:
+            rt = pod.metadata.labels.get(ctrl.LABEL_REPLICA_TYPE, "")
+            exp_key = naming.gen_expectation_pods_key(key, rt)
+            self.expectations.raise_expectations(exp_key, 0, 1)
+            if not self.pod_control.delete_pod(pod.namespace, pod.name, job):
+                self.expectations.deletion_observed(exp_key)
 
     # ---------------------------------------------------------- limit checks
 

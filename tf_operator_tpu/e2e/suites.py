@@ -409,6 +409,39 @@ def pod_names_contract(client: TrainJobClient) -> None:
 # ------------------------------------------------------------------ elastic
 
 
+def _await_progress(client: TrainJobClient, name: str, pred, what: str,
+                    stall_timeout: float = 90.0,
+                    max_timeout: float = 600.0) -> None:
+    """Event-driven wait (round 10, deflaking elastic_scale_up_down): the
+    deadline is measured from the job's LAST OBSERVED EVENT, not from the
+    start of the wait. Under co-located bench load a slow-but-advancing
+    rolling replacement keeps emitting pod create/delete/TopologyChanged
+    events and never times out; a genuinely wedged controller goes quiet
+    and fails after stall_timeout of silence. max_timeout hard-bounds the
+    wait regardless (a pathological event storm must not wait forever)."""
+    start = time.monotonic()
+    last_activity = start
+    seen = -1
+    while True:
+        state = pred()
+        if state is True:
+            return
+        n = len(client.get_events(NS, name))
+        now = time.monotonic()
+        if n != seen:
+            seen = n
+            last_activity = now
+        if now - last_activity > stall_timeout:
+            raise AssertionError(
+                f"{what}: no controller activity for "
+                f"{now - last_activity:.0f}s (events={n}, state={state!r})")
+        if now - start > max_timeout:
+            raise AssertionError(
+                f"{what}: not reached after {max_timeout:.0f}s "
+                f"(events={n}, state={state!r})")
+        time.sleep(0.2)
+
+
 def elastic_scale_up_down(client: TrainJobClient) -> None:
     """Beyond the reference's eight behaviors (SURVEY §5 'No elasticity'):
     scale a RUNNING job up, see the new replica appear (and every worker
@@ -420,29 +453,31 @@ def elastic_scale_up_down(client: TrainJobClient) -> None:
     try:
         client.wait_for_condition(NS, name, ("Running",))
 
-        client.scale(NS, name, {"Worker": 3})
-        deadline = time.monotonic() + 120
-        while time.monotonic() < deadline:
-            pods = {p["name"] for p in client.list_pods(NS)
+        def job_pods() -> set[str]:
+            return {p["name"] for p in client.list_pods(NS)
                     if p["name"].startswith(f"{name}-")}
-            if pods == {f"{name}-worker-{i}" for i in range(3)}:
-                break
-            time.sleep(0.2)
-        else:
-            raise AssertionError(f"scale-up never produced 3 workers: {pods}")
+
+        def pods_are(want: set[str]):
+            def pred():
+                pods = job_pods()
+                return True if pods == want else sorted(pods)
+            return pred
+
+        client.scale(NS, name, {"Worker": 3})
+        _await_progress(
+            client, name,
+            pods_are({f"{name}-worker-{i}" for i in range(3)}),
+            "scale-up to 3 workers",
+        )
         job = client.get(NS, name)
         assert job["manifest"]["spec"]["replicaSpecs"]["Worker"]["replicas"] == 3
 
         client.scale(NS, name, {"Worker": 1})
-        deadline = time.monotonic() + 120
-        while time.monotonic() < deadline:
-            pods = {p["name"] for p in client.list_pods(NS)
-                    if p["name"].startswith(f"{name}-")}
-            if pods == {f"{name}-worker-0"}:
-                break
-            time.sleep(0.2)
-        else:
-            raise AssertionError(f"scale-down never drained to worker-0: {pods}")
+        _await_progress(
+            client, name,
+            pods_are({f"{name}-worker-0"}),
+            "scale-down to worker-0",
+        )
         events = [e["reason"] for e in client.get_events(NS, name)]
         assert "ScaleDown" in events, events
         assert "TopologyChanged" in events, events

@@ -121,10 +121,38 @@ def _aux_tree(state) -> dict:
     return tree
 
 
-# Trainer-side chaos directives (kill-at-step / torn-checkpoint), set once
-# per main() from TPUJOB_CHAOS / --chaos; None — the default — costs one
-# `is None` check per boundary.
+# Trainer-side chaos directives (kill-at-step / hang-at-step /
+# torn-checkpoint), set once per main() from TPUJOB_CHAOS / --chaos; None —
+# the default — costs one `is None` check per boundary.
 _chaos = None
+
+# Progress heartbeat (TPUJOB_HEARTBEAT_FILE, runtime-injected): written at
+# step boundaries so the operator's hang watchdog can tell a Running job
+# from a wedged one. Module-global like _chaos (the two loops and the
+# boundary helpers share it); None-path costs one `is None` check.
+_heartbeat = None
+
+
+def _hb(step: int, force: bool = False) -> None:
+    if _heartbeat is not None:
+        _heartbeat.write(step, force=force)
+
+
+def _boundary_chaos(done: int, start_step: int) -> None:
+    """Step-boundary chaos hook shared by both loops: hang-at-step (stop
+    making progress without exiting — the wedged-collective simulation the
+    heartbeat watchdog exists for), then kill-at-step. Order matters: a
+    directive pairing both at one step should go quiet BEFORE dying."""
+    if _chaos is None:
+        return
+    d = _chaos.hang_at(done, start_step)
+    if d is not None:
+        from tf_operator_tpu import chaos as chaos_lib
+
+        duration = d.params.get("duration")
+        _emit({"event": "chaos_hang", "step": done, "duration": duration})
+        chaos_lib.hang(duration)
+    _chaos.maybe_kill(done, start_step)
 
 
 def _save_checkpoint(ckpt_dir: str, step: int, state, final: bool = False,
@@ -527,6 +555,7 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
             "local_samples": ds.num_samples,
         }
     )
+    _hb(done, force=True)  # first optimizer step landed: liveness + step
     profiling = bool(args.profile_dir) and done < args.steps
     if profiling:
         _start_profile(args.profile_dir)
@@ -565,11 +594,12 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
                         args.checkpoint_dir, done, state,
                         keep=args.keep_checkpoints)
                     last_ckpt_step = done
-            # Step boundary: chaos kill-at-step fires here, and a latched
+            # Step boundary: the progress heartbeat records the completed
+            # step, chaos hang/kill-at-step fire here, and a latched
             # preemption signal (SIGTERM/SIGINT/SIGUSR1 — real or chaos-
             # injected) turns into emergency-checkpoint + exit 128+signum.
-            if _chaos is not None:
-                _chaos.maybe_kill(done, start_step)
+            _hb(done)
+            _boundary_chaos(done, start_step)
             if guard.triggered:
                 return _preempt_exit(args, guard, state, done, saver,
                                      last_save_s, last_ckpt_step, st)
@@ -590,6 +620,9 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
     if saver:
         _save_checkpoint(args.checkpoint_dir, args.steps, state, final=True,
                          keep=args.keep_checkpoints)
+    # The final step must land in the heartbeat whatever the throttle did
+    # at intermediate boundaries (the watchdog/collector read it back).
+    _hb(args.steps, force=True)
     steady = args.steps - start_step - 1
     sps = round(steady / dt, 4) if steady > 0 else None
     from tf_operator_tpu.data.prefetch import overlap_efficiency
@@ -908,10 +941,16 @@ def main(argv: list[str] | None = None) -> int:
     # so a signal during startup is latched rather than fatal, and after
     # flag validation so ap.error paths never touch process-wide signal
     # disposition (in-process CLI tests included).
-    from tf_operator_tpu.utils.preemption import PreemptionGuard
+    from tf_operator_tpu.utils.preemption import HeartbeatWriter, PreemptionGuard
 
     guard = PreemptionGuard()
     guard.install()
+    # Liveness from the very first moment: an immediate forced heartbeat
+    # (before the slow jax import) tells the hang watchdog this generation
+    # is alive even while startup/compile produces no step boundaries.
+    global _heartbeat
+    _heartbeat = HeartbeatWriter.from_env()
+    _hb(0, force=True)
 
     try:
         return _run_trainer(args, guard)
@@ -922,6 +961,7 @@ def main(argv: list[str] | None = None) -> int:
         # the host's Ctrl-C semantics survive this function.
         guard.uninstall()
         _chaos = None
+        _heartbeat = None
         if args.chaos is not None:
             if chaos_env_prev is None:
                 os.environ.pop(chaos_lib.ENV_CHAOS, None)
@@ -976,6 +1016,7 @@ def _run_trainer(args, guard) -> int:
     # the north-star latency metric is judged on).
     _emit({"event": "jax_ready", "t": time.time(),
            "backend": jax.default_backend()})
+    _hb(0, force=True)  # startup liveness milestone (pre state-build)
     rules = None
     # Each branch defines init_params(rng) -> (params, model_state) as a
     # TRACEABLE closure: the whole setup (init + optimizer) compiles into
@@ -1239,6 +1280,10 @@ def _run_trainer(args, guard) -> int:
     state, start_step = _try_resume(args.checkpoint_dir, state, tx)
     state = shard_state(state, mesh, rules)
     _emit({"event": "model_ready", "t": time.time()})
+    # Startup liveness milestone: the resumed step is known, the first
+    # (possibly long) compile is about to start — refresh the heartbeat so
+    # the watchdog's staleness clock restarts here, not at process start.
+    _hb(start_step, force=True)
     if start_step >= args.steps:
         # Already trained to (or past) the target: restart policies must be
         # idempotent, not retrain.
@@ -1314,10 +1359,11 @@ def _run_trainer(args, guard) -> int:
             last_ckpt_step = done
 
     def check_boundary(done: int, st=None) -> int | None:
-        """Chaos kill-at-step + preemption handling after a chunk: returns
-        the exit code to leave with, or None to continue training."""
-        if _chaos is not None:
-            _chaos.maybe_kill(done, start_step)
+        """Heartbeat + chaos hang/kill-at-step + preemption handling after
+        a chunk: returns the exit code to leave with, or None to continue
+        training."""
+        _hb(done)
+        _boundary_chaos(done, start_step)
         if guard.triggered:
             return _preempt_exit(args, guard, state, done, saver,
                                  last_save_s, last_ckpt_step, st)
@@ -1435,6 +1481,9 @@ def _run_trainer(args, guard) -> int:
     if saver:
         _save_checkpoint(args.checkpoint_dir, args.steps, state, final=True,
                          keep=args.keep_checkpoints)
+    # The final step must land in the heartbeat whatever the throttle did
+    # at intermediate boundaries (the watchdog/collector read it back).
+    _hb(args.steps, force=True)
     # With steps <= one chunk there is no steady-state window (only the
     # compile call ran); report null throughput rather than a
     # microseconds-denominator lie.

@@ -302,6 +302,47 @@ class LocalProcessRuntime:
             proc.stopping = True
             self._terminate(proc.process)
 
+            def _drop_after_exit(p=proc.process, uid=pod.metadata.uid):
+                # _terminate only sends SIGTERM: the trainer latches it,
+                # finishes the in-flight step, and writes a final heartbeat
+                # at the boundary — recreating the file AFTER the unlink
+                # below. For a pod that is never respawned (scale-down, job
+                # deleted) that resurrected file would be exactly the stale
+                # signal the drop exists to prevent, so drop again once the
+                # process is confirmed dead. Skip if a replacement pod
+                # already exists — its spawn-side drop owns the file now,
+                # and unlinking here would blip its live heartbeat.
+                try:
+                    p.wait(timeout=60.0)
+                except Exception:
+                    pass
+                cur = self.cluster.try_get_pod(pod.namespace, pod.name)
+                if cur is None or cur.metadata.uid == uid:
+                    self._drop_heartbeat(pod)
+
+            threading.Thread(target=_drop_after_exit, daemon=True,
+                             name=f"hb-drop-{pod.name}").start()
+        self._drop_heartbeat(pod)
+
+    def _drop_heartbeat(self, pod: Pod) -> None:
+        """The heartbeat drives control decisions (hang watchdog, restart
+        tally reset), so a deleted or replaced pod must not leave a stale
+        file behind: the collector aggregates by job-name glob, and a
+        resubmitted same-name job (or one scaled below its old replica
+        count) would inherit the dead run's step high-water and heartbeat
+        existence. Only the runtime-injected per-pod default path is
+        dropped — an explicit TPUJOB_HEARTBEAT_FILE override is the
+        caller's to manage, and metrics event files deliberately persist
+        (they are the append-only post-mortem record)."""
+        if not self.log_dir:
+            return
+        try:
+            os.unlink(os.path.join(
+                self.log_dir, f"{pod.namespace}_{pod.name}.heartbeat.json"
+            ))
+        except OSError:
+            pass
+
     def _await_drained(self, ns: str, job: str, grace: float = 2.0,
                        timeout: float = 8.0) -> None:
         """Block until every draining process of (ns, job) is dead (SIGKILL
@@ -363,6 +404,14 @@ class LocalProcessRuntime:
             env["TPUJOB_METRICS_FILE"] = os.path.join(
                 self.log_dir, f"{pod.namespace}_{pod.name}.metrics.jsonl"
             )
+        # Progress heartbeat (round 10, same pattern as the metrics file):
+        # the trainer os.replace's a tiny {step, t} JSON here at step
+        # boundaries; the controller's hang watchdog and the telemetry
+        # collector's tpujob_heartbeat_age_seconds gauge read it back.
+        if self.log_dir and not env.get("TPUJOB_HEARTBEAT_FILE"):
+            env["TPUJOB_HEARTBEAT_FILE"] = os.path.join(
+                self.log_dir, f"{pod.namespace}_{pod.name}.heartbeat.json"
+            )
         return env
 
     def _own_host(self, pod: Pod, pm: PortMap) -> tuple[str | None, dict[str, int]]:
@@ -417,6 +466,11 @@ class LocalProcessRuntime:
         cur = self.cluster.try_get_pod(pod.namespace, pod.name)
         if self._stopped or cur is None or cur.metadata.uid != pod.metadata.uid:
             return
+        # A fresh execution must not inherit a previous same-named pod's
+        # heartbeat (runtime restarted over an old log_dir, job deleted
+        # uncleanly): ordering after _await_drained means no old-generation
+        # process can rewrite the file after this point.
+        self._drop_heartbeat(pod)
         pm = self._port_map_for(pod)
         env = self._build_env(pod, pm)
         restart_policy = pod.spec.restart_policy or "Never"

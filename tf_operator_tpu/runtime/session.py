@@ -40,8 +40,18 @@ class LocalSession:
         log_dir: str | None = None,
     ):
         self.cluster = InMemoryCluster()
+        # With a log_dir the runtime injects per-pod heartbeat/metrics
+        # files; the collector reads them back as the controller's
+        # heartbeat source (hang watchdog + consecutive-restart reset).
+        self.telemetry = None
+        if log_dir:
+            from tf_operator_tpu.telemetry.collector import TelemetryCollector
+
+            self.telemetry = TelemetryCollector(log_dir)
         self.controller = TrainJobController(
-            self.cluster, enable_gang=enable_gang, slice_allocator=slice_allocator
+            self.cluster, enable_gang=enable_gang,
+            slice_allocator=slice_allocator,
+            heartbeat_source=self.telemetry,
         )
         self.runtime = LocalProcessRuntime(
             self.cluster, env_overrides=env_overrides, log_dir=log_dir

@@ -40,6 +40,27 @@ REASON_INVALID_SPEC = "TrainJobFailedValidation"
 REASON_BACKOFF_EXCEEDED = "BackoffLimitExceeded"
 REASON_DEADLINE_EXCEEDED = "DeadlineExceeded"
 REASON_SUSPENDED = "TrainJobSuspended"
+# Gang-coherent recovery (round 10): a slice-wide roll gets its own
+# Restarting reason so dashboards/tests can tell it from a single-pod
+# replacement; the stale-heartbeat warning and stuck-Pending warning are
+# event reasons with the same stability contract.
+REASON_GANG_RESTART = "GangRestart"
+REASON_HEARTBEAT_STALE = "HeartbeatStale"
+REASON_STUCK_PENDING = "StuckPending"
+
+
+def record_gang_restart(job: TrainJob, message: str, now: float) -> bool:
+    """Set the Restarting condition for a gang-coherent restart (reason
+    GangRestart) and count the jobs_restarted transition — the
+    gang-recovery analogue of update_status_single's restart branch.
+    Returns True when the condition changed."""
+    changed = set_condition(
+        job.status, JobConditionType.RESTARTING, REASON_GANG_RESTART,
+        message, now,
+    )
+    if changed:
+        metrics.jobs_restarted.labels(namespace=job.namespace).inc()
+    return changed
 
 
 def _find(status: JobStatus, ctype: JobConditionType) -> JobCondition | None:

@@ -307,10 +307,13 @@ jobs_restarted = DEFAULT.counter(
 # from a crash-looping image (page someone): reason=preempt (killed by an
 # infrastructure signal: 130/137/143...), exit_code (retryable
 # app-declared code, e.g. 138), backoff (kubelet in-place Always/
-# OnFailure restart, the kind pastBackoffLimit counts).
+# OnFailure restart, the kind pastBackoffLimit counts), hang (the
+# progress-heartbeat watchdog declared a Running job wedged and
+# gang-restarted it — round 10). A gang restart increments ONCE however
+# many pods it rolls.
 restarts_total = DEFAULT.counter(
     "tpujob_restarts_total",
-    "Replica restarts by cause (reason: preempt | exit_code | backoff)",
+    "Replica restarts by cause (reason: preempt | exit_code | backoff | hang)",
     labels_only=True,
 )
 is_leader = DEFAULT.gauge(

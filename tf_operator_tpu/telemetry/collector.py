@@ -25,8 +25,10 @@ from __future__ import annotations
 import json
 import os
 import re
+import time
 
 from tf_operator_tpu.status import metrics as metrics_mod
+from tf_operator_tpu.utils.preemption import read_heartbeat
 
 __all__ = ["TRAINER_GAUGES", "TelemetryCollector", "summarize_events"]
 
@@ -48,6 +50,9 @@ TRAINER_GAUGES = {
         "Median per-step wall-clock from the done event's step_time_s",
     "tpujob_trainer_step_time_p99_s":
         "p99 per-step wall-clock from the done event's step_time_s",
+    "tpujob_heartbeat_age_seconds":
+        "Seconds since the job's freshest trainer progress heartbeat "
+        "(TPUJOB_HEARTBEAT_FILE; the hang-watchdog's staleness signal)",
 }
 
 # Pod names are {job}-{type}-{index} (utils/naming.py); anchoring on the
@@ -126,14 +131,17 @@ class TelemetryCollector:
 
     # ------------------------------------------------------------- reading
 
-    def _job_files(self, namespace: str, job: str) -> dict[str, str]:
-        """pod name -> metrics-file path, for every replica of the job
-        that ever wrote one (globbing the log_dir covers pods that have
-        already been deleted — their last telemetry outlives them)."""
+    def _job_files(self, namespace: str, job: str,
+                   suffix: str = r"\.metrics\.jsonl") -> dict[str, str]:
+        """pod name -> per-pod file path for every replica of the job that
+        ever wrote one (globbing the log_dir covers pods that have already
+        been deleted — their last telemetry outlives them). `suffix` picks
+        the file family: metrics events by default, heartbeats via
+        _job_heartbeat_files."""
         # Filename layout mirrors the runtime's log files ({ns}_{pod}.log).
         pat = re.compile(
             rf"^{re.escape(namespace)}_({re.escape(job)}-{_REPLICA_RE})"
-            rf"\.metrics\.jsonl$"
+            rf"{suffix}$"
         )
         out: dict[str, str] = {}
         try:
@@ -146,6 +154,46 @@ class TelemetryCollector:
                 out[m.group(1)] = os.path.join(self.log_dir, fn)
         return out
 
+    def _job_heartbeat_files(self, namespace: str, job: str) -> dict[str, str]:
+        """pod name -> heartbeat-file path (runtime-injected
+        TPUJOB_HEARTBEAT_FILE, same naming scheme as the metrics files).
+        Evaluator replicas are EXCLUDED, mirroring the controller's gang
+        exemption: they sit outside the collective and their trainer
+        process only force-writes heartbeats at startup milestones, never
+        in the eval polling loop — aggregating that one-shot signal would
+        arm the hang watchdog for a gang whose workers never heartbeat
+        and then read permanently stale, rolling a healthy job to
+        BackoffLimitExceeded."""
+        return {
+            pod: path
+            for pod, path in self._job_files(
+                namespace, job, suffix=r"\.heartbeat\.json").items()
+            if not pod.startswith(f"{job}-evaluator-")
+        }
+
+    def job_heartbeat(self, namespace: str, job: str) -> dict | None:
+        """The job's aggregated progress heartbeat, or None when no replica
+        has written one yet. `step` is the high-water step across replicas,
+        `t` the FRESHEST write — a gang is only 'hung' once even its most
+        recent member has gone quiet (when one host dies the survivors
+        wedge in the collective, so all heartbeats go stale together).
+        This is the controller's heartbeat_source interface."""
+        per_pod: dict[str, dict] = {}
+        for pod, path in sorted(self._job_heartbeat_files(namespace, job).items()):
+            hb = read_heartbeat(path)
+            if hb is not None:
+                per_pod[pod] = hb
+        if not per_pod:
+            return None
+        step = max((hb.get("step") or 0) for hb in per_pod.values())
+        t = max((hb.get("t") or 0.0) for hb in per_pod.values())
+        return {
+            "step": int(step),
+            "t": float(t),
+            "age_seconds": max(0.0, time.time() - float(t)),
+            "replicas": per_pod,
+        }
+
     def job_telemetry(self, namespace: str, job: str) -> dict | None:
         """The per-job `telemetry` block for GET /api/trainjobs/{ns}/{name}:
         {"replicas": {pod: summary}} or None when no replica reported."""
@@ -154,7 +202,17 @@ class TelemetryCollector:
             summary = summarize_events(_read_events(path))
             if summary:
                 replicas[pod] = summary
-        return {"replicas": replicas} if replicas else None
+        hb = self.job_heartbeat(namespace, job)
+        if not replicas and hb is None:
+            return None
+        out: dict = {"replicas": replicas}
+        if hb is not None:
+            out["heartbeat"] = {
+                "step": hb["step"],
+                "t": hb["t"],
+                "age_seconds": round(hb["age_seconds"], 3),
+            }
+        return out
 
     # -------------------------------------------------------------- gauges
 
@@ -188,10 +246,17 @@ class TelemetryCollector:
             tel = self.job_telemetry(job.namespace, job.name)
             if not tel:
                 continue
+            labels = {"namespace": job.namespace, "job": job.name}
+            hb = tel.get("heartbeat")
+            if hb is not None:
+                # Recomputed per scrape, not cached: age grows between
+                # trainer writes, and a frozen age is exactly the signal
+                # a hang dashboard alerts on.
+                self._gauges["tpujob_heartbeat_age_seconds"].labels(
+                    **labels).set(float(hb["age_seconds"]))
             primary = self._primary(tel["replicas"])
             if not primary:
                 continue
-            labels = {"namespace": job.namespace, "job": job.name}
             step_time = primary.get("step_time_s") or {}
             for gauge_name, value in (
                 ("tpujob_trainer_steps_per_sec",

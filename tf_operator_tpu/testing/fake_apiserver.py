@@ -149,8 +149,16 @@ def _validate_and_prune(obj, schema: dict, path: str = "") -> list[str]:
     if enum is not None and obj not in enum:
         errs.append(f"{path}: {obj!r} not in {enum}")
     if isinstance(obj, (int, float)) and not isinstance(obj, bool):
-        if "minimum" in schema and obj < schema["minimum"]:
-            errs.append(f"{path}: {obj} < minimum {schema['minimum']}")
+        if "minimum" in schema:
+            # apiextensions/v1 JSONSchemaProps: exclusiveMinimum is a
+            # BOOLEAN modifying `minimum` (not the JSON-Schema-draft
+            # numeric form the name suggests).
+            if schema.get("exclusiveMinimum") and obj <= schema["minimum"]:
+                errs.append(
+                    f"{path}: {obj} <= exclusive minimum {schema['minimum']}"
+                )
+            elif obj < schema["minimum"]:
+                errs.append(f"{path}: {obj} < minimum {schema['minimum']}")
         if "maximum" in schema and obj > schema["maximum"]:
             errs.append(f"{path}: {obj} > maximum {schema['maximum']}")
     return errs

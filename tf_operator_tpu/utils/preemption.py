@@ -22,15 +22,88 @@ Signals handled:
 Only the FIRST signal is latched (a second SIGTERM during the grace
 window must not re-enter teardown); the handler itself is async-signal
 safe — it records (signum, monotonic time) and returns.
+
+This module also carries the progress heartbeat (round 10): exit codes
+can only report failures that EXIT. A job wedged in a dead collective is
+Running forever as far as pod phases go, so the trainer additionally
+writes a tiny monotonic `{step, t}` heartbeat file at step boundaries
+(`TPUJOB_HEARTBEAT_FILE`, injected by the runtime like
+`TPUJOB_METRICS_FILE`); the operator's hang watchdog
+(`recovery.heartbeatTimeoutSeconds`) treats a stale heartbeat on a
+Running job as a hang and gang-restarts it.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import signal
 import threading
 import time
 
 HANDLED_SIGNALS = (signal.SIGTERM, signal.SIGINT, signal.SIGUSR1)
+
+ENV_HEARTBEAT_FILE = "TPUJOB_HEARTBEAT_FILE"
+
+
+class HeartbeatWriter:
+    """Writes the trainer's progress heartbeat: `{"step": N, "t": <epoch>,
+    "pid": ...}`, atomically (tmp + os.replace) so a reader never sees a
+    torn JSON. `step` is monotonic within one process generation; `t` is
+    wall-clock at write time — the watchdog's staleness clock.
+
+    Throttled: boundaries closer together than `min_interval_s` skip the
+    write (tiny models step thousands of times per second; hang timeouts
+    are seconds-scale, so sub-second cadence buys nothing). With no path
+    configured every call is a no-op — standalone runs pay one `is None`
+    check. IO errors are swallowed: a full disk must degrade the liveness
+    signal, never kill the training step that just completed."""
+
+    def __init__(self, path: str | None, min_interval_s: float = 0.5):
+        self.path = path or None
+        self.min_interval_s = min_interval_s
+        self._last_write = 0.0
+
+    @classmethod
+    def from_env(cls, env: dict | None = None) -> "HeartbeatWriter":
+        e = os.environ if env is None else env
+        return cls(e.get(ENV_HEARTBEAT_FILE))
+
+    def write(self, step: int, force: bool = False) -> bool:
+        """Record `step` as completed; True when a write actually landed."""
+        if self.path is None:
+            return False
+        now = time.monotonic()
+        if not force and now - self._last_write < self.min_interval_s:
+            return False
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"step": int(step), "t": time.time(),
+                           "pid": os.getpid()}, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        self._last_write = now
+        return True
+
+
+def read_heartbeat(path: str) -> dict | None:
+    """One pod's heartbeat, or None (absent/torn/not-yet-written). The
+    writer's os.replace makes a torn read mean 'no heartbeat', which the
+    watchdog treats as not-armed — the safe direction."""
+    try:
+        with open(path) as f:
+            hb = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(hb, dict) or "t" not in hb:
+        return None
+    return hb
 
 
 class PreemptionGuard:
