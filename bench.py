@@ -473,7 +473,25 @@ def _main() -> int:
     cc_dir = tempfile.mkdtemp(prefix="tpujob-bench-cc-")
     mnist_args = dict(steps=200, batch=128, extra=[], timeout=600,
                       env={"TPUJOB_COMPILE_CACHE": cc_dir})
+
+    def _cc_entries():
+        # jax's persistent cache writes one "<key>-cache" file per compiled
+        # executable (plus "-atime" bookkeeping). Counting entries after
+        # EACH run turns a warm==cold reading from a mystery into a
+        # verdict (the round-11 fix for BENCH_r05's relapse): the warm run
+        # adding entries means the cache keys changed between identical
+        # runs — measurement broken, file a bug; zero new entries means
+        # the cache HIT, so any remaining warm startup cost is genuinely
+        # not compile ("cache ineffective on this backend" is then a
+        # backend statement, not a bench artifact).
+        try:
+            return sum(1 for f in os.listdir(cc_dir) if f.endswith("-cache"))
+        except OSError:
+            return None
+
     mnist = chip_job("mnist-mlp", **mnist_args)
+    entries_after_cold = _cc_entries()
+    entries_after_warm = None
     mnist_first_run = None
     cold_startup = None
     warm_ran = False
@@ -491,6 +509,7 @@ def _main() -> int:
                            "startup_s": cold_startup,
                            "compile_cache": "cold (fresh cache dir)"}
         second = chip_job("mnist-mlp", **mnist_args)
+        entries_after_warm = _cc_entries()
         if second["ok"]:
             mnist = second
             warm_ran = True
@@ -500,10 +519,11 @@ def _main() -> int:
             log("  second run failed; headline keeps the first run")
             mnist_first_run["second_run_error"] = second.get(
                 "error", "job failed")
-    try:
-        cc_entries = sum(1 for f in os.listdir(cc_dir) if f.endswith("-cache"))
-    except OSError:
-        cc_entries = None
+    cc_entries = (entries_after_warm if entries_after_warm is not None
+                  else entries_after_cold)
+    cc_warm_new = (entries_after_warm - entries_after_cold
+                   if entries_after_warm is not None
+                   and entries_after_cold is not None else None)
     if not mnist["ok"]:
         log(f"MNIST job FAILED: {mnist}")
         tunnel_note = None if _state["tunnel_ok"] else "tunnel_down_midrun"
@@ -527,7 +547,8 @@ def _main() -> int:
     device_kind = ev.get("first_step", {}).get("device_kind")
     peak = device_peak_tflops(device_kind)
     log(f"  wallclock={mnist['wallclock_s']}s startup->first-step={startup}s "
-        f"(cold={cold_startup}s, compile cache entries={cc_entries}) "
+        f"(cold={cold_startup}s, compile cache entries={cc_entries}, "
+        f"warm run added {cc_warm_new}) "
         f"steps/s={mnist_sps} backend={backend}")
 
     # --- Workload 2: ResNet-50 training throughput on the chip ---
@@ -600,19 +621,23 @@ def _main() -> int:
         extra=["--image-size", str(rn_size), "--data-dir", rnd_dir],
         timeout=1800,
     )
-    # --- Workload 2c (round 7): the same point through the staging ring ---
-    # data/staging.py: uint8 wire + K staged device batches fed by a
-    # background transfer thread + chunked puts, normalization on-device in
-    # the step's preprocess hook. The unstaged 2b point is KEPT for
-    # trajectory continuity; this one carries the round-7 target
-    # (0.062 -> >=0.5 vs synthetic) and the first-class transfer/overlap
-    # accounting the staged done event emits.
-    log("bench: ResNet-50 through the STAGED data pipeline...")
+    # --- Workload 2c (rounds 7+11): the same point through the staging
+    # ring — now the HEADLINE data-pipeline point. data/staging.py: uint8
+    # wire + K staged device batches fed by the multi-lane transfer engine
+    # (--staging-tune probes {lanes x chunks} against the live link at
+    # startup and locks the best; the probe table lands in bench_detail),
+    # normalization on-device in the step's preprocess hook. The unstaged
+    # 2b point above is KEPT as the serial-ingest diagnostic; this one
+    # carries the target (0.062 -> >=0.5 vs synthetic, judged at r06) and
+    # the first-class transfer/overlap accounting the staged done event
+    # emits — transfer_mb_per_s / transfer_lanes / input_overlap_fraction
+    # surface top-level in the summary line.
+    log("bench: ResNet-50 through the STAGED data pipeline (tuned)...")
     rn_staged = chip_job(
         "resnet50", steps=40 if on_tpu else 10, batch=rn_batch,
         extra=["--image-size", str(rn_size), "--data-dir", rnd_dir,
                "--input-staging", "staged", "--staging-depth", "3",
-               "--staging-chunks", "4", "--wire-dtype", "uint8"],
+               "--staging-tune", "--wire-dtype", "uint8"],
         timeout=1800,
     )
     shutil.rmtree(rnd_dir, ignore_errors=True)
@@ -625,6 +650,8 @@ def _main() -> int:
     log(f"  ok={rn_staged['ok']} images/s={rn_staged_ips} "
         f"vs synthetic={rn_staged_frac} "
         f"transfer_mb_per_s={(rn_staging or {}).get('transfer_mb_per_s')} "
+        f"lanes={(rn_staging or {}).get('lanes_effective')} "
+        f"chunks={(rn_staging or {}).get('chunks_effective')} "
         f"overlap={(rn_staging or {}).get('input_overlap_fraction')}")
     rdev = {e["event"]: e for e in rn_data["events"]}
     rn_data_ips = rdev.get("done", {}).get("examples_per_sec")
@@ -922,6 +949,19 @@ def _main() -> int:
         "compile_cache": {
             "fresh_dir": True,
             "entries": cc_entries,
+            # per-run entry deltas (round 11): the hit/miss evidence that
+            # distinguishes "cache ineffective on this backend" from
+            # "measurement broken" — warm_new_entries > 0 means the warm
+            # run RE-COMPILED (keys changed between identical runs: bench
+            # bug), 0 with warm_ran AND a populated cold cache means a
+            # true cache hit (0-entries-after-both means the cache never
+            # engaged at all — NOT a hit, the other broken-measurement
+            # shape).
+            "entries_after_cold": entries_after_cold,
+            "warm_new_entries": cc_warm_new,
+            "warm_cache_hit": (cc_warm_new == 0
+                               and entries_after_cold > 0) if warm_ran
+            and cc_warm_new is not None else None,
             "warm_ran": warm_ran,
             "cold_startup_s": cold_startup,
             "warm_startup_s": startup if warm_ran else None,
@@ -939,21 +979,25 @@ def _main() -> int:
         "resnet50_batch": rn_batch,
         "resnet50_mfu": rn_mfu,
         "resnet50_mfu_macs_convention": rn_mfu_macs,  # = rounds 1-2 scale
-        "resnet50_data_pipeline_ok": rn_data["ok"],
-        "resnet50_data_pipeline_images_per_sec": rn_data_ips,
-        "resnet50_data_pipeline_vs_synthetic": rn_data_frac,
-        "resnet50_data_pipeline_prefetch": rn_prefetch,
-        "resnet50_data_pipeline_diagnosis": rn_data_diag,
-        # Round-7 staged ingest point (uint8 wire + staging ring + chunked
-        # puts; the unstaged point above is kept for trajectory
-        # continuity). transfer_mb_per_s / input_overlap_fraction are the
-        # ring's own timers, surfaced first-class.
-        "resnet50_data_pipeline_staged_ok": rn_staged["ok"],
-        "resnet50_data_pipeline_staged_images_per_sec": rn_staged_ips,
-        "resnet50_data_pipeline_staged_vs_synthetic": rn_staged_frac,
+        # Round-11 promotion: the HEADLINE resnet50_data_pipeline keys now
+        # carry the tuned multi-lane STAGED run (rounds <= 10 published
+        # the serial-prefetch run here); the prefetch point is kept as the
+        # *_unstaged_* diagnostic so the round-over-round trajectory has
+        # both legs. transfer_mb_per_s / transfer_lanes /
+        # input_overlap_fraction are the engine's own timers, top-level.
+        "resnet50_data_pipeline_ok": rn_staged["ok"],
+        "resnet50_data_pipeline_images_per_sec": rn_staged_ips,
+        "resnet50_data_pipeline_vs_synthetic": rn_staged_frac,
+        "resnet50_data_pipeline_mode": "staged+tuned",
         "transfer_mb_per_s": (rn_staging or {}).get("transfer_mb_per_s"),
+        "transfer_lanes": (rn_staging or {}).get("lanes_effective"),
         "input_overlap_fraction": (
             (rn_staging or {}).get("input_overlap_fraction")),
+        "resnet50_data_pipeline_unstaged_ok": rn_data["ok"],
+        "resnet50_data_pipeline_unstaged_images_per_sec": rn_data_ips,
+        "resnet50_data_pipeline_unstaged_vs_synthetic": rn_data_frac,
+        "resnet50_data_pipeline_unstaged_prefetch": rn_prefetch,
+        "resnet50_data_pipeline_diagnosis": rn_data_diag,
         # Itemized standalone-vs-operator ladder (VERDICT r4 #3), measured
         # by tools/exp_resnet_tax.py (too slow to re-run inside every
         # bench). Preference order: a FRESH complete run's snapshot
@@ -1029,10 +1073,11 @@ def _main() -> int:
         "resnet50_wallclock_s": resnet.get("wallclock_s"),
         "resnet50_image_size": rn_size,
         "resnet50_roofline": rn_roofline,
-        # full staging diagnosis (ring depth, chunking, wire dtype, byte/
-        # time accounting) from the staged job's done event
-        "resnet50_data_pipeline_staged_staging": rn_staging,
-        "resnet50_data_pipeline_staged_segments": rn_staged.get("segments"),
+        # full staging diagnosis (ring depth, lanes, chunking, wire dtype/
+        # codec, byte/time accounting, the auto-tuner's probe table) from
+        # the headline staged job's done event
+        "resnet50_data_pipeline_staging": rn_staging,
+        "resnet50_data_pipeline_segments": rn_staged.get("segments"),
         "moe_roofline": moe_roofline,
         # embed table + UNTIED lm_head are both vocab x hidden
         "longctx_params_m": round(
@@ -1047,10 +1092,10 @@ def _main() -> int:
         "mnist_phase_breakdown": mnist_phases,
         "resnet50_step_time_s": rev.get("done", {}).get("step_time_s"),
         "resnet50_phase_breakdown": rev.get("done", {}).get("phase_breakdown"),
-        "resnet50_data_pipeline_step_time_s": rdev.get("done", {}).get("step_time_s"),
-        "resnet50_data_pipeline_phase_breakdown": rdev.get("done", {}).get("phase_breakdown"),
-        "resnet50_data_pipeline_staged_step_time_s": rsev.get("done", {}).get("step_time_s"),
-        "resnet50_data_pipeline_staged_phase_breakdown": rsev.get("done", {}).get("phase_breakdown"),
+        "resnet50_data_pipeline_step_time_s": rsev.get("done", {}).get("step_time_s"),
+        "resnet50_data_pipeline_phase_breakdown": rsev.get("done", {}).get("phase_breakdown"),
+        "resnet50_data_pipeline_unstaged_step_time_s": rdev.get("done", {}).get("step_time_s"),
+        "resnet50_data_pipeline_unstaged_phase_breakdown": rdev.get("done", {}).get("phase_breakdown"),
         "longctx_step_time_s": lev.get("done", {}).get("step_time_s"),
         "longctx_phase_breakdown": lev.get("done", {}).get("phase_breakdown"),
         "moe_step_time_s": mev.get("done", {}).get("step_time_s"),

@@ -124,14 +124,24 @@ class TestChaosSpec:
         "kill:step=5,when=now",       # unknown key
         "kill:step=5,signal=NOPE",    # unknown signal
         "torn:step=3,mode=shred",     # unknown tear mode
-        "stall:delay=0.1",            # neither batch nor every
-        "stall:batch=1,every=2,delay=0.1",  # both
+        "stall:delay=0.1",            # no target: batch, every, or lane
+        "stall:batch=1,every=2,delay=0.1",  # both batch and every
         "stall:batch=1",              # no delay
+        "stall:lane=-1,delay=0.1",    # negative lane
         "apiserver:errors=-1",        # negative budget
     ])
     def test_strict_parse_rejects(self, bad):
         with pytest.raises(ValueError):
             chaos_lib.parse_chaos(bad)
+
+    def test_stall_lane_grammar(self):
+        """Round 11: lane=L targets one transfer lane of the multi-lane
+        engine. Lane-only stalls every batch that lane carries; lane
+        composes with batch/every as an AND."""
+        only = chaos_lib.parse_chaos("stall:lane=1,delay=0.5")[0]
+        assert only.params == {"lane": 1, "delay": 0.5}
+        both = chaos_lib.parse_chaos("stall:lane=0,every=2,delay=0.25")[0]
+        assert both.params == {"lane": 0, "every": 2, "delay": 0.25}
 
     def test_signal_forms(self):
         assert chaos_lib.parse_signal("TERM") == signal.SIGTERM
@@ -168,6 +178,24 @@ class TestChaosSpec:
         assert f(1, stalls) == 0.0
         assert f(2, stalls) == 0.5    # batch=2
         assert f(3, stalls) == 0.25
+
+    def test_staging_stall_delay_lane_targeting(self):
+        """lane=L fires only in that lane; a caller predating the
+        multi-lane engine (lane=None) never matches a lane-targeted
+        directive; lane-only stalls every batch the lane carries."""
+        f = chaos_lib.staging_stall_delay
+        only = chaos_lib.parse_chaos("stall:lane=1,delay=0.5")
+        assert f(0, only, lane=1) == 0.5
+        assert f(7, only, lane=1) == 0.5      # every batch lane 1 carries
+        assert f(0, only, lane=0) == 0.0
+        assert f(0, only) == 0.0              # legacy caller: no lane
+        both = chaos_lib.parse_chaos("stall:lane=0,every=2,delay=0.25")
+        assert f(0, both, lane=0) == 0.25     # lane AND every match
+        assert f(1, both, lane=0) == 0.0      # every misses
+        assert f(2, both, lane=1) == 0.0      # lane misses
+        # untargeted directives still fire whatever the carrying lane
+        legacy = chaos_lib.parse_chaos("stall:batch=1,delay=0.125")
+        assert f(1, legacy, lane=3) == 0.125
 
 
 # ---------------------------------------------------------- guard units

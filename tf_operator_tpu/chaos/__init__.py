@@ -198,14 +198,25 @@ def staging_stalls_from_env(env: dict | None = None) -> list[Directive]:
     return [d for d in from_env(e) if d.kind == "stall"]
 
 
-def staging_stall_delay(index: int, stalls: list[Directive]) -> float:
-    """Total injected sleep for staged batch `index` (0-based)."""
+def staging_stall_delay(index: int, stalls: list[Directive],
+                        lane: int | None = None) -> float:
+    """Total injected sleep for staged batch `index` (0-based) when
+    carried by transfer lane `lane`. A directive with `lane=L` fires only
+    in that lane (None — callers predating the multi-lane engine — never
+    matches a lane-targeted directive); `lane=L` with no batch/every
+    stalls every batch the lane carries."""
     total = 0.0
     for d in stalls:
+        want_lane = d.params.get("lane")
+        if want_lane is not None and lane != want_lane:
+            continue
         if "batch" in d.params:
             if index == d.params["batch"]:
                 total += d.params["delay"]
-        elif index % d.params["every"] == 0:
+        elif "every" in d.params:
+            if index % d.params["every"] == 0:
+                total += d.params["delay"]
+        else:  # lane-only directive: every batch this lane carries
             total += d.params["delay"]
     return total
 

@@ -39,7 +39,14 @@ Directive kinds and their keys (all integers/floats unless noted):
                                       the resume-fallback scenario.
     stall      delay=S batch=N        sleep S seconds in the staging
                     | every=K         ring's transfer leg for batch N
-                                      (or every Kth batch).
+               [lane=L]               (or every Kth batch). lane=L
+                                      restricts the stall to transfer
+                                      lane L of the multi-lane engine
+                                      (how a test wedges ONE lane and
+                                      proves the others keep the ring
+                                      ordered and live); lane=L alone
+                                      (no batch/every) stalls every
+                                      batch that lane carries.
     apiserver  errors=N code=C        the fake apiserver fails the next N
                latency=S match=SUB    matched requests with HTTP C
                                       (code=0: latency only), sleeping S
@@ -71,7 +78,7 @@ _KEYS: dict[str, dict[str, type]] = {
     "kill": {"step": int, "signal": str, "replica": str, "index": int},
     "hang": {"step": int, "duration": float, "replica": str, "index": int},
     "torn": {"step": int, "mode": str},
-    "stall": {"delay": float, "batch": int, "every": int},
+    "stall": {"delay": float, "batch": int, "every": int, "lane": int},
     "apiserver": {"errors": int, "code": int, "latency": float,
                   "match": str},
 }
@@ -170,12 +177,19 @@ def _validate(kind: str, params: dict) -> None:
     elif kind == "stall":
         if "delay" not in params or params["delay"] < 0:
             raise ValueError("chaos: stall requires delay=SECONDS >= 0")
-        if ("batch" in params) == ("every" in params):
+        if "batch" in params and "every" in params:
             raise ValueError(
-                "chaos: stall takes exactly one of batch=N or every=K"
+                "chaos: stall takes at most one of batch=N or every=K"
+            )
+        if ("batch" not in params and "every" not in params
+                and "lane" not in params):
+            raise ValueError(
+                "chaos: stall needs a target: batch=N, every=K, or lane=L"
             )
         if params.get("every", 1) < 1:
             raise ValueError("chaos: stall: every must be >= 1")
+        if params.get("lane", 0) < 0:
+            raise ValueError("chaos: stall: lane must be >= 0")
     elif kind == "apiserver":
         if params.get("errors", 1) < 0:
             raise ValueError("chaos: apiserver: errors must be >= 0")

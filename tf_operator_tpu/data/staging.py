@@ -13,21 +13,50 @@ answer, in two coordinated layers:
      (int32, already minimal) pass through the same API unchanged.
 
   2. **Staging ring** (`stage_to_device`): K device-batch slots fed by a
-     background transfer thread, so the transfer of batch N+1 overlaps the
-     compute of batch N. The ring bounds in-flight device memory to K
+     pool of N background transfer *lanes* (round 11; one lane = the
+     round-7 ring), so the transfer of batch N+1 overlaps the compute of
+     batch N and — with multiple lanes — transfers of several batches
+     overlap each other. The ring bounds in-flight device memory to K
      staged batches (+1 being consumed): a slot frees when the consumer
      takes the next batch, and XLA's allocator recycles the freed arrays'
      pages for the next transfer. Transfers can be *chunked* — split along
      the batch dim into C concurrent `device_put` calls reassembled
      on-device — which raises the effective rate on links where a single
      serial put can't fill the pipe (the tunnel, PCIe with small copies).
+     Lanes pull host batches through one ordered reader (each take tagged
+     with a sequence number) and deposit finished slots into an ordered
+     reassembly buffer, so the consumer sees the EXACT batch order however
+     the lanes race. `autotune_staging` micro-probes {lanes x chunks}
+     against the live link and returns the best combination plus the full
+     probe table (the trainer's `--staging-tune`).
+
+  3. **Wire codecs** (`encode_batch`/`decode_batch`, round 11): an
+     optional lossless compression layer on the wire — stdlib zlib at
+     speed-biased level 1 (the lz4-ish point of the zlib dial). The
+     producer leg compresses each large leaf, the lane decompresses on
+     the HOST side immediately before its `device_put` (there is no
+     on-device inflate), so the device math is bit-identical to the
+     uncompressed wire. On a single-host runtime the codec only *costs*
+     CPU — `device_put` still moves raw bytes — but the accounting
+     (`bytes_encoded`, `encode_s`/`decode_s`, `codec_ratio`) measures
+     exactly what a compressed remote-reader/tunnel wire protocol would
+     save vs what the codec burns, which is the decision input the
+     on-chip round needs (52 MB/s measured link vs codec MB/s + ratio).
 
 Accounting is explicit (the bench reports numbers, not assertions):
-`transfer_mb_per_s` from the producer's own put timers, and
+`transfer_mb_per_s` from the lanes' own put timers — bytes over the
+UNION of wire-busy intervals (`transfer_busy_s`), so concurrent lanes
+report the effective link rate, not a per-lane average — and
 `input_overlap_fraction` — the share of steady-state input seconds that
 hid under compute — from stamps that telescope exactly to the consumer's
 wall-clock (wall_s == consumer_wait_s + consumer_busy_s by construction,
 which tests verify against a synthetic slow producer).
+
+Thread discipline (the PR-2 invariant, now PER-LANE and pinned by test):
+a lane thread only ever calls `device_put` — never `jnp.concatenate` or
+any other traced program — because two threads dispatching programs onto
+a multi-device mesh interleave their collectives per-device and deadlock.
+Chunk reassembly therefore always runs on the consumer thread.
 
 Normalization math is defined ONCE here (multiply by a f32-rounded
 reciprocal) and used by both the host-side f32 wire path and the
@@ -41,8 +70,6 @@ tested as exact equality.
 
 from __future__ import annotations
 
-import collections
-import queue
 import threading
 import time
 from typing import Any, Callable, Iterator
@@ -68,10 +95,6 @@ WIRE_DTYPES = ("auto", "uint8", "f32")
 # silently wrecks the loss). Every model entry in models/train.py uses
 # "x" for its image tensor; extend here if that contract grows.
 IMAGE_KEYS = ("x",)
-
-
-class _Stop:
-    pass
 
 
 def normalize_uint8(x):
@@ -142,6 +165,84 @@ def make_preprocess_fn(
         }
 
     return preprocess
+
+
+WIRE_CODECS = ("none", "zlib")
+
+# Leaves under this size ship uncompressed whatever the codec: a label
+# vector is a few hundred bytes — zlib headers + a dict hop cost more
+# than the wire saves.
+MIN_ENCODE_BYTES = 1 << 10
+
+# Speed-biased deflate: level 1 is the "lz4-style" point of the zlib
+# dial — on uint8 image batches it compresses within a few percent of
+# level 6 at several times the throughput, and the codec rides the
+# transfer path where MB/s is the whole point.
+_ZLIB_LEVEL = 1
+
+
+class Encoded:
+    """One array leaf as it would cross a compressed wire: the codec
+    payload plus the dtype/shape needed to reinflate it host-side.
+    Deliberately NOT a pytree container (jax.tree.map leaf)."""
+
+    __slots__ = ("payload", "dtype", "shape", "codec", "raw_nbytes")
+
+    def __init__(self, payload: bytes, dtype, shape, codec: str,
+                 raw_nbytes: int):
+        self.payload = payload
+        self.dtype = dtype
+        self.shape = shape
+        self.codec = codec
+        self.raw_nbytes = raw_nbytes
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.payload)
+
+
+def encode_batch(batch: dict, codec: str) -> dict:
+    """Host-side wire compression of one dict batch: every array leaf at
+    or over MIN_ENCODE_BYTES becomes an `Encoded` payload; small leaves
+    pass through raw. Lossless for ANY dtype (bytes round-trip exactly),
+    so unlike `to_wire` it needs no image-key contract."""
+    if codec not in WIRE_CODECS:
+        raise ValueError(f"wire codec {codec!r} not in {WIRE_CODECS}")
+    if codec == "none":
+        return batch
+    import zlib
+
+    out = {}
+    for k, v in batch.items():
+        if getattr(v, "nbytes", 0) < MIN_ENCODE_BYTES:
+            out[k] = v
+            continue
+        out[k] = Encoded(
+            zlib.compress(np.ascontiguousarray(v).tobytes(), _ZLIB_LEVEL),
+            v.dtype, v.shape, codec, v.nbytes,
+        )
+    return out
+
+
+def decode_batch(batch: dict) -> dict:
+    """Inflate `Encoded` leaves back to the exact source arrays — the
+    host side of the wire, immediately before the lane's device_put."""
+    import zlib
+
+    out = {}
+    for k, v in batch.items():
+        if isinstance(v, Encoded):
+            out[k] = np.frombuffer(
+                zlib.decompress(v.payload), dtype=v.dtype
+            ).reshape(v.shape)
+        else:
+            out[k] = v
+    return out
+
+
+def encoded_nbytes(batch: dict) -> int:
+    """Wire bytes of an encoded batch (codec payloads + raw small leaves)."""
+    return sum(v.nbytes for v in batch.values())
 
 
 class _Chunks:
@@ -267,9 +368,14 @@ def chunked_device_put(x, sharding=None, chunks: int = 1):
 
 
 def transfer_mb_per_s(stats: dict) -> float | None:
-    """Effective host->device transfer rate from the producer thread's own
-    put timers (wire bytes / seconds actually spent in device_put)."""
-    s = stats.get("transfer_s", 0.0)
+    """Effective host->device transfer rate from the lanes' own put
+    timers: bytes actually moved through device_put over the UNION of
+    wire-busy intervals (`transfer_busy_s` — seconds during which at
+    least one lane sat in its transfer leg). Lane-seconds (`transfer_s`)
+    would under-report a multi-lane engine by up to the lane count; the
+    single-lane case is identical under both clocks. Falls back to
+    `transfer_s` for stats dicts predating the union clock."""
+    s = stats.get("transfer_busy_s") or stats.get("transfer_s", 0.0)
     b = stats.get("bytes_staged", 0)
     if s <= 0 or b <= 0:
         return None
@@ -277,11 +383,14 @@ def transfer_mb_per_s(stats: dict) -> float | None:
 
 
 def input_overlap_fraction(stats: dict) -> float | None:
-    """Share of the steady-state input path (host production + wire cast +
-    transfer of the consumed batches past pipeline fill) that hid under
-    compute. Same estimator as prefetch.overlap_efficiency — the staging
-    ring populates the identical keys, so the two pipelines' numbers are
-    directly comparable."""
+    """Share of the steady-state input path (host production + wire cast/
+    codec + transfer) that hid under compute. Same estimator as
+    prefetch.overlap_efficiency — the ring populates the identical keys —
+    but its steady_input_s denominator is the UNION of lane input-leg
+    intervals windowed to the consumer's steady state, so concurrent
+    lanes don't count multiply (a single-lane ring reduces to prefetch's
+    per-batch sum and the two pipelines' numbers stay directly
+    comparable)."""
     return overlap_efficiency(stats)
 
 
@@ -292,13 +401,17 @@ def stage_to_device(
     chunks: int = 1,
     wire_dtype: str = "auto",
     stats: dict | None = None,
+    lanes: int = 1,
+    codec: str = "none",
 ) -> Iterator[Any]:
     """Wrap a host-batch iterator; yields batches staged on device through
-    a ring of `depth` slots fed by a background transfer thread.
+    a ring of `depth` slots fed by a pool of `lanes` transfer threads.
 
     depth      — ring size K: how many batches may be device-resident ahead
                  of the consumer (2 = classic double buffering). In-flight
-                 device memory is bounded by K staged (+1 being consumed).
+                 device memory is bounded by K staged (+1 being consumed),
+                 however many lanes feed the ring (each lane holds a slot
+                 permit for the batch it is transferring).
     sharding   — optional jax.sharding.Sharding for the put (multi-process
                  jobs assemble the global batch from local slices, like
                  prefetch_to_device).
@@ -313,24 +426,52 @@ def stage_to_device(
     wire_dtype — host-side wire conversion (see to_wire). On-device
                  normalization of the uint8 wire is the train step's
                  preprocess hook, not the stager's job.
+    lanes      — transfer threads issuing device_puts CONCURRENTLY.
+                 Batches keep their exact order: one locked reader tags
+                 each host batch with a sequence number, lanes deposit
+                 finished slots into an ordered buffer, and the consumer
+                 takes sequence k before k+1 — whatever order the lanes
+                 finish in. Degraded to min(lanes, depth) (an extra lane
+                 could never hold a slot) and to 1 on the multi-process
+                 global-assembly path; stats records lanes_effective.
+    codec      — lossless wire compression (WIRE_CODECS; "none" default).
+                 Encoded on the producer leg, decoded HOST-side by the
+                 lane immediately before its device_put — the device math
+                 is bit-identical to the uncompressed wire. See the
+                 module docstring for what this measures on a single host.
     stats      — optional dict updated IN PLACE while the iterator is live:
-        batches_staged   — batches the producer finished transferring
-        bytes_staged     — wire bytes moved host->device
-        host_s           — producer seconds in next(it) + to_wire
-        transfer_s       — producer seconds in device_put (transfer
-                           complete: the producer blocks on readiness so
-                           a slot is always fully resident when yielded —
-                           and so this timer measures the wire, not the
-                           dispatch)
-        input_s          — host_s + transfer_s, per-batch total (raw)
-        steady_input_s   — input seconds of just the CONSUMED steady-state
-                           batches (input_overlap_fraction's denominator)
+        batches_staged   — batches the lanes finished transferring
+        bytes_staged     — wire bytes moved host->device (decoded)
+        bytes_encoded    — codec payload bytes (what a compressed remote
+                           wire would carry; 0 under codec "none")
+        host_s           — lane-seconds in next(it) + to_wire
+        encode_s/decode_s— lane-seconds in the wire codec
+        transfer_s       — lane-seconds in device_put (transfer complete:
+                           each lane blocks on readiness so a slot is
+                           always fully resident when delivered — and so
+                           this timer measures the wire, not the dispatch)
+        transfer_busy_s  — UNION wall-clock during which >= 1 lane sat in
+                           its transfer leg (transfer_mb_per_s's clock:
+                           the effective link rate under concurrency)
+        input_s          — host_s + encode_s + decode_s + transfer_s,
+                           per-batch total (raw lane-seconds)
+        steady_input_s   — UNION wall-clock with >= 1 lane anywhere in
+                           its input leg (read+encode+transfer), windowed
+                           to the consumer's steady state (first take ->
+                           last take). input_overlap_fraction's
+                           denominator: raw lane-seconds would count
+                           concurrent lanes multiply and report a fully
+                           ingest-bound multi-lane job as mostly
+                           "hidden"; the union clock keeps the estimator
+                           honest and comparable to the single-threaded
+                           prefetch number
         batches_consumed — batches the consumer took
         consumer_wait_s  — consumer seconds blocked past the fill batch
         consumer_busy_s  — consumer seconds NOT blocked (its compute)
         wall_s           — consumer wall-clock from first to last take;
                            equals consumer_wait_s + consumer_busy_s
                            exactly (the stamps telescope)
+        lanes / lanes_effective / codec — the engine config that RAN
     """
     import jax
 
@@ -338,29 +479,59 @@ def stage_to_device(
         raise ValueError("depth must be >= 1")
     if chunks < 1:
         raise ValueError("chunks must be >= 1")
-    if stats is not None:
-        for k in ("batches_staged", "batches_consumed"):
-            stats.setdefault(k, 0)
-        stats.setdefault("bytes_staged", 0)
-        for k in ("host_s", "transfer_s", "input_s", "steady_input_s",
-                  "consumer_wait_s", "consumer_busy_s", "wall_s"):
-            stats.setdefault(k, 0.0)
+    if lanes < 1:
+        raise ValueError("lanes must be >= 1")
+    if codec not in WIRE_CODECS:
+        raise ValueError(f"wire codec {codec!r} not in {WIRE_CODECS}")
 
     multiproc = jax.process_count() > 1
-    pending_times: collections.deque = collections.deque()
+    assembly = sharding is not None and multiproc
+    # A lane above depth could never hold a slot permit; the global-
+    # assembly path owns its transfers (make_array_from_process_local_data
+    # is not documented thread-safe, and its collectives must not race).
+    n_lanes = 1 if assembly else max(1, min(lanes, depth))
+    if stats is not None:
+        for k in ("batches_staged", "batches_consumed", "bytes_staged",
+                  "bytes_encoded"):
+            stats.setdefault(k, 0)
+        for k in ("host_s", "encode_s", "decode_s", "transfer_s",
+                  "transfer_busy_s", "input_s", "steady_input_s",
+                  "consumer_wait_s", "consumer_busy_s", "wall_s"):
+            stats.setdefault(k, 0.0)
+        stats["lanes"] = lanes
+        stats["lanes_effective"] = n_lanes
+        stats["codec"] = codec
+
     free = threading.Semaphore(depth)
-    q: queue.Queue = queue.Queue()
-    err: list[BaseException] = []
     stop = threading.Event()
+    # TWO locks, deliberately: `read_lock` serializes the sequenced
+    # reader (next(it) can be a real disk read — holding the delivery
+    # lock across it would block a finished lane's deposit and the
+    # consumer's take behind host I/O, eroding exactly the overlap this
+    # engine exists to create), while `lock`/`cond` guard the shared
+    # stats, the wire-busy union clock, and the ordered delivery buffer.
+    # Lock order is always read_lock -> cond, never the reverse.
+    read_lock = threading.Lock()
+    lock = threading.Lock()
+    cond = threading.Condition(lock)
+    ready: dict[int, Any] = {}  # seq -> staged tree
+    err: list[BaseException] = []
+    src = {"next_seq": 0, "total": None}
+    wire = {"active": 0, "t0": 0.0}
+    # Union clock over the WHOLE input leg (read+codec+transfer): the
+    # overlap estimator's denominator. `acc` accumulates closed
+    # intervals; an open interval (active > 0) is added on read.
+    inp = {"active": 0, "t0": 0.0, "acc": 0.0}
     # Chaos stall directives (TPUJOB_CHAOS "stall:..."): deterministic
-    # transfer-leg delays for fault-injection tests. Parsed once here; []
-    # (the no-chaos path) costs nothing per batch.
+    # transfer-leg delays for fault-injection tests, optionally targeting
+    # one lane (lane=L). Parsed once here; [] (the no-chaos path) costs
+    # nothing per batch.
     from tf_operator_tpu.chaos import staging_stall_delay, staging_stalls_from_env
 
     stalls = staging_stalls_from_env()
 
     def put_tree(batch):
-        if sharding is not None and multiproc:
+        if assembly:
             return jax.tree.map(
                 lambda x: jax.make_array_from_process_local_data(sharding, x),
                 batch,
@@ -369,111 +540,230 @@ def stage_to_device(
             lambda x: _put_chunks(x, sharding, chunks), batch
         )
 
-    def worker():
-        staged_idx = 0
+    def _wire_enter():
+        if stats is None:
+            return
+        with lock:
+            if wire["active"] == 0:
+                wire["t0"] = time.perf_counter()
+            wire["active"] += 1
+
+    def _wire_exit():
+        if stats is None:
+            return
+        with lock:
+            wire["active"] -= 1
+            if wire["active"] == 0:
+                stats["transfer_busy_s"] += time.perf_counter() - wire["t0"]
+
+    def _input_enter():
+        if stats is None:
+            return
+        with lock:
+            if inp["active"] == 0:
+                inp["t0"] = time.perf_counter()
+            inp["active"] += 1
+
+    def _input_exit():
+        if stats is None:
+            return
+        with lock:
+            inp["active"] -= 1
+            if inp["active"] == 0:
+                inp["acc"] += time.perf_counter() - inp["t0"]
+
+    def _input_busy_now():
+        # caller holds `lock`
+        if inp["active"]:
+            return inp["acc"] + (time.perf_counter() - inp["t0"])
+        return inp["acc"]
+
+    def worker(lane: int):
         try:
             while True:
                 # A free ring slot gates the NEXT transfer — this is what
-                # bounds read-ahead to `depth` device batches.
+                # bounds read-ahead to `depth` device batches across ALL
+                # lanes (a lane holds its permit while transferring).
                 while not free.acquire(timeout=0.1):
                     if stop.is_set():
                         return
                 t0 = time.perf_counter()
-                # Tracer spans (--trace): the transfer thread's host/wire
-                # and h2d legs land on their own track in the Chrome
-                # trace, so "did the transfer hide under compute" is
-                # visible, not inferred. No-ops when tracing is off.
-                with telemetry.span("staging/host_next"):
-                    try:
-                        batch = next(it)
-                    except StopIteration:
-                        return
-                    if stop.is_set():
-                        return
-                    batch = to_wire(batch, wire_dtype)
-                if stats is not None and "chunks_effective" not in stats:
-                    # What the knob actually did for THIS job (leaf max):
-                    # 1 on the global-assembly path (the same condition
-                    # put_tree branches on) and whenever every leaf is
-                    # too small / indivisible — so a tuner reading
-                    # transfer_mb_per_s knows whether chunking was live.
-                    assembly = sharding is not None and multiproc
-                    stats["chunks_effective"] = 1 if assembly else max(
-                        (effective_chunks(leaf, sharding, chunks)
-                         for leaf in jax.tree.leaves(batch)), default=1)
-                # (attr computed only when tracing — span() evaluates its
-                # kwargs at the call site and a per-batch tree reduction
-                # is not "near-zero cost when disabled" — and BEFORE t1,
-                # so it charges to the host leg, never to transfer_s: the
-                # wire timer's accuracy is a pinned PR-2 contract)
-                _attrs = (
-                    {"bytes": sum(x.nbytes for x in jax.tree.leaves(batch))}
-                    if telemetry.get_tracer().enabled else {}
-                )
-                t1 = time.perf_counter()
-                with telemetry.span("staging/h2d_transfer", **_attrs):
-                    if stalls:
-                        # Injected link stall: charged to transfer_s like
-                        # the real slow-wire failure it simulates.
-                        delay = staging_stall_delay(staged_idx, stalls)
-                        if delay > 0:
-                            time.sleep(delay)
-                    staged_idx += 1
-                    dev = put_tree(batch)
-                    # Block on transfer completion: the slot must be
-                    # resident before the consumer can see it, and
-                    # transfer_s must time the wire rather than the async
-                    # dispatch. (_Chunks is an opaque leaf — unwrap to its
-                    # arrays for the wait.)
-                    jax.block_until_ready([
-                        leaf.parts if isinstance(leaf, _Chunks) else leaf
-                        for leaf in jax.tree.leaves(dev)
-                    ])
-                t2 = time.perf_counter()
-                if stats is not None:
-                    # One producer thread: plain += is safe. Per-batch time
-                    # queues BEFORE the batch so the consumer's popleft
-                    # pairs with the batch it just took.
-                    stats["batches_staged"] += 1
-                    stats["bytes_staged"] += sum(
-                        x.nbytes for x in jax.tree.leaves(batch)
+                # The union input clock brackets the WHOLE leg (read +
+                # codec + transfer); the finally closes the interval on
+                # every early return and on the error path, so the
+                # overlap denominator never counts a dead lane as busy.
+                _input_enter()
+                try:
+                    # Tracer spans (--trace): each lane's host/wire and
+                    # h2d legs land on their own track in the Chrome
+                    # trace, so "did the transfer hide under compute" —
+                    # and whether the lanes actually overlapped — is
+                    # visible, not inferred. No-ops when tracing is off.
+                    with telemetry.span("staging/host_next", lane=lane):
+                        exhausted = False
+                        with read_lock:
+                            if src["total"] is not None or err:
+                                free.release()
+                                return
+                            try:
+                                batch = next(it)
+                            except StopIteration:
+                                src["total"] = src["next_seq"]
+                                exhausted = True
+                            else:
+                                seq = src["next_seq"]
+                                src["next_seq"] = seq + 1
+                        if exhausted:
+                            free.release()
+                            with cond:
+                                cond.notify_all()
+                            return
+                        if stop.is_set():
+                            return
+                        batch = to_wire(batch, wire_dtype)
+                    enc_bytes, t_enc, t_dec = 0, 0.0, 0.0
+                    if codec != "none":
+                        # encode -> (the queue hop IS the notional
+                        # single-host wire) -> decode, both host-side on
+                        # this lane; the decoded arrays are what
+                        # device_put ships.
+                        te0 = time.perf_counter()
+                        batch = encode_batch(batch, codec)
+                        enc_bytes = encoded_nbytes(batch)
+                        te1 = time.perf_counter()
+                        batch = decode_batch(batch)
+                        t_enc = te1 - te0
+                        t_dec = time.perf_counter() - te1
+                    if stats is not None:
+                        with lock:
+                            if "chunks_effective" not in stats:
+                                # What the knob actually did for THIS job
+                                # (leaf max): 1 on the global-assembly
+                                # path and whenever every leaf is too
+                                # small / indivisible — so a tuner
+                                # reading transfer_mb_per_s knows whether
+                                # chunking was live.
+                                stats["chunks_effective"] = (
+                                    1 if assembly else max(
+                                        (effective_chunks(leaf, sharding,
+                                                          chunks)
+                                         for leaf in jax.tree.leaves(batch)),
+                                        default=1))
+                    # (attr computed only when tracing — span() evaluates
+                    # its kwargs at the call site and a per-batch tree
+                    # reduction is not "near-zero cost when disabled" —
+                    # and BEFORE t1, so it charges to the host leg, never
+                    # to transfer_s: the wire timer's accuracy is a
+                    # pinned PR-2 contract)
+                    _attrs = (
+                        {"lane": lane,
+                         "bytes": sum(x.nbytes
+                                      for x in jax.tree.leaves(batch))}
+                        if telemetry.get_tracer().enabled else {}
                     )
-                    stats["host_s"] += t1 - t0
-                    stats["transfer_s"] += t2 - t1
-                    stats["input_s"] += t2 - t0
-                    pending_times.append(t2 - t0)
-                q.put(dev)
+                    t1 = time.perf_counter()
+                    with telemetry.span("staging/h2d_transfer", **_attrs):
+                        _wire_enter()
+                        try:
+                            if stalls:
+                                # Injected link stall: inside the wire
+                                # window, charged to transfer_s AND
+                                # transfer_busy_s like the real slow-wire
+                                # failure it simulates.
+                                delay = staging_stall_delay(seq, stalls,
+                                                            lane=lane)
+                                if delay > 0:
+                                    time.sleep(delay)
+                            dev = put_tree(batch)
+                            # Block on transfer completion: the slot must
+                            # be resident before the consumer can see it,
+                            # and transfer_s must time the wire rather
+                            # than the async dispatch. (_Chunks is an
+                            # opaque leaf — unwrap to its arrays for the
+                            # wait.)
+                            jax.block_until_ready([
+                                leaf.parts if isinstance(leaf, _Chunks)
+                                else leaf
+                                for leaf in jax.tree.leaves(dev)
+                            ])
+                        finally:
+                            _wire_exit()
+                    t2 = time.perf_counter()
+                finally:
+                    _input_exit()
+                with cond:
+                    if stats is not None:
+                        stats["batches_staged"] += 1
+                        stats["bytes_staged"] += sum(
+                            x.nbytes for x in jax.tree.leaves(batch)
+                        )
+                        stats["bytes_encoded"] += enc_bytes
+                        stats["host_s"] += t1 - t0 - t_enc - t_dec
+                        stats["encode_s"] += t_enc
+                        stats["decode_s"] += t_dec
+                        stats["transfer_s"] += t2 - t1
+                        stats["input_s"] += t2 - t0
+                    # Ordered delivery: the slot waits HERE (keyed by its
+                    # sequence number) until the consumer's cursor reaches
+                    # it — lanes may finish out of order, consumers never
+                    # see out of order.
+                    ready[seq] = dev
+                    cond.notify_all()
         except BaseException as e:  # noqa: BLE001 — surfaced to the consumer
-            err.append(e)
-        finally:
-            q.put(_Stop)  # unbounded queue: delivery never blocks
+            with cond:
+                err.append(e)
+                cond.notify_all()
 
-    t = threading.Thread(target=worker, daemon=True, name="staging")
-    t.start()
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True,
+                         name=f"staging-{i}")
+        for i in range(n_lanes)
+    ]
+    for t in threads:
+        t.start()
     # Consumer stamps telescope: busy_i = t_get_i - t_take_{i-1} (caller
     # compute between takes), wait_i = t_item_i - t_get_i (blocked on the
     # ring), so wall_s = t_item_last - t_item_first == sum(busy) + sum(wait).
     t_prev_take = None
+    inp_prev = 0.0
+    expected = 0
     try:
         while True:
             t_get = time.perf_counter()
-            item = q.get()
-            t_item = time.perf_counter()
-            if item is _Stop:
-                if err:
+            with cond:
+                while (expected not in ready and not err
+                       and not (src["total"] is not None
+                                and expected >= src["total"])):
+                    cond.wait()
+                if expected in ready:
+                    # Delivered slots drain before an error/end surfaces —
+                    # same semantics as the single-lane queue, where items
+                    # queued ahead of the sentinel were always yielded.
+                    item = ready.pop(expected)
+                elif err:
                     raise err[0]
-                return
+                else:
+                    return
+            expected += 1
+            t_item = time.perf_counter()
             if stats is not None:
-                produced_s = pending_times.popleft() if pending_times else 0.0
-                if t_prev_take is not None:
-                    stats["consumer_busy_s"] += t_get - t_prev_take
-                    stats["consumer_wait_s"] += t_item - t_get
-                    stats["wall_s"] += t_item - t_prev_take
-                    stats["steady_input_s"] += produced_s
-                stats["batches_consumed"] += 1
+                with lock:
+                    # Steady-state input = union-busy DELTA between takes:
+                    # wall-clock with >= 1 lane in its input leg during
+                    # the consumer's steady window. Per-batch lane-seconds
+                    # here would count concurrent lanes multiply and read
+                    # a fully ingest-bound multi-lane run as "hidden".
+                    inp_now = _input_busy_now()
+                    if t_prev_take is not None:
+                        stats["consumer_busy_s"] += t_get - t_prev_take
+                        stats["consumer_wait_s"] += t_item - t_get
+                        stats["wall_s"] += t_item - t_prev_take
+                        stats["steady_input_s"] += inp_now - inp_prev
+                    inp_prev = inp_now
+                    stats["batches_consumed"] += 1
             t_prev_take = t_item
             # Taking batch i frees a slot: batch i's arrays now belong to
-            # the consumer/step, and the producer may overwrite the slot by
+            # the consumer/step, and a lane may overwrite the slot by
             # staging batch i+depth.
             free.release()
             # Chunk reassembly dispatches a PROGRAM, so it must happen here
@@ -482,8 +772,120 @@ def stage_to_device(
             yield _assemble(item, sharding)
     finally:
         stop.set()
-        try:
-            while True:
-                q.get_nowait()
-        except queue.Empty:
-            pass
+        with cond:
+            ready.clear()
+            cond.notify_all()
+
+
+# Auto-tuner probe grids: small powers of two around the proven operating
+# points (PR 2 shipped chunks {2,4,8} as the manual sweep; lanes beyond 4
+# never won a probe on either backend we can see — the reader lock and
+# the link itself serialize first).
+TUNE_LANES = (1, 2, 4)
+TUNE_CHUNKS = (1, 2, 4)
+
+
+def autotune_staging(
+    sample_batch: dict,
+    sharding=None,
+    lanes_grid: tuple[int, ...] = TUNE_LANES,
+    chunks_grid: tuple[int, ...] = TUNE_CHUNKS,
+    reps: int = 3,
+    depth: int | None = None,
+    wire_dtype: str = "auto",
+    codec: str = "none",
+) -> dict:
+    """Micro-probe {lanes x chunks} against the LIVE link and pick the
+    best: each combination stages `reps` copies of `sample_batch` through
+    a real ring with a zero-compute consumer and is scored by the ring's
+    own wire clock (transfer_mb_per_s — bytes over wire-busy union), so
+    the probe measures exactly the machinery the job will run, tunnel and
+    sharding included. Pass the job's real `depth` so the probes run the
+    geometry the job will (the ring caps lanes at depth). Ties break
+    toward fewer lanes, then fewer chunks (less thread/dispatch overhead
+    at equal rate).
+
+    Returns {"lanes", "chunks", "mb_per_s", "table": [{lanes, chunks,
+    requested, mb_per_s, delivered_mb_per_s}, ...], "reps", "probe_s"} —
+    the table is recorded in the trainer's done-event accounting so a
+    bench reader can audit WHY the tuner chose what it chose. Table rows
+    are unique EFFECTIVE geometries (what a ring actually runs: lanes
+    capped at depth, chunks degraded per-array, the multi-process
+    assembly path forced to 1x1) and `requested` lists the grid combos
+    that collapsed onto each row — degenerate combos are probed ONCE,
+    not once per alias (on the assembly path the whole default grid is
+    a single probe instead of 9 stagings of the full global batch), and
+    the locked lanes/chunks always reproduce a configuration that was
+    actually probed.
+
+    The caller keeps `sample_batch` (probes only read it): peek one batch
+    off the real host iterator, tune, then chain it back in front so the
+    training trajectory is byte-identical to an untuned run.
+    """
+    import jax
+
+    if not lanes_grid or not chunks_grid:
+        raise ValueError("autotune_staging: empty probe grid")
+    t_probe0 = time.perf_counter()
+    assembly = sharding is not None and jax.process_count() > 1
+    # Chunk feasibility is decided against the WIRE arrays (to_wire can
+    # 4x a leaf's bytes across the MIN_CHUNK_BYTES threshold).
+    wire_leaves = jax.tree.leaves(to_wire(sample_batch, wire_dtype))
+
+    def _effective(lanes: int, chunks: int) -> tuple[int, int]:
+        d = depth if depth is not None else max(2, lanes)
+        if assembly:
+            return 1, 1
+        return (max(1, min(lanes, d)),
+                max((effective_chunks(leaf, sharding, chunks)
+                     for leaf in wire_leaves), default=1))
+
+    table: list[dict] = []
+    probed: dict[tuple[int, int], dict] = {}
+    best = None
+    for lanes in lanes_grid:
+        for chunks in chunks_grid:
+            eff = _effective(lanes, chunks)
+            if eff in probed:
+                # This combo degrades to an already-probed geometry —
+                # measuring it again would stage reps more copies of the
+                # batch to learn the same number.
+                probed[eff]["requested"].append([lanes, chunks])
+                continue
+            stats: dict = {}
+            it = stage_to_device(
+                iter([sample_batch] * reps),
+                depth=depth if depth is not None else max(2, lanes),
+                sharding=sharding, chunks=chunks, wire_dtype=wire_dtype,
+                stats=stats, lanes=lanes, codec=codec,
+            )
+            n = 0
+            t0 = time.perf_counter()
+            for dev in it:
+                jax.block_until_ready(jax.tree.leaves(dev))
+                n += 1
+            dt = time.perf_counter() - t0
+            rate = transfer_mb_per_s(stats) or 0.0
+            row = {
+                # the geometry this probe's ring ACTUALLY ran — the ring
+                # reports it back (should equal `eff`; trust the ring)
+                "lanes": stats.get("lanes_effective", eff[0]),
+                "chunks": stats.get("chunks_effective", eff[1]),
+                "requested": [[lanes, chunks]],
+                "mb_per_s": round(rate, 2),
+                "delivered_mb_per_s": (
+                    round(stats.get("bytes_staged", 0) / 1e6 / dt, 2)
+                    if dt > 0 else None),
+            }
+            table.append(row)
+            probed[eff] = row
+            if best is None or rate > best[0]:
+                best = (rate, row["lanes"], row["chunks"])
+    return {
+        "lanes": best[1],
+        "chunks": best[2],
+        "mb_per_s": round(best[0], 2),
+        "table": table,
+        "reps": reps,
+        "probe_s": round(time.perf_counter() - t_probe0, 3),
+    }

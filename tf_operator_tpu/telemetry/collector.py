@@ -53,6 +53,9 @@ TRAINER_GAUGES = {
     "tpujob_heartbeat_age_seconds":
         "Seconds since the job's freshest trainer progress heartbeat "
         "(TPUJOB_HEARTBEAT_FILE; the hang-watchdog's staleness signal)",
+    "tpujob_trainer_transfer_mb_per_s":
+        "Staged-ingest host->device transfer rate (bytes over wire-busy "
+        "union across lanes) from the done event's staging accounting",
 }
 
 # Pod names are {job}-{type}-{index} (utils/naming.py); anchoring on the
@@ -258,6 +261,7 @@ class TelemetryCollector:
             if not primary:
                 continue
             step_time = primary.get("step_time_s") or {}
+            staging = primary.get("staging") or {}
             for gauge_name, value in (
                 ("tpujob_trainer_steps_per_sec",
                  primary.get("steady_steps_per_sec")),
@@ -268,6 +272,8 @@ class TelemetryCollector:
                 ("tpujob_trainer_startup_s", primary.get("startup_s")),
                 ("tpujob_trainer_step_time_p50_s", step_time.get("p50")),
                 ("tpujob_trainer_step_time_p99_s", step_time.get("p99")),
+                ("tpujob_trainer_transfer_mb_per_s",
+                 staging.get("transfer_mb_per_s")),
             ):
                 if value is not None:
                     self._gauges[gauge_name].labels(**labels).set(float(value))

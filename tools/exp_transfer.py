@@ -1,4 +1,5 @@
-"""Host->device transfer microbenchmark: serial vs chunked vs staged puts.
+"""Host->device transfer microbenchmark: serial vs chunked vs staged puts,
+plus the round-11 lanes x chunks x codec sweep.
 
 The round-5 bench attributed the real-data ResNet gap to ingest: serial
 f32 device_put measured 52 MB/s against a 361 MB/s parity requirement.
@@ -9,17 +10,26 @@ uint8 of the SAME logical batch):
   chunked  — C concurrent puts per batch, reassembled on device
              (data/staging.py chunked_device_put)
   staged   — end-to-end rate through the staging ring (background
-             transfer thread + K slots) with a zero-compute consumer:
-             the ceiling the ring can feed a step loop
+             transfer lanes + K slots) with a zero-compute consumer:
+             the ceiling the ring can feed a step loop — measured at
+             one lane AND at --lanes (the multi-lane A/B)
 
-Runnable on CPU (numbers are meaningful relatively: chunking/staging
-overheads show up even when the "wire" is a memcpy) and on the chip,
-where the serial-vs-staged delta is the round-7 lever. One JSON line on
+and, over the uint8 batch, a {lanes x chunks x codec} sweep through the
+real engine (the same probe autotune_staging runs at trainer startup),
+so the next on-chip round reads the whole response surface of the
+tunnel in one tool run instead of one bench flag combination per run.
+
+Runnable on CPU (numbers are meaningful relatively: chunking/staging/
+codec overheads show up even when the "wire" is a memcpy) and on the
+chip, where serial-vs-multilane is the round-11 lever. One JSON line on
 stdout; diagnostics on stderr.
 
 Usage: python tools/exp_transfer.py [--batch 256] [--image-size 224]
-       [--reps 8] [--chunks 4] [--depth 3]
-(CPU smoke: --batch 32 --image-size 64 --reps 3)
+       [--reps 8] [--chunks 4] [--depth 3] [--lanes 4]
+       [--sweep-lanes 1,2,4] [--sweep-chunks 1,2,4]
+       [--sweep-codecs none,zlib | --no-sweep]
+(CI smoke: --batch 8 --image-size 32 --reps 2 --sweep-lanes 1,2
+ --sweep-chunks 1,2)
 """
 
 from __future__ import annotations
@@ -42,6 +52,10 @@ def _mb_per_s(nbytes: int, seconds: float) -> float | None:
     return round(nbytes / 1e6 / seconds, 2) if seconds > 0 else None
 
 
+def _grid(text: str) -> tuple:
+    return tuple(t.strip() for t in text.split(",") if t.strip())
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=256)
@@ -49,12 +63,20 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--reps", type=int, default=8)
     ap.add_argument("--chunks", type=int, default=4)
     ap.add_argument("--depth", type=int, default=3)
+    ap.add_argument("--lanes", type=int, default=4,
+                    help="lane count for the multi-lane staged row")
+    ap.add_argument("--sweep-lanes", default="1,2,4")
+    ap.add_argument("--sweep-chunks", default="1,2,4")
+    ap.add_argument("--sweep-codecs", default="none,zlib")
+    ap.add_argument("--no-sweep", action="store_true",
+                    help="skip the lanes x chunks x codec sweep")
     args = ap.parse_args(argv)
 
     import jax
     import numpy as np
 
     from tf_operator_tpu.data.staging import (
+        autotune_staging,
         chunked_device_put,
         stage_to_device,
         transfer_mb_per_s,
@@ -104,25 +126,30 @@ def main(argv: list[str] | None = None) -> int:
         # rates: the ring's own wire timer (transfer_mb_per_s, comparable
         # to serial/chunked) and the consumer-observed delivery rate
         # (includes host batch production riding under the transfers).
-        stats: dict = {}
-        it = stage_to_device(
-            iter([x] * args.reps), depth=args.depth, chunks=args.chunks,
-            stats=stats,
-        )
-        t0 = time.perf_counter()
-        n = 0
-        for dev in it:
-            jax.block_until_ready(dev)
-            n += 1
-        dt = time.perf_counter() - t0
-        rate = transfer_mb_per_s(stats)
-        row["staged_wire_mb_per_s"] = round(rate, 2) if rate else None
-        row["staged_delivered_mb_per_s"] = _mb_per_s(x.nbytes * n, dt)
-        # The ring degrades chunking per-array (size threshold, shard
-        # divisibility) — report what actually ran so small-batch smoke
-        # configs can't read a chunked-vs-staged comparison into what was
-        # really chunked-vs-serial.
-        row["staged_chunks_effective"] = stats.get("chunks_effective")
+        # Measured at ONE lane (the round-7 ring) and at --lanes (the
+        # round-11 multi-lane engine) — the serial-vs-multilane delta is
+        # the headline A/B.
+        for tag, lanes in (("staged", 1), ("staged_multilane", args.lanes)):
+            stats: dict = {}
+            it = stage_to_device(
+                iter([x] * args.reps), depth=max(args.depth, lanes),
+                chunks=args.chunks, stats=stats, lanes=lanes,
+            )
+            t0 = time.perf_counter()
+            n = 0
+            for dev in it:
+                jax.block_until_ready(dev)
+                n += 1
+            dt = time.perf_counter() - t0
+            rate = transfer_mb_per_s(stats)
+            row[f"{tag}_wire_mb_per_s"] = round(rate, 2) if rate else None
+            row[f"{tag}_delivered_mb_per_s"] = _mb_per_s(x.nbytes * n, dt)
+            # The ring degrades chunking per-array (size threshold, shard
+            # divisibility) and lanes per-path — report what actually ran
+            # so small-batch smoke configs can't read a chunked-vs-staged
+            # comparison into what was really chunked-vs-serial.
+            row[f"{tag}_chunks_effective"] = stats.get("chunks_effective")
+            row[f"{tag}_lanes_effective"] = stats.get("lanes_effective")
         out[dtype] = row
         log(f"  {dtype}: {row}")
 
@@ -132,6 +159,31 @@ def main(argv: list[str] | None = None) -> int:
     # MB/s on the uint8 wire — report the effective image-rate gain.
     out["uint8_vs_f32_image_rate_gain"] = (
         round(4 * s / f, 2) if s and f else None)
+
+    if not args.no_sweep:
+        # {lanes x chunks x codec} response surface over the uint8 batch,
+        # through the REAL engine (autotune_staging is the identical probe
+        # the trainer's --staging-tune runs at startup). One sub-table per
+        # codec: the "none" table says what geometry the link wants; the
+        # codec tables say what a compressed remote wire would add/cost.
+        sweep = {}
+        for codec in _grid(args.sweep_codecs):
+            tune = autotune_staging(
+                {"x": u8},
+                lanes_grid=tuple(int(v) for v in _grid(args.sweep_lanes)),
+                chunks_grid=tuple(int(v) for v in _grid(args.sweep_chunks)),
+                reps=args.reps, depth=args.depth, codec=codec,
+            )
+            sweep[codec] = tune
+            log(f"  sweep[{codec}]: best lanes={tune['lanes']} "
+                f"chunks={tune['chunks']} {tune['mb_per_s']} MB/s "
+                f"({tune['probe_s']}s)")
+        out["sweep"] = sweep
+        best = sweep.get("none", {}).get("mb_per_s")
+        # The round-11 A/B: the tuned multi-lane engine vs the serial
+        # single-put baseline on the SAME uint8 batch.
+        out["tuned_staged_vs_serial_gain"] = (
+            round(best / s, 2) if best and s else None)
     print(json.dumps(out))
     return 0
 
