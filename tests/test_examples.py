@@ -24,6 +24,14 @@ EXAMPLES = sorted((REPO / "examples").rglob("*.yaml"))
 class TestManifests:
     @pytest.mark.parametrize("path", EXAMPLES, ids=[p.name for p in EXAMPLES])
     def test_example_validates(self, path):
+        if path.name == "fleet-config.yaml":
+            # Not a job manifest: the fleet scheduling policy document
+            # (docs/scheduling.md) — validated by its own loader.
+            from tf_operator_tpu.sched.policy import fleet_policy_from_yaml
+
+            policy = fleet_policy_from_yaml(path.read_text())
+            assert policy.validate() == []
+            return
         job = compat.job_from_yaml(path.read_text())
         assert validation.validate_job(job) == []
 

@@ -259,6 +259,9 @@ def job_to_dict(job: TrainJob) -> dict[str, Any]:
                 "schedulingPolicy": {
                     "gang": rp.scheduling.gang,
                     "queue": rp.scheduling.queue,
+                    # Round 12: was silently DROPPED on emit — a job
+                    # round-tripped through the API lost its priority.
+                    "priorityClass": rp.scheduling.priority_class,
                     "minAvailable": rp.scheduling.min_available,
                 },
                 "recovery": {

@@ -72,6 +72,13 @@ class JobConditionType(str, enum.Enum):
     # job keeps its object + status but holds no pods (and no TPU slice) —
     # batch/v1 Job.spec.suspend semantics, resumable via checkpoints.
     SUSPENDED = "Suspended"
+    # Fleet-scheduler states (sched/): a Queued job passed admission but
+    # holds no slice yet (capacity or namespace quota); a Preempted job was
+    # gracefully evicted (SIGTERM -> emergency checkpoint -> pods deleted)
+    # to make room for higher priority and is waiting to be rescheduled —
+    # explicitly NOT Failed, and NOT counted against backoffLimit.
+    QUEUED = "Queued"
+    PREEMPTED = "Preempted"
 
     def __str__(self) -> str:
         return self.value
@@ -318,6 +325,16 @@ class JobStatus:
     # Pods Pending past recovery.pending_timeout_seconds (stuck-Pending
     # detection): surfaced here so the API shows WHY a job sits in Created.
     stuck_pending_pods: list[str] = field(default_factory=list)
+    # Fleet-scheduler preemption bookkeeping (sched/): lifetime preemption
+    # count, when the job was last evicted (drives the scheduler's
+    # anti-thrash cooldown across operator failovers), and the drain latch
+    # — uids of pods a preemption doomed whose deletions may still be in
+    # flight. Same failover discipline as pending_gang_roll_uids: the
+    # preemption is recorded ONCE; a new leader re-issues the deletes
+    # without re-counting.
+    preemptions: int = 0
+    last_preemption_time: float | None = None
+    pending_preemption_uids: list[str] = field(default_factory=list)
 
 
 @dataclass

@@ -30,9 +30,14 @@ class ValidationError(ValueError):
         super().__init__("; ".join(problems))
 
 
-def validate_spec(spec: TrainJobSpec) -> list[str]:
+def validate_spec(spec: TrainJobSpec, fleet=None) -> list[str]:
     """Returns all problems found (empty list = valid). Mirrors
-    ValidateV1TFJobSpec (validation.go:27) but reports every issue at once."""
+    ValidateV1TFJobSpec (validation.go:27) but reports every issue at once.
+
+    `fleet` (sched.FleetPolicy, optional) enables fleet-scheduler checks:
+    a priorityClass must NAME A CLASS THE POLICY KNOWS — a typo'd class
+    silently falling back to default priority is exactly the failure mode
+    admission-time validation exists to prevent."""
     problems: list[str] = []
     if not spec.replica_specs:
         problems.append("replicaSpecs must not be empty")
@@ -70,6 +75,25 @@ def validate_spec(spec: TrainJobSpec) -> list[str]:
     if ReplicaType.CHIEF in spec.replica_specs and ReplicaType.MASTER in spec.replica_specs:
         problems.append("job may have Chief or Master, not both")
 
+    # Scheduling knobs (sched/): queue/priorityClass are DNS-1035 labels
+    # (the CRD schema carries the same pattern, so the fake apiserver 422s
+    # these exactly where a real server would; this is the semantic layer
+    # for dict-submitted jobs that never cross the wire).
+    sched = spec.run_policy.scheduling
+    for label, value in (("queue", sched.queue),
+                         ("priorityClass", sched.priority_class)):
+        if value and not is_valid_dns_name(value):
+            problems.append(
+                f"runPolicy.schedulingPolicy.{label} {value!r} is not a "
+                "valid DNS-1035 label")
+    if fleet is not None:
+        if sched.priority_class and not fleet.knows_class(
+                sched.priority_class):
+            known = ", ".join(sorted(fleet.priority_classes)) or "<none>"
+            problems.append(
+                f"runPolicy.schedulingPolicy.priorityClass "
+                f"{sched.priority_class!r} names no PriorityClass in the "
+                f"fleet policy (known: {known})")
     rec = spec.run_policy.recovery
     if rec.policy not in ("", "gang", "pod"):
         problems.append(
@@ -103,18 +127,30 @@ def validate_spec(spec: TrainJobSpec) -> list[str]:
     return problems
 
 
-def validate_job(job: TrainJob) -> list[str]:
+def validate_job(job: TrainJob, fleet=None) -> list[str]:
     problems: list[str] = []
     if not is_valid_dns_name(job.metadata.name):
         problems.append(
             f"job name {job.metadata.name!r} is not a valid DNS-1035 label "
             "(lowercase alphanumerics and '-', <= 63 chars)"
         )
-    problems.extend(validate_spec(job.spec))
+    problems.extend(validate_spec(job.spec, fleet=fleet))
+    # Fleet quota sanity: a slice job in a namespace whose quota is 0 can
+    # NEVER be admitted — reject at the door instead of queueing forever.
+    if (fleet is not None and job.spec.tpu is not None
+            and job.spec.tpu.topology):
+        quota = fleet.quota_for(job.metadata.namespace)
+        if quota is not None and (quota.max_slices == 0
+                                  or quota.max_jobs == 0):
+            problems.append(
+                f"namespace {job.metadata.namespace!r} has a zero "
+                f"ResourceQuota for TPU slices (maxSlices="
+                f"{quota.max_slices}, maxJobs={quota.max_jobs}): this job "
+                "can never be admitted")
     return problems
 
 
-def must_validate(job: TrainJob) -> None:
-    problems = validate_job(job)
+def must_validate(job: TrainJob, fleet=None) -> None:
+    problems = validate_job(job, fleet=fleet)
     if problems:
         raise ValidationError(problems)

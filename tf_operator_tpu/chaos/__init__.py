@@ -45,7 +45,7 @@ __all__ = [
     "ENV_CHAOS", "ENV_CHAOS_STATE", "Directive", "OneShotState",
     "from_env", "parse_chaos", "parse_signal",
     "TrainerChaos", "hang", "tear_checkpoint", "staging_stalls_from_env",
-    "staging_stall_delay", "apiserver_directives",
+    "staging_stall_delay", "apiserver_directives", "preempt_directives",
 ]
 
 
@@ -227,3 +227,13 @@ def apiserver_directives(env: dict | None = None) -> list[Directive]:
     if not e.get(ENV_CHAOS):
         return []
     return [d for d in from_env(e) if d.kind == "apiserver"]
+
+
+def preempt_directives(env: dict | None = None) -> list[Directive]:
+    """`preempt:` directives — the operator-side eviction feed
+    (core/trainjob_controller.py reads these at construction and evicts
+    the named job once its heartbeat crosses the step)."""
+    e = os.environ if env is None else env
+    if not e.get(ENV_CHAOS):
+        return []
+    return [d for d in from_env(e) if d.kind == "preempt"]

@@ -52,6 +52,20 @@ Directive kinds and their keys (all integers/floats unless noted):
                                       (code=0: latency only), sleeping S
                                       first; match is a substring of
                                       "METHOD /path".
+    preempt    step=N job=NAME        OPERATOR-side: the controller
+               [namespace=NS]         gracefully evicts the named job
+                                      (SIGTERM -> emergency checkpoint ->
+                                      requeue, Preempted condition, tally
+                                      untouched) once its progress
+                                      heartbeat reaches step N — the
+                                      deterministic stand-in for a
+                                      higher-priority arrival, so
+                                      preemption e2es fire at an exact
+                                      step boundary like kill/hang.
+                                      Requires a heartbeat source
+                                      (operator --log-dir). namespace
+                                      defaults to "default". One-shot
+                                      like kill/hang.
 
 One-shot semantics across restarts: when `TPUJOB_CHAOS_STATE` names a
 directory, each fired directive drops a marker file there and never fires
@@ -72,7 +86,7 @@ from dataclasses import dataclass, field
 ENV_CHAOS = "TPUJOB_CHAOS"
 ENV_CHAOS_STATE = "TPUJOB_CHAOS_STATE"
 
-KINDS = ("kill", "hang", "torn", "stall", "apiserver")
+KINDS = ("kill", "hang", "torn", "stall", "apiserver", "preempt")
 
 _KEYS: dict[str, dict[str, type]] = {
     "kill": {"step": int, "signal": str, "replica": str, "index": int},
@@ -81,6 +95,7 @@ _KEYS: dict[str, dict[str, type]] = {
     "stall": {"delay": float, "batch": int, "every": int, "lane": int},
     "apiserver": {"errors": int, "code": int, "latency": float,
                   "match": str},
+    "preempt": {"step": int, "job": str, "namespace": str},
 }
 
 TORN_MODES = ("truncate", "unlink")
@@ -195,6 +210,11 @@ def _validate(kind: str, params: dict) -> None:
             raise ValueError("chaos: apiserver: errors must be >= 0")
         if params.get("latency", 0.0) < 0:
             raise ValueError("chaos: apiserver: latency must be >= 0")
+    elif kind == "preempt":
+        if "step" not in params:
+            raise ValueError("chaos: preempt requires step=N")
+        if not params.get("job"):
+            raise ValueError("chaos: preempt requires job=NAME")
 
 
 def from_env(env: dict | None = None) -> list[Directive]:

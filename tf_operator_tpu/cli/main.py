@@ -112,6 +112,20 @@ def cmd_operator(args) -> int:
     from tf_operator_tpu.utils.leader import LeaderElector
 
     log = FieldLogger({"component": "operator"})
+    # Fleet scheduling policy (sched/): priority classes, per-namespace
+    # quotas, weighted queues, preemption cooldown. With --tpu-slices the
+    # scheduler arbitrates the fleet; without slices the policy still
+    # drives admission validation.
+    fleet_policy = None
+    if args.fleet_config:
+        from tf_operator_tpu.sched.policy import fleet_policy_from_yaml
+
+        with open(args.fleet_config) as f:
+            fleet_policy = fleet_policy_from_yaml(f.read())
+        log.info("fleet policy loaded from %s (%d priority classes, "
+                 "%d quotas, %d queues)", args.fleet_config,
+                 len(fleet_policy.priority_classes),
+                 len(fleet_policy.quotas), len(fleet_policy.queues))
     # Substrate: a K8s API server (real cluster deployment — pods run as
     # real cluster pods, kubelet feeds status back) or the in-memory
     # substrate with the local-process runtime (one-host deployment).
@@ -130,6 +144,13 @@ def cmd_operator(args) -> int:
     else:
         cluster = InMemoryCluster()
     allocator = SliceAllocator.of(*args.tpu_slices) if args.tpu_slices else None
+    scheduler = None
+    if allocator is not None:
+        from tf_operator_tpu.sched import FleetScheduler
+
+        scheduler = FleetScheduler(allocator, policy=fleet_policy)
+        log.info("fleet scheduler arbitrating %d slice(s)",
+                 len(allocator.slices))
 
     # Admission webhook serves on EVERY replica (stateless, no leadership
     # needed — a real cluster load-balances webhook calls across the
@@ -141,6 +162,7 @@ def cmd_operator(args) -> int:
         webhook_server = AdmissionWebhookServer(
             port=args.webhook_port, host=args.webhook_bind,
             cert_file=args.webhook_cert, key_file=args.webhook_key,
+            fleet=scheduler.policy if scheduler is not None else fleet_policy,
         ).start()
         log.info("admission webhook on %s", webhook_server.url)
 
@@ -165,6 +187,9 @@ def cmd_operator(args) -> int:
             gang_scheduler_name=args.gang_scheduler_name,
             slice_allocator=allocator,
             heartbeat_source=heartbeat_source,
+            scheduler=scheduler,
+            queue_shards=args.queue_shards,
+            fleet_policy=fleet_policy,
         )
         runtime = None
         if on_k8s:
@@ -191,7 +216,8 @@ def cmd_operator(args) -> int:
         # kubectl port-forward both enter via the pod's loopback).
         api = ApiServer(cluster, port=args.monitoring_port, log_dir=args.log_dir,
                         runtime=runtime, bind=args.bind,
-                        telemetry=heartbeat_source)
+                        telemetry=heartbeat_source, scheduler=scheduler,
+                        fleet=fleet_policy)
         api.start()
         log.info("REST/metrics API on %s:%d", args.bind, api.port)
         controller.run(workers=args.threadiness)
@@ -408,6 +434,17 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("operator")
     p.add_argument("--threadiness", type=int, default=2)  # options.go default
+    p.add_argument("--queue-shards", type=int, default=1,
+                   help="shard the reconcile workqueue (fleet scale: "
+                        "workers stop contending on one queue lock; keys "
+                        "route to stable shards). 1 = the classic single "
+                        "queue")
+    p.add_argument("--fleet-config", default=None,
+                   help="fleet scheduling policy YAML (priorityClasses, "
+                        "per-namespace quotas, weighted queues, "
+                        "preemptionCooldownSeconds — docs/scheduling.md); "
+                        "with --tpu-slices the fleet scheduler arbitrates "
+                        "admission and preemption")
     p.add_argument("--monitoring-port", type=int, default=8443)
     p.add_argument("--bind", default="127.0.0.1",
                    help="REST/metrics bind address; the API is "

@@ -36,7 +36,7 @@ from tf_operator_tpu.status import metrics
 
 
 def _job_payload(cluster: InMemoryCluster, job: TrainJob,
-                 telemetry=None) -> dict:
+                 telemetry=None, scheduler=None) -> dict:
     payload = {
         "manifest": compat.job_to_dict(job),
         "status": {
@@ -61,6 +61,10 @@ def _job_payload(cluster: InMemoryCluster, job: TrainJob,
             "gangRestarts": job.status.gang_restarts,
             "consecutiveRestarts": job.status.consecutive_restarts,
             "stuckPendingPods": list(job.status.stuck_pending_pods),
+            # Preemption visibility (sched/): planned evictions are a
+            # first-class lifecycle event, not failures.
+            "preemptions": job.status.preemptions,
+            "lastPreemptionTime": job.status.last_preemption_time,
         },
         "events": [
             {"type": e.type, "reason": e.reason, "message": e.message, "ts": e.timestamp}
@@ -74,16 +78,29 @@ def _job_payload(cluster: InMemoryCluster, job: TrainJob,
         # phase_breakdown. Single-job GETs only — list responses stay
         # cheap (no file IO per job per list).
         payload["telemetry"] = telemetry.job_telemetry(job.namespace, job.name)
+    if scheduler is not None:
+        # Fleet-scheduler view: live state (Admitted/Queued), queue,
+        # priority, and — for waiters — the 1-based queue position.
+        payload["scheduling"] = scheduler.job_view(job.key())
     return payload
 
 
 class ApiServer:
     def __init__(self, cluster: InMemoryCluster, port: int = 8443,
                  log_dir: str | None = None, runtime=None,
-                 bind: str = "127.0.0.1", telemetry=None):
+                 bind: str = "127.0.0.1", telemetry=None, scheduler=None,
+                 fleet=None):
         self.cluster = cluster
         self.log_dir = log_dir
         self.runtime = runtime  # LocalProcessRuntime, for the endpoints view
+        # Fleet scheduler (sched.FleetScheduler): serves per-job queue
+        # position on single-job GETs and the whole-fleet /api/queues view.
+        self.scheduler = scheduler
+        # Fleet policy for submit-time validation. Passed separately so a
+        # --fleet-config-only deployment (no slices -> no scheduler) still
+        # 400s a typo'd priorityClass at the API edge.
+        self.fleet = fleet or (scheduler.policy
+                               if scheduler is not None else None)
         # Trainer telemetry rides the same log_dir the runtime writes pod
         # metrics files into; without a log_dir there is nothing to read.
         # Callers that already own a collector for the same log_dir (the
@@ -166,12 +183,12 @@ class ApiServer:
                         c.status and str(c.type) in wanted
                         for c in job.status.conditions
                     ):
-                        return self._send(_job_payload(outer.cluster, job, outer.telemetry))
+                        return self._send(_job_payload(outer.cluster, job, outer.telemetry, outer.scheduler))
                     remaining = deadline - _time.monotonic()
                     if remaining <= 0:
                         payload = {"timeout": True}
                         if job is not None:
-                            payload["job"] = _job_payload(outer.cluster, job, outer.telemetry)
+                            payload["job"] = _job_payload(outer.cluster, job, outer.telemetry, outer.scheduler)
                         return self._send(payload, 408)
                     with outer._events:
                         if outer._events_gen == gen:
@@ -204,6 +221,16 @@ class ApiServer:
                     elif parts == ["api", "namespaces"]:
                         ns = sorted({j.namespace for j in outer.cluster.list_jobs()})
                         self._send({"namespaces": ns})
+                    elif parts == ["api", "queues"]:
+                        # Whole-fleet scheduler view: per-queue depths and
+                        # weights, the globally-ranked waiting list (with
+                        # positions), held slices, in-flight evictions,
+                        # and the self-audit stats (inversions /
+                        # quota_violations must read 0).
+                        if outer.scheduler is None:
+                            self._send({"error": "no fleet scheduler"}, 404)
+                        else:
+                            self._send(outer.scheduler.snapshot())
                     elif parts[:2] == ["api", "trainjobs"] and len(parts) == 2:
                         self._send(
                             {
@@ -385,9 +412,12 @@ class ApiServer:
                         job = compat.job_from_dict(json.loads(raw))
                     # Admission-time validation (SURVEY.md §7: validate at the
                     # API edge instead of the reference's in-controller
-                    # invalid-spec status write-back, informer.go:82).
+                    # invalid-spec status write-back, informer.go:82). With a
+                    # fleet scheduler its policy joins the invariants: a
+                    # typo'd priorityClass is a 400 here, not a silent
+                    # default-priority run.
                     defaults.set_defaults(job)
-                    problems = validation.validate_job(job)
+                    problems = validation.validate_job(job, fleet=outer.fleet)
                     if problems:
                         self._send({"error": "invalid TrainJob",
                                     "problems": problems}, 400)

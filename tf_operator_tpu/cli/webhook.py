@@ -30,12 +30,14 @@ from tf_operator_tpu.api.validation import validate_job
 from tf_operator_tpu.core.k8s import job_from_k8s
 
 
-def review_response(review: dict) -> dict:
+def review_response(review: dict, fleet=None) -> dict:
     """Pure request->response admission logic (unit-testable sans HTTP).
 
     Accepts an `AdmissionReview` dict; returns the AdmissionReview response
     envelope with `.response.allowed` and, on denial, a `.response.status`
     whose code is 400 (the code kubectl surfaces as the denial message).
+    `fleet` (sched.FleetPolicy) additionally rejects unknown
+    priorityClass names and zero-quota namespaces at admission.
     """
     req = review.get("request") or {}
     uid = req.get("uid", "")
@@ -43,7 +45,7 @@ def review_response(review: dict) -> dict:
     problems: list[str]
     if req.get("operation") in (None, "CREATE", "UPDATE"):
         try:
-            problems = validate_job(job_from_k8s(obj))
+            problems = validate_job(job_from_k8s(obj), fleet=fleet)
         except Exception as exc:  # malformed beyond parsing: deny, not crash
             problems = [f"unparseable TrainJob: {exc}"]
     else:  # DELETE etc. carry no object to validate
@@ -63,7 +65,8 @@ class AdmissionWebhookServer:
     clusters require it); plain HTTP otherwise (in-repo substrate)."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 cert_file: str | None = None, key_file: str | None = None):
+                 cert_file: str | None = None, key_file: str | None = None,
+                 fleet=None):
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # noqa: N802 — quiet
                 pass
@@ -75,7 +78,7 @@ class AdmissionWebhookServer:
                 try:
                     n = int(self.headers.get("Content-Length") or 0)
                     review = json.loads(self.rfile.read(n) or b"{}")
-                    payload = review_response(review)
+                    payload = review_response(review, fleet=fleet)
                 except ValueError:
                     self.send_error(400, "bad AdmissionReview payload")
                     return

@@ -59,9 +59,13 @@ def gen_labels(job_name: str) -> dict[str, str]:
 class JobControllerBase:
     """Reconcile engine: workqueue + expectations + claim/adopt."""
 
-    def __init__(self, cluster: InMemoryCluster):
+    def __init__(self, cluster: InMemoryCluster, queue_shards: int = 1):
         self.cluster = cluster
-        self.queue = make_queue()
+        # queue_shards > 1: fleet-scale mode — keys route to stable shards
+        # and each worker thread services its own (core/workqueue.py
+        # ShardedRateLimitingQueue), so reconcile workers stop contending
+        # on one queue lock under thousands of jobs.
+        self.queue = make_queue(shards=queue_shards)
         self.expectations = make_expectations()
         self.pod_control = PodControl(cluster)
         self.service_control = ServiceControl(cluster)
@@ -279,7 +283,10 @@ class JobControllerBase:
         for job in self.cluster.list_jobs():
             self.enqueue(job.key())
         for i in range(workers):
-            t = threading.Thread(target=self._worker, name=f"reconciler-{i}", daemon=True)
+            t = threading.Thread(
+                target=self._worker, args=(i,), name=f"reconciler-{i}",
+                daemon=True,
+            )
             t.start()
             self._workers.append(t)
 
@@ -308,9 +315,13 @@ class JobControllerBase:
             metrics.reconcile_latency.observe(time.monotonic() - t0)
             self.queue.done(item)
 
-    def _worker(self) -> None:
+    def _worker(self, index: int = 0) -> None:
+        sharded = getattr(self.queue, "sharded", False)
         while not self._stop.is_set():
-            item = self.queue.get(timeout=0.2)
+            if sharded:
+                item = self.queue.get(timeout=0.2, shard=index)
+            else:
+                item = self.queue.get(timeout=0.2)
             if item is None:
                 continue
             with self._idle_cond:

@@ -23,6 +23,7 @@ Tier-2 wire-protocol tests without a cluster.
 
 from __future__ import annotations
 
+import copy
 import http.client
 import json
 import ssl
@@ -176,6 +177,13 @@ def job_status_to_dict(status: JobStatus) -> dict:
         "restartHeartbeatStep": status.restart_heartbeat_step,
         "pendingGangRollUids": list(status.pending_gang_roll_uids),
         "stuckPendingPods": list(status.stuck_pending_pods),
+        # Preemption bookkeeping (sched/): count + cooldown anchor + drain
+        # latch must survive operator failover exactly like the gang-roll
+        # latch above (a new leader re-issues eviction deletes without
+        # re-counting the incident).
+        "preemptions": status.preemptions,
+        "lastPreemptionTime": status.last_preemption_time,
+        "pendingPreemptionUids": list(status.pending_preemption_uids),
     }
 
 
@@ -190,6 +198,9 @@ def job_status_from_dict(d: dict) -> JobStatus:
         restart_heartbeat_step=d.get("restartHeartbeatStep"),
         pending_gang_roll_uids=list(d.get("pendingGangRollUids") or []),
         stuck_pending_pods=list(d.get("stuckPendingPods") or []),
+        preemptions=int(d.get("preemptions") or 0),
+        last_preemption_time=d.get("lastPreemptionTime"),
+        pending_preemption_uids=list(d.get("pendingPreemptionUids") or []),
     )
     for c in d.get("conditions") or []:
         status.conditions.append(
@@ -878,12 +889,24 @@ class K8sCluster:
         KIND_PODGROUP: (podgroup_to_k8s, podgroup_from_k8s),
     }
 
-    def __init__(self, api: K8sApi, namespace: str | None = None):
+    def __init__(self, api: K8sApi, namespace: str | None = None,
+                 lists_from_cache: bool = False):
         self.api = api
         self.namespace = namespace  # None = all namespaces
         self._handlers: dict[tuple[str, str], list[Callable]] = {}
         self._informers: list[_Informer] = []
         self._lock = threading.Lock()
+        # client-go lister semantics (fleet scale): serve pod/service
+        # LISTs from the synced informer cache instead of a fresh
+        # apiserver round-trip per reconcile. The controller's
+        # expectations machinery exists precisely to absorb the cache's
+        # bounded staleness (a just-created pod not yet delivered), and
+        # every real operator reads through listers for this reason —
+        # with thousands of jobs, two HTTP lists per sync is the
+        # dominant apiserver load. Jobs stay read-through: status
+        # latches (gang roll / preemption drains) must read their own
+        # writes. Default off: bit-for-bit the old behavior.
+        self.lists_from_cache = lists_from_cache
 
     # ------------------------------------------------------------- paths
 
@@ -1001,7 +1024,42 @@ class K8sCluster:
         )
         return self.decode(kind, d) if d.get("kind") not in (None, "Status") else None
 
+    def _cache_list(self, kind: str, namespace: str | None,
+                    selector: dict | None):
+        """Lister-style read from the informer cache; None when the kind
+        has no synced informer (callers fall back to HTTP)."""
+        if kind == KIND_JOB:
+            return None  # jobs read-through: status latches need RYW
+        inf = next((i for i in self._informers
+                    if i.kind == kind and i.synced.is_set()), None)
+        if inf is None:
+            return None
+        for _ in range(8):
+            try:
+                objs = list(inf._cache.values())
+                break
+            except RuntimeError:  # cache resized mid-iteration: retry
+                continue
+        else:
+            return None
+        out = []
+        for o in objs:
+            if namespace and o.namespace != namespace:
+                continue
+            if selector and any(
+                    o.metadata.labels.get(k) != v
+                    for k, v in selector.items()):
+                continue
+            # Deep copies: reconcile mutates listed objects (claim/adopt)
+            # and must never write into the shared cache.
+            out.append(copy.deepcopy(o))
+        return out
+
     def _list(self, kind: str, namespace: str | None, selector: dict | None):
+        if self.lists_from_cache:
+            cached = self._cache_list(kind, namespace, selector)
+            if cached is not None:
+                return cached
         if namespace:
             path = self._ns_path(kind, namespace)
         else:

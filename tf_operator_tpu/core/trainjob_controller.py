@@ -92,12 +92,41 @@ class TrainJobController(ctrl.JobControllerBase):
         slice_allocator: gang.SliceAllocator | None = None,
         keep_failed_pods: bool = True,
         heartbeat_source=None,
+        scheduler=None,
+        queue_shards: int = 1,
+        fleet_policy=None,
     ):
-        super().__init__(cluster)
+        super().__init__(cluster, queue_shards=queue_shards)
         self.enable_gang = enable_gang
         self.gang_scheduler_name = gang_scheduler_name
+        # Fleet scheduler (sched.FleetScheduler): priority/quota/fair-share
+        # admission + graceful preemption above the gang layer. When set,
+        # it OWNS the slice allocator — `_admit_slice` consults decide()
+        # instead of the allocator directly, and validation enforces its
+        # FleetPolicy (unknown priorityClass fails the job, not silently
+        # default-priority).
+        self.scheduler = scheduler
+        if scheduler is not None and slice_allocator is None:
+            slice_allocator = scheduler.allocator
         self.slice_allocator = slice_allocator
+        # Fleet policy for VALIDATION (unknown priorityClass, zero-quota
+        # namespace) — also honored with no scheduler/slices configured,
+        # so a --fleet-config-only deployment still rejects typo'd
+        # classes instead of silently running them at default priority.
+        self.fleet_policy = fleet_policy or (
+            scheduler.policy if scheduler is not None else None)
         self.keep_failed_pods = keep_failed_pods
+        # Deterministic preemption e2es: `preempt:step=N,job=NAME`
+        # directives in TPUJOB_CHAOS make THIS controller evict the named
+        # job once its heartbeat crosses step N — the same graceful
+        # eviction path a real higher-priority arrival triggers, minus the
+        # nondeterministic arrival timing. One-shot markers share
+        # TPUJOB_CHAOS_STATE with the trainer-side directives.
+        from tf_operator_tpu import chaos as chaos_lib
+
+        self._chaos_preempts = chaos_lib.preempt_directives()
+        self._chaos_state = chaos_lib.OneShotState.from_env()
+        self._chaos_preempt_warned: set[str] = set()
         # Anything with `job_heartbeat(ns, name) -> {"step", "t", ...} | None`
         # (telemetry.collector.TelemetryCollector). Drives the hang watchdog
         # and the consecutive-restart reset; None disables both (the
@@ -147,9 +176,7 @@ class TrainJobController(ctrl.JobControllerBase):
                 self.expectations.delete_expectations(
                     naming.gen_expectation_services_key(key, str(rtype))
                 )
-            if self.slice_allocator is not None:
-                if self.slice_allocator.release(key):
-                    self._kick_slice_waiters()
+            self._release_capacity(key)
             return
 
         job = shared.deep_copy()
@@ -157,7 +184,9 @@ class TrainJobController(ctrl.JobControllerBase):
 
         # Invalid spec: mark Failed, emit event, never crash (parity with the
         # unstructured-informer tolerance + invalid_tfjob_tests behavior).
-        problems = api_validation.validate_job(job)
+        # With a fleet scheduler, its policy joins the invariants (unknown
+        # priorityClass, zero-quota namespace) — enforced BEFORE admission.
+        problems = api_validation.validate_job(job, fleet=self.fleet_policy)
         if problems:
             msg = "; ".join(problems)
             self.cluster.record_event(
@@ -235,9 +264,7 @@ class TrainJobController(ctrl.JobControllerBase):
                     self.expectations.deletion_observed(exp_key)
             if self.enable_gang:
                 gang.delete_podgroup(self.cluster, job)
-            if self.slice_allocator is not None:
-                if self.slice_allocator.release(key):
-                    self._kick_slice_waiters()
+            self._release_capacity(key)
             status_engine.set_condition(
                 job.status, JobConditionType.SUSPENDED,
                 status_engine.REASON_SUSPENDED,
@@ -265,23 +292,41 @@ class TrainJobController(ctrl.JobControllerBase):
             self._delete_pods_and_services(job, pods, services)
             if self.enable_gang:
                 gang.delete_podgroup(self.cluster, job)
-            if self.slice_allocator is not None:
-                if self.slice_allocator.release(job.key()):
-                    self._kick_slice_waiters()
+            self._release_capacity(job.key())
             # Status must be durable before TTL GC may delete the job.
             if job.status != old_status:
                 self.cluster.update_job_status(job)
             self._cleanup_by_ttl(job)
             return
 
-        # Gang: PodGroup + atomic slice admission gate pod creation.
+        # Gang: PodGroup + atomic slice admission gate pod creation. With
+        # a fleet scheduler, the PodGroup syncs only once ADMITTED — the
+        # scheduler replaces kube-batch as the arbiter, so a queued job's
+        # every retry paying a PodGroup GET would be pure apiserver load
+        # at fleet scale (the group object exists for external gang
+        # schedulers to observe, which only matters once pods exist).
         if self.enable_gang and job.spec.run_policy.scheduling.gang:
-            gang.sync_podgroup(self.cluster, job)
-            if not self._admit_slice(job, key):
+            if self.scheduler is None:
+                gang.sync_podgroup(self.cluster, job)
+            retry_delay = self._admit_slice(job, key)
+            if retry_delay is not None:
                 if job.status != old_status:
                     self.cluster.update_job_status(job)
-                self.queue.add_after(key, SLICE_RETRY_DELAY_S)
+                self.queue.add_after(key, retry_delay)
                 return
+            if self.scheduler is not None:
+                gang.sync_podgroup(self.cluster, job)
+
+        # Graceful preemption (fleet scheduler eviction or chaos
+        # `preempt:` directive): evict, drain, requeue — skipping the
+        # per-type loop, exactly like a gang roll (deletions drive the
+        # next sync). Runs BEFORE gang recovery so an eviction in flight
+        # can never be double-counted as a retryable failure.
+        if self._preemption_tick(job, pods, key):
+            if job.status != old_status:
+                job.status.last_reconcile_time = self._now()
+                self.cluster.update_job_status(job)
+            return
 
         # Pods/services of replica types REMOVED from the spec would never be
         # visited by the per-type loop: delete them, or their stale topology
@@ -347,25 +392,75 @@ class TrainJobController(ctrl.JobControllerBase):
             job.status.last_reconcile_time = self._now()
             self.cluster.update_job_status(job)
 
-    def _admit_slice(self, job: TrainJob, key: str) -> bool:
-        """Whole-slice admission; True when pods may be created."""
-        if (
-            self.slice_allocator is None
-            or job.spec.tpu is None
-            or not job.spec.tpu.topology
-        ):
-            return True
-        slice_id = self.slice_allocator.admit(key, job.spec.tpu.topology)
-        if slice_id is None:
-            self.cluster.record_event(
-                TrainJob.KIND, job.namespace, job.name, "Warning",
-                "SliceUnavailable",
-                f"no free {job.spec.tpu.topology} slice; gang-waiting",
+    def _admit_slice(self, job: TrainJob, key: str) -> float | None:
+        """Whole-slice admission: None when pods may be created, else the
+        retry delay before this job should re-check.
+
+        With a fleet scheduler the decision adds priority/fair-share
+        ordering, namespace quota, and preemption on the job's behalf; a
+        deferred job gets a Queued condition and its position is served
+        live by the API. Releases wake the exact jobs the freed capacity
+        serves (kick_targets), so the timer is only a safety net — and it
+        scales with queue position: a job 500-deep re-checking every 15 s
+        is pure apiserver load, it cannot possibly admit before hundreds
+        of releases each of which would have kicked it. Without a
+        scheduler, this is the original first-come allocator gate."""
+        if job.spec.tpu is None or not job.spec.tpu.topology:
+            return None
+        if self.scheduler is None:
+            if self.slice_allocator is None:
+                return None
+            slice_id = self.slice_allocator.admit(key, job.spec.tpu.topology)
+            if slice_id is None:
+                self.cluster.record_event(
+                    TrainJob.KIND, job.namespace, job.name, "Warning",
+                    "SliceUnavailable",
+                    f"no free {job.spec.tpu.topology} slice; gang-waiting",
+                )
+                return SLICE_RETRY_DELAY_S
+            if job.metadata.annotations.get(ANNOTATION_SLICE) != slice_id:
+                job.metadata.annotations[ANNOTATION_SLICE] = slice_id
+            return None
+
+        decision = self.scheduler.decide(job)
+        if decision.admit:
+            if (decision.slice_id and job.metadata.annotations.get(
+                    ANNOTATION_SLICE) != decision.slice_id):
+                job.metadata.annotations[ANNOTATION_SLICE] = decision.slice_id
+            return None
+        sched = job.spec.run_policy.scheduling
+        if decision.reason == "quota":
+            reason, msg = status_engine.REASON_QUOTA, (
+                f"namespace {job.namespace} ResourceQuota exhausted; "
+                f"queued in {sched.queue or 'default'}"
             )
-            return False
-        if job.metadata.annotations.get(ANNOTATION_SLICE) != slice_id:
-            job.metadata.annotations[ANNOTATION_SLICE] = slice_id
-        return True
+        else:
+            reason, msg = status_engine.REASON_QUEUED, (
+                f"no free {job.spec.tpu.topology} slice; queued in "
+                f"{sched.queue or 'default'}"
+                + (" (preempting a lower-priority job)"
+                   if decision.preempting else "")
+            )
+        # A freshly-preempted victim keeps its Preempted condition as the
+        # activity state while it waits — Queued would overwrite the one
+        # visible record that the disruption was planned, not a failure.
+        # The event fires only on a condition CHANGE: waiters re-decide on
+        # every kick/retry, and one event per re-check would flood the
+        # event log at fleet scale.
+        if not has_condition(job.status, JobConditionType.PREEMPTED):
+            if status_engine.set_condition(
+                job.status, JobConditionType.QUEUED, reason, msg, self._now(),
+            ):
+                self.cluster.record_event(
+                    TrainJob.KIND, job.namespace, job.name, "Normal",
+                    "Queued", f"{msg} (position {decision.position})",
+                )
+        if decision.preempting:
+            # Run the victim's eviction promptly (its own sync executes it
+            # through the graceful SIGTERM -> emergency-checkpoint path).
+            self.enqueue(decision.preempting)
+        return SLICE_RETRY_DELAY_S + min(
+            120.0, 0.25 * (decision.position or 0))
 
     # ------------------------------------------------- gang-coherent recovery
 
@@ -445,6 +540,135 @@ class TrainJobController(ctrl.JobControllerBase):
             if e.startswith(f"{key}:")
             and e.split(":", 1)[1] not in pending_uids
         }
+
+    # ------------------------------------------------------ graceful preemption
+
+    def _chaos_preempt_due(self, job: TrainJob):
+        """The unfired `preempt:step=N,job=NAME` chaos directive targeting
+        this job, or None. Returns (directive, ready): ready=False means
+        the heartbeat has not crossed the step yet (poll again soon)."""
+        for d in self._chaos_preempts:
+            if d.params.get("job") != job.name:
+                continue
+            if d.params.get("namespace", "default") != job.namespace:
+                continue
+            if self._chaos_state.fired(d):
+                continue
+            hb = self._job_heartbeat(job)
+            step = hb.get("step") if hb else None
+            if step is not None and int(step) >= int(d.params["step"]):
+                return d, True
+            return d, False
+        return None, False
+
+    def _preemption_tick(self, job: TrainJob, pods: list[Pod], key: str) -> bool:
+        """Graceful eviction: triggered by the fleet scheduler (a pending
+        higher-priority job claimed this gang's slice) or by a chaos
+        `preempt:` directive (deterministic e2es). Deletes every
+        non-succeeded pod — the runtime SIGTERMs them, trainers finish the
+        in-flight step and emergency-checkpoint (PR 4), the drain
+        discipline SIGKILLs stragglers (PR 5) — then requeues the job with
+        a Preempted condition. The restart tally is NEVER touched: a
+        planned eviction is not a failure, and counting it against
+        backoffLimit would fail exactly the long-running low-priority jobs
+        preemption targets. Returns True when this sync acted (the caller
+        skips the per-type loop; deletions drive the next sync)."""
+        # Drain phase first: a counted preemption re-issues its deletes
+        # across syncs (and operator failovers — the latch is in status)
+        # without ever re-counting the incident.
+        if job.status.pending_preemption_uids:
+            pending = set(job.status.pending_preemption_uids)
+            left = [p for p in pods if p.metadata.uid in pending]
+            if left:
+                self._delete_gang_pods(job, key, left)
+                return True
+            job.status.pending_preemption_uids = []
+            self._finish_preemption_drain(job, key)
+            return True
+
+        detail = None
+        if self.scheduler is not None:
+            preemptor = self.scheduler.eviction_requested(key)
+            if preemptor is not None:
+                detail = f"preempted by higher-priority TrainJob {preemptor}"
+        if detail is None and self._chaos_preempts:
+            d, ready = self._chaos_preempt_due(job)
+            if d is not None and not ready:
+                if self.heartbeat_source is None:
+                    # No heartbeat source (operator without --log-dir):
+                    # the directive can NEVER fire — warn once instead of
+                    # fast-polling this job's sync forever.
+                    if key not in self._chaos_preempt_warned:
+                        self._chaos_preempt_warned.add(key)
+                        self.cluster.record_event(
+                            TrainJob.KIND, job.namespace, job.name,
+                            "Warning", "ChaosPreemptUnarmed",
+                            "preempt: directive targets this job but the "
+                            "operator has no heartbeat source (--log-dir); "
+                            "the step boundary can never be observed",
+                        )
+                else:
+                    # Armed but the trainer has not reached the step yet:
+                    # poll the heartbeat soon (chaos determinism beats
+                    # efficiency).
+                    self.queue.add_after(key, 0.3)
+            elif d is not None:
+                self._chaos_state.mark(d)
+                detail = (f"chaos preempt directive fired at step >= "
+                          f"{d.params['step']}")
+        if detail is None:
+            return False
+        if is_terminal(job.status):
+            # Raced completion: nothing to evict; drop the request.
+            if self.scheduler is not None:
+                self.scheduler.clear_eviction(key)
+            return False
+
+        now = self._now()
+        # The eviction marker is deliberately NOT cleared here: it stands
+        # ("eviction in progress") until requeue_preempted/release pops it,
+        # so the preemptor's retry syncs can neither re-mark this victim
+        # nor pick a second one while the drain is still in flight.
+        job.status.preemptions += 1
+        job.status.last_preemption_time = now
+        metrics.sched_preemptions_total.labels(namespace=job.namespace).inc()
+        doomed = [p for p in pods if p.status.phase != PodPhase.SUCCEEDED]
+        self.cluster.record_event(
+            TrainJob.KIND, job.namespace, job.name, "Normal",
+            status_engine.REASON_PREEMPTED,
+            f"Preempting TrainJob {key} ({detail}): gracefully evicting "
+            f"{len(doomed)} pod(s) (SIGTERM -> emergency checkpoint); the "
+            f"job will requeue and resume",
+        )
+        status_engine.set_condition(
+            job.status, JobConditionType.PREEMPTED,
+            status_engine.REASON_PREEMPTED,
+            f"TrainJob {key} was preempted ({detail}); waiting to be "
+            f"rescheduled.", now,
+        )
+        if doomed:
+            job.status.pending_preemption_uids = sorted(
+                p.metadata.uid for p in doomed
+            )
+            self._delete_gang_pods(job, key, doomed)
+        else:
+            self._finish_preemption_drain(job, key)
+        return True
+
+    def _finish_preemption_drain(self, job: TrainJob, key: str) -> None:
+        """Every evicted pod is gone: hand the slice back (the preemptor
+        is among the kick targets) and requeue this job — it resumes from
+        its emergency checkpoint when capacity frees again."""
+        if self.scheduler is not None:
+            self.scheduler.requeue_preempted(job)
+            self._kick_slice_waiters()
+        elif self.slice_allocator is not None:
+            if self.slice_allocator.release(key):
+                self._kick_slice_waiters()
+        # Our own readmission attempt (chaos preemptions with idle
+        # capacity readmit on this wake-up; scheduler-queued jobs get
+        # their Queued position refreshed).
+        self.queue.add_after(key, 0.2)
 
     def _gang_recovery_tick(self, job: TrainJob, pods: list[Pod], key: str) -> bool:
         """One gang-recovery pass: consecutive-tally reset on heartbeat
@@ -684,10 +908,28 @@ class TrainJobController(ctrl.JobControllerBase):
 
     # ---------------------------------------------------------- limit checks
 
+    def _release_capacity(self, key: str) -> None:
+        """Free the job's slice claim (terminal/suspend/delete) and wake
+        whoever can use it."""
+        freed = False
+        if self.scheduler is not None:
+            freed = self.scheduler.release(key)
+        elif self.slice_allocator is not None:
+            freed = self.slice_allocator.release(key)
+        if freed:
+            self._kick_slice_waiters()
+
     def _kick_slice_waiters(self) -> None:
-        """A slice was just freed (job finished/suspended/deleted): enqueue
-        every non-terminal slice-requesting job immediately instead of
-        leaving it to the SLICE_RETRY_DELAY_S backoff."""
+        """A slice was just freed (job finished/suspended/deleted): wake
+        the waiters immediately instead of leaving them to the
+        SLICE_RETRY_DELAY_S backoff. With a fleet scheduler, wake exactly
+        the jobs the freed capacity can serve (in admission order) — the
+        old shotgun re-listed and re-enqueued EVERY job per release, which
+        is O(n²) sync work at 10k concurrent jobs."""
+        if self.scheduler is not None:
+            for key in self.scheduler.kick_targets():
+                self.enqueue(key)
+            return
         try:
             jobs = self.cluster.list_jobs()
         except Exception:

@@ -100,10 +100,16 @@ def default_rate_limiter() -> MaxOfRateLimiter:
     )
 
 
-def make_queue(rate_limiter: Any | None = None) -> "RateLimitingQueue":
+def make_queue(rate_limiter: Any | None = None, shards: int = 1):
     """Preferred queue for string-keyed controllers: the native (C++)
     implementation when the library is available, else this module's
-    pure-Python one. A custom rate_limiter forces the Python path."""
+    pure-Python one. A custom rate_limiter forces the Python path.
+    `shards` > 1 returns a ShardedRateLimitingQueue (always pure Python:
+    sharding exists to spread the queue's one lock across worker threads,
+    which the single native queue cannot do)."""
+    if shards > 1:
+        return ShardedRateLimitingQueue(shards, rate_limiter_factory=(
+            (lambda: rate_limiter) if rate_limiter is not None else None))
     if rate_limiter is None:
         try:
             from tf_operator_tpu.native import NativeRateLimitingQueue
@@ -203,3 +209,100 @@ class RateLimitingQueue:
     def __len__(self) -> int:
         with self._cond:
             return len(self._queue)
+
+
+class ShardedRateLimitingQueue:
+    """N independent RateLimitingQueues behind one interface.
+
+    Scale-out refactor for fleet-sized control planes (ISSUE 7): with
+    thousands of jobs, every reconcile worker contends on the single
+    queue's one Condition — adds from informer handlers, gets from
+    workers, delayed drains all serialize. Sharding routes each key to a
+    stable shard (crc32 — NOT the process-seeded hash(), so routing is
+    identical across operator restarts and test runs), and each worker
+    thread services its own shard (`get(shard=i)`), so the hot path takes
+    one uncontended lock.
+
+    Correctness properties carry over because all of client-go's queue
+    semantics are PER-KEY: a key always lands on the same shard, so
+    dedup, in-flight exclusivity, and per-item backoff behave exactly as
+    the single queue — two keys on different shards were always allowed
+    to proceed concurrently.
+
+    `get()` without a shard scans all shards (tests / run_until_idle);
+    workers pass their index for affinity. A worker whose own shard is
+    empty steals one scan of the others before blocking, so a lone busy
+    shard cannot idle the rest of the pool.
+    """
+
+    sharded = True
+
+    def __init__(self, shards: int = 2, rate_limiter_factory=None):
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        factory = rate_limiter_factory or (lambda: None)
+        self.shards = [RateLimitingQueue(factory()) for _ in range(shards)]
+        self._n = shards
+        self._shutdown = False
+
+    def shard_of(self, item: Hashable) -> int:
+        import zlib
+
+        return zlib.crc32(str(item).encode()) % self._n
+
+    def _q(self, item: Hashable) -> RateLimitingQueue:
+        return self.shards[self.shard_of(item)]
+
+    def add(self, item: Hashable) -> None:
+        self._q(item).add(item)
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        self._q(item).add_after(item, delay)
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        self._q(item).add_rate_limited(item)
+
+    def forget(self, item: Hashable) -> None:
+        self._q(item).forget(item)
+
+    def num_requeues(self, item: Hashable) -> int:
+        return self._q(item).num_requeues(item)
+
+    def done(self, item: Hashable) -> None:
+        self._q(item).done(item)
+
+    def get(self, timeout: float | None = None,
+            shard: int | None = None) -> Hashable | None:
+        """With `shard`, block on that shard alone after one non-blocking
+        steal-scan of the others; without, poll every shard fairly until
+        an item is ready or the timeout lapses."""
+        if shard is not None:
+            own = self.shards[shard % self._n]
+            item = own.get(timeout=0)
+            if item is not None:
+                return item
+            for i in range(self._n):
+                if i != shard % self._n:
+                    item = self.shards[i].get(timeout=0)
+                    if item is not None:
+                        return item
+            return own.get(timeout=timeout)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            for q in self.shards:
+                item = q.get(timeout=0)
+                if item is not None:
+                    return item
+            if self._shutdown:
+                return None
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+            time.sleep(0.002)
+
+    def shut_down(self) -> None:
+        self._shutdown = True
+        for q in self.shards:
+            q.shut_down()
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self.shards)

@@ -124,3 +124,21 @@ class SliceAllocator:
     def free_slices(self) -> int:
         with self._lock:
             return sum(1 for s in self.slices if s.held_by is None)
+
+    def free_by_class(self) -> dict[tuple[str, int], int]:
+        """Free slice count per capacity class (accelerator, num_chips) —
+        the granularity `admit` matches on. The fleet scheduler simulates
+        reservations for higher-ranked waiters against this view."""
+        out: dict[tuple[str, int], int] = {}
+        with self._lock:
+            for s in self.slices:
+                if s.held_by is None:
+                    k = (s.topology.accelerator, s.topology.num_chips)
+                    out[k] = out.get(k, 0) + 1
+        return out
+
+def slice_class(topology: str) -> tuple[str, int]:
+    """Capacity class of a topology request: (accelerator, chip count) —
+    exactly the fields SliceAllocator.admit matches a free slice on."""
+    t = parse_topology(topology)
+    return (t.accelerator, t.num_chips)

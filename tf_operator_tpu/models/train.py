@@ -1070,6 +1070,11 @@ def _run_trainer(args, guard) -> int:
     from tf_operator_tpu.parallel.distributed import initialize_from_env
 
     initialize_from_env()
+    # jax.distributed.initialize installs XLA's TSL PreemptionNotifier
+    # SIGTERM handler over the guard's — without re-asserting, a
+    # multi-process gang steps straight through a graceful eviction and
+    # gets SIGKILLed checkpointless by the drain discipline.
+    guard.reassert()
 
     import jax
 

@@ -38,6 +38,7 @@ class LocalSession:
         workers: int = 2,
         env_overrides: dict[str, str] | None = None,
         log_dir: str | None = None,
+        scheduler=None,
     ):
         self.cluster = InMemoryCluster()
         # With a log_dir the runtime injects per-pod heartbeat/metrics
@@ -48,10 +49,14 @@ class LocalSession:
             from tf_operator_tpu.telemetry.collector import TelemetryCollector
 
             self.telemetry = TelemetryCollector(log_dir)
+        # scheduler (sched.FleetScheduler): priority/quota/fair-share
+        # admission + graceful preemption over the slice fleet.
+        self.scheduler = scheduler
         self.controller = TrainJobController(
             self.cluster, enable_gang=enable_gang,
             slice_allocator=slice_allocator,
             heartbeat_source=self.telemetry,
+            scheduler=scheduler,
         )
         self.runtime = LocalProcessRuntime(
             self.cluster, env_overrides=env_overrides, log_dir=log_dir

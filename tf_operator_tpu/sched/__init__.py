@@ -1,0 +1,48 @@
+"""Fleet scheduler: priority, quota, fair-share queueing, and graceful
+preemption over slice capacity.
+
+The reference's lineage is kube-batch gang scheduling — PodGroups carry a
+`queue` and a `priorityClass` (jobcontroller.go:226-258, the fork's
+explicitly upgraded dependency) — and our PodGroups have carried both
+fields since the gang layer landed, but nothing read them: SliceAllocator
+admitted whichever job's sync ran first, so under capacity pressure the
+fleet was first-come-first-served with no quota and no way to bump a
+low-priority job. This package is the scheduler above the gang layer:
+
+  * `policy`     PriorityClass objects (value + preemptionPolicy),
+                 per-namespace ResourceQuota (max concurrent slices/jobs),
+                 weighted queues — one FleetPolicy config, validated at
+                 load and enforced at admission.
+  * `queue`      the fair-share wait queue: jobs that fit nowhere wait in
+                 per-queue heaps, globally ranked by (priority,
+                 share-deficit, submit time).
+  * `scheduler`  FleetScheduler — the decision engine the controller
+                 consults before `_admit_slice`: admit / queue (with
+                 position) / preempt, with an anti-thrash cooldown.
+
+Preemption is deliberately a PLANNED invocation of machinery that is
+already e2e-proven: the victim gang rides the SIGTERM -> finish step ->
+emergency checkpoint -> exit path (utils/preemption.py, PR 4) and the
+controller's drain discipline (PR 5); it lands a Preempted condition —
+never Failed — and its restart tally is untouched.
+"""
+
+from tf_operator_tpu.sched.policy import (
+    BUILTIN_PRIORITY_CLASSES,
+    DEFAULT_QUEUE,
+    PREEMPT_LOWER,
+    PREEMPT_NEVER,
+    FleetPolicy,
+    PriorityClass,
+    QueueSpec,
+    ResourceQuota,
+)
+from tf_operator_tpu.sched.queue import FairShareQueue, QueueEntry
+from tf_operator_tpu.sched.scheduler import Decision, FleetScheduler
+
+__all__ = [
+    "BUILTIN_PRIORITY_CLASSES", "DEFAULT_QUEUE", "PREEMPT_LOWER",
+    "PREEMPT_NEVER", "FleetPolicy", "PriorityClass", "QueueSpec",
+    "ResourceQuota", "FairShareQueue", "QueueEntry", "Decision",
+    "FleetScheduler",
+]

@@ -1,0 +1,233 @@
+"""Fleet scheduling policy objects: PriorityClass, ResourceQuota, queues.
+
+Modeled on scheduling.k8s.io/v1 PriorityClass (value + preemptionPolicy)
+and core/v1 ResourceQuota, scoped to what the fleet scheduler arbitrates:
+whole TPU slices. One `FleetPolicy` document (YAML/dict, `tpujob operator
+--fleet-config`) declares everything; it is validated at load — a typo'd
+priority class in a job spec is then an ADMISSION error (webhook /
+REST-submit / fake-apiserver 400), not a silent fall-through to default
+priority.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from tf_operator_tpu.utils.naming import is_valid_dns_name
+
+# scheduling.k8s.io/v1 preemptionPolicy vocabulary.
+PREEMPT_LOWER = "PreemptLowerPriority"
+PREEMPT_NEVER = "Never"
+
+DEFAULT_QUEUE = "default"
+
+
+@dataclass(frozen=True)
+class PriorityClass:
+    """A named priority level (scheduling.k8s.io/v1 shape).
+
+    value: higher runs first. preemption_policy is the PREEMPTOR's right:
+    PreemptLowerPriority lets a pending job of this class evict a running
+    lower-priority gang; Never means it waits its turn however urgent.
+    """
+
+    name: str
+    value: int
+    preemption_policy: str = PREEMPT_LOWER
+    description: str = ""
+
+
+@dataclass(frozen=True)
+class ResourceQuota:
+    """Per-namespace concurrency caps, enforced at slice admission.
+
+    max_slices: whole TPU slices the namespace may hold at once.
+    max_jobs:   slice-requesting jobs the namespace may have admitted at
+                once (distinct knobs so multi-slice jobs — roadmap — can
+                be capped either way). None = unlimited; 0 = the
+                namespace can never run a slice job (rejected at
+                admission, not queued forever).
+    """
+
+    namespace: str
+    max_slices: int | None = None
+    max_jobs: int | None = None
+
+
+@dataclass(frozen=True)
+class QueueSpec:
+    """A fair-share queue: weight is the queue's target share of held
+    capacity. Jobs name their queue in runPolicy.schedulingPolicy.queue;
+    unnamed jobs ride DEFAULT_QUEUE."""
+
+    name: str
+    weight: float = 1.0
+
+
+# Zero-config defaults (overridable by --fleet-config): three classes so
+# priority works out of the box, mirroring common cluster setups. "high"
+# preempts; "low"/"normal" wait their turn.
+BUILTIN_PRIORITY_CLASSES = (
+    PriorityClass("low", 100, PREEMPT_NEVER, "best-effort / batch"),
+    PriorityClass("normal", 500, PREEMPT_NEVER, "standard training"),
+    PriorityClass("high", 1000, PREEMPT_LOWER,
+                  "urgent; may gracefully evict lower-priority gangs"),
+)
+
+
+@dataclass
+class FleetPolicy:
+    """The whole fleet's scheduling configuration."""
+
+    priority_classes: dict[str, PriorityClass] = field(default_factory=dict)
+    quotas: dict[str, ResourceQuota] = field(default_factory=dict)
+    queues: dict[str, QueueSpec] = field(default_factory=dict)
+    # Priority of jobs naming no class ("" stays valid for back-compat —
+    # every pre-scheduler manifest has it).
+    default_priority: int = 0
+    # Anti-thrash: a gang holding its slice for less than this is not a
+    # preemption candidate — a just-(re)admitted job always gets a window
+    # to make progress (and amortize one emergency-checkpoint cycle), so
+    # two high-priority arrivals cannot ping-pong one slice.
+    preemption_cooldown_seconds: float = 60.0
+
+    @classmethod
+    def default(cls) -> "FleetPolicy":
+        return cls(priority_classes={c.name: c
+                                     for c in BUILTIN_PRIORITY_CLASSES})
+
+    # ------------------------------------------------------------- lookups
+
+    def resolve(self, class_name: str) -> PriorityClass:
+        """The effective PriorityClass of a job naming `class_name`
+        (\"\" -> a synthetic default-priority, never-preempting class).
+        Unknown names raise KeyError — admission validates first, so the
+        scheduler treating this as fatal is a bug trap, not a user path."""
+        if not class_name:
+            return PriorityClass("", self.default_priority, PREEMPT_NEVER)
+        return self.priority_classes[class_name]
+
+    def knows_class(self, class_name: str) -> bool:
+        return not class_name or class_name in self.priority_classes
+
+    def queue_weight(self, queue: str) -> float:
+        spec = self.queues.get(queue or DEFAULT_QUEUE)
+        return spec.weight if spec is not None else 1.0
+
+    def quota_for(self, namespace: str) -> ResourceQuota | None:
+        return self.quotas.get(namespace)
+
+    # ---------------------------------------------------------- validation
+
+    def validate(self) -> list[str]:
+        """All problems with the policy document (empty = valid)."""
+        problems: list[str] = []
+        for name, pc in self.priority_classes.items():
+            if name != pc.name:
+                problems.append(
+                    f"priorityClass {name!r}: key does not match name "
+                    f"{pc.name!r}")
+            if not is_valid_dns_name(name):
+                problems.append(
+                    f"priorityClass {name!r}: not a valid DNS-1035 label")
+            if pc.preemption_policy not in (PREEMPT_LOWER, PREEMPT_NEVER):
+                problems.append(
+                    f"priorityClass {name!r}: preemptionPolicy must be "
+                    f"{PREEMPT_LOWER!r} or {PREEMPT_NEVER!r}, got "
+                    f"{pc.preemption_policy!r}")
+        for ns, q in self.quotas.items():
+            for label, v in (("maxSlices", q.max_slices),
+                             ("maxJobs", q.max_jobs)):
+                if v is not None and v < 0:
+                    problems.append(f"quota {ns!r}: {label} must be >= 0")
+        for name, qs in self.queues.items():
+            if not is_valid_dns_name(name):
+                problems.append(f"queue {name!r}: not a valid DNS-1035 label")
+            if qs.weight <= 0:
+                problems.append(
+                    f"queue {name!r}: weight must be > 0, got {qs.weight}")
+        if self.preemption_cooldown_seconds < 0:
+            problems.append("preemptionCooldownSeconds must be >= 0")
+        return problems
+
+
+def fleet_policy_from_dict(d: dict[str, Any]) -> FleetPolicy:
+    """Parse a fleet-config document:
+
+        priorityClasses:
+          - name: high
+            value: 1000
+            preemptionPolicy: PreemptLowerPriority   # default
+        quotas:
+          - namespace: team-a
+            maxSlices: 4
+            maxJobs: 8
+        queues:
+          - name: research
+            weight: 2.0
+        defaultPriority: 0
+        preemptionCooldownSeconds: 60
+
+    Omitted priorityClasses fall back to the built-ins (low/normal/high)
+    so `--fleet-config` with only quotas still has working priorities.
+    Raises ValueError on a structurally or semantically invalid document.
+    """
+    d = d or {}
+    classes: dict[str, PriorityClass] = {}
+    raw_classes = d.get("priorityClasses")
+    if raw_classes is None:
+        classes = {c.name: c for c in BUILTIN_PRIORITY_CLASSES}
+    else:
+        for item in raw_classes:
+            pc = PriorityClass(
+                name=str(item.get("name", "")),
+                value=int(item.get("value", 0)),
+                preemption_policy=str(
+                    item.get("preemptionPolicy", PREEMPT_LOWER)),
+                description=str(item.get("description", "")),
+            )
+            if pc.name in classes:
+                raise ValueError(
+                    f"fleet config: duplicate priorityClass {pc.name!r}")
+            classes[pc.name] = pc
+    quotas: dict[str, ResourceQuota] = {}
+    for item in d.get("quotas") or []:
+        ns = str(item.get("namespace", ""))
+        if not ns:
+            raise ValueError("fleet config: quota entry missing namespace")
+        if ns in quotas:
+            raise ValueError(f"fleet config: duplicate quota for {ns!r}")
+        ms, mj = item.get("maxSlices"), item.get("maxJobs")
+        quotas[ns] = ResourceQuota(
+            namespace=ns,
+            max_slices=None if ms is None else int(ms),
+            max_jobs=None if mj is None else int(mj),
+        )
+    queues: dict[str, QueueSpec] = {}
+    for item in d.get("queues") or []:
+        name = str(item.get("name", ""))
+        if not name:
+            raise ValueError("fleet config: queue entry missing name")
+        if name in queues:
+            raise ValueError(f"fleet config: duplicate queue {name!r}")
+        queues[name] = QueueSpec(name=name,
+                                 weight=float(item.get("weight", 1.0)))
+    policy = FleetPolicy(
+        priority_classes=classes,
+        quotas=quotas,
+        queues=queues,
+        default_priority=int(d.get("defaultPriority", 0)),
+        preemption_cooldown_seconds=float(
+            d.get("preemptionCooldownSeconds", 60.0)),
+    )
+    problems = policy.validate()
+    if problems:
+        raise ValueError("fleet config: " + "; ".join(problems))
+    return policy
+
+
+def fleet_policy_from_yaml(text: str) -> FleetPolicy:
+    import yaml  # deferred, like api/compat.py
+
+    return fleet_policy_from_dict(yaml.safe_load(text) or {})

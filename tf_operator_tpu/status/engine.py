@@ -47,6 +47,13 @@ REASON_SUSPENDED = "TrainJobSuspended"
 REASON_GANG_RESTART = "GangRestart"
 REASON_HEARTBEAT_STALE = "HeartbeatStale"
 REASON_STUCK_PENDING = "StuckPending"
+# Fleet scheduler (sched/): Queued = admitted but waiting for capacity or
+# namespace quota; Preempted = gracefully evicted for a higher-priority
+# job (a planned disruption — never Failed, never counted against
+# backoffLimit).
+REASON_QUEUED = "WaitingForCapacity"
+REASON_QUOTA = "QuotaExhausted"
+REASON_PREEMPTED = "PreemptedByHigherPriority"
 
 
 def record_gang_restart(job: TrainJob, message: str, now: float) -> bool:
@@ -87,10 +94,11 @@ def set_condition(status: JobStatus, ctype: JobConditionType, reason: str, messa
     for c in status.conditions:
         if c.type == ctype:
             continue
-        # Running, Restarting, and Suspended are mutually exclusive views of
-        # the job's activity state.
+        # Running, Restarting, Suspended, Queued, and Preempted are
+        # mutually exclusive views of the job's activity state.
         _ACTIVE = (JobConditionType.RUNNING, JobConditionType.RESTARTING,
-                   JobConditionType.SUSPENDED)
+                   JobConditionType.SUSPENDED, JobConditionType.QUEUED,
+                   JobConditionType.PREEMPTED)
         if ctype in _ACTIVE and c.type in _ACTIVE:
             continue
         # A terminal condition demotes Running to status=False.

@@ -193,6 +193,13 @@ class Histogram:
                     return
             self._counts[-1] += 1
 
+    def bucket_counts(self) -> list[int]:
+        """Per-bucket (non-cumulative) counts, last entry = +Inf — the
+        public surface percentile estimators (tools/exp_fleet.py) read,
+        so delta-percentiles don't poke at _counts."""
+        with self._lock:
+            return list(self._counts)
+
     def _sample_lines(self) -> list[str]:
         with self._lock:
             counts = list(self._counts)
@@ -251,10 +258,13 @@ class Registry:
             return m
 
     def histogram(self, name: str, help_text: str = "",
-                  labels_only: bool = False) -> Histogram:
+                  labels_only: bool = False,
+                  buckets: tuple[float, ...] = Histogram.DEFAULT_BUCKETS,
+                  ) -> Histogram:
         with self._lock:
             if name not in self._metrics:
                 self._metrics[name] = Histogram(name, help_text,
+                                                buckets=buckets,
                                                 labels_only=labels_only)
             m = self._metrics[name]
             assert isinstance(m, Histogram)
@@ -329,4 +339,33 @@ reconcile_latency = DEFAULT.histogram(
     "tpujob_operator_reconcile_duration_seconds",
     "Per-reconcile sync latency (ref controller.go:289-291 logs this; "
     "here it is a scrapeable histogram)",
+)
+
+# --- Fleet scheduler (sched/): admission, fair-share queueing, preemption.
+sched_queue_depth = DEFAULT.gauge(
+    "tpujob_sched_queue_depth",
+    "TrainJobs waiting for slice capacity or quota, by scheduler queue",
+    labels_only=True,
+)
+sched_admitted_total = DEFAULT.counter(
+    "tpujob_sched_admitted_total",
+    "Slice admissions granted by the fleet scheduler, by queue",
+    labels_only=True,
+)
+sched_preemptions_total = DEFAULT.counter(
+    "tpujob_sched_preemptions_total",
+    "Graceful preemptions executed (victim evicted via SIGTERM -> "
+    "emergency checkpoint -> requeue), by victim namespace",
+    labels_only=True,
+)
+sched_quota_blocked_total = DEFAULT.counter(
+    "tpujob_sched_quota_blocked_total",
+    "Admission decisions deferred because the namespace ResourceQuota "
+    "(maxSlices/maxJobs) was exhausted (one sample per deferred decision)",
+    labels_only=True,
+)
+sched_queue_wait_seconds = DEFAULT.histogram(
+    "tpujob_sched_queue_wait_seconds",
+    "Submit-to-admission wait of slice jobs through the fair-share queue",
+    buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0, 7200.0),
 )

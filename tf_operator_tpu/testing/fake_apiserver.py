@@ -45,6 +45,7 @@ Not modeled: auth, json-patch/strategic-merge patch types.
 
 from __future__ import annotations
 
+import bisect
 import copy
 import json
 import re
@@ -136,6 +137,16 @@ def _validate_and_prune(obj, schema: dict, path: str = "") -> list[str]:
     if t == "string":
         if not isinstance(obj, str):
             return [f"{path}: expected string, got {type(obj).__name__}"]
+        # apiextensions/v1 string facets (a real apiserver enforces both;
+        # the schema's queue/priorityClass DNS-label patterns depend on
+        # them actually 422ing here).
+        if "maxLength" in schema and len(obj) > schema["maxLength"]:
+            errs.append(
+                f"{path}: length {len(obj)} > maxLength {schema['maxLength']}"
+            )
+        pattern = schema.get("pattern")
+        if pattern is not None and re.search(pattern, obj) is None:
+            errs.append(f"{path}: {obj!r} does not match {pattern!r}")
     elif t == "integer":
         if isinstance(obj, bool) or not isinstance(obj, int):
             return [f"{path}: expected integer, got {type(obj).__name__}"]
@@ -488,10 +499,19 @@ class FakeApiServer:
                             # gone from history — that stream must get 410
                             # too, not silently skip them.
                             mid_expired = store.expired(res, sent)
+                            # The log is append-only with monotonic rv:
+                            # bisect to the resume point instead of
+                            # rescanning the whole retained history per
+                            # wakeup — a fleet-scale run grows the log to
+                            # tens of thousands of entries, and a full
+                            # scan per stream per write is where the
+                            # 2000-job bench used to melt down.
+                            start = 0 if mid_expired else bisect.bisect_right(
+                                store.log, sent, key=lambda e: e[0])
                             fresh = [] if mid_expired else [
                                 (rv, t, o, prev)
-                                for rv, t, r, o, prev in store.log
-                                if r == res and rv > sent
+                                for rv, t, r, o, prev in store.log[start:]
+                                if r == res
                                 and (ns is None or o["metadata"].get("namespace") == ns)
                             ]
                             if not selecting:
@@ -792,6 +812,12 @@ class FakeApiServer:
             # shutdown on them.
             daemon_threads = True
             block_on_close = False
+            # socketserver's default listen backlog is 5: a fleet-scale
+            # burst (2000 jobs submitting while 8 reconcile workers sync)
+            # overflows it, connections get dropped, and the client-side
+            # retry/backoff storm collapses controller throughput. A real
+            # apiserver listens with a deep backlog; so does this one.
+            request_queue_size = 512
 
         self._server = _Server(("127.0.0.1", port), Handler)
         self.port = self._server.server_port

@@ -132,6 +132,29 @@ class PreemptionGuard:
         self.installed = True
         return True
 
+    def reassert(self) -> bool:
+        """Re-take the handled signals if a library displaced our handlers
+        AFTER install(): jax.distributed.initialize constructs XLA's TSL
+        PreemptionNotifier, whose own SIGTERM handler silently replaces
+        the guard's — a multi-process trainer would then step straight
+        through a graceful eviction (the notifier logs "SIGTERM caught"
+        and nothing else happens) until the runtime's drain discipline
+        SIGKILLs it, losing the emergency checkpoint. Call after any
+        distributed init. The ORIGINALLY displaced handlers stay
+        remembered, so uninstall() still restores the pre-guard world."""
+        if (not self.installed
+                or threading.current_thread() is not threading.main_thread()):
+            return False
+        try:
+            for sig in HANDLED_SIGNALS:
+                # `==`, not `is`: self._handler is a bound method, and
+                # every attribute access builds a fresh wrapper object.
+                if signal.getsignal(sig) != self._handler:
+                    signal.signal(sig, self._handler)
+        except (ValueError, OSError):
+            return False
+        return True
+
     def uninstall(self) -> None:
         """Restore the displaced handlers. An in-process caller of the
         trainer's main() (tests, notebooks) must get its SIGINT semantics

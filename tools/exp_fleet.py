@@ -1,0 +1,423 @@
+#!/usr/bin/env python3
+"""Fleet-scale control-plane bench: thousands of synthetic TrainJobs
+through the scheduler (ISSUE 7 acceptance surface).
+
+Drives the REAL controller — fleet scheduler, sharded workqueue, gang
+admission, preemption — over one of two substrates:
+
+  * `--substrate kube` (default): a FakeApiServer speaking the K8s wire
+    protocol + K8sCluster informers, with CRD schema validation live (a
+    bad priorityClass 422s). This is the acceptance configuration.
+  * `--substrate memory`: the in-memory cluster — same controller code,
+    no HTTP. Used by the non-slow pytest smoke (seconds, not minutes).
+
+Pods never execute anything: a fake kubelet thread flips each created
+pod Running and then, after `--job-seconds`, Succeeded — so the bench
+measures the CONTROL PLANE (reconcile throughput/latency, watch fanout,
+scheduling policy), not trainer startup.
+
+Gated invariants (exit 1 on violation):
+  * zero quota violations — no namespace ever exceeds its ResourceQuota
+    (scheduler self-audit + an independent sampling monitor);
+  * zero priority inversions — a slice never goes to a job while a
+    strictly-higher-priority, quota-eligible job of the same slice class
+    waits (scheduler self-audit at every admission);
+  * zero starved jobs — every submitted job reaches Succeeded;
+  * reconcile-latency p99 under `--gate-p99` (when set) — computed as a
+    DELTA over tpujob_operator_reconcile_duration_seconds, so repeated
+    in-process runs don't contaminate each other.
+
+Also reported: watch-fanout (informer event deliveries total / per job),
+jobs/sec, preemption and queue stats.
+
+Usage:
+  python tools/exp_fleet.py                          # 2000 jobs, kube
+  python tools/exp_fleet.py --jobs 200 --gate-p99 2  # CI fleet-smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import heapq
+import json
+import random
+import sys
+import threading
+import time
+
+REPO = __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tf_operator_tpu.api import defaults as api_defaults  # noqa: E402
+from tf_operator_tpu.api.types import (  # noqa: E402
+    CleanPodPolicy,
+    ContainerSpec,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    ReplicaType,
+    TrainJob,
+    TrainJobSpec,
+    TPUSpec,
+    is_succeeded,
+    is_terminal,
+)
+from tf_operator_tpu.core.cluster import KIND_JOB, KIND_POD  # noqa: E402
+from tf_operator_tpu.core.trainjob_controller import (  # noqa: E402
+    TrainJobController,
+)
+from tf_operator_tpu.gang.podgroup import SliceAllocator  # noqa: E402
+from tf_operator_tpu.sched import (  # noqa: E402
+    FleetPolicy,
+    FleetScheduler,
+    PriorityClass,
+    QueueSpec,
+    ResourceQuota,
+)
+from tf_operator_tpu.status import metrics as status_metrics  # noqa: E402
+
+TOPOLOGY = "v5e-8"
+PRIORITY_MIX = (("low", 5), ("normal", 3), ("high", 2))  # weighted draw
+QUEUE_MIX = (("batch", 3), ("research", 2))
+
+
+def percentile_from_buckets(buckets: tuple[float, ...], delta: list[int],
+                            q: float) -> float:
+    """Nearest-rank percentile estimate from per-bucket counts: the upper
+    bound of the bucket containing rank ceil(q*n) (+Inf reports the top
+    finite bound — a conservative 'worse than' marker)."""
+    total = sum(delta)
+    if total == 0:
+        return 0.0
+    rank = max(1, int(q * total + 0.999999))
+    cum = 0
+    for i, c in enumerate(delta):
+        cum += c
+        if cum >= rank:
+            return buckets[i] if i < len(buckets) else buckets[-1]
+    return buckets[-1]
+
+
+def make_policy(namespaces: list[str], quota_slices: int,
+                cooldown: float) -> FleetPolicy:
+    policy = FleetPolicy(
+        priority_classes={
+            "low": PriorityClass("low", 100, "Never"),
+            "normal": PriorityClass("normal", 500, "Never"),
+            "high": PriorityClass("high", 1000, "PreemptLowerPriority"),
+        },
+        quotas={ns: ResourceQuota(ns, max_slices=quota_slices,
+                                  max_jobs=quota_slices)
+                for ns in namespaces},
+        queues={"batch": QueueSpec("batch", 1.0),
+                "research": QueueSpec("research", 2.0)},
+        preemption_cooldown_seconds=cooldown,
+    )
+    problems = policy.validate()
+    assert not problems, problems
+    return policy
+
+
+def make_job(name: str, namespace: str, priority_class: str,
+             queue: str) -> TrainJob:
+    job = TrainJob(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=TrainJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1,
+                    template=PodTemplateSpec(containers=[
+                        ContainerSpec(name="tensorflow", image="synthetic",
+                                      command=["true"]),
+                    ]),
+                )
+            },
+            tpu=TPUSpec(topology=TOPOLOGY),
+        ),
+    )
+    job.spec.run_policy.scheduling.priority_class = priority_class
+    job.spec.run_policy.scheduling.queue = queue
+    # All: pods are GC'd at terminal so the pod store stays O(slices)
+    # however many jobs flow through (list scans stay flat).
+    job.spec.run_policy.clean_pod_policy = CleanPodPolicy.ALL
+    api_defaults.set_defaults(job)
+    return job
+
+
+class FakeKubelet:
+    """Flips created pods Running, then Succeeded after `duration` — the
+    kubelet stand-in that makes 2000 jobs cost control-plane work only.
+    Cluster event handlers may fire under the substrate's lock, so the
+    handler just enqueues; a runner thread does the status writes."""
+
+    def __init__(self, set_phase, duration: float):
+        self._set_phase = set_phase  # (ns, name, phase, exit_code) -> None
+        self.duration = duration
+        self._heap: list[tuple[float, int, str, str, str]] = []
+        self._cond = threading.Condition()
+        self._seq = 0
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fake-kubelet")
+
+    def on_pod_add(self, pod) -> None:
+        now = time.monotonic()
+        with self._cond:
+            self._seq += 1
+            heapq.heappush(self._heap,
+                           (now, self._seq, pod.metadata.namespace,
+                            pod.name, "Running"))
+            self._seq += 1
+            heapq.heappush(self._heap,
+                           (now + self.duration, self._seq,
+                            pod.metadata.namespace, pod.name, "Succeeded"))
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stop:
+                    if self._heap:
+                        wait = self._heap[0][0] - time.monotonic()
+                        if wait <= 0:
+                            break
+                        self._cond.wait(wait)
+                    else:
+                        self._cond.wait()
+                if self._stop:
+                    return
+                _, _, ns, name, phase = heapq.heappop(self._heap)
+            try:
+                self._set_phase(ns, name, phase,
+                                0 if phase == "Succeeded" else None)
+            except Exception:
+                pass  # pod deleted (preemption/scale-down): nothing to flip
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+
+
+def run_fleet(jobs: int = 2000, slices: int = 16, substrate: str = "kube",
+              namespaces: int = 4, job_seconds: float = 0.05,
+              workers: int = 4, shards: int = 4, seed: int = 0,
+              quota_slices: int | None = None, cooldown: float = 0.5,
+              gate_p99: float | None = None, timeout: float = 600.0,
+              progress=None) -> dict:
+    """Run the bench; returns the result dict (see module docstring)."""
+    rng = random.Random(seed)
+    ns_names = [f"team-{i}" for i in range(namespaces)]
+    if quota_slices is None:
+        # Tight enough to actually bind under skew, loose enough that the
+        # fleet drains: ~60% of the slice pool per namespace.
+        quota_slices = max(1, (slices * 6) // 10)
+    policy = make_policy(ns_names, quota_slices, cooldown)
+    allocator = SliceAllocator.of(*[TOPOLOGY] * slices)
+    scheduler = FleetScheduler(allocator, policy)
+
+    hist = status_metrics.reconcile_latency
+    counts_before = hist.bucket_counts()
+    errors_before = status_metrics.reconcile_errors.value()
+
+    fake = None
+    watch_events = [0]
+    terminal: set[str] = set()
+    succeeded: set[str] = set()
+    term_lock = threading.Lock()
+
+    def job_handler(*args) -> None:
+        watch_events[0] += 1
+        new = args[-1]
+        if is_terminal(new.status):
+            with term_lock:
+                terminal.add(new.key())
+                if is_succeeded(new.status):
+                    succeeded.add(new.key())
+
+    def count_handler(*args) -> None:
+        watch_events[0] += 1
+
+    if substrate == "kube":
+        from tf_operator_tpu.core.k8s import K8sApi, K8sCluster
+        from tf_operator_tpu.testing.fake_apiserver import FakeApiServer
+
+        # A deep watch log so 2000-job churn doesn't 410 the informers
+        # into repeated full relists mid-bench.
+        fake = FakeApiServer(watch_log_retain=262144).start()
+        api = K8sApi(fake.url, qps=0.0)  # client throttle off: bench load
+        # Lister-backed reads: at fleet scale the controller must not pay
+        # two HTTP lists per sync (see K8sCluster.lists_from_cache).
+        cluster = K8sCluster(api, lists_from_cache=True)
+
+        def set_phase(ns, name, phase, exit_code):
+            fake.set_pod_status(ns, name, phase, exit_code)
+    else:
+        from tf_operator_tpu.core.cluster import InMemoryCluster, PodPhase
+
+        cluster = InMemoryCluster()
+
+        def set_phase(ns, name, phase, exit_code):
+            cluster.set_pod_phase(ns, name, PodPhase(phase),
+                                  exit_code=exit_code)
+
+    kubelet = FakeKubelet(set_phase, job_seconds).start()
+    cluster.on_add(KIND_POD, kubelet.on_pod_add)
+    cluster.on_add(KIND_JOB, job_handler)
+    cluster.on_update(KIND_JOB, job_handler)
+    cluster.on_update(KIND_POD, count_handler)
+    cluster.on_delete(KIND_POD, count_handler)
+
+    controller = TrainJobController(
+        cluster, enable_gang=True, scheduler=scheduler, queue_shards=shards,
+    )
+    quota_monitor_stop = threading.Event()
+    quota_violations = [0]
+    max_by_ns: dict[str, int] = {}
+
+    def monitor() -> None:
+        # Independent of the scheduler's self-audit: samples the actual
+        # admitted counts against the quota at 20 Hz.
+        while not quota_monitor_stop.wait(0.05):
+            for ns, n in scheduler.running_by_namespace().items():
+                max_by_ns[ns] = max(max_by_ns.get(ns, 0), n)
+                q = policy.quota_for(ns)
+                if q is not None and q.max_slices is not None \
+                        and n > q.max_slices:
+                    quota_violations[0] += 1
+
+    t0 = time.monotonic()
+    if substrate == "kube":
+        cluster.start()
+        assert cluster.wait_synced(60), "informers never synced"
+    controller.run(workers=workers)
+    threading.Thread(target=monitor, daemon=True,
+                     name="quota-monitor").start()
+
+    specs = []
+    for i in range(jobs):
+        pc = rng.choices([p for p, _ in PRIORITY_MIX],
+                         weights=[w for _, w in PRIORITY_MIX])[0]
+        qname = rng.choices([q for q, _ in QUEUE_MIX],
+                            weights=[w for _, w in QUEUE_MIX])[0]
+        specs.append(make_job(f"fleet-{i:05d}", rng.choice(ns_names),
+                              pc, qname))
+    # Paced arrival: keep at most `window` jobs in flight — real fleets
+    # arrive over time, and 2000 simultaneous waiters mostly measures the
+    # submit flood's own retry noise rather than steady-state scheduling.
+    # Every job still flows through the full wire path.
+    window = max(4 * slices, 200)
+    submit_t0 = time.monotonic()
+    deadline = time.monotonic() + timeout
+    submitted = 0
+    last_report = 0.0
+    while time.monotonic() < deadline:
+        with term_lock:
+            done = len(terminal)
+        while submitted < jobs and submitted - done < window:
+            cluster.create_job(specs[submitted])
+            submitted += 1
+        if submitted >= jobs and done >= jobs:
+            break
+        if progress and time.monotonic() - last_report > 5.0:
+            last_report = time.monotonic()
+            progress(f"{done}/{jobs} terminal ({submitted} submitted), "
+                     f"{len(scheduler.waiting_keys_ranked())} queued")
+        time.sleep(0.1)
+    submit_s = time.monotonic() - submit_t0
+    wall_s = time.monotonic() - t0
+
+    quota_monitor_stop.set()
+    kubelet.stop()
+    controller.stop()
+    if substrate == "kube":
+        cluster.stop()
+        fake.stop()
+
+    with term_lock:
+        n_terminal, n_succeeded = len(terminal), len(succeeded)
+    starved = jobs - n_succeeded
+    counts_after = hist.bucket_counts()
+    delta = [a - b for a, b in zip(counts_after, counts_before)]
+    p50 = percentile_from_buckets(hist.buckets, delta, 0.50)
+    p99 = percentile_from_buckets(hist.buckets, delta, 0.99)
+
+    stats = dict(scheduler.stats)
+    result = {
+        "jobs": jobs,
+        "slices": slices,
+        "substrate": substrate,
+        "namespaces": namespaces,
+        "quota_slices_per_ns": quota_slices,
+        "wall_s": round(wall_s, 3),
+        "submit_s": round(submit_s, 3),
+        "jobs_per_sec": round(jobs / wall_s, 2) if wall_s else None,
+        "reconcile_p50_s": p50,
+        "reconcile_p99_s": p99,
+        "reconciles": sum(delta),
+        "reconcile_errors": status_metrics.reconcile_errors.value()
+        - errors_before,
+        "watch_events": watch_events[0],
+        "watch_events_per_job": round(watch_events[0] / jobs, 2),
+        "sched": stats,
+        "max_running_by_namespace": max_by_ns,
+        "invariants": {
+            "starved": starved,
+            "terminal_not_succeeded": n_terminal - n_succeeded,
+            "quota_violations_sampled": quota_violations[0],
+            "quota_violations_audit": stats["quota_violations"],
+            "priority_inversions": stats["inversions"],
+        },
+        "gate_p99_s": gate_p99,
+    }
+    failures = []
+    if starved:
+        failures.append(f"{starved} job(s) never succeeded (starvation)")
+    if quota_violations[0] or stats["quota_violations"]:
+        failures.append("namespace quota exceeded")
+    if stats["inversions"]:
+        failures.append(f"{stats['inversions']} priority inversion(s)")
+    if gate_p99 is not None and p99 > gate_p99:
+        failures.append(f"reconcile p99 {p99}s > gate {gate_p99}s")
+    result["ok"] = not failures
+    result["failures"] = failures
+    return result
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="exp_fleet.py", description=__doc__)
+    ap.add_argument("--jobs", type=int, default=2000)
+    ap.add_argument("--slices", type=int, default=16)
+    ap.add_argument("--substrate", choices=("kube", "memory"),
+                    default="kube")
+    ap.add_argument("--namespaces", type=int, default=4)
+    ap.add_argument("--job-seconds", type=float, default=0.05)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--quota-slices", type=int, default=None)
+    ap.add_argument("--cooldown", type=float, default=0.5)
+    ap.add_argument("--gate-p99", type=float, default=None,
+                    help="fail (exit 1) when reconcile p99 exceeds this")
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args(argv)
+    result = run_fleet(
+        jobs=args.jobs, slices=args.slices, substrate=args.substrate,
+        namespaces=args.namespaces, job_seconds=args.job_seconds,
+        workers=args.workers, shards=args.shards, seed=args.seed,
+        quota_slices=args.quota_slices, cooldown=args.cooldown,
+        gate_p99=args.gate_p99, timeout=args.timeout,
+        progress=lambda msg: print(f"# {msg}", file=sys.stderr),
+    )
+    print(json.dumps(result, indent=2, sort_keys=True))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
