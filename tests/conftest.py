@@ -42,6 +42,49 @@ if not os.environ.get("TPUJOB_TEST_TPU"):
     except ImportError:
         pass
 
+# Runtime lock-graph race detector (tf_operator_tpu/testing/lockcheck.py;
+# docs/static_analysis.md): TPUJOB_LOCKCHECK=1 wraps every threading.Lock/
+# RLock/Condition allocated from tf_operator_tpu code and raises on
+# lock-order cycles — the Python analogue of the reference's `-race` CI
+# wiring. Installed at conftest import so locks created at module-import
+# time during collection are covered; the autouse fixture below fails any
+# test whose run recorded a cycle even when library code swallowed the
+# raised PotentialDeadlockError. CI enables it for the chaos-smoke and
+# fleet-smoke stages.
+try:
+    from tf_operator_tpu.testing import lockcheck as _lockcheck
+
+    if _lockcheck.enabled_by_env():
+        _lockcheck.install()
+except ImportError:
+    _lockcheck = None
+
+
+import pytest  # noqa: E402  (env setup above must run before anything heavy)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_teardown(item, nextitem):
+    # Hookwrapper: the default runner teardown — fixture finalizers
+    # included — must COMPLETE before the violation check (the yield).
+    # Raising ahead of it would leak every fixture of the failing test
+    # and error the NEXT one with "previous item was not torn down
+    # properly"; it also lets a test that deliberately seeds inversions
+    # reset the graph in its own fixture finalizer before this reads it.
+    yield
+    if _lockcheck is None or not _lockcheck.installed():
+        return
+    bad = _lockcheck.violations()
+    # Reset per test either way: edges are keyed by lock identity (id()),
+    # so a graph accumulated across tests could attach stale edges to a
+    # recycled id; per-test scoping keeps the graph meaningful and small.
+    _lockcheck.reset()
+    if bad:
+        raise AssertionError(
+            "lockcheck: lock-order violations recorded during "
+            f"{item.nodeid}:\n" + "\n".join(bad))
+
+
 # Retry-once for @pytest.mark.flaky tests (a minimal in-repo
 # pytest-rerunfailures: the image ships no plugin and tier-1 may not
 # install one). Timing-sensitive tests — wall-clock fits like the GPipe

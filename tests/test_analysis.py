@@ -1,0 +1,624 @@
+"""tpulint (tools/analysis/) — the round-13 multi-pass static analyzer.
+
+Per-pass fixture tests (a known-bad snippet must flag, the known-good
+twin must not), the allowlist contract (mandatory justification, stale
+entries fail), the schema-drift regression demo (deleting the
+priorityClass emit line from compat.py must fail the pass — the PR-7
+bug re-introduced on purpose), and the acceptance test: the full
+analyzer over the real repo is clean.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.analysis import run_analysis  # noqa: E402
+from tools.analysis.allowlist import apply_allowlist, parse_allowlist  # noqa: E402
+from tools.analysis.core import Project  # noqa: E402
+from tools.analysis.passes import donation, hygiene, locks, schema, threads  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def repo_run():
+    """ONE full-analyzer run over the real repo, shared by the acceptance
+    tests — the walk costs seconds and must not be paid per test."""
+    return run_analysis()
+
+
+@pytest.fixture(scope="session")
+def repo_project():
+    return Project()
+
+
+def make_project(tmp_path: Path, files: dict[str, str]) -> Project:
+    """A fixture tree shaped like the repo: {relpath: source}."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return Project(root=tmp_path)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# --------------------------------------------------------------------------
+class TestThreadDiscipline:
+    BAD = {
+        "tf_operator_tpu/__init__.py": "",
+        "tf_operator_tpu/data/__init__.py": "",
+        "tf_operator_tpu/data/staging.py": """
+            import threading
+            import jax.numpy as jnp
+
+            def helper(batch):
+                return jnp.concatenate(batch)
+
+            def start():
+                def worker():
+                    helper([1, 2])
+                t = threading.Thread(target=worker)
+                t.start()
+        """,
+    }
+
+    def test_bad_fixture_flags_with_chain(self, tmp_path):
+        found = threads.run(make_project(tmp_path, self.BAD))
+        assert any(f.rule == "TPT201" for f in found)
+        msg = next(f for f in found if f.rule == "TPT201")
+        # the chain names root AND offender so the report is actionable
+        assert "worker" in msg.key and "jax.numpy.concatenate" in msg.key
+
+    def test_device_put_is_allowed(self, tmp_path):
+        good = dict(self.BAD)
+        good["tf_operator_tpu/data/staging.py"] = """
+            import threading
+            import jax
+
+            def start(it, sharding):
+                def worker():
+                    batch = next(it)
+                    dev = jax.tree.map(
+                        lambda x: jax.device_put(x, sharding), batch)
+                    jax.block_until_ready(jax.tree.leaves(dev))
+                t = threading.Thread(target=worker)
+                t.start()
+        """
+        assert threads.run(make_project(tmp_path, good)) == []
+
+    def test_jitted_callable_flagged(self, tmp_path):
+        bad = dict(self.BAD)
+        bad["tf_operator_tpu/data/staging.py"] = """
+            import threading
+            import jax
+
+            step = jax.jit(lambda x: x + 1)
+
+            def start():
+                def worker():
+                    step(1)
+                threading.Thread(target=worker).start()
+        """
+        found = threads.run(make_project(tmp_path, bad))
+        assert any(f.rule == "TPT201" and "step" in f.key for f in found)
+
+    def test_callable_argument_checked(self, tmp_path):
+        # jax.tree.map(jnp.asarray, ...) dispatches per leaf on the
+        # transfer thread even though jnp.asarray is never the call's func
+        bad = dict(self.BAD)
+        bad["tf_operator_tpu/data/staging.py"] = """
+            import threading
+            import jax
+            import jax.numpy as jnp
+
+            def start(batch):
+                def worker():
+                    jax.tree.map(jnp.asarray, batch)
+                threading.Thread(target=worker).start()
+        """
+        found = threads.run(make_project(tmp_path, bad))
+        assert any("jax.numpy.asarray" in f.key for f in found)
+
+    def test_repo_thread_roots_resolve(self, repo_project):
+        # the REAL staging/prefetch modules must contribute roots — if the
+        # resolver ever loses them the pass silently proves nothing
+        roots = threads._thread_roots(repo_project)
+        names = {qual for _, qual in roots}
+        assert "stage_to_device.worker" in names
+        assert "prefetch_to_device.worker" in names
+
+
+# --------------------------------------------------------------------------
+class TestLockDiscipline:
+    def test_order_inversion_across_functions(self, tmp_path):
+        project = make_project(tmp_path, {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/mod.py": """
+                import threading
+
+                a = threading.Lock()
+                b = threading.Lock()
+
+                def one():
+                    with a:
+                        with b:
+                            pass
+
+                def two():
+                    with b:
+                        with a:
+                            pass
+            """,
+        })
+        found = locks.run(project)
+        assert any(f.rule == "TPL301" for f in found)
+        cyc = next(f for f in found if f.rule == "TPL301")
+        assert "mod.a" in cyc.key and "mod.b" in cyc.key
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        project = make_project(tmp_path, {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/mod.py": """
+                import threading
+
+                a = threading.Lock()
+                b = threading.Lock()
+
+                def one():
+                    with a:
+                        with b:
+                            pass
+
+                def two():
+                    with a:
+                        with b:
+                            pass
+            """,
+        })
+        assert [f for f in locks.run(project) if f.rule == "TPL301"] == []
+
+    def test_cross_class_edge_through_init_annotation(self, tmp_path):
+        # FleetScheduler._lock -> SliceAllocator._lock pattern: the callee
+        # class is known only through the __init__ parameter annotation.
+        # Sched.decide holds Sched._lock entering Alloc._lock; a callback
+        # (Alloc.release -> Sched.kick) takes the reverse order.
+        project = make_project(tmp_path, {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/mod.py": """
+                import threading
+
+                class Alloc:
+                    def __init__(self, sched: Sched):
+                        self._lock = threading.Lock()
+                        self.sched = sched
+
+                    def admit(self):
+                        with self._lock:
+                            return 1
+
+                    def release(self):
+                        with self._lock:
+                            return self.sched.kick()
+
+                class Sched:
+                    def __init__(self, allocator: Alloc):
+                        self._lock = threading.Lock()
+                        self.allocator = allocator
+
+                    def decide(self):
+                        with self._lock:
+                            return self.allocator.admit()
+
+                    def kick(self):
+                        with self._lock:
+                            return 2
+            """,
+        })
+        found = [f for f in locks.run(project) if f.rule == "TPL301"]
+        assert found, "cross-class inversion must be found"
+        assert any("Sched._lock" in f.key and "Alloc._lock" in f.key
+                   for f in found)
+
+    def test_wait_outside_loop_flagged(self, tmp_path):
+        project = make_project(tmp_path, {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/mod.py": """
+                import threading
+
+                lock = threading.Lock()
+                cond = threading.Condition(lock)
+
+                def bad():
+                    with cond:
+                        cond.wait()
+
+                def good(ready):
+                    with cond:
+                        while not ready():
+                            cond.wait()
+            """,
+        })
+        found = [f for f in locks.run(project) if f.rule == "TPL302"]
+        assert len(found) == 1
+        assert "::bad" in found[0].key
+
+    def test_condition_aliases_to_wrapped_lock(self, tmp_path):
+        # `with lock:` then nested `with cond:` (same lock) must NOT be an
+        # edge or a self-cycle: Condition(lock) IS that lock
+        project = make_project(tmp_path, {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/mod.py": """
+                import threading
+
+                lock = threading.Lock()
+                cond = threading.Condition(lock)
+                other = threading.Lock()
+
+                def one():
+                    with other:
+                        with cond:
+                            pass
+
+                def two(x):
+                    with other:
+                        with lock:
+                            pass
+            """,
+        })
+        assert [f for f in locks.run(project) if f.rule == "TPL301"] == []
+
+    def test_repo_is_clean(self, repo_project):
+        found = locks.run(repo_project)
+        assert found == [], [f.render() for f in found]
+
+
+# --------------------------------------------------------------------------
+class TestSchemaDrift:
+    def _real(self):
+        return (
+            (REPO / "tf_operator_tpu/api/types.py").read_text(),
+            (REPO / "tf_operator_tpu/api/compat.py").read_text(),
+            (REPO / "tf_operator_tpu/api/validation.py").read_text(),
+            (REPO / "manifests/trainjob-crd.yaml").read_text(),
+        )
+
+    def test_repo_contract_is_aligned(self):
+        types, compat, validation, crd = self._real()
+        found = schema.analyze_schema(types, compat, validation, crd)
+        assert found == [], [f.render() for f in found]
+
+    def test_removing_emit_line_fails(self):
+        # THE regression demo: re-introduce the PR-7 bug (job_to_dict
+        # dropping schedulingPolicy.priorityClass) and the pass must fail.
+        types, compat, validation, crd = self._real()
+        lines = [ln for ln in compat.splitlines()
+                 if '"priorityClass"' not in ln]
+        assert len(lines) < len(compat.splitlines()), "fixture went stale"
+        found = schema.analyze_schema(
+            types, "\n".join(lines), validation, crd)
+        assert any(f.rule == "TPS402"
+                   and f.key == "schema-emit::SchedulingPolicy.priority_class"
+                   for f in found), [f.render() for f in found]
+
+    def test_removing_parse_fails(self):
+        types, compat, validation, crd = self._real()
+        mutated = compat.replace('rec_d.get("heartbeatTimeoutSeconds")',
+                                 "None")
+        found = schema.analyze_schema(types, mutated, validation, crd)
+        assert any(f.rule == "TPS401" and "heartbeat_timeout_seconds" in f.key
+                   for f in found)
+
+    def test_removing_crd_property_fails(self):
+        types, compat, validation, crd = self._real()
+        mutated = crd.replace("priorityClass:", "somethingElse:")
+        found = schema.analyze_schema(types, compat, validation, mutated)
+        assert any(f.rule == "TPS403" and "priority_class" in f.key
+                   for f in found)
+
+    def test_enum_drift_fails(self):
+        types, compat, validation, crd = self._real()
+        mutated = crd.replace("enum: [Always, OnFailure, Never, ExitCode]",
+                              "enum: [Always, OnFailure, Never]")
+        found = schema.analyze_schema(types, compat, validation, mutated)
+        assert any(f.rule == "TPS404" and "restart_policy" in f.key
+                   for f in found)
+
+    def test_new_types_field_without_wire_fails(self):
+        # the forward direction: grow types.py, forget compat -> fail
+        types, compat, validation, crd = self._real()
+        mutated = types.replace(
+            "    topology: str = \"\"",
+            "    topology: str = \"\"\n    brand_new_knob: int = 0")
+        found = schema.analyze_schema(mutated, compat, validation, crd)
+        keys = {f.key for f in found}
+        assert "schema-emit::TPUSpec.brand_new_knob" in keys
+        assert "schema-parse::TPUSpec.brand_new_knob" in keys
+
+
+# --------------------------------------------------------------------------
+class TestDonationSafety:
+    def test_donated_use_after_call(self, tmp_path):
+        project = make_project(tmp_path, {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/mod.py": """
+                import jax
+
+                step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+                def bad(state, batch):
+                    new_state = step(state, batch)
+                    return state.params  # donated buffer, now XLA's
+
+                def good(state, batch):
+                    state = step(state, batch)
+                    return state
+            """,
+        })
+        found = donation.run(project)
+        assert len([f for f in found if f.rule == "TPD501"]) == 1
+        assert "::bad::state" in found[0].key
+
+    def test_multiline_call_not_flagged(self, tmp_path):
+        # the donated arg's own load on a continuation line is part of
+        # the call, not a read-after-donation (review finding, round 13)
+        project = make_project(tmp_path, {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/mod.py": """
+                import jax
+
+                step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+                def fine(state, batch):
+                    out = step(
+                        state, batch)
+                    return out
+            """,
+        })
+        assert donation.run(project) == []
+
+    def test_loop_rebind_not_flagged(self, tmp_path):
+        project = make_project(tmp_path, {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/mod.py": """
+                import jax
+
+                step = jax.jit(lambda s: s, donate_argnums=(0,))
+
+                def train(state, n):
+                    for _ in range(n):
+                        state = step(state)
+                    return state
+            """,
+        })
+        assert donation.run(project) == []
+
+    def test_host_buffer_mutated_after_put(self, tmp_path):
+        project = make_project(tmp_path, {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/mod.py": """
+                import jax
+                import numpy as np
+
+                def bad():
+                    x = np.zeros(4)
+                    dev = jax.device_put(x)
+                    x[0] = 1.0  # may alias dev on CPU
+                    return dev
+
+                def good():
+                    x = np.zeros(4)
+                    dev = jax.device_put(x)
+                    x = np.ones(4)  # rebind, not mutation
+                    return dev, x
+            """,
+        })
+        found = donation.run(project)
+        assert len(found) == 1 and found[0].rule == "TPD502"
+        assert "::bad::x" in found[0].key
+
+
+# --------------------------------------------------------------------------
+class TestHygieneUpgrades:
+    def test_swallowed_broad_exception(self, tmp_path):
+        project = make_project(tmp_path, {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/mod.py": """
+                def sync_job(cluster, key):
+                    try:
+                        cluster.delete(key)
+                    except Exception:
+                        pass
+
+                def narrow_is_fine(path):
+                    try:
+                        return open(path).read()
+                    except OSError:
+                        pass
+
+                def handled_is_fine(log):
+                    try:
+                        log.flush()
+                    except Exception as e:
+                        log.error("flush: %s", e)
+            """,
+        })
+        found = [f for f in hygiene.run(project) if f.rule == "TPH101"]
+        assert len(found) == 1 and "sync_job" in found[0].key
+
+    def test_bound_method_is_comparison(self, tmp_path):
+        project = make_project(tmp_path, {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/mod.py": """
+                import signal
+
+                class Guard:
+                    def _handler(self, signum, frame):
+                        pass
+
+                    def broken(self, sig):
+                        # always False: fresh wrapper per attribute read
+                        return signal.getsignal(sig) is self._handler
+
+                    def plain_attr_is_fine(self, other):
+                        return self.value is other
+            """,
+        })
+        found = [f for f in hygiene.run(project) if f.rule == "TPH102"]
+        assert len(found) == 1
+        assert "self._handler" in found[0].key
+        assert "ALWAYS false" in found[0].message
+
+    def test_unlocked_module_state(self, tmp_path):
+        project = make_project(tmp_path, {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/mod.py": """
+                import threading
+
+                _cache = {}
+                _lock = threading.Lock()
+
+                def bad(k, v):
+                    _cache[k] = v
+
+                def good(k, v):
+                    with _lock:
+                        _cache[k] = v
+
+                def local_shadow_is_fine(k):
+                    _cache = {}
+                    _cache[k] = 1
+                    return _cache
+            """,
+        })
+        found = [f for f in hygiene.run(project) if f.rule == "TPH103"]
+        assert len(found) == 1 and "::bad::_cache" in found[0].key
+
+    def test_unlocked_state_seen_through_from_import(self, tmp_path):
+        # `from threading import Thread` must mark the module threaded too
+        # (review finding, round 13: the gate only matched bare `import
+        # threading`)
+        project = make_project(tmp_path, {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/mod.py": """
+                from threading import Thread
+
+                _registry = {}
+
+                def bad(k, v):
+                    _registry[k] = v
+            """,
+        })
+        found = [f for f in hygiene.run(project) if f.rule == "TPH103"]
+        assert len(found) == 1
+
+    def test_lint_codes_still_flow_through(self, tmp_path):
+        project = make_project(tmp_path, {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/mod.py": """
+                import os
+
+                def f():
+                    return missing_name
+            """,
+        })
+        rules = rules_of(hygiene.run(project))
+        assert "F821" in rules and "F401" in rules
+
+
+# --------------------------------------------------------------------------
+class TestAllowlist:
+    def test_suppression_and_staleness(self):
+        from tools.analysis.core import Finding
+
+        findings = [Finding("TPH101", "x.py", 3, "swallowed::x::f", "m")]
+        entries, meta = parse_allowlist(
+            "TPH101 swallowed::x::f -- deliberate best-effort\n"
+            "TPH101 swallowed::gone::g -- excused code deleted\n",
+            "allow.txt")
+        assert meta == []
+        out, suppressed = apply_allowlist(findings, entries, "allow.txt")
+        assert suppressed == 1
+        assert [f.rule for f in out] == ["TPA002"]  # the stale entry
+
+    def test_missing_justification_is_a_finding(self):
+        entries, meta = parse_allowlist("TPH101 some::key\n", "allow.txt")
+        assert entries == []
+        assert [f.rule for f in meta] == ["TPA001"]
+
+    def test_malformed_line_is_a_finding(self):
+        entries, meta = parse_allowlist("justsomething\n", "allow.txt")
+        assert [f.rule for f in meta] == ["TPA003"]
+
+    def test_stale_check_scoped_to_active_rules(self):
+        from tools.analysis.core import Finding
+
+        entries, _ = parse_allowlist(
+            "TPH101 swallowed::x::f -- why\n", "allow.txt")
+        # a run whose selected passes can never emit TPH101 must not call
+        # the entry stale
+        out, _ = apply_allowlist([], entries, "allow.txt",
+                                 active_rules={"TPM601"})
+        assert out == []
+        # ...but the full run (active_rules=None) must
+        out, _ = apply_allowlist([], entries, "allow.txt",
+                                 active_rules=None)
+        assert [f.rule for f in out] == ["TPA002"]
+
+    def test_single_pass_run_respects_allowlist_scope(self):
+        # the documented `--pass metrics-doc` invocation: the repo
+        # allowlist holds thread/hygiene entries those passes never emit —
+        # they must not surface as stale (review finding, round 13)
+        findings, stats = run_analysis(passes=["metrics-doc"])
+        assert findings == [], [f.render() for f in findings]
+
+    def test_repo_allowlist_entries_all_match(self, repo_run):
+        # the acceptance run would also catch this (stale entries surface
+        # as TPA002), but pin it explicitly: every shipped entry
+        # suppresses a live finding
+        findings, stats = repo_run
+        assert not [f for f in findings if f.rule == "TPA002"], \
+            [f.render() for f in findings]
+        assert stats["allowlist_entries"] > 0
+        assert stats["suppressed"] == stats["allowlist_entries"]
+
+
+# --------------------------------------------------------------------------
+class TestAcceptance:
+    def test_repo_is_clean(self, repo_run):
+        # THE acceptance gate: the full analyzer over the real tree, in
+        # process — same call the CI py-lint stage makes.
+        findings, stats = repo_run
+        assert findings == [], [f.render() for f in findings]
+        # every pass actually ran
+        assert set(stats["passes"]) == {
+            "hygiene", "thread-discipline", "lock-discipline",
+            "schema-drift", "donation-safety", "metrics-doc"}
+
+    @pytest.mark.slow
+    def test_cli_exit_codes(self, tmp_path):
+        # exit 0 on the repo...
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.analysis"],
+            capture_output=True, text=True, cwd=REPO)
+        assert r.returncode == 0, r.stdout + r.stderr
+        # ...and non-zero on a bad fixture tree via --root
+        bad = tmp_path / "tree"
+        (bad / "tf_operator_tpu").mkdir(parents=True)
+        (bad / "tf_operator_tpu" / "mod.py").write_text(
+            "def f():\n    return missing\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.analysis", "--root", str(bad),
+             "--pass", "hygiene"],
+            capture_output=True, text=True, cwd=REPO)
+        assert r.returncode == 1
+        assert "F821" in r.stdout

@@ -271,6 +271,26 @@ class TestCompat:
         assert job2.spec.replica_specs[ReplicaType.PS].replicas == 2
         assert job2.spec.run_policy.clean_pod_policy == CleanPodPolicy.ALL
 
+    def test_success_policy_round_trips_and_accepts_string_form(self):
+        # Round 13: the field was never wire-serialized at all (the
+        # schema-drift pass caught it). Native wire is {"policy": ...};
+        # the legacy TFJob form is a PLAIN STRING — both must parse, and
+        # a typo'd value must reach validation, not crash the parser.
+        m = dict(self.LEGACY)
+        m["spec"] = {**m["spec"], "successPolicy": {"policy": "AllWorkers"}}
+        job = compat.job_from_dict(m)
+        assert job.spec.success_policy.policy == "AllWorkers"
+        rt = compat.job_from_dict(compat.job_to_dict(job))
+        assert rt.spec.success_policy.policy == "AllWorkers"
+
+        m["spec"] = {**m["spec"], "successPolicy": "AllWorkers"}
+        assert compat.job_from_dict(m).spec.success_policy.policy == \
+            "AllWorkers"
+
+        m["spec"] = {**m["spec"], "successPolicy": "allworkers"}
+        bad = compat.job_from_dict(m)
+        assert any("successPolicy" in p for p in validation.validate_job(bad))
+
     def test_native_manifest_with_tpu(self):
         manifest = {
             "kind": "TrainJob",

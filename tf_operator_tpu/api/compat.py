@@ -33,6 +33,7 @@ from tf_operator_tpu.api.types import (
     RestartPolicy,
     RunPolicy,
     SchedulingPolicy,
+    SuccessPolicy,
     TPUSpec,
     TrainJob,
     TrainJobSpec,
@@ -173,6 +174,21 @@ def job_from_dict(manifest: dict[str, Any], apply_defaults: bool = True) -> Trai
     mesh_d = spec_d.get("mesh")
     mesh = MeshSpec(axes=dict(mesh_d.get("axes", {}) or {})) if mesh_d else None
 
+    # Round 13: successPolicy existed in types since the seed but was never
+    # parsed or emitted — a manifest asking for AllWorkers silently got the
+    # chief-else-worker-0 default (the drift class the schema-drift pass
+    # now gates). The legacy TFJob wire form is a PLAIN STRING
+    # (`successPolicy: AllWorkers`); native emits {"policy": ...} — accept
+    # both, and let a typo'd value reach validate_spec instead of crashing.
+    sp_d = spec_d.get("successPolicy")
+    if isinstance(sp_d, str):
+        policy = sp_d or "default"
+    elif isinstance(sp_d, dict):
+        policy = sp_d.get("policy") or "default"
+    else:
+        policy = "default"
+    success_policy = SuccessPolicy(policy=policy)
+
     job = TrainJob(
         metadata=ObjectMeta(
             name=meta_d.get("name", ""),
@@ -181,7 +197,8 @@ def job_from_dict(manifest: dict[str, Any], apply_defaults: bool = True) -> Trai
             annotations=dict(meta_d.get("annotations", {}) or {}),
         ),
         spec=TrainJobSpec(
-            replica_specs=replica_specs, run_policy=run_policy, tpu=tpu, mesh=mesh
+            replica_specs=replica_specs, run_policy=run_policy, tpu=tpu,
+            mesh=mesh, success_policy=success_policy,
         ),
     )
     if apply_defaults:
@@ -211,6 +228,22 @@ def job_to_dict(job: TrainJob) -> dict[str, Any]:
                 "spec": {
                     "schedulerName": rspec.template.scheduler_name,
                     "nodeSelector": rspec.template.node_selector,
+                    "restartPolicy": rspec.template.restart_policy,
+                    # Round 13: volumes were parsed but never emitted — a
+                    # job round-tripped through the API lost its volumes
+                    # (same drift class as the priorityClass drop).
+                    "volumes": [
+                        {
+                            "name": v.name,
+                            **({"hostPath": {"path": v.host_path}}
+                               if v.host_path else {}),
+                            **({"persistentVolumeClaim":
+                                {"claimName": v.claim_name}}
+                               if v.claim_name else {}),
+                            **({"emptyDir": {}} if v.empty_dir else {}),
+                        }
+                        for v in rspec.template.volumes
+                    ],
                     "containers": [
                         {
                             "name": c.name,
@@ -232,6 +265,7 @@ def job_to_dict(job: TrainJob) -> dict[str, Any]:
                                 }
                                 for v in c.volume_mounts
                             ],
+                            "workingDir": c.working_dir,
                         }
                         for c in rspec.template.containers
                     ],
@@ -280,6 +314,7 @@ def job_to_dict(job: TrainJob) -> dict[str, Any]:
                         rp.recovery.progress_threshold_steps,
                 },
             },
+            "successPolicy": {"policy": job.spec.success_policy.policy},
         },
     }
     if job.spec.tpu is not None:

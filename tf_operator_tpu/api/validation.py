@@ -94,6 +94,14 @@ def validate_spec(spec: TrainJobSpec, fleet=None) -> list[str]:
                 f"runPolicy.schedulingPolicy.priorityClass "
                 f"{sched.priority_class!r} names no PriorityClass in the "
                 f"fleet policy (known: {known})")
+    # successPolicy reached validation unchecked until round 13 (the field
+    # wasn't even wire-parsed; see compat.py) — a typo'd policy silently
+    # fell back to the default success rule.
+    if spec.success_policy.policy not in ("default", "AllWorkers"):
+        problems.append(
+            f"successPolicy.policy must be 'default' or 'AllWorkers', "
+            f"got {spec.success_policy.policy!r}"
+        )
     rec = spec.run_policy.recovery
     if rec.policy not in ("", "gang", "pod"):
         problems.append(

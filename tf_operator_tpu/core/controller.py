@@ -115,26 +115,31 @@ class JobControllerBase:
         # Cascade deletion: the reference relied on the K8s garbage collector
         # following ownerReferences (blockOwnerDeletion); this substrate IS
         # the API server, so the controller collects the children itself.
+        # Cascade failures are expected (delete races: the object may be
+        # gone by the time we get there) but must not vanish — tpulint
+        # TPH101: a broad except hiding a real apiserver error here would
+        # leak every child of every deleted job, silently.
+        log = logger_for_key(key)
         for pod in self.cluster.list_pods(job.namespace, gen_labels(job.name)):
             ref = pod.controller_ref()
             if ref is not None and ref.uid == job.uid:
                 try:
                     self.cluster.delete_pod(pod.namespace, pod.name)
-                except Exception:
-                    pass
+                except Exception as e:
+                    log.info("cascade pod delete %s: %s", pod.name, e)
         for svc in self.cluster.list_services(job.namespace, gen_labels(job.name)):
             ref = svc.controller_ref()
             if ref is not None and ref.uid == job.uid:
                 try:
                     self.cluster.delete_service(svc.namespace, svc.name)
-                except Exception:
-                    pass
+                except Exception as e:
+                    log.info("cascade service delete %s: %s", svc.name, e)
         pg = self.cluster.try_get_podgroup(job.namespace, job.name)
         if pg is not None:
             try:
                 self.cluster.delete_podgroup(job.namespace, job.name)
-            except Exception:
-                pass
+            except Exception as e:
+                log.info("cascade podgroup delete: %s", e)
         # One final sync of the now-missing key releases slice allocations
         # and expectation entries (sync_job's not-found path).
         self.enqueue(key)

@@ -58,6 +58,7 @@ from tf_operator_tpu.utils.exit_codes import (
     is_retryable_exit_code,
     is_signal_exit,
 )
+from tf_operator_tpu.utils.logging import logger_for_key
 
 # Fork TTL defaults (ref job.go:25-26,183-202): a finished job with no
 # explicit TTL is GC'd after 15min ONLY when cleanPodPolicy==All and the job
@@ -1031,8 +1032,13 @@ class TrainJobController(ctrl.JobControllerBase):
         if now >= expiry:
             try:
                 self.cluster.delete_job(job.namespace, job.name)
-            except Exception:
-                pass
+            except Exception as e:
+                # Likely a delete race (already gone) — but a real
+                # apiserver error must retry, not strand the job past its
+                # TTL forever (tpulint TPH101: no silent broad excepts in
+                # reconcile paths).
+                logger_for_key(job.key()).info("ttl delete failed: %s", e)
+                self.queue.add_after(job.key(), 1.0)
         else:
             self.queue.add_after(job.key(), expiry - now + 0.1)
 

@@ -1,0 +1,335 @@
+"""Runtime lock-graph race detector — the Python analogue of the
+reference's `go test -race` wiring.
+
+Opt-in via `TPUJOB_LOCKCHECK=1` (tests/conftest.py installs it; the CI
+chaos-smoke and fleet-smoke stages set the env): `install()` replaces
+`threading.Lock/RLock/Condition` with instrumented variants that record
+the **held-while-acquiring graph** across all threads — an edge A→B
+means some thread acquired B while holding A. An acquisition that would
+close a cycle raises `PotentialDeadlockError` (and records the cycle in
+`violations()`), so a lock-order inversion is reported on the FIRST run
+that exhibits both orders, even when the interleaving never actually
+deadlocks — the same once-and-done property `-race` has over "run it
+until it hangs".
+
+Scope discipline: only locks allocated from `tf_operator_tpu` source get
+wrapped — jax/orbax/stdlib allocate locks constantly, their internal
+ordering is not ours to police, and wrapping them would both slow every
+test and surface cycles we cannot act on. The check is therefore
+complementary to tools/analysis's static lock-discipline pass: the
+static pass proves ordering over calls it can resolve; this detector
+catches the dynamic orders (callbacks, foreign objects, per-instance
+lock pairs) statics cannot see.
+
+Condition support rides on the wrapper being a real lock to
+`threading.Condition`: `wait()` internally releases the underlying
+wrapped lock (popping it from the thread's held stack) and re-acquires
+it on wake (pushing and re-checking edges) — exactly the semantics the
+graph needs.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import _thread
+
+__all__ = [
+    "PotentialDeadlockError", "install", "uninstall", "installed",
+    "enabled_by_env", "violations", "reset", "checked_lock",
+]
+
+ENV = "TPUJOB_LOCKCHECK"
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_RealLock = _thread.allocate_lock
+_RealRLock = threading._CRLock or threading._PyRLock  # type: ignore[attr-defined]
+_RealCondition = threading.Condition
+
+
+class PotentialDeadlockError(RuntimeError):
+    """An acquisition would close a cycle in the held-while-acquiring
+    graph: two threads have taken (or are taking) the same locks in
+    opposite orders. Not necessarily deadlocked NOW — guaranteed
+    deadlockable."""
+
+
+class _Graph:
+    """Global lock-order graph. Its own mutex is a raw lock (never
+    wrapped) and no wrapped lock is ever acquired while holding it."""
+
+    def __init__(self) -> None:
+        self.mu = _RealLock()
+        self.edges: dict[int, set[int]] = {}
+        self.sites: dict[tuple[int, int], str] = {}
+        self.names: dict[int, str] = {}
+        self.violations: list[str] = []
+        self.tls = threading.local()
+
+    def held(self) -> list:
+        h = getattr(self.tls, "held", None)
+        if h is None:
+            h = self.tls.held = []
+        return h
+
+    def before_acquire(self, lock: "_Checked") -> None:
+        held = self.held()
+        if not held or held[-1] is lock or any(h is lock for h in held):
+            return  # top-level or re-entrant: no new ordering
+        me = id(lock)
+        with self.mu:
+            self.names[me] = lock._lc_name
+            new_cycle = None
+            for h in held:
+                a = id(h)
+                self.names[a] = h._lc_name
+                if me in self.edges.get(a, ()):
+                    continue  # known-good order, already checked
+                # would edge a->me close a cycle (me ->* a)?
+                path = self._find_path(me, a)
+                if path is not None:
+                    cyc = [self.names[n] for n in path] + [self.names[me]]
+                    new_cycle = (
+                        f"lock-order cycle: {' -> '.join(cyc)} "
+                        f"(thread {threading.current_thread().name!r} "
+                        f"holds {self.names[a]!r} while acquiring "
+                        f"{self.names[me]!r}; the reverse order was "
+                        f"recorded at {self.sites.get((me, a), '?')})")
+                    self.violations.append(new_cycle)
+                self.edges.setdefault(a, set()).add(me)
+                self.sites[(a, me)] = _caller()
+        if new_cycle is not None:
+            raise PotentialDeadlockError(new_cycle)
+
+    def _find_path(self, src: int, dst: int) -> list[int] | None:
+        stack = [(src, [src])]
+        seen = set()
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in self.edges.get(node, ()):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    def on_acquired(self, lock: "_Checked") -> None:
+        self.held().append(lock)
+
+    def on_release(self, lock: "_Checked") -> None:
+        held = self.held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+
+_graph = _Graph()
+
+
+def _caller() -> str:
+    f = sys._getframe(2)
+    for _ in range(8):
+        if f is None:
+            break
+        fn = f.f_code.co_filename
+        if os.path.basename(os.path.dirname(fn)) != "testing" or \
+                os.path.basename(fn) != "lockcheck.py":
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "?"
+
+
+def _alloc_site() -> str:
+    """file:line of the first frame outside this module — the lock's
+    human name in cycle reports."""
+    f = sys._getframe(2)
+    for _ in range(10):
+        if f is None:
+            break
+        fn = f.f_code.co_filename
+        base = os.path.basename(fn)
+        if (not fn.endswith(os.path.join("testing", "lockcheck.py"))
+                and "threading" not in base
+                and base != "dataclasses.py"
+                and not fn.startswith("<")):
+            return f"{os.path.relpath(fn, os.path.dirname(_PKG_DIR))}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _ours() -> bool:
+    """True when the allocation came from tf_operator_tpu source (frame
+    walk, skipping this module, threading.py, and synthesized frames —
+    a dataclass `field(default_factory=threading.Lock)` calls the
+    factory from the generated __init__ whose co_filename is
+    '<string>', with dataclasses.py beneath it; treating those as the
+    caller would leave e.g. SliceAllocator._lock unwrapped)."""
+    f = sys._getframe(2)
+    for _ in range(10):
+        if f is None:
+            return False
+        fn = f.f_code.co_filename
+        base = os.path.basename(fn)
+        if (fn.endswith(os.path.join("testing", "lockcheck.py"))
+                or base in ("threading.py", "dataclasses.py")
+                or fn.startswith("<")):
+            f = f.f_back
+            continue
+        return fn.startswith(_PKG_DIR)
+    return False
+
+
+class _Checked:
+    """Instrumented lock. Quacks like threading.Lock/RLock enough for
+    threading.Condition to build on it (acquire/release plus the RLock
+    save/restore protocol)."""
+
+    def __init__(self, inner, reentrant: bool, name: str | None = None):
+        self._lc_inner = inner
+        self._lc_reentrant = reentrant
+        self._lc_name = name or _alloc_site()
+
+    # -- core protocol ---------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        _graph.before_acquire(self)
+        got = self._lc_inner.acquire(blocking, timeout)
+        if got:
+            _graph.on_acquired(self)
+        return got
+
+    def release(self) -> None:
+        self._lc_inner.release()
+        _graph.on_release(self)
+
+    def locked(self) -> bool:
+        return self._lc_inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<lockcheck {self._lc_name} wrapping {self._lc_inner!r}>"
+
+    # -- Condition(RLock-style) protocol --------------------------------
+    def _release_save(self):
+        # fully release (RLock may be held multiple times) and drop every
+        # held-stack entry: while waiting, this lock orders NOTHING.
+        if hasattr(self._lc_inner, "_release_save"):
+            state = self._lc_inner._release_save()
+            count = 1
+        else:
+            self._lc_inner.release()
+            state, count = None, 1
+        held = _graph.held()
+        n = 0
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                n += 1
+        return (state, n)
+
+    def _acquire_restore(self, saved):
+        state, n = saved
+        _graph.before_acquire(self)
+        if hasattr(self._lc_inner, "_acquire_restore") and state is not None:
+            self._lc_inner._acquire_restore(state)
+        else:
+            self._lc_inner.acquire()
+        for _ in range(max(1, n)):
+            _graph.on_acquired(self)
+
+    def _is_owned(self) -> bool:
+        if hasattr(self._lc_inner, "_is_owned"):
+            return self._lc_inner._is_owned()
+        # plain-lock fallback, as threading.Condition does it
+        if self._lc_inner.acquire(False):
+            self._lc_inner.release()
+            return False
+        return True
+
+    def _at_fork_reinit(self) -> None:
+        if hasattr(self._lc_inner, "_at_fork_reinit"):
+            self._lc_inner._at_fork_reinit()
+
+
+def checked_lock(name: str | None = None, reentrant: bool = False) -> _Checked:
+    """Explicitly instrumented lock (tests, fixtures) — wrapped whether or
+    not install() is active."""
+    inner = _RealRLock() if reentrant else _RealLock()
+    return _Checked(inner, reentrant, name=name)
+
+
+def _make_lock():
+    if _ours():
+        return _Checked(_RealLock(), False)
+    return _RealLock()
+
+
+def _make_rlock():
+    if _ours():
+        return _Checked(_RealRLock(), True)
+    return _RealRLock()
+
+
+def _make_condition(lock=None):
+    if lock is None and _ours():
+        lock = _Checked(_RealRLock(), True)
+    return _RealCondition(lock)
+
+
+_installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def enabled_by_env(env: dict | None = None) -> bool:
+    e = os.environ if env is None else env
+    return e.get(ENV, "").strip() not in ("", "0", "off", "false")
+
+
+def install() -> None:
+    """Route threading.Lock/RLock/Condition through the checker for locks
+    allocated from tf_operator_tpu code. Locks created BEFORE install
+    (module-import-time singletons) stay raw — install early."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _make_lock            # type: ignore[assignment]
+    threading.RLock = _make_rlock          # type: ignore[assignment]
+    threading.Condition = _make_condition  # type: ignore[assignment]
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _thread.allocate_lock  # type: ignore[assignment]
+    threading.RLock = _RealRLock            # type: ignore[assignment]
+    threading.Condition = _RealCondition    # type: ignore[assignment]
+    _installed = False
+
+
+def violations() -> list[str]:
+    with _graph.mu:
+        return list(_graph.violations)
+
+
+def reset() -> None:
+    """Clear the recorded graph and violations (per-test isolation)."""
+    with _graph.mu:
+        _graph.edges.clear()
+        _graph.sites.clear()
+        _graph.names.clear()
+        _graph.violations.clear()

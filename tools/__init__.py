@@ -1,0 +1,4 @@
+# Package marker so `python -m tools.analysis` works from the repo root.
+# The scripts in this directory are still runnable directly
+# (`python tools/ci.py`, `python tools/lint.py`, ...): running a file as a
+# script does not involve the package.

@@ -1,0 +1,110 @@
+"""tpulint — the repo's multi-pass static analyzer (`python -m
+tools.analysis`).
+
+The CI py-lint stage's single entry point: absorbs tools/lint.py's
+hygiene checks and tools/check_metrics_doc.py's doc guard, and adds the
+concurrency/drift passes (thread-discipline, lock-discipline,
+schema-drift, donation-safety). See docs/static_analysis.md for the
+pass catalog, the allowlist format, and how to add a pass; the runtime
+complement (the lock-graph race detector) lives in
+tf_operator_tpu/testing/lockcheck.py.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+from tools.analysis.allowlist import (
+    DEFAULT_PATH as DEFAULT_ALLOWLIST,
+    apply_allowlist,
+    parse_allowlist,
+)
+from tools.analysis.core import REPO, Finding, Project, ordinalize
+
+__all__ = ["Finding", "Project", "run_analysis", "main"]
+
+
+def run_analysis(passes: list[str] | None = None,
+                 allowlist_path: Path | None = None,
+                 root: Path | None = None) -> tuple[list[Finding], dict]:
+    """Run the selected passes (default: all) over the repo, apply the
+    allowlist, and return (surviving findings, stats)."""
+    from tools.analysis.passes import ALL_PASSES
+
+    project = Project(root=root)
+    selected = [p for p in ALL_PASSES
+                if passes is None or p.NAME in passes]
+    t0 = time.perf_counter()
+    raw: list[Finding] = []
+    per_pass: dict[str, int] = {}
+    for p in selected:
+        found = p.run(project)
+        per_pass[p.NAME] = len(found)
+        raw.extend(found)
+    path = Path(allowlist_path or DEFAULT_ALLOWLIST)
+    entries: list = []
+    # Duplicate keys (two findings of the same rule in one function) get
+    # ::2/::3 ordinals so each is a separate allowlist decision.
+    raw = ordinalize(raw)
+    findings = list(raw)
+    if path.exists():
+        rel = str(path.relative_to(REPO)) if path.is_relative_to(REPO) \
+            else str(path)
+        entries, meta = parse_allowlist(path.read_text(), rel)
+        active = (None if passes is None
+                  else {r for p in selected for r in p.RULES})
+        findings, suppressed = apply_allowlist(findings, entries, rel,
+                                               active_rules=active)
+        findings.extend(meta)
+    else:
+        suppressed = 0
+    stats = {
+        "passes": per_pass,
+        "files": len(project.modules),
+        "raw": len(raw),
+        "suppressed": suppressed,
+        "allowlist_entries": len(entries),
+        "seconds": round(time.perf_counter() - t0, 2),
+    }
+    return findings, stats
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    from tools.analysis.passes import ALL_PASSES
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="tpulint: multi-pass static analysis for this repo")
+    ap.add_argument("--pass", dest="passes", action="append", metavar="NAME",
+                    choices=[p.NAME for p in ALL_PASSES],
+                    help="run only this pass (repeatable; default: all)")
+    ap.add_argument("--allowlist", default=None,
+                    help=f"allowlist file (default: {DEFAULT_ALLOWLIST})")
+    ap.add_argument("--root", default=None,
+                    help="analyze a tree other than the repo (the tree "
+                         "passes walk <root>/tf_operator_tpu; repo-level "
+                         "passes — schema, metrics-doc — still read the "
+                         "real repo). Used by the fixture tests.")
+    ap.add_argument("--list-passes", action="store_true")
+    args = ap.parse_args(argv)
+    if args.list_passes:
+        for p in ALL_PASSES:
+            print(f"{p.NAME:18s} {', '.join(p.RULES)}")
+        return 0
+    findings, stats = run_analysis(
+        passes=args.passes,
+        allowlist_path=Path(args.allowlist) if args.allowlist else None,
+        root=Path(args.root) if args.root else None)
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        print(f"{f.render()}  [{f.key}]")
+    per = " ".join(f"{k}={v}" for k, v in stats["passes"].items())
+    print(
+        f"tpulint: {stats['files']} modules, {stats['raw']} raw findings "
+        f"({per}), {stats['suppressed']} allowlisted, "
+        f"{len(findings)} surviving, {stats['seconds']}s",
+        file=sys.stderr)
+    return 1 if findings else 0

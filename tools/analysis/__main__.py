@@ -1,0 +1,14 @@
+import sys
+from pathlib import Path
+
+# Running `python -m tools.analysis` requires the repo root importable;
+# running from a checkout subdirectory or with an odd sys.path[0] should
+# behave identically.
+_REPO = str(Path(__file__).resolve().parent.parent.parent)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.analysis import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

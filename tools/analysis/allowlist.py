@@ -1,0 +1,92 @@
+"""tpulint allowlist: per-finding suppressions with mandatory justification.
+
+Format (one entry per line, `#` comments and blank lines ignored):
+
+    RULE KEY -- justification text
+
+`RULE` is the finding's rule id (TPH102, TPL301, ...), `KEY` its stable
+line-number-free key (printed with every finding as `[key]`), and the
+justification after ` -- ` is REQUIRED — an entry without one is itself
+a finding (TPA001). So is a stale entry that matched nothing in the run
+(TPA002): suppressions must die with the code they excused, or the file
+silently grows into a second, weaker ruleset.
+
+This is deliberately not `# noqa`: inline suppressions scatter through
+the tree with no room for a reason; one reviewed file keeps every
+accepted exception and its why in a single diff-able place.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+
+from tools.analysis.core import Finding
+
+DEFAULT_PATH = Path(__file__).resolve().parent / "allowlist.txt"
+
+RULE_MISSING_WHY = "TPA001"
+RULE_STALE = "TPA002"
+RULE_MALFORMED = "TPA003"
+
+
+@dataclass
+class AllowEntry:
+    rule: str
+    key: str
+    why: str
+    line: int
+
+
+def parse_allowlist(text: str, rel_path: str) -> tuple[list[AllowEntry],
+                                                       list[Finding]]:
+    """Entries + findings for malformed/justification-less lines."""
+    entries: list[AllowEntry] = []
+    findings: list[Finding] = []
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        body, sep, why = line.partition(" -- ")
+        rule, _, key = body.strip().partition(" ")
+        key = key.strip()
+        if not rule or not key:
+            findings.append(Finding(
+                RULE_MALFORMED, rel_path, lineno,
+                f"allowlist-malformed::{lineno}",
+                f"malformed allowlist line (want 'RULE KEY -- why'): {raw!r}"))
+            continue
+        if not sep or not why.strip():
+            findings.append(Finding(
+                RULE_MISSING_WHY, rel_path, lineno,
+                f"allowlist-no-why::{rule}::{key}",
+                f"allowlist entry for {rule} {key} has no ' -- justification'"))
+            continue
+        entries.append(AllowEntry(rule, key, why.strip(), lineno))
+    return entries, findings
+
+
+def apply_allowlist(findings: list[Finding], entries: list[AllowEntry],
+                    rel_path: str,
+                    active_rules: set[str] | None = None) -> tuple[
+                        list[Finding], int]:
+    """Drop allowlisted findings; flag stale entries. Returns the
+    surviving findings (allowlist meta-findings included) and the count
+    suppressed. `active_rules` scopes the stale check to the rules the
+    selected passes could have emitted — a `--pass metrics-doc` run must
+    not declare every thread/lock entry stale just because those passes
+    never ran (None = all rules active: the full run)."""
+    allowed = {(e.rule, e.key) for e in entries}
+    survivors = [f for f in findings if (f.rule, f.key) not in allowed]
+    matched = {(f.rule, f.key) for f in findings} & allowed
+    suppressed = len(findings) - len(survivors)
+    for e in entries:
+        if active_rules is not None and e.rule not in active_rules:
+            continue
+        if (e.rule, e.key) not in matched:
+            survivors.append(Finding(
+                RULE_STALE, rel_path, e.line,
+                f"allowlist-stale::{e.rule}::{e.key}",
+                f"stale allowlist entry: {e.rule} {e.key} matched no "
+                f"finding — the excused code is gone; delete the entry"))
+    return survivors, suppressed

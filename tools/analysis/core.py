@@ -1,0 +1,285 @@
+"""tpulint core: the project model every analysis pass shares.
+
+The reference repo's correctness tooling was Go's: `go vet`, pylint via
+py_checks.py, and `-race` wiring in CI. This package is the in-repo
+equivalent for a heavily-threaded Python control plane — stdlib-ast only
+(the image ships no linter and installs are off-limits), organised as a
+framework so a new invariant is one new pass, not a new script:
+
+  * `Project` loads every `tf_operator_tpu` module once (source + AST),
+    builds per-module import tables and a qualified-function index
+    (nested functions and methods included), and answers the name
+    questions passes keep asking: "what does `telemetry.span` resolve
+    to?", "which function is `worker` in this scope?".
+  * `Finding` is the one report currency: a stable, line-number-free
+    `key` identifies a finding across edits (the allowlist matches on
+    it), `path:line` is for the human reading CI output.
+
+Resolution is deliberately conservative: calls through objects we cannot
+type (`obj.method()`, call results) resolve to UNKNOWN and passes ignore
+them. A static pass that guesses produces noise; one that under-claims
+still turns the invariant it DOES prove into a CI gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent.parent
+PACKAGE = "tf_operator_tpu"
+
+# resolve() verdicts
+FUNC = "func"          # (FUNC, Module, qualname)
+CLASS = "class"        # (CLASS, Module, classname)
+MODULE = "module"      # (MODULE, Module, "")
+EXTERNAL = "external"  # (EXTERNAL, None, dotted)  e.g. "jax.numpy.concatenate"
+UNKNOWN = "unknown"    # (UNKNOWN, None, "")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analysis finding. `key` is the allowlist identity: stable under
+    reformatting (no line numbers), unique enough to pin one decision."""
+
+    rule: str     # e.g. "TPT201"
+    path: str     # repo-relative, for humans
+    line: int
+    key: str      # stable allowlist key, e.g. "thread-dispatch::staging::worker->jax.numpy.concatenate"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def dotted_of(node: ast.AST) -> str | None:
+    """ "a.b.c" for a Name/Attribute chain, else None (call results,
+    subscripts — the unresolvable shapes)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class Module:
+    """One parsed source file: import table + qualified function/class
+    index. Function qualnames use '.' nesting: `stage_to_device.worker`,
+    `FleetScheduler.decide`."""
+
+    def __init__(self, name: str, path: Path, src: str, tree: ast.Module,
+                 root: Path = REPO):
+        self.name = name
+        self.path = path
+        try:
+            self.rel = str(path.relative_to(root))
+        except ValueError:
+            self.rel = str(path)
+        self.src = src
+        self.tree = tree
+        self.imports: dict[str, str] = {}       # local alias -> dotted target
+        self.functions: dict[str, ast.FunctionDef | ast.AsyncFunctionDef] = {}
+        self.classes: dict[str, ast.ClassDef] = {}
+        self._index(tree, [])
+        self._bind_imports(tree)
+
+    def _index(self, node: ast.AST, stack: list[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(stack + [child.name])
+                self.functions[qual] = child
+                self._index(child, stack + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                qual = ".".join(stack + [child.name])
+                self.classes[qual] = child
+                self._index(child, stack + [child.name])
+            else:
+                self._index(child, stack)
+
+    def _bind_imports(self, tree: ast.Module) -> None:
+        pkg_parts = self.name.split(".")
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                if node.level:
+                    # relative: drop the module's own leaf (__init__ keeps it)
+                    base_parts = pkg_parts[:]
+                    if not self.path.name == "__init__.py":
+                        base_parts = base_parts[:-1]
+                    base_parts = base_parts[:len(base_parts) - (node.level - 1)]
+                    base = ".".join(base_parts + (
+                        [node.module] if node.module else []))
+                else:
+                    base = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.imports[a.asname or a.name] = f"{base}.{a.name}"
+
+    def lookup(self, scope: str, name: str) -> str | None:
+        """Resolve a bare name from inside function `scope` to a function
+        qualname in THIS module: innermost enclosing scope first (sibling
+        nested defs), then module level."""
+        parts = scope.split(".") if scope else []
+        for i in range(len(parts), -1, -1):
+            qual = ".".join(parts[:i] + [name])
+            if qual in self.functions or qual in self.classes:
+                return qual
+        return None
+
+
+class Project:
+    def __init__(self, root: Path | None = None, package: str = PACKAGE):
+        self.root = Path(root or REPO)
+        self.modules: dict[str, Module] = {}
+        pkg_dir = self.root / package
+        for path in sorted(pkg_dir.rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            rel = path.relative_to(self.root)
+            parts = list(rel.with_suffix("").parts)
+            if parts[-1] == "__init__":
+                parts = parts[:-1]
+            self.add_module(".".join(parts), path)
+
+    def add_module(self, name: str, path: Path,
+                   src: str | None = None) -> Module | None:
+        src = path.read_text() if src is None else src
+        try:
+            tree = ast.parse(src, filename=str(path))
+        except SyntaxError:
+            return None  # compileall/lint report syntax errors; not our job
+        mod = Module(name, path, src, tree, root=self.root)
+        self.modules[name] = mod
+        return mod
+
+    # ------------------------------------------------------------ resolution
+
+    def resolve_global(self, dotted: str, depth: int = 0):
+        """A fully-qualified dotted name -> (kind, module, detail)."""
+        if depth > 6:
+            return (UNKNOWN, None, "")
+        if not dotted.startswith(PACKAGE):
+            return (EXTERNAL, None, dotted)
+        # longest module prefix wins
+        parts = dotted.split(".")
+        for i in range(len(parts), 0, -1):
+            mname = ".".join(parts[:i])
+            mod = self.modules.get(mname)
+            if mod is None:
+                continue
+            rest = parts[i:]
+            if not rest:
+                return (MODULE, mod, "")
+            qual = ".".join(rest)
+            if qual in mod.functions:
+                return (FUNC, mod, qual)
+            if qual in mod.classes:
+                return (CLASS, mod, qual)
+            # re-export: `from .tracer import span` in __init__.py
+            if rest[0] in mod.imports:
+                target = ".".join([mod.imports[rest[0]]] + rest[1:])
+                return self.resolve_global(target, depth + 1)
+            return (UNKNOWN, None, "")
+        return (UNKNOWN, None, "")
+
+    def resolve(self, module: Module, scope: str, dotted: str):
+        """A possibly-dotted name as written inside `module` at function
+        `scope` -> (kind, module, detail). Applies local scoping, the
+        import table, and re-export chains."""
+        head, _, tail = dotted.partition(".")
+        if not tail:
+            qual = module.lookup(scope, head)
+            if qual is not None:
+                if qual in module.functions:
+                    return (FUNC, module, qual)
+                return (CLASS, module, qual)
+        if head in module.imports:
+            target = module.imports[head] + (f".{tail}" if tail else "")
+            return self.resolve_global(target)
+        if tail:
+            # dotted local: Class.method in this module
+            qual = module.lookup(scope, head)
+            if qual is not None and qual in module.classes:
+                mqual = f"{qual}.{tail}"
+                if mqual in module.functions:
+                    return (FUNC, module, mqual)
+            return (UNKNOWN, None, "")
+        return (UNKNOWN, None, "")
+
+    # ------------------------------------------------------------- utilities
+
+    def rel(self, path: os.PathLike | str) -> str:
+        p = Path(path)
+        try:
+            return str(p.relative_to(self.root))
+        except ValueError:
+            return str(p)
+
+
+def ordinalize(findings: list[Finding]) -> list[Finding]:
+    """Disambiguate duplicate keys: the 2nd, 3rd... finding sharing a key
+    gets a `::2`/`::3` suffix (emission order). Keys are per-DECISION
+    allowlist identities — without this, one entry for a function's first
+    swallowed-except would silently suppress every future one added to
+    the same function, defeating the stale-entry contract."""
+    seen: dict[str, int] = {}
+    out: list[Finding] = []
+    for f in findings:
+        n = seen.get(f.key, 0) + 1
+        seen[f.key] = n
+        if n > 1:
+            f = Finding(f.rule, f.path, f.line, f"{f.key}::{n}", f.message)
+        out.append(f)
+    return out
+
+
+def function_body(fn: ast.FunctionDef | ast.AsyncFunctionDef):
+    """Statements executed when the function RUNS — nested def/class bodies
+    are their own graph nodes, so walks over a function's behavior must not
+    descend into them. Yields every node except those subtrees."""
+    stack: list[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            # lambdas ARE walked: a lambda passed to jax.tree.map runs on
+            # the caller's thread for every leaf — its body belongs to the
+            # enclosing function's behavior for discipline purposes.
+            stack.append(child)
+
+
+def enclosing_class(module: Module, scope: str) -> str | None:
+    """Innermost class qualname containing function `scope`, or None."""
+    parts = scope.split(".")
+    for i in range(len(parts), 0, -1):
+        qual = ".".join(parts[:i])
+        if qual in module.classes:
+            return qual
+    return None
+
+
+def enclosing_function(module: Module, node: ast.AST) -> str | None:
+    """qualname of the function whose body contains `node` (by position)."""
+    best: str | None = None
+    best_span = None
+    for qual, fn in module.functions.items():
+        if (fn.lineno <= node.lineno
+                and node.lineno <= (fn.end_lineno or fn.lineno)):
+            span = (fn.end_lineno or fn.lineno) - fn.lineno
+            if best_span is None or span < best_span:
+                best, best_span = qual, span
+    return best

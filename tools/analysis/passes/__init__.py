@@ -1,0 +1,17 @@
+"""tpulint pass registry.
+
+A pass is a module with `NAME` (its CLI id), `RULES` (the rule codes it
+may emit), and `run(project) -> list[Finding]`. Register new passes here
+— order is report order, cheap-and-broad first.
+"""
+
+from tools.analysis.passes import (  # noqa: F401
+    donation,
+    hygiene,
+    locks,
+    metrics_doc,
+    schema,
+    threads,
+)
+
+ALL_PASSES = (hygiene, threads, locks, schema, donation, metrics_doc)

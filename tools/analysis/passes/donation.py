@@ -1,0 +1,169 @@
+"""Pass: donation/aliasing safety (TPD501, TPD502).
+
+The PR-1 heap-corruption class: on CPU, `jax.device_put` of a numpy
+array can be ZERO-COPY — the device array aliases the host buffer — so
+a donated jitted call that then stomps its input, or host code mutating
+a buffer it already shipped, corrupts memory that something else still
+reads (the seed-era resume crash took three rounds to trace). Two
+checks, both intraprocedural and conservative:
+
+  TPD501 donated-arg-use-after-call: `f = jax.jit(..., donate_argnums=
+         (i,))` followed by `f(.., x, ..)` and a LATER read of `x` in
+         the same function, unless the call's own assignment rebinds it
+         (`state = step(state, batch)` — the blessed pattern). After
+         donation the buffer belongs to XLA; reading it is
+         use-after-free that happens to work until it doesn't.
+  TPD502 host-buffer-mutated-after-device-put: a name passed to
+         `jax.device_put` and later mutated in place (subscript store,
+         augmented assign, or an in-place ndarray method) in the same
+         function — exactly the aliasing PR 1 fixed by copying into
+         XLA-owned storage.
+
+Ordering is by line number with a first-event-wins rule, so the loop
+idiom (`state = step(state)` re-entering the loop head) never flags.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.core import Finding, Project, dotted_of, function_body
+
+NAME = "donation-safety"
+RULES = ("TPD501", "TPD502")
+
+_INPLACE_METHODS = {"fill", "sort", "partition", "put", "resize", "setflags"}
+
+
+def _donated_jits(module) -> dict[str, tuple[int, ...]]:
+    """name -> donated positional indices, for `name = jax.jit(...,
+    donate_argnums=...)` assignments anywhere in the module."""
+    out: dict[str, tuple[int, ...]] = {}
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        callee = dotted_of(node.value.func)
+        if callee is None or callee.split(".")[-1] != "jit":
+            continue
+        donated: tuple[int, ...] = ()
+        for kw in node.value.keywords:
+            if kw.arg == "donate_argnums":
+                if isinstance(kw.value, ast.Tuple):
+                    donated = tuple(
+                        e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant))
+                elif isinstance(kw.value, ast.Constant):
+                    donated = (kw.value.value,)
+        if donated:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = donated
+    return out
+
+
+def _loads_stores(fn) -> list[tuple[int, str, str]]:
+    """(lineno, kind, name) events: kind in load|store|mutate."""
+    events = []
+    for node in function_body(fn):
+        if isinstance(node, ast.Name):
+            kind = "load" if isinstance(node.ctx, ast.Load) else "store"
+            events.append((node.lineno, kind, node.id))
+        elif isinstance(node, ast.Subscript):
+            if isinstance(node.ctx, (ast.Store, ast.Del)) and isinstance(
+                    node.value, ast.Name):
+                events.append((node.lineno, "mutate", node.value.id))
+        elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name):
+            events.append((node.lineno, "mutate", node.target.id))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in _INPLACE_METHODS
+                    and isinstance(f.value, ast.Name)):
+                events.append((node.lineno, "mutate", f.value.id))
+    return sorted(events)
+
+
+def _stmt_targets(stmt) -> set[str]:
+    if isinstance(stmt, ast.Assign):
+        out = set()
+        for t in stmt.targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+        return out
+    return set()
+
+
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for module in project.modules.values():
+        jits = _donated_jits(module)
+        for qual, fn in module.functions.items():
+            events = _loads_stores(fn)
+            for stmt in function_body(fn):
+                if not isinstance(stmt, (ast.Assign, ast.Expr)):
+                    continue
+                call = stmt.value if isinstance(
+                    stmt.value, ast.Call) else None
+                if call is None:
+                    continue
+                cname = dotted_of(call.func)
+                if cname is None:
+                    continue
+                # --- TPD501: donated args read after the call
+                if cname in jits:
+                    rebound = _stmt_targets(stmt)
+                    for idx in jits[cname]:
+                        if idx >= len(call.args):
+                            continue
+                        arg = call.args[idx]
+                        if not isinstance(arg, ast.Name) or arg.id in rebound:
+                            continue
+                        if _read_after(events, call.end_lineno or call.lineno,
+                                       arg.id):
+                            findings.append(Finding(
+                                "TPD501", module.rel, call.lineno,
+                                f"donated-use::{module.name}::{qual}::{arg.id}",
+                                f"{arg.id!r} is donated to {cname}() and "
+                                f"read afterwards in {qual} — the buffer "
+                                f"belongs to XLA after donation"))
+                # --- TPD502: host buffer mutated after device_put
+                if cname.split(".")[-1] == "device_put":
+                    for arg in call.args[:1]:
+                        if not isinstance(arg, ast.Name):
+                            continue
+                        if _mutated_after(events,
+                                          call.end_lineno or call.lineno,
+                                          arg.id):
+                            findings.append(Finding(
+                                "TPD502", module.rel, call.lineno,
+                                f"put-mutate::{module.name}::{qual}::{arg.id}",
+                                f"{arg.id!r} passed to device_put and "
+                                f"mutated afterwards in {qual} — on CPU "
+                                f"the device array may alias this host "
+                                f"buffer (the PR-1 corruption class)"))
+    return findings
+
+
+def _read_after(events, end_lineno: int, name: str) -> bool:
+    # `end_lineno` is the CALL's last line: a multi-line call's own
+    # argument loads on continuation lines are part of the call, not a
+    # use-after-donation (review finding, round 13).
+    for ln, kind, n in events:
+        if ln <= end_lineno or n != name:
+            continue
+        return kind == "load"  # first later event wins; a store rebinds
+    return False
+
+
+def _mutated_after(events, end_lineno: int, name: str) -> bool:
+    for ln, kind, n in events:
+        if ln <= end_lineno or n != name:
+            continue
+        if kind == "store":
+            return False  # rebound: the old buffer is out of scope
+        if kind == "mutate":
+            return True
+    return False

@@ -1,0 +1,243 @@
+"""Pass: hygiene — the seed-era lint checks plus three threaded-repo
+upgrades.
+
+The F/E/B-coded checks (undefined names, unused imports, redefinitions,
+mutable defaults, bare except, f-string lints) are tools/lint.py's ast
+linter, absorbed here so `python -m tools.analysis` is the ONE entry
+point the CI py-lint stage runs; `tools/lint.py` keeps working
+standalone and stays the engine. On top:
+
+  TPH101 swallowed-broad-exception: `except Exception/BaseException:`
+         (or bare) whose body is only pass/continue. A narrow except
+         with a silent body is a judgment call; a BROAD one inside a
+         controller is how reconcile errors vanish — every keeper gets
+         an allowlist entry with its why, everything else gets a log
+         line or a narrower type.
+  TPH102 bound-method-comparison: `x is self._m` / `x == self._m` where
+         `_m` is a method of the enclosing class. Attribute access
+         builds a FRESH bound-method wrapper per read, so `is` is
+         always-False (the PR-5 signal-restore trap) and `==` deserves
+         a justified allowlist entry where it is the deliberate,
+         correct form.
+  TPH103 unlocked-module-state: a module-level dict/list/set mutated
+         inside a function with no enclosing `with <lock>:`, in a
+         module that imports threading — shared state in a threaded
+         module either takes the lock or explains itself.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.core import (
+    Finding,
+    Module,
+    Project,
+    dotted_of,
+    enclosing_class,
+    enclosing_function,
+)
+
+NAME = "hygiene"
+RULES = ("F821", "F401", "F811", "F541", "B006", "E722", "E999",
+         "TPH101", "TPH102", "TPH103")
+
+_LINT_LINE = re.compile(r"^(?P<path>.+?):(?P<line>\d+): (?P<code>[A-Z]\d+) "
+                        r"(?P<msg>.*)$")
+
+_MUTATING_METHODS = {"append", "add", "update", "setdefault", "pop",
+                     "extend", "insert", "clear", "remove", "discard"}
+
+
+def _lint_findings(project: Project) -> list[Finding]:
+    """tools/lint.py over its default roots (package + tools + tests +
+    entry scripts), re-shaped into Findings. A non-default project root
+    (fixture trees in tests) lints just the project's own modules."""
+    from pathlib import Path
+
+    from tools import lint
+    from tools.analysis.core import REPO
+
+    if project.root != REPO:
+        roots = [m.path for m in project.modules.values()]
+    else:
+        roots = [Path(p) for p in lint.DEFAULT_PATHS]
+    findings = []
+    for root in roots:
+        files = (sorted(root.rglob("*.py")) if root.is_dir()
+                 else [root] if root.suffix == ".py" else [])
+        for f in files:
+            if "__pycache__" in f.parts:
+                continue
+            for line in lint.lint_file(f):
+                m = _LINT_LINE.match(line)
+                if m is None:
+                    continue
+                rel = project.rel(m.group("path"))
+                findings.append(Finding(
+                    m.group("code"), rel, int(m.group("line")),
+                    f"lint::{rel}::{m.group('code')}::{m.group('msg')}",
+                    m.group("msg")))
+    return findings
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    names = []
+    if isinstance(handler.type, ast.Tuple):
+        names = [dotted_of(e) or "" for e in handler.type.elts]
+    else:
+        names = [dotted_of(handler.type) or ""]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _body_is_silent(handler: ast.ExceptHandler) -> bool:
+    return all(isinstance(s, (ast.Pass, ast.Continue)) for s in handler.body)
+
+
+def _swallowed(module: Module) -> list[Finding]:
+    out = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if _is_broad(node) and _body_is_silent(node):
+            fname = enclosing_function(module, node) or "<module>"
+            out.append(Finding(
+                "TPH101", module.rel, node.lineno,
+                f"swallowed::{module.rel}::{fname}",
+                f"broad exception silently swallowed in {fname} — log it, "
+                f"narrow it, or allowlist it with the why"))
+    return out
+
+
+def _bound_method_compares(module: Module) -> list[Finding]:
+    out = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not any(isinstance(op, (ast.Is, ast.IsNot, ast.Eq, ast.NotEq))
+                   for op in node.ops):
+            continue
+        scope = enclosing_function(module, node) or ""
+        cls = enclosing_class(module, scope)
+        if cls is None:
+            continue
+        for side in [node.left] + list(node.comparators):
+            name = dotted_of(side)
+            if not name or not name.startswith("self."):
+                continue
+            attr = name[5:]
+            if "." in attr:
+                continue
+            if f"{cls}.{attr}" not in module.functions:
+                continue
+            is_identity = any(isinstance(op, (ast.Is, ast.IsNot))
+                              for op in node.ops)
+            detail = ("`is` on a bound method is ALWAYS false — every "
+                      "attribute read builds a fresh wrapper; use =="
+                      if is_identity else
+                      "== on a bound method: correct but subtle — "
+                      "allowlist with the why if deliberate")
+            out.append(Finding(
+                "TPH102", module.rel, node.lineno,
+                f"bound-method-cmp::{module.rel}::{scope}::{name}",
+                f"comparison against bound method {name} in "
+                f"{scope or '<module>'}: {detail}"))
+    return out
+
+
+def _module_state(project: Project, module: Module) -> list[Finding]:
+    # `import threading` OR `from threading import Lock, Thread` both mark
+    # the module as threaded (the latter records dotted values).
+    if not any(v == "threading" or v.startswith("threading.")
+               for v in module.imports.values()):
+        return []
+    # module-level mutable containers
+    mutables: set[str] = set()
+    for stmt in module.tree.body:
+        if isinstance(stmt, ast.Assign):
+            v = stmt.value
+            is_container = isinstance(v, (ast.Dict, ast.List, ast.Set)) or (
+                isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+                and v.func.id in ("dict", "list", "set"))
+            if is_container:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and not t.id.isupper():
+                        mutables.add(t.id)
+    if not mutables:
+        return []
+    out = []
+    for qual, fn in module.functions.items():
+        out.extend(_unlocked_mutations(project, module, qual, fn, mutables))
+    return out
+
+
+def _unlocked_mutations(project, module, qual, fn, mutables) -> list[Finding]:
+    # a cheap local lock notion: any `with x:` where the name hints lock
+    findings = []
+
+    def walk(node, locked: bool):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, ast.With):
+            names = [dotted_of(i.context_expr) or "" for i in node.items]
+            now_locked = locked or any(
+                re.search(r"lock|cond|mutex", n, re.I) for n in names)
+            for child in node.body:
+                walk(child, now_locked)
+            return
+        target = None
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, (ast.Store, ast.Del))
+                and isinstance(node.value, ast.Name)):
+            target = node.value.id
+        elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name):
+            target = node.target.id
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr in _MUTATING_METHODS
+              and isinstance(node.func.value, ast.Name)):
+            target = node.func.value.id
+        if (target in mutables and not locked
+                # a local rebind shadows the module global
+                and not _locally_bound(fn, target)):
+            findings.append(Finding(
+                "TPH103", module.rel, node.lineno,
+                f"unlocked-state::{module.rel}::{qual}::{target}",
+                f"module-level mutable {target!r} mutated in {qual} "
+                f"without a lock, in a threading module"))
+        for child in ast.iter_child_nodes(node):
+            walk(child, locked)
+
+    for stmt in fn.body:
+        walk(stmt, False)
+    return findings
+
+
+def _locally_bound(fn, name: str) -> bool:
+    args = fn.args
+    for a in (list(args.posonlyargs) + list(args.args)
+              + list(args.kwonlyargs)
+              + ([args.vararg] if args.vararg else [])
+              + ([args.kwarg] if args.kwarg else [])):
+        if a.arg == name:
+            return True
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return True
+    return False
+
+
+def run(project: Project) -> list[Finding]:
+    findings = _lint_findings(project)
+    for module in project.modules.values():
+        findings.extend(_swallowed(module))
+        findings.extend(_bound_method_compares(module))
+        findings.extend(_module_state(project, module))
+    return findings

@@ -1,0 +1,404 @@
+"""Pass: lock-discipline (TPL301, TPL302) — static lock-order and
+Condition hygiene.
+
+The codebase is now heavily threaded (staging lanes, sharded workqueue,
+FleetScheduler, telemetry collector) and its deadlock-freedom rests on
+informal ordering contracts ("Lock order is always read_lock -> cond,
+never the reverse" — staging.py). This pass extracts those contracts
+from the code and gates CI on them:
+
+  * TPL301 lock-order-cycle: build the held-while-acquiring graph —
+    lock identities are allocation sites (`module.Class._lock`,
+    `module.func.name`), `threading.Condition(lock)` aliases to the lock
+    it wraps, and acquisition is `with <lock>:` nesting, propagated
+    through resolvable calls (same-module functions, same-class methods,
+    and attributes whose class is named by an __init__ parameter
+    annotation — how `FleetScheduler._lock -> SliceAllocator._lock` is
+    discovered). A cycle means two code paths take the same pair of
+    locks in opposite orders: a potential deadlock even if no test has
+    interleaved it yet.
+  * TPL302 wait-outside-loop: `Condition.wait()`/`wait_for()` on a known
+    condition must sit inside a `while` predicate loop — a bare `if` +
+    `wait()` misses spurious wakeups and notify races (the bug class
+    `Condition`'s own docs warn about).
+
+The propagation is an over-approximation (a callee's locks are charged
+to every call site, even ones that release first), which is the safe
+direction: a false edge is an allowlist entry with a justification; a
+missed real cycle is an operator deadlocked under an informer storm.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.core import (
+    CLASS,
+    EXTERNAL,
+    FUNC,
+    Finding,
+    Module,
+    Project,
+    dotted_of,
+    enclosing_class as _class_of_scope,
+)
+
+NAME = "lock-discipline"
+RULES = ("TPL301", "TPL302")
+
+_LOCK_FACTORIES = {"threading.Lock", "threading.RLock", "threading.Condition"}
+_CONDITION_FACTORY = "threading.Condition"
+
+
+def _factory_of(project: Project, module: Module, scope: str,
+                value: ast.AST) -> tuple[str, ast.Call] | None:
+    """("threading.Lock"|..., call) when `value` constructs a lock."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted_of(value.func)
+    if name is None:
+        return None
+    kind, _, detail = project.resolve(module, scope, name)
+    if kind == EXTERNAL and detail in _LOCK_FACTORIES:
+        return detail, value
+    return None
+
+
+class _LockWorld:
+    """All known lock identities in the project + alias resolution."""
+
+    def __init__(self) -> None:
+        # canonical id -> (module rel path, lineno) for reporting
+        self.locks: dict[str, tuple[str, int]] = {}
+        self.conditions: set[str] = set()
+        self.alias: dict[str, str] = {}  # condition id -> wrapped lock id
+
+    def canon(self, lock_id: str) -> str:
+        seen = set()
+        while lock_id in self.alias and lock_id not in seen:
+            seen.add(lock_id)
+            lock_id = self.alias[lock_id]
+        return lock_id
+
+
+def _collect_locks(project: Project, world: _LockWorld) -> None:
+    """Find every lock allocation: module/function-level `x = Lock()`,
+    `self._lock = Lock()` in methods, and dataclass lock fields."""
+    from tools.analysis.core import enclosing_function
+
+    for module in project.modules.values():
+        for node in ast.walk(module.tree):
+            targets: list[tuple[str, ast.AST]] = []
+            value = None
+            if isinstance(node, ast.Assign):
+                value = node.value
+                targets = [(dotted_of(t) or "", t) for t in node.targets]
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value = node.value
+                targets = [(dotted_of(node.target) or "", node.target)]
+            if value is None:
+                continue
+            scope = enclosing_function(module, node) or ""
+            fac = _factory_of(project, module, scope, value)
+            if fac is None:
+                # dataclass field: `_lock: threading.Lock =
+                #   field(default_factory=threading.Lock)`
+                fac = _dataclass_lock(project, module, scope, node)
+                if fac is None:
+                    continue
+            fac_name, call = fac
+            for tname, _ in targets:
+                if not tname:
+                    continue
+                lid = _target_id(module, scope, tname)
+                if lid is None:
+                    continue
+                world.locks[lid] = (module.rel, node.lineno)
+                if fac_name == _CONDITION_FACTORY:
+                    world.conditions.add(lid)
+                    if call.args:
+                        wrapped = dotted_of(call.args[0])
+                        if wrapped is not None:
+                            wid = _target_id(module, scope, wrapped)
+                            if wid is not None:
+                                world.alias[lid] = wid
+        # class-body AnnAssign lock fields (dataclasses) with no value
+        for cqual, cls in module.classes.items():
+            for stmt in cls.body:
+                if (isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)):
+                    ann = dotted_of(stmt.annotation) or ""
+                    kind, _, detail = project.resolve(module, "", ann)
+                    if kind == EXTERNAL and detail in _LOCK_FACTORIES:
+                        lid = f"{module.name}.{cqual}.{stmt.target.id}"
+                        world.locks[lid] = (module.rel, stmt.lineno)
+                        if detail == _CONDITION_FACTORY:
+                            world.conditions.add(lid)
+
+
+def _dataclass_lock(project, module, scope, node):
+    """`field(default_factory=threading.Lock, ...)` assignments."""
+    value = node.value if isinstance(node, (ast.Assign, ast.AnnAssign)) else None
+    if not isinstance(value, ast.Call):
+        return None
+    fname = dotted_of(value.func)
+    if fname is None or fname.split(".")[-1] != "field":
+        return None
+    for kw in value.keywords:
+        if kw.arg == "default_factory":
+            dname = dotted_of(kw.value)
+            if dname is None:
+                continue
+            kind, _, detail = project.resolve(module, scope, dname)
+            if kind == EXTERNAL and detail in _LOCK_FACTORIES:
+                return detail, value
+    return None
+
+
+def _target_id(module: Module, scope: str, tname: str) -> str | None:
+    """Canonical lock id for an assignment target as written."""
+    if tname.startswith("self."):
+        cls = _class_of_scope(module, scope)
+        if cls is None:
+            return None
+        return f"{module.name}.{cls}.{tname[5:]}"
+    if "." in tname:
+        return None  # foreign-object attribute: not ours to name
+    if scope:
+        # function-local lock: name it by the OUTERMOST function so the
+        # same lock referenced from nested workers canonicalizes equal
+        owner = scope.split(".")[0]
+        return f"{module.name}.{owner}.{tname}"
+    return f"{module.name}.{tname}"
+
+
+def _attr_types(project: Project, module: Module,
+                cls_qual: str) -> dict[str, tuple[Module, str]]:
+    """self.<attr> -> (module, ClassName) inferred from __init__: either a
+    parameter with a class annotation assigned to the attr, or a direct
+    `self.x = SomeClass(...)` construction."""
+    out: dict[str, tuple[Module, str]] = {}
+    init = module.functions.get(f"{cls_qual}.__init__")
+    if init is None:
+        return out
+    ann_of: dict[str, str] = {}
+    for a in list(init.args.args) + list(init.args.kwonlyargs):
+        if a.annotation is not None:
+            ann = dotted_of(a.annotation)
+            if ann is None and isinstance(a.annotation, ast.BinOp):
+                ann = dotted_of(a.annotation.left)  # `X | None`
+            if ann:
+                ann_of[a.arg] = ann
+    for node in ast.walk(init):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        t = dotted_of(node.targets[0])
+        if not t or not t.startswith("self."):
+            continue
+        attr = t[5:]
+        src: str | None = None
+        if isinstance(node.value, ast.Name) and node.value.id in ann_of:
+            src = ann_of[node.value.id]
+        elif isinstance(node.value, ast.Call):
+            src = dotted_of(node.value.func)
+        if src is None:
+            continue
+        kind, cmod, detail = project.resolve(module, f"{cls_qual}.__init__", src)
+        if kind == CLASS:
+            out[attr] = (cmod, detail)
+    return out
+
+
+def _candidate_ids(module: Module, scope: str, name: str) -> list[str]:
+    """Possible lock ids for a name as written at `scope`: the
+    function-local id (outermost enclosing function) first, then the
+    module-level id — Python name resolution order."""
+    out = []
+    tid = _target_id(module, scope, name)
+    if tid is not None:
+        out.append(tid)
+    if scope and "." not in name:
+        out.append(f"{module.name}.{name}")
+    return out
+
+
+def _lock_of_expr(project: Project, module: Module, scope: str,
+                  world: _LockWorld, expr: ast.AST) -> str | None:
+    name = dotted_of(expr)
+    if name is None:
+        return None
+    for lid in _candidate_ids(module, scope, name):
+        if lid in world.locks:
+            return world.canon(lid)
+    return None
+
+
+def run(project: Project) -> list[Finding]:
+    world = _LockWorld()
+    _collect_locks(project, world)
+    attr_types: dict[tuple[str, str], dict] = {}
+
+    # Per-function: (direct) ordered acquisitions with held context, calls
+    # with held context, and wait() sites.
+    acquires: dict[tuple[str, str], set[str]] = {}
+    edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+    calls_held: list[tuple] = []  # (module, caller_qual, held, callee_mod, callee_qual, lineno)
+    findings: list[Finding] = []
+
+    def callee_of(module, scope, node: ast.Call):
+        name = dotted_of(node.func)
+        if name is None:
+            return None
+        if name.startswith("self."):
+            cls = _class_of_scope(module, scope)
+            if cls is not None:
+                parts = name.split(".")
+                if len(parts) == 2:  # self.method()
+                    mqual = f"{cls}.{parts[1]}"
+                    if mqual in module.functions:
+                        return (module, mqual)
+                elif len(parts) == 3:  # self.attr.method()
+                    key = (module.name, cls)
+                    if key not in attr_types:
+                        attr_types[key] = _attr_types(project, module, cls)
+                    tgt = attr_types[key].get(parts[1])
+                    if tgt is not None:
+                        tmod, tcls = tgt
+                        mqual = f"{tcls}.{parts[2]}"
+                        if mqual in tmod.functions:
+                            return (tmod, mqual)
+            return None
+        kind, cmod, detail = project.resolve(module, scope, name)
+        if kind == FUNC:
+            return (cmod, detail)
+        return None
+
+    def scan(module, qual, fn):
+        direct: set[str] = set()
+
+        def walk(node, held: tuple[str, ...]):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return
+            if isinstance(node, ast.With):
+                new_held = held
+                for item in node.items:
+                    lid = _lock_of_expr(project, module, qual, world,
+                                        item.context_expr)
+                    if lid is not None:
+                        direct.add(lid)
+                        for h in new_held:
+                            if h != lid and (h, lid) not in edges:
+                                edges[(h, lid)] = (module.rel, node.lineno,
+                                                   f"{module.name}::{qual}")
+                        new_held = new_held + (lid,)
+                for child in node.body:
+                    walk(child, new_held)
+                for item in node.items:
+                    walk(item.context_expr, held)
+                return
+            if isinstance(node, ast.Call):
+                # Condition wait hygiene
+                cal = dotted_of(node.func)
+                if cal and cal.split(".")[-1] in ("wait", "wait_for"):
+                    base = cal.rsplit(".", 1)[0]
+                    blid = _lock_of_expr(
+                        project, module, qual,
+                        world, ast.parse(base, mode="eval").body)
+                    if blid is not None and _raw_is_condition(world, base,
+                                                             module, qual):
+                        if not _in_while(fn, node):
+                            findings.append(Finding(
+                                "TPL302", module.rel, node.lineno,
+                                f"wait-outside-loop::{module.name}::{qual}",
+                                f"Condition.{cal.split('.')[-1]}() outside "
+                                f"a while predicate loop in {qual} — "
+                                f"spurious wakeups and notify races slip "
+                                f"a bare if/wait"))
+                tgt = callee_of(module, qual, node)
+                if tgt is not None:
+                    calls_held.append((module, qual, held, tgt[0], tgt[1],
+                                       node.lineno))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in fn.body:
+            walk(stmt, ())
+        acquires[(module.name, qual)] = direct
+
+    def _raw_is_condition(world, base, module, qual):
+        return any(lid in world.conditions
+                   for lid in _candidate_ids(module, qual, base))
+
+    def _in_while(fn, node):
+        # nearest statement ancestry by position: any While containing it
+        for anc in ast.walk(fn):
+            if isinstance(anc, ast.While) and anc.lineno <= node.lineno <= (
+                    anc.end_lineno or anc.lineno):
+                return True
+        return False
+
+    for module in project.modules.values():
+        for qual, fn in module.functions.items():
+            scan(module, qual, fn)
+
+    # Transitive acquisition sets (fixpoint over the call graph).
+    trans: dict[tuple[str, str], set[str]] = {
+        k: set(v) for k, v in acquires.items()}
+    call_edges: dict[tuple[str, str], set[tuple[str, str]]] = {}
+    for module, caller, held, cmod, cqual, lineno in calls_held:
+        call_edges.setdefault((module.name, caller), set()).add(
+            (cmod.name, cqual))
+    changed = True
+    while changed:
+        changed = False
+        for caller, callees in call_edges.items():
+            base = trans.setdefault(caller, set())
+            for c in callees:
+                extra = trans.get(c, set()) - base
+                if extra:
+                    base |= extra
+                    changed = True
+
+    # Cross-function edges: held locks at a call site order before every
+    # lock the callee may take.
+    for module, caller, held, cmod, cqual, lineno in calls_held:
+        if not held:
+            continue
+        for lid in trans.get((cmod.name, cqual), set()):
+            for h in held:
+                if h != lid and (h, lid) not in edges:
+                    edges[(h, lid)] = (
+                        module.rel, lineno,
+                        f"{module.name}::{caller} -> {cmod.name}::{cqual}")
+
+    # Cycle detection over the order graph.
+    graph: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    reported: set[tuple[str, ...]] = set()
+    for start in sorted(graph):
+        stack = [(start, (start,))]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    cyc = _canon_cycle(path)
+                    if cyc not in reported:
+                        reported.add(cyc)
+                        rel, lineno, where = edges[(node, start)]
+                        pretty = " -> ".join(path + (start,))
+                        findings.append(Finding(
+                            "TPL301", rel, lineno,
+                            "lock-cycle::" + "->".join(cyc),
+                            f"lock-order cycle {pretty} (edge observed at "
+                            f"{where}) — two paths take these locks in "
+                            f"opposite orders: potential deadlock"))
+                elif nxt not in path and len(path) < 6:
+                    stack.append((nxt, path + (nxt,)))
+    return findings
+
+
+def _canon_cycle(path: tuple[str, ...]) -> tuple[str, ...]:
+    i = path.index(min(path))
+    return path[i:] + path[:i]

@@ -1,0 +1,50 @@
+"""Pass: metrics-doc drift (TPM601) — every exposed metric family must
+appear in docs/monitoring.md.
+
+The round-8 guard (tools/check_metrics_doc.py), absorbed as an analysis
+pass so doc-drift failures come out of the same entry point and report
+format as everything else; the old CLI remains as a thin shim over
+these functions. Enumeration is live: operator families register in
+status.metrics.DEFAULT at import time, trainer gauges are the
+telemetry.collector.TRAINER_GAUGES dict (created lazily by the
+collector, so the registry alone would miss them).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tools.analysis.core import REPO, Finding
+
+NAME = "metrics-doc"
+RULES = ("TPM601",)
+
+DEFAULT_DOC = REPO / "docs" / "monitoring.md"
+
+
+def exposed_metric_names() -> list[str]:
+    if str(REPO) not in sys.path:
+        sys.path.insert(0, str(REPO))
+    from tf_operator_tpu.status import metrics
+    from tf_operator_tpu.telemetry import collector
+
+    return sorted(set(metrics.DEFAULT.names()) | set(collector.TRAINER_GAUGES))
+
+
+def missing_from_doc(doc_text: str) -> list[str]:
+    return [n for n in exposed_metric_names() if n not in doc_text]
+
+
+def run(project) -> list[Finding]:
+    try:
+        doc = DEFAULT_DOC.read_text()
+    except OSError as e:
+        return [Finding("TPM601", "docs/monitoring.md", 1,
+                        "metrics-doc::unreadable",
+                        f"cannot read docs/monitoring.md: {e}")]
+    return [
+        Finding("TPM601", "docs/monitoring.md", 1, f"metric::{name}",
+                f"metric family {name} is exposed but not documented in "
+                f"docs/monitoring.md")
+        for name in missing_from_doc(doc)
+    ]

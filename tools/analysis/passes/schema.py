@@ -1,0 +1,261 @@
+"""Pass: schema/compat drift (TPS4xx) — types.py, compat.py, the CRD,
+and validation.py must agree on every spec field.
+
+The PR-7 bug class, which has now bitten twice: `job_to_dict` silently
+dropped `schedulingPolicy.priorityClass`, so a job round-tripped through
+the API lost its priority — and nothing failed until the fleet scheduler
+ran everything at default priority. The wire contract lives in FOUR
+places (dataclass fields, parse, emit, CRD schema) and only convention
+kept them aligned. This pass walks the spec dataclass tree from
+`TrainJobSpec` and checks, per field:
+
+  TPS401 field-not-parsed   wire name never read by job_from_dict/helpers
+  TPS402 field-not-emitted  wire name never written by job_to_dict
+                            (the exact priorityClass failure)
+  TPS403 field-missing-from-crd  structural CRD schema lacks the
+                            property (the fake apiserver PRUNES unknown
+                            fields, so this drift silently eats data on
+                            the wire) — subtrees under
+                            x-kubernetes-preserve-unknown-fields exempt
+  TPS404 crd-enum-drift     a CRD `enum:` list disagrees with the str
+                            Enum in types.py it mirrors
+  TPS405 stale-validation-reference  a dotted wire path quoted in a
+                            validation message names a field that no
+                            longer exists
+
+Wire names derive from snake_case -> camelCase with an explicit override
+table for the exceptions (`scheduling` -> `schedulingPolicy`). Analysis
+is source-text based (ast + yaml), so the pass also powers the
+regression tests: feed it a compat.py with a line deleted and it must
+fail.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.core import REPO, Finding
+
+NAME = "schema-drift"
+RULES = ("TPS401", "TPS402", "TPS403", "TPS404", "TPS405")
+
+TYPES = REPO / "tf_operator_tpu" / "api" / "types.py"
+COMPAT = REPO / "tf_operator_tpu" / "api" / "compat.py"
+VALIDATION = REPO / "tf_operator_tpu" / "api" / "validation.py"
+CRD = REPO / "manifests" / "trainjob-crd.yaml"
+
+ROOT_CLASS = "TrainJobSpec"
+
+# snake field -> wire name, where plain snake->camel is not the rule.
+WIRE_OVERRIDES = {
+    ("RunPolicy", "scheduling"): "schedulingPolicy",
+}
+
+# Dataclasses that are NOT wire contract: server-owned metadata and the
+# status block, whose wire form lives in core/k8s.py (status latches are
+# read-modify-write server state, not manifest round-trip).
+SKIP_CLASSES = {"ObjectMeta", "JobStatus", "JobCondition", "ReplicaStatus",
+                "OwnerReference", "TrainJob"}
+
+
+def snake_to_camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(p.capitalize() for p in rest)
+
+
+def _dataclasses(tree: ast.Module) -> dict[str, list[tuple[str, str]]]:
+    """class -> [(field, annotation source)] for every @dataclass."""
+    out: dict[str, list[tuple[str, str]]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        deco = {d.id if isinstance(d, ast.Name) else getattr(d, "attr", "")
+                for d in node.decorator_list}
+        if "dataclass" not in deco:
+            continue
+        fields = []
+        for stmt in node.body:
+            if (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                    and not stmt.target.id.startswith("_")
+                    and not stmt.target.id.isupper()):
+                fields.append((stmt.target.id, ast.unparse(stmt.annotation)))
+        out[node.name] = fields
+    return out
+
+
+def _enums(tree: ast.Module) -> dict[str, set[str]]:
+    """str-Enum class -> member values."""
+    out: dict[str, set[str]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        bases = {ast.unparse(b) for b in node.bases}
+        if not bases & {"enum.Enum", "Enum"}:
+            continue
+        values = {
+            stmt.value.value
+            for stmt in node.body
+            if isinstance(stmt, ast.Assign)
+            and isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, str)
+        }
+        if values:
+            out[node.name] = values
+    return out
+
+
+def _strings_in(node: ast.AST) -> set[str]:
+    return {n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}
+
+
+def _compat_string_sets(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """(parse-side strings, emit-side strings): every string constant in
+    job_to_dict is emit vocabulary; everything else in the module is
+    parse vocabulary."""
+    parse: set[str] = set()
+    emit: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "job_to_dict":
+            emit |= _strings_in(node)
+        else:
+            parse |= _strings_in(node)
+    return parse, emit
+
+
+def _crd_schema(crd: dict) -> dict:
+    version = crd["spec"]["versions"][0]
+    return version["schema"]["openAPIV3Schema"]
+
+
+def _child_schema(schema: dict | None, wire: str) -> dict | None:
+    """Navigate one property, unwrapping additionalProperties/items maps
+    and stopping (returning a preserve marker) at preserve-unknown
+    subtrees."""
+    if schema is None:
+        return None
+    if schema.get("x-kubernetes-preserve-unknown-fields"):
+        return {"x-kubernetes-preserve-unknown-fields": True}
+    sub = (schema.get("properties") or {}).get(wire)
+    if sub is None:
+        return None
+    while True:
+        if isinstance(sub.get("additionalProperties"), dict):
+            sub = sub["additionalProperties"]
+        elif isinstance(sub.get("items"), dict):
+            sub = sub["items"]
+        else:
+            return sub
+
+
+_DOTTED = re.compile(r"^[a-z][a-zA-Z0-9]*(\.[a-zA-Z0-9{}!r']+)+$")
+
+
+def analyze_schema(types_src: str, compat_src: str, validation_src: str,
+                   crd_text: str) -> list[Finding]:
+    import yaml
+
+    findings: list[Finding] = []
+    types_tree = ast.parse(types_src)
+    dcs = _dataclasses(types_tree)
+    enums = _enums(types_tree)
+    parse_strings, emit_strings = _compat_string_sets(ast.parse(compat_src))
+    crd_root = _crd_schema(yaml.safe_load(crd_text))
+    spec_schema = (crd_root.get("properties") or {}).get("spec")
+
+    known_wire: set[str] = {"spec", "metadata", "status"}
+    rel_types = "tf_operator_tpu/api/types.py"
+
+    # Walk the spec dataclass tree. Each visit carries the CRD schema node
+    # for the class (None once we've passed through a field the CRD does
+    # not model structurally).
+    seen: set[str] = set()
+    stack: list[tuple[str, dict | None]] = [(ROOT_CLASS, spec_schema)]
+    while stack:
+        cls, schema = stack.pop()
+        if cls in seen or cls in SKIP_CLASSES or cls not in dcs:
+            continue
+        seen.add(cls)
+        preserve = bool(schema and schema.get(
+            "x-kubernetes-preserve-unknown-fields"))
+        for field, ann in dcs[cls]:
+            wire = WIRE_OVERRIDES.get((cls, field), snake_to_camel(field))
+            known_wire.add(wire)
+            key = f"{cls}.{field}"
+            line = _field_line(types_src, cls, field)
+            if wire not in parse_strings:
+                findings.append(Finding(
+                    "TPS401", rel_types, line, f"schema-parse::{key}",
+                    f"{key}: wire name {wire!r} never read by "
+                    f"job_from_dict — manifests carrying it are silently "
+                    f"ignored"))
+            if wire not in emit_strings:
+                findings.append(Finding(
+                    "TPS402", rel_types, line, f"schema-emit::{key}",
+                    f"{key}: wire name {wire!r} never written by "
+                    f"job_to_dict — the field is DROPPED on round-trip "
+                    f"(the priorityClass bug class)"))
+            child = _child_schema(schema, wire) if schema else None
+            if schema is not None and not preserve and child is None:
+                findings.append(Finding(
+                    "TPS403", rel_types, line, f"schema-crd::{key}",
+                    f"{key}: wire name {wire!r} missing from the CRD "
+                    f"schema — the apiserver PRUNES unknown fields, so "
+                    f"this field dies on the wire"))
+            # enum drift: field typed by a str Enum with a CRD enum list
+            enum_cls = next((e for e in enums if e in ann), None)
+            if enum_cls and child and isinstance(child.get("enum"), list):
+                crd_vals = set(child["enum"])
+                # yaml parses a bare `None` enum entry as null
+                crd_vals = {("None" if v is None else v) for v in crd_vals}
+                if crd_vals != enums[enum_cls]:
+                    findings.append(Finding(
+                        "TPS404", rel_types, line, f"schema-enum::{key}",
+                        f"{key}: CRD enum {sorted(crd_vals)} != "
+                        f"types.{enum_cls} values "
+                        f"{sorted(enums[enum_cls])}"))
+            # recurse into child dataclasses named in the annotation
+            for child_cls in dcs:
+                if child_cls != cls and re.search(
+                        rf"\b{child_cls}\b", ann):
+                    stack.append((child_cls, child))
+
+    # Stale dotted wire paths quoted in validation messages.
+    val_tree = ast.parse(validation_src)
+    for s in sorted(_strings_in(val_tree)):
+        parts_of_s = s.split()
+        token = parts_of_s[0] if parts_of_s else ""
+        if not _DOTTED.match(token):
+            continue
+        for part in token.split("."):
+            if re.search(r"[{}'!]", part):
+                continue  # f-string placeholder or quoted fragment
+            if not part or not part[0].isalpha():
+                continue
+            if part not in known_wire:
+                findings.append(Finding(
+                    "TPS405", "tf_operator_tpu/api/validation.py", 1,
+                    f"schema-staleref::{token}::{part}",
+                    f"validation message quotes wire path {token!r} but "
+                    f"{part!r} names no known spec field"))
+    return findings
+
+
+def _field_line(types_src: str, cls: str, field: str) -> int:
+    in_cls = False
+    for i, line in enumerate(types_src.splitlines(), start=1):
+        if line.startswith(f"class {cls}"):
+            in_cls = True
+        elif in_cls and line.startswith("class "):
+            return 1
+        elif in_cls and re.match(rf"\s+{field}\s*:", line):
+            return i
+    return 1
+
+
+def run(project) -> list[Finding]:
+    return analyze_schema(
+        TYPES.read_text(), COMPAT.read_text(), VALIDATION.read_text(),
+        CRD.read_text())

@@ -1,0 +1,160 @@
+"""Pass: thread-discipline (TPT201) — transfer/producer threads must
+never dispatch an XLA program.
+
+The PR-2 invariant, promoted from one monkeypatch-spy test to a
+repo-wide static guarantee: two threads dispatching programs onto a
+multi-device mesh interleave their collectives per-device and DEADLOCK
+(reproduced on the 8-dev CPU mesh; data/staging.py's module docstring is
+the incident report). A transfer thread may call `jax.device_put` — a
+raw copy, no program — but never `jnp.*`, `jax.lax.*`, or a jitted
+callable.
+
+Mechanics: every `threading.Thread(target=f)` in the configured data
+modules roots a reachability walk over the project call graph (nested
+functions, same-module calls, cross-module imports and __init__
+re-exports all resolve; lambdas passed as arguments — `jax.tree.map(
+lambda x: ...)` — are walked as caller-thread code). Any reachable call
+whose resolved external name is a dispatching API is a finding carrying
+the full call chain. Calls through untypeable objects (`obj.method()`)
+are ignored — conservative by design; the invariant proven is "no
+STATICALLY VISIBLE dispatch", which is exactly what a reviewer can't
+check by eye across modules.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.core import EXTERNAL, FUNC, Finding, Project, dotted_of, function_body
+
+NAME = "thread-discipline"
+RULES = ("TPT201",)
+
+# Modules whose Thread targets are transfer/producer threads under the
+# dispatch ban (the staging lanes and the prefetch producer).
+ROOT_MODULES = ("tf_operator_tpu.data.staging", "tf_operator_tpu.data.prefetch")
+
+# Dispatching APIs: anything that builds/runs an XLA program.
+DISPATCH_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.scipy.", "jax.nn.")
+DISPATCH_EXACT = {
+    "jax.jit", "jax.pmap", "jax.grad", "jax.value_and_grad",
+    "jax.eval_shape", "jax.vmap", "jax.shard_map",
+}
+# Transfer-side jax APIs that are explicitly SAFE from a non-dispatching
+# thread (device_put is the one the whole engine is built on;
+# make_array_from_process_local_data is the multi-process put the
+# prefetcher has always issued from its producer).
+SAFE_EXACT = {
+    "jax.device_put", "jax.block_until_ready",
+    "jax.make_array_from_process_local_data",
+}
+
+
+def _is_dispatch(name: str) -> bool:
+    if name in SAFE_EXACT:
+        return False
+    return name.startswith(DISPATCH_PREFIXES) or name in DISPATCH_EXACT
+
+
+def _jitted_names(module) -> set[str]:
+    """Names assigned from jax.jit(...) anywhere in the module — calling
+    one IS dispatching a program."""
+    out: set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = dotted_of(node.value.func)
+            if callee and callee.split(".")[-1] in ("jit", "pmap"):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _thread_roots(project: Project) -> list[tuple]:
+    """(module, target_qualname) for every Thread(target=...) in the root
+    modules."""
+    roots = []
+    for mname in ROOT_MODULES:
+        module = project.modules.get(mname)
+        if module is None:
+            continue
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_of(node.func)
+            if callee is None:
+                continue
+            kind, _, detail = project.resolve(module, "", callee)
+            if not (kind == EXTERNAL and detail == "threading.Thread"):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                target = dotted_of(kw.value)
+                if target is None:
+                    continue
+                scope = _scope_of(module, node)
+                tkind, tmod, tqual = project.resolve(module, scope, target)
+                if tkind == FUNC:
+                    roots.append((tmod, tqual))
+    return roots
+
+
+def _scope_of(module, node: ast.AST) -> str:
+    from tools.analysis.core import enclosing_function
+
+    return enclosing_function(module, node) or ""
+
+
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    jitted_cache: dict[str, set[str]] = {}
+    seen: set[tuple[str, str]] = set()
+    # BFS over (module, qualname) with the chain that got us there.
+    queue: list[tuple] = [(m, q, f"{m.name.split('.')[-1]}::{q}")
+                          for m, q in _thread_roots(project)]
+    while queue:
+        module, qual, chain = queue.pop(0)
+        if (module.name, qual) in seen:
+            continue
+        seen.add((module.name, qual))
+        fn = module.functions.get(qual)
+        if fn is None:
+            continue
+        jitted = jitted_cache.setdefault(module.name, _jitted_names(module))
+        for node in function_body(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callees = []
+            name = dotted_of(node.func)
+            if name is not None:
+                callees.append(name)
+            # callables passed as arguments run on this thread too
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                aname = dotted_of(arg)
+                if aname is not None:
+                    callees.append(aname)
+            for cname in callees:
+                if cname.split(".")[0] in jitted:
+                    findings.append(Finding(
+                        "TPT201", module.rel, node.lineno,
+                        f"thread-dispatch::{chain}->{cname}",
+                        f"thread-reachable call to jitted callable "
+                        f"{cname!r} via {chain} — transfer/producer "
+                        f"threads must never dispatch XLA programs"))
+                    continue
+                kind, cmod, detail = project.resolve(
+                    module, qual, cname)
+                if kind == EXTERNAL:
+                    if _is_dispatch(detail):
+                        findings.append(Finding(
+                            "TPT201", module.rel, node.lineno,
+                            f"thread-dispatch::{chain}->{detail}",
+                            f"dispatching API {detail!r} reachable from "
+                            f"thread entry via {chain} — transfer/producer "
+                            f"threads must only call device_put"))
+                elif kind == FUNC and (cmod.name, detail) not in seen:
+                    queue.append(
+                        (cmod, detail,
+                         f"{chain}->{cmod.name.split('.')[-1]}::{detail}"))
+    return findings

@@ -1,20 +1,11 @@
 #!/usr/bin/env python3
 """CI guard: every exposed metric name must appear in docs/monitoring.md.
 
-Round 8 found the doc documenting `tpujob_operator_sync_seconds` while the
-code exposed `tpujob_operator_reconcile_duration_seconds` — name drift a
-reader only discovers when their PromQL returns nothing. This check makes
-that class of drift a CI failure:
-
-  * operator metrics: every family registered in status.metrics.DEFAULT
-    (registered at import time, so importing the module is enumeration)
-  * trainer gauges: telemetry.collector.TRAINER_GAUGES (created lazily by
-    the collector, so the registry alone would miss them)
-
-A name "appears" when the doc contains it verbatim (typically as a table
-row). Run from CI's py-lint stage (ci/pipeline.yaml) and directly:
-
-  python tools/check_metrics_doc.py [--doc docs/monitoring.md]
+Round 13: the logic moved into tpulint (tools/analysis/passes/
+metrics_doc.py — `python -m tools.analysis --pass metrics-doc`) so
+doc-drift failures share the analyzer's entry point and report format;
+this CLI remains as a thin shim with the original flags and output for
+scripts and muscle memory.
 """
 
 from __future__ import annotations
@@ -24,19 +15,22 @@ import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
 DEFAULT_DOC = os.path.join(REPO, "docs", "monitoring.md")
 
 
 def exposed_metric_names() -> list[str]:
-    sys.path.insert(0, REPO)
-    from tf_operator_tpu.status import metrics
-    from tf_operator_tpu.telemetry import collector
+    from tools.analysis.passes import metrics_doc
 
-    return sorted(set(metrics.DEFAULT.names()) | set(collector.TRAINER_GAUGES))
+    return metrics_doc.exposed_metric_names()
 
 
 def missing_from_doc(doc_text: str) -> list[str]:
-    return [n for n in exposed_metric_names() if n not in doc_text]
+    from tools.analysis.passes import metrics_doc
+
+    return metrics_doc.missing_from_doc(doc_text)
 
 
 def main(argv: list[str] | None = None) -> int:
