@@ -198,6 +198,7 @@ class TestRecoveryApi:
             "heartbeatTimeoutSeconds": 45.0,
             "pendingTimeoutSeconds": 120.0,
             "progressThresholdSteps": 7,
+            "elastic": {"minReplicas": None, "reshapeOnRecovery": False},
         }
         back = compat.job_from_dict(d)
         assert back.spec.run_policy.recovery == job.spec.run_policy.recovery

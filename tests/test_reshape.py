@@ -1,0 +1,1043 @@
+"""Topology-portable checkpoints + elastic gang recovery (round 14).
+
+Four layers under test:
+
+  * checkpoint sharding manifests + reshard-on-restore
+    (models/checkpoint.py, models/train._try_resume): a trainstate saved
+    on one gang shape restores — bit-equal, digest-proven — onto another;
+    foreign shapes without --allow-reshape degrade like corrupt
+    checkpoints, never crash.
+  * the reshape arithmetic (gang/elastic.py) and the allocator's
+    capacity dial (gang/podgroup.py set_capacity/upgrade/held_offline).
+  * the controller's elastic admission (recovery.elastic): degraded
+    re-admission with a GangReshaped condition instead of pinning
+    Pending, scale-back-up on capacity return, restart tallies NEVER
+    touched by a reshape; the fleet scheduler's degraded decide/upgrade.
+  * chaos `capacity:slices=N` — the deterministic slice-inventory dial
+    the degraded-capacity e2es ride.
+
+The slow capstones kill a REAL 2-process jax.distributed gang under a
+chaos-shrunk inventory and prove reshaped resume (2 -> 1 workers,
+restored state digest-equal to the save) and genuine scale-back-up
+(1 -> 2 workers when capacity returns).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tf_operator_tpu import chaos as chaos_lib
+from tf_operator_tpu.api import compat, defaults, validation
+from tf_operator_tpu.api.types import (
+    ContainerSpec,
+    JobConditionType,
+    MeshSpec,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUSpec,
+    TrainJob,
+    TrainJobSpec,
+    has_condition,
+    is_succeeded,
+)
+from tf_operator_tpu.core.cluster import InMemoryCluster, PodPhase
+from tf_operator_tpu.core.trainjob_controller import TrainJobController
+from tf_operator_tpu.gang import elastic as elastic_lib
+from tf_operator_tpu.gang.podgroup import SliceAllocator
+from tf_operator_tpu.status import metrics as status_metrics
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+PY = sys.executable
+DONE = (JobConditionType.SUCCEEDED, JobConditionType.FAILED)
+STEPS = 24
+
+ONE_DEV = {
+    "PYTHONPATH": REPO_ROOT,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+
+def make_elastic_job(name: str, workers: int = 2, topology: str = "2x1",
+                     mesh_axes: dict | None = None, elastic: bool = True,
+                     min_replicas: int | None = None,
+                     cmd: list[str] | None = None) -> TrainJob:
+    tmpl = PodTemplateSpec(containers=[
+        ContainerSpec(name="tensorflow", image="local",
+                      command=list(cmd) if cmd else [])
+    ])
+    job = TrainJob(metadata=ObjectMeta(name=name), spec=TrainJobSpec(
+        replica_specs={ReplicaType.WORKER: ReplicaSpec(
+            replicas=workers, restart_policy=RestartPolicy.EXIT_CODE,
+            template=tmpl)},
+        tpu=TPUSpec(topology=topology),
+        mesh=MeshSpec(axes=dict(mesh_axes or {"dp": workers})),
+    ))
+    job.spec.run_policy.recovery.policy = "gang"
+    job.spec.run_policy.recovery.elastic.reshape_on_recovery = elastic
+    job.spec.run_policy.recovery.elastic.min_replicas = min_replicas
+    return defaults.set_defaults(job)
+
+
+def drive(cluster, controller, key: str, pred, timeout: float = 10.0):
+    """Re-sync `key` until pred() is truthy (bounded); returns the job."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        controller.enqueue(key)
+        controller.run_until_idle(10.0)
+        ns, name = key.split("/")
+        job = cluster.get_job(ns, name)
+        if pred(job):
+            return job
+        time.sleep(0.02)
+    raise AssertionError(f"{key}: condition not reached within {timeout}s")
+
+
+def reshard_value(direction: str) -> float:
+    return status_metrics.restore_reshard_total.labels(
+        namespace="default", direction=direction).value()
+
+
+# ------------------------------------------------------------- API surface
+
+
+class TestElasticApi:
+    def test_defaults_off(self):
+        job = make_elastic_job("d", elastic=False)
+        e = job.spec.run_policy.recovery.elastic
+        assert e.reshape_on_recovery is False and e.min_replicas is None
+        assert validation.validate_job(job) == []
+
+    def test_compat_roundtrip(self):
+        job = make_elastic_job("rt", min_replicas=1)
+        d = compat.job_to_dict(job)
+        assert d["spec"]["runPolicy"]["recovery"]["elastic"] == {
+            "minReplicas": 1, "reshapeOnRecovery": True,
+        }
+        back = compat.job_from_dict(d)
+        assert (back.spec.run_policy.recovery.elastic
+                == job.spec.run_policy.recovery.elastic)
+
+    def test_explicit_null_elastic_tolerated(self):
+        d = compat.job_to_dict(make_elastic_job("nul"))
+        d["spec"]["runPolicy"]["recovery"]["elastic"] = None
+        job = compat.job_from_dict(d)
+        assert job.spec.run_policy.recovery.elastic.reshape_on_recovery is False
+
+    @pytest.mark.parametrize("mutate, needle", [
+        (lambda j: setattr(j.spec.run_policy.recovery.elastic,
+                           "min_replicas", 0),
+         "minReplicas must be >= 1"),
+        (lambda j: setattr(j.spec.run_policy.recovery.elastic,
+                           "min_replicas", 5),
+         "exceeds Worker replicas"),
+        (lambda j: setattr(j.spec.run_policy.recovery, "policy", "pod"),
+         "requires runPolicy.recovery.policy 'gang'"),
+    ])
+    def test_validation_matrix(self, mutate, needle):
+        job = make_elastic_job("v")
+        mutate(job)
+        problems = validation.validate_job(job)
+        assert any(needle in p for p in problems), problems
+
+    def test_zero_min_replicas_422s_at_the_fake_apiserver(self):
+        """The CRD declares elastic.minReplicas with minimum: 1 — a 0
+        must 422 at the structural fake apiserver like a real one."""
+        import urllib.error
+        import urllib.request
+
+        from tf_operator_tpu.core.k8s import job_to_k8s
+        from tf_operator_tpu.testing.fake_apiserver import FakeApiServer
+
+        job = make_elastic_job("zmr")
+        job.spec.run_policy.recovery.elastic.min_replicas = 0
+        with FakeApiServer() as server:
+            req = urllib.request.Request(
+                f"{server.url}/apis/{TrainJob.API_VERSION}"
+                f"/namespaces/default/{TrainJob.PLURAL}",
+                data=json.dumps(job_to_k8s(job)).encode(), method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 422
+
+    def test_elastic_survives_the_wire(self):
+        """The fake apiserver PRUNES unknown fields: the elastic block
+        coming back intact proves the CRD schema actually carries it (a
+        schema gap would silently eat the knob — the drift class tpulint
+        TPS403 gates)."""
+        import urllib.request
+
+        from tf_operator_tpu.core.k8s import job_to_k8s
+        from tf_operator_tpu.testing.fake_apiserver import FakeApiServer
+
+        job = make_elastic_job("wire", min_replicas=1)
+        with FakeApiServer() as server:
+            url = (f"{server.url}/apis/{TrainJob.API_VERSION}"
+                   f"/namespaces/default/{TrainJob.PLURAL}")
+            req = urllib.request.Request(
+                url, data=json.dumps(job_to_k8s(job)).encode(),
+                method="POST", headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req)
+            got = json.load(urllib.request.urlopen(f"{url}/wire"))
+            rec = got["spec"]["runPolicy"]["recovery"]
+            assert rec["elastic"] == {"minReplicas": 1,
+                                      "reshapeOnRecovery": True}
+
+    def test_status_wire_roundtrip(self):
+        from tf_operator_tpu.core.k8s import (job_status_from_dict,
+                                              job_status_to_dict)
+
+        job = make_elastic_job("w")
+        job.status.reshaped_replicas = 1
+        job.status.reshaped_topology = "v5e-1"
+        back = job_status_from_dict(job_status_to_dict(job.status))
+        assert back.reshaped_replicas == 1
+        assert back.reshaped_topology == "v5e-1"
+        # Unset round-trips as unset, not 0/"".
+        job.status.reshaped_replicas = None
+        job.status.reshaped_topology = ""
+        back = job_status_from_dict(job_status_to_dict(job.status))
+        assert back.reshaped_replicas is None
+        assert back.reshaped_topology == ""
+
+
+# --------------------------------------------------------- reshape arithmetic
+
+
+class TestElasticMath:
+    def test_scaled_worker_count(self):
+        assert elastic_lib.scaled_worker_count(2, 2, 1) == 1
+        assert elastic_lib.scaled_worker_count(4, 8, 4) == 2
+        assert elastic_lib.scaled_worker_count(2, 2, 2) == 2  # no shrink
+        assert elastic_lib.scaled_worker_count(2, 4, 3) is None  # inexact
+        assert elastic_lib.scaled_worker_count(2, 2, 1, min_replicas=2) is None
+        assert elastic_lib.scaled_worker_count(0, 2, 1) is None
+
+    def test_scaled_mesh_axes(self):
+        assert elastic_lib.scaled_mesh_axes({"dp": 2}, 2, 1) == {"dp": 1}
+        assert elastic_lib.scaled_mesh_axes({"dp": 4, "tp": 2}, 4, 2) \
+            == {"dp": 2, "tp": 2}
+        # fsdp absorbs when dp cannot.
+        assert elastic_lib.scaled_mesh_axes({"dp": 1, "fsdp": 4}, 4, 2) \
+            == {"dp": 1, "fsdp": 2}
+        # tp alone cannot absorb a replica change.
+        assert elastic_lib.scaled_mesh_axes({"tp": 4}, 4, 2) is None
+        assert elastic_lib.scaled_mesh_axes({}, 2, 1) == {}
+
+    def test_degraded_plan(self):
+        plan = elastic_lib.degraded_plan("2x1", 2, "v5e-1", {"dp": 2})
+        assert plan == (1, {"dp": 1})
+        assert elastic_lib.degraded_plan("2x1", 2, "v5e-1", {"tp": 2}) is None
+        assert elastic_lib.degraded_plan(
+            "2x1", 2, "v5e-1", {"dp": 2}, min_replicas=2) is None
+
+
+# ------------------------------------------------------- allocator capacity
+
+
+class TestAllocatorCapacity:
+    def test_set_capacity_offline_and_restore(self):
+        alloc = SliceAllocator.of("1x1", "2x1")
+        assert alloc.admit("j", "2x1") == "slice-1"
+        affected = alloc.set_capacity(1)
+        assert affected == ["j"]
+        assert alloc.held_offline("j")
+        # Held claim survives; fresh admission of the class fails.
+        assert alloc.admit("other", "2x1") is None
+        assert alloc.free_by_class() == {("v5e", 1): 1}
+        alloc.set_capacity(2)
+        assert not alloc.held_offline("j")
+
+    def test_upgrade_swaps_classes(self):
+        alloc = SliceAllocator.of("1x1", "2x1")
+        assert alloc.upgrade("j", "v5e-1") == "slice-0"
+        # Idempotent on the held class; swap releases the old slice.
+        assert alloc.upgrade("j", "v5e-1") == "slice-0"
+        assert alloc.upgrade("j", "2x1") == "slice-1"
+        assert alloc.free_by_class() == {("v5e", 1): 1}
+        # No free slice of the class: keep what we hold.
+        alloc2 = SliceAllocator.of("2x1")
+        assert alloc2.admit("a", "2x1") == "slice-0"
+        assert alloc2.upgrade("b", "2x1") is None
+
+    def test_free_classes_below(self):
+        alloc = SliceAllocator.of("1x1", "2x1", "4x1", "1x1")
+        assert alloc.free_classes_below("4x1") == ["v5e-2", "v5e-1"]
+        alloc.admit("j", "2x1")
+        assert alloc.free_classes_below("4x1") == ["v5e-1"]
+        # Offline slices are not candidates.
+        alloc.set_capacity(0)
+        assert alloc.free_classes_below("4x1") == []
+
+
+# ------------------------------------------------------------ chaos grammar
+
+
+class TestChaosCapacityGrammar:
+    def test_parse(self):
+        (d,) = chaos_lib.parse_chaos("capacity:slices=1,at_step=8,job=x")
+        assert d.kind == "capacity"
+        assert d.params == {"slices": 1, "at_step": 8, "job": "x"}
+
+    @pytest.mark.parametrize("bad", [
+        "capacity:",                       # slices required
+        "capacity:slices=-1",              # negative
+        "capacity:slices=1,at_step=5",     # at_step needs job
+        "capacity:slices=1,nope=2",        # unknown key
+    ])
+    def test_strict_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            chaos_lib.parse_chaos(bad)
+
+    def test_capacity_directives_feed(self, monkeypatch):
+        monkeypatch.setenv(
+            chaos_lib.ENV_CHAOS,
+            "capacity:slices=1;kill:step=3;capacity:slices=2,at_step=9,job=x")
+        ds = chaos_lib.capacity_directives()
+        assert [d.params.get("slices") for d in ds] == [1, 2]
+
+    def test_stepless_directive_applies_at_construction(self, monkeypatch,
+                                                        tmp_path):
+        monkeypatch.setenv(chaos_lib.ENV_CHAOS, "capacity:slices=1")
+        # With a persistent one-shot dir armed: a step-less dial is
+        # inventory STATE, so a restarted operator (fresh allocator) must
+        # RE-apply it — a failover silently restoring lost capacity would
+        # scale reshaped gangs back up onto nothing.
+        monkeypatch.setenv(chaos_lib.ENV_CHAOS_STATE, str(tmp_path / "cs"))
+        alloc = SliceAllocator.of("1x1", "2x1")
+        TrainJobController(InMemoryCluster(), enable_gang=True,
+                           slice_allocator=alloc)
+        assert alloc.free_by_class() == {("v5e", 1): 1}
+        alloc2 = SliceAllocator.of("1x1", "2x1")  # "failover": rebuilt
+        TrainJobController(InMemoryCluster(), enable_gang=True,
+                           slice_allocator=alloc2)
+        assert alloc2.free_by_class() == {("v5e", 1): 1}
+
+
+# -------------------------------------------------------- controller reshape
+
+
+class StubHeartbeat:
+    def __init__(self):
+        self.hb: dict | None = None
+
+    def job_heartbeat(self, ns, name):
+        return self.hb
+
+
+@pytest.fixture
+def env():
+    cluster = InMemoryCluster()
+    alloc = SliceAllocator.of("1x1", "2x1")
+    hb = StubHeartbeat()
+    controller = TrainJobController(cluster, enable_gang=True,
+                                    slice_allocator=alloc,
+                                    heartbeat_source=hb)
+    return cluster, controller, alloc, hb
+
+
+def pod_env(pod, name):
+    return pod.spec.containers[0].env_dict().get(name)
+
+
+def fail_worker(cluster, job_name, index, code=137):
+    for p in cluster.list_pods("default"):
+        if p.name == f"{job_name}-worker-{index}":
+            cluster.set_pod_phase("default", p.name, PodPhase.FAILED,
+                                  exit_code=code)
+
+
+class TestControllerReshape:
+    def test_roll_into_lost_capacity_reshapes(self, env):
+        """The acceptance flow at unit scale: gang admitted at full size,
+        its slice goes offline, a retryable kill rolls the gang — the
+        re-admission lands on the surviving smaller slice at 1 worker
+        with GangReshaped, scaled mesh, allow-reshape env, and EXACTLY
+        the roll's one restart on the tally."""
+        cluster, controller, alloc, _ = env
+        shrink0 = reshard_value("shrink")
+        cluster.create_job(make_elastic_job("j1"))
+        job = drive(cluster, controller, "default/j1",
+                    lambda j: len(cluster.list_pods("default")) == 2)
+        assert job.metadata.annotations["tpujob.dev/slice"] == "slice-1"
+        for p in cluster.list_pods("default"):
+            assert pod_env(p, "TPUJOB_MESH") == '{"dp": 2}'
+            assert pod_env(p, "TPUJOB_ALLOW_RESHAPE") == "1"
+
+        alloc.set_capacity(1)  # the held 2-chip slice is gone
+        fail_worker(cluster, "j1", 1)
+        job = drive(cluster, controller, "default/j1",
+                    lambda j: j.status.reshaped_replicas == 1
+                    and len(cluster.list_pods("default")) == 1)
+        assert job.status.reshaped_topology == "v5e-1"
+        assert job.metadata.annotations["tpujob.dev/slice"] == "slice-0"
+        (pod,) = cluster.list_pods("default")
+        assert pod.name == "j1-worker-0"
+        assert pod_env(pod, "TPUJOB_MESH") == '{"dp": 1}'
+        assert pod_env(pod, "TPUJOB_ALLOW_RESHAPE") == "1"
+        assert has_condition(job.status, JobConditionType.GANG_RESHAPED)
+        reasons = [e.reason for e in cluster.events_for(
+            "TrainJob", "default", "j1")]
+        assert "SliceLost" in reasons and "GangReshaped" in reasons
+        # One roll, zero reshape inflation.
+        assert job.status.gang_restarts == 1
+        assert job.status.consecutive_restarts == 1
+        assert reshard_value("shrink") == shrink0 + 1
+
+    def test_scale_back_up_when_capacity_returns(self, env):
+        cluster, controller, alloc, _ = env
+        grow0 = reshard_value("grow")
+        alloc.set_capacity(1)  # only the 1-chip slice exists at submit
+        cluster.create_job(make_elastic_job("j2"))
+        job = drive(cluster, controller, "default/j2",
+                    lambda j: j.status.reshaped_replicas == 1
+                    and len(cluster.list_pods("default")) == 1)
+        restarts_before = job.status.gang_restarts
+
+        alloc.set_capacity(2)
+        job = drive(cluster, controller, "default/j2",
+                    lambda j: j.status.reshaped_replicas is None
+                    and len(cluster.list_pods("default")) == 2)
+        assert job.status.reshaped_topology == ""
+        assert job.metadata.annotations["tpujob.dev/slice"] == "slice-1"
+        for p in cluster.list_pods("default"):
+            assert pod_env(p, "TPUJOB_MESH") == '{"dp": 2}'
+        cond = [c for c in job.status.conditions
+                if c.type == JobConditionType.GANG_RESHAPED][0]
+        assert cond.status is False and cond.reason == "GangRestored"
+        assert any(e.reason == "GangRestored" for e in cluster.events_for(
+            "TrainJob", "default", "j2"))
+        assert reshard_value("grow") == grow0 + 1
+        # Scaling back up is a TopologyChanged roll, never a counted one.
+        assert job.status.gang_restarts == restarts_before
+        # The freed small slice is available again.
+        assert alloc.free_by_class().get(("v5e", 1)) == 1
+
+    def test_live_gang_keeps_offline_claim(self, env):
+        """A LIVE full-size gang whose slice went offline keeps its
+        claim — it is NOT silently migrated onto a free online
+        same-class slice its pods don't occupy (the claim moves only
+        once the gang drains)."""
+        cluster = InMemoryCluster()
+        alloc = SliceAllocator.of("2x1", "2x1")
+        controller = TrainJobController(cluster, enable_gang=True,
+                                        slice_allocator=alloc)
+        cluster.create_job(make_elastic_job("jm"))
+        job = drive(cluster, controller, "default/jm",
+                    lambda j: len(cluster.list_pods("default")) == 2)
+        assert job.metadata.annotations["tpujob.dev/slice"] == "slice-0"
+        alloc.slices[0].offline = True  # targeted loss of the held slice
+        job = drive(cluster, controller, "default/jm", lambda j: True)
+        assert job.metadata.annotations["tpujob.dev/slice"] == "slice-0"
+        assert alloc.holding("default/jm") == "slice-0"
+        assert alloc.free_by_class() == {("v5e", 2): 1}  # slice-1 untouched
+
+    def test_min_replicas_blocks_reshape(self, env):
+        cluster, controller, alloc, _ = env
+        alloc.set_capacity(1)
+        cluster.create_job(make_elastic_job("j3", min_replicas=2))
+        drive(cluster, controller, "default/j3",
+              lambda j: any(e.reason == "SliceUnavailable"
+                            for e in cluster.events_for(
+                                "TrainJob", "default", "j3")))
+        job = cluster.get_job("default", "j3")
+        assert job.status.reshaped_replicas is None
+        assert cluster.list_pods("default") == []
+
+    def test_non_elastic_job_waits(self, env):
+        cluster, controller, alloc, _ = env
+        alloc.set_capacity(1)
+        cluster.create_job(make_elastic_job("j4", elastic=False))
+        drive(cluster, controller, "default/j4",
+              lambda j: any(e.reason == "SliceUnavailable"
+                            for e in cluster.events_for(
+                                "TrainJob", "default", "j4")))
+        job = cluster.get_job("default", "j4")
+        assert job.status.reshaped_replicas is None
+        assert cluster.list_pods("default") == []
+
+    def test_gang_size_gauge_tracks_and_clears(self, env):
+        cluster, controller, alloc, _ = env
+        alloc.set_capacity(1)
+        cluster.create_job(make_elastic_job("j5"))
+        drive(cluster, controller, "default/j5",
+              lambda j: j.status.reshaped_replicas == 1)
+        assert ('tpujob_gang_size{job="j5",namespace="default"} 1'
+                in status_metrics.DEFAULT.expose())
+        cluster.delete_job("default", "j5")
+        controller.run_until_idle(10.0)
+        assert ('tpujob_gang_size{job="j5"'
+                not in status_metrics.DEFAULT.expose())
+
+    def test_at_step_capacity_fires_on_heartbeat(self, env, monkeypatch):
+        monkeypatch.setenv(chaos_lib.ENV_CHAOS,
+                           "capacity:slices=1,at_step=8,job=j6")
+        cluster = InMemoryCluster()
+        alloc = SliceAllocator.of("1x1", "2x1")
+        hb = StubHeartbeat()
+        controller = TrainJobController(cluster, enable_gang=True,
+                                        slice_allocator=alloc,
+                                        heartbeat_source=hb)
+        cluster.create_job(make_elastic_job("j6"))
+        drive(cluster, controller, "default/j6",
+              lambda j: len(cluster.list_pods("default")) == 2)
+        assert not alloc.held_offline("default/j6")  # not fired yet
+        hb.hb = {"step": 9, "t": time.time()}
+        drive(cluster, controller, "default/j6",
+              lambda j: alloc.held_offline("default/j6"))
+        assert any(e.reason == "ChaosCapacity" for e in cluster.events_for(
+            "TrainJob", "default", "j6"))
+        # One-shot: a later heartbeat does not re-fire (inventory dialed
+        # back up stays up).
+        alloc.set_capacity(2)
+        hb.hb = {"step": 20, "t": time.time()}
+        drive(cluster, controller, "default/j6",
+              lambda j: True)
+        assert not alloc.held_offline("default/j6")
+
+
+# ------------------------------------------------------- scheduler elastic
+
+
+class TestSchedulerElastic:
+    def _mk_sched(self, clock=None):
+        from tf_operator_tpu.sched.policy import FleetPolicy
+        from tf_operator_tpu.sched.scheduler import FleetScheduler
+
+        alloc = SliceAllocator.of("1x1", "2x1")
+        kw = {"clock": clock} if clock else {}
+        return alloc, FleetScheduler(alloc, policy=FleetPolicy.default(),
+                                     **kw)
+
+    def test_degraded_decide_and_upgrade(self):
+        alloc, sched = self._mk_sched()
+        blocker = make_elastic_job("blocker", elastic=False)
+        waiter = make_elastic_job("waiter")
+        assert sched.decide(blocker).admit
+        d = sched.decide(waiter)
+        assert not d.admit and d.reason == "capacity"
+        # The controller's elastic loop: same job, smaller class.
+        d2 = sched.decide(waiter, topology="v5e-1")
+        assert d2.admit and d2.slice_id == "slice-0"
+        assert sched.running_class("default/waiter") == ("v5e", 1)
+        # Capacity frees: the running branch upgrades back to full size.
+        sched.release("default/blocker")
+        d3 = sched.decide(waiter)
+        assert d3.admit and d3.slice_id == "slice-1"
+        assert sched.running_class("default/waiter") == ("v5e", 2)
+        # HOLD-BOTH: the small slice stays held (its pods may still be
+        # draining) until the controller's cleanup releases it — no
+        # waiter can double-allocate onto it meanwhile.
+        assert sorted(alloc.held_slices("default/waiter")) == [
+            "slice-0", "slice-1"]
+        assert alloc.free_by_class().get(("v5e", 1)) is None
+        assert alloc.release_except_class("default/waiter", "2x1")
+        assert alloc.free_by_class().get(("v5e", 1)) == 1
+
+    def test_upgrade_defers_to_ranked_waiters(self):
+        alloc, sched = self._mk_sched()
+        blocker = make_elastic_job("blocker", elastic=False)
+        assert sched.decide(blocker).admit  # holds the 2-chip slice
+        degraded = make_elastic_job("deg")
+        assert sched.decide(degraded, topology="v5e-1").admit
+        # A waiter queues for the full class; when the blocker releases,
+        # the degraded job must NOT take the freed 2-chip slice past it.
+        waiter = make_elastic_job("other", elastic=False)
+        assert not sched.decide(waiter).admit
+        sched.release("default/blocker")
+        d = sched.decide(degraded)
+        assert d.admit and d.slice_id == "slice-0"  # kept its small slice
+        assert sched.running_class("default/deg") == ("v5e", 1)
+        # The waiter takes what it was owed.
+        assert sched.decide(waiter).admit
+
+    def test_failed_probe_is_pure(self):
+        """A failed degraded probe must not perturb scheduler state: the
+        waiting entry keeps its REQUESTED class (full-class reservations
+        and kicks stay correct) and no preemption victim is marked on a
+        probe's behalf."""
+        fake_now = [1000.0]
+        alloc, sched = self._mk_sched(clock=lambda: fake_now[0])
+        blocker = make_elastic_job("blocker", elastic=False)
+        blocker.spec.run_policy.scheduling.priority_class = "high"
+        victim = make_elastic_job("victim", topology="1x1", workers=1,
+                                  mesh_axes={"dp": 1}, elastic=False)
+        victim.spec.run_policy.scheduling.priority_class = "low"
+        assert sched.decide(blocker).admit      # holds the 2-chip slice
+        assert sched.decide(victim).admit       # holds the 1-chip slice
+        fake_now[0] += 3600  # well past the preemption cooldown
+        prober = make_elastic_job("prober")
+        prober.spec.run_policy.scheduling.priority_class = "high"
+        d = sched.decide(prober)
+        assert not d.admit
+        d2 = sched.decide(prober, topology="v5e-1")
+        assert not d2.admit and d2.preempting is None
+        assert sched.eviction_requested("default/victim") is None
+        # The waiting entry still ranks (and reserves) at the full class.
+        assert sched._waiting.get("default/prober").topology == "2x1"
+        # A NON-probe decide at the same spot still preempts (the gate
+        # is probe-ness, not a behavior change for real admissions).
+        small = make_elastic_job("small", topology="1x1", workers=1,
+                                 mesh_axes={"dp": 1}, elastic=False)
+        small.spec.run_policy.scheduling.priority_class = "high"
+        d3 = sched.decide(small)
+        assert d3.preempting == "default/victim"
+
+    def test_low_priority_waiters_do_not_pin_upgrade(self):
+        """Finding-4 regression: a high-priority degraded gang upgrades
+        past LOWER-priority waiters (their reservation would itself be
+        an inversion), while equal/higher-priority waiters still win."""
+        alloc, sched = self._mk_sched()
+        blocker = make_elastic_job("blocker", elastic=False)
+        blocker.spec.run_policy.scheduling.priority_class = "high"
+        assert sched.decide(blocker).admit  # holds the 2-chip slice
+        deg = make_elastic_job("deg")
+        deg.spec.run_policy.scheduling.priority_class = "high"
+        assert sched.decide(deg, topology="v5e-1").admit
+        low = make_elastic_job("low", elastic=False)
+        low.spec.run_policy.scheduling.priority_class = "low"
+        assert not sched.decide(low).admit  # queued for the full class
+        sched.release("default/blocker")
+        d = sched.decide(deg)
+        assert d.admit and d.slice_id == "slice-1"  # upgraded past `low`
+        assert sched.running_class("default/deg") == ("v5e", 2)
+
+    def test_controller_scheduler_degraded_admission(self):
+        """The controller's scheduler path end-to-end: a preempt-style
+        requeue (here: fresh submit into exhausted full-class capacity)
+        resumes onto the smaller class with GangReshaped."""
+        from tf_operator_tpu.sched.policy import FleetPolicy
+        from tf_operator_tpu.sched.scheduler import FleetScheduler
+
+        cluster = InMemoryCluster()
+        alloc = SliceAllocator.of("1x1", "2x1")
+        sched = FleetScheduler(alloc, policy=FleetPolicy.default())
+        controller = TrainJobController(cluster, enable_gang=True,
+                                        scheduler=sched)
+        cluster.create_job(make_elastic_job("blk", elastic=False))
+        drive(cluster, controller, "default/blk",
+              lambda j: len(cluster.list_pods("default")) == 2)
+        cluster.create_job(make_elastic_job("ela"))
+        job = drive(cluster, controller, "default/ela",
+                    lambda j: j.status.reshaped_replicas == 1)
+        assert job.status.reshaped_topology == "v5e-1"
+        assert has_condition(job.status, JobConditionType.GANG_RESHAPED)
+        pods = [p for p in cluster.list_pods("default")
+                if p.name.startswith("ela-")]
+        assert len(pods) == 1
+        assert pod_env(pods[0], "TPUJOB_MESH") == '{"dp": 1}'
+
+
+# ---------------------------------------------------- reshard-on-restore
+
+
+@pytest.fixture
+def trainer_env(tmp_path, monkeypatch):
+    events = tmp_path / "events.jsonl"
+    monkeypatch.setenv("TPUJOB_METRICS_FILE", str(events))
+    monkeypatch.delenv("TPUJOB_ALLOW_RESHAPE", raising=False)
+
+    def read_events():
+        if not events.exists():
+            return []
+        return [json.loads(ln) for ln in events.read_text().splitlines()
+                if ln.strip()]
+
+    return tmp_path, read_events
+
+
+def _tiny_state():
+    import jax.numpy as jnp
+
+    from tf_operator_tpu import optim as optim_lib
+    from tf_operator_tpu.parallel.train_step import create_train_state
+
+    tx = optim_lib.make_optimizer(
+        optim_lib.OptimizerConfig(name="adamw", learning_rate=1e-3))
+    params = {"w": jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+              "b": jnp.ones((4,), jnp.float32)}
+    return tx, create_train_state(params, tx)
+
+
+def _with_step(state, n: int):
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    return dataclasses.replace(state, step=jnp.asarray(n, jnp.int32))
+
+
+def _save_on_mesh(ckdir, step, state, axes, monkeypatch):
+    from tf_operator_tpu.models import train as train_mod
+    from tf_operator_tpu.parallel import mesh as mesh_lib
+    from tf_operator_tpu.parallel.train_step import shard_state
+
+    mesh = mesh_lib.make_mesh(axes)
+    # The aux tree's step (not the dir name) is what resume restores.
+    placed = shard_state(_with_step(state, step), mesh, None)
+    monkeypatch.setattr(train_mod, "_mesh", mesh)
+    # Digests are opt-in (reshape-enabled jobs only pay the hash pass).
+    monkeypatch.setattr(train_mod, "_digest_saves", True)
+    train_mod._save_checkpoint(str(ckdir), step, placed)
+    return mesh, placed
+
+
+def _leaves_equal(a, b):
+    import jax
+    import numpy as np
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(x)), np.asarray(jax.device_get(y)))
+
+
+class TestReshardRestore:
+    def test_mesh_relayout_roundtrip_property(self, trainer_env, monkeypatch):
+        """The round-trip property: a trainstate hops dp=8 -> dp=4xfsdp=2
+        -> dp=8 (same device count, different layouts — the single-host
+        stand-in for N->M processes) and EVERY leaf (params + optimizer
+        state + step) is equal after each hop, digests matching the
+        manifest."""
+        import jax
+
+        from tf_operator_tpu.models import train as train_mod
+        from tf_operator_tpu.parallel import mesh as mesh_lib
+        from tf_operator_tpu.parallel.train_step import shard_state
+
+        tmp, read_events = trainer_env
+        ck = tmp / "ck"
+        tx, state = _tiny_state()
+        mesh1, placed1 = _save_on_mesh(ck, 5, state, {"dp": 8}, monkeypatch)
+
+        mesh2 = mesh_lib.make_mesh({"dp": 4, "fsdp": 2})
+        fresh = jax.tree.map(lambda x: x * 0, state)
+        st2, start2 = train_mod._try_resume(str(ck), fresh, tx, mesh=mesh2,
+                                            allow_reshape=True)
+        _leaves_equal(st2.params, placed1.params)
+        _leaves_equal(st2.opt_state, placed1.opt_state)
+        resumed = [e for e in read_events() if e["event"] == "resumed"][-1]
+        assert resumed["reshaped"] == {
+            "from_processes": 1, "from_mesh": {"dp": 8},
+            "to_processes": 1, "to_mesh": {"dp": 4, "fsdp": 2}}
+        assert resumed["digest"] == resumed["saved_digest"]
+
+        # Hop back: save from the relaid-out state, restore on mesh1.
+        placed2 = shard_state(st2, mesh2, None)
+        monkeypatch.setattr(train_mod, "_mesh", mesh2)
+        train_mod._save_checkpoint(str(ck), 6, placed2)
+        st3, _ = train_mod._try_resume(str(ck), fresh, tx, mesh=mesh1,
+                                       allow_reshape=True)
+        _leaves_equal(st3.params, placed1.params)
+        _leaves_equal(st3.opt_state, placed1.opt_state)
+        resumed = [e for e in read_events() if e["event"] == "resumed"][-1]
+        assert resumed["digest"] == resumed["saved_digest"]
+
+    def test_foreign_shape_without_flag_degrades(self, trainer_env,
+                                                 monkeypatch):
+        import jax
+
+        from tf_operator_tpu.models import train as train_mod
+        from tf_operator_tpu.parallel import mesh as mesh_lib
+
+        tmp, read_events = trainer_env
+        ck = tmp / "ck"
+        tx, state = _tiny_state()
+        _save_on_mesh(ck, 5, state, {"dp": 8}, monkeypatch)
+        mesh2 = mesh_lib.make_mesh({"dp": 4, "fsdp": 2})
+        fresh = jax.tree.map(lambda x: x * 0, state)
+        st, start = train_mod._try_resume(str(ck), fresh, tx, mesh=mesh2,
+                                          allow_reshape=False)
+        assert start == 0  # degraded to cold start, no crash
+        ev = read_events()
+        fallbacks = [e for e in ev if e["event"] == "resume_fallback"]
+        assert any("foreign_shape" in e.get("reason", "")
+                   and "--allow-reshape" in e["reason"] for e in fallbacks)
+        assert not [e for e in ev if e["event"] == "resumed"]
+
+    def test_foreign_falls_back_to_older_same_shape(self, trainer_env,
+                                                    monkeypatch):
+        """A foreign newest checkpoint behaves exactly like a corrupt
+        one: the walk degrades to the older same-shape candidate."""
+        import jax
+
+        from tf_operator_tpu.models import train as train_mod
+
+        tmp, read_events = trainer_env
+        ck = tmp / "ck"
+        tx, state = _tiny_state()
+        mesh1, placed1 = _save_on_mesh(ck, 4, state, {"dp": 8}, monkeypatch)
+        # Newer checkpoint from a DIFFERENT shape.
+        _save_on_mesh(ck, 9, state, {"dp": 4, "fsdp": 2}, monkeypatch)
+        fresh = jax.tree.map(lambda x: x * 0, state)
+        st, start = train_mod._try_resume(str(ck), fresh, tx, mesh=mesh1,
+                                          allow_reshape=False)
+        assert start == 4
+        _leaves_equal(st.params, placed1.params)
+
+    def test_process_count_gate(self, trainer_env, monkeypatch):
+        """A manifest declaring a different processCount (the real N->M
+        case) is foreign even when the mesh dict matches."""
+        import jax
+
+        from tf_operator_tpu.models import checkpoint as ckpt
+        from tf_operator_tpu.models import train as train_mod
+
+        tmp, read_events = trainer_env
+        ck = tmp / "ck"
+        tx, state = _tiny_state()
+        mesh1, placed1 = _save_on_mesh(ck, 5, state, {"dp": 8}, monkeypatch)
+        sm = ckpt.read_sharding_manifest(str(ck), "step_5")
+        sm["processCount"] = 2
+        ckpt.write_sharding_manifest(str(ck), "step_5", sm)
+        fresh = jax.tree.map(lambda x: x * 0, state)
+        st, start = train_mod._try_resume(str(ck), fresh, tx, mesh=mesh1,
+                                          allow_reshape=False)
+        assert start == 0
+        st, start = train_mod._try_resume(str(ck), fresh, tx, mesh=mesh1,
+                                          allow_reshape=True)
+        assert start == 5
+        _leaves_equal(st.params, placed1.params)
+
+    def test_missing_sharding_manifest_grace(self, trainer_env, monkeypatch):
+        """No sharding manifest (pre-manifest checkpoint): restorable
+        under same-shape semantics, with a clear resume_fallback note
+        when reshape verification was requested — never a crash."""
+        import os as _os
+
+        import jax
+
+        from tf_operator_tpu.models import checkpoint as ckpt
+        from tf_operator_tpu.models import train as train_mod
+
+        tmp, read_events = trainer_env
+        ck = tmp / "ck"
+        tx, state = _tiny_state()
+        mesh1, placed1 = _save_on_mesh(ck, 5, state, {"dp": 8}, monkeypatch)
+        _os.unlink(_os.path.join(str(ck), "step_5" + ckpt.SHARDING_SUFFIX))
+        fresh = jax.tree.map(lambda x: x * 0, state)
+        st, start = train_mod._try_resume(str(ck), fresh, tx, mesh=mesh1,
+                                          allow_reshape=True)
+        assert start == 5
+        _leaves_equal(st.params, placed1.params)
+        ev = read_events()
+        assert any("missing_sharding_manifest" in e.get("reason", "")
+                   for e in ev if e["event"] == "resume_fallback")
+        resumed = [e for e in ev if e["event"] == "resumed"][-1]
+        assert "reshaped" not in resumed and "digest" not in resumed
+
+    def test_reshard_shape_mismatch_walks_back(self, trainer_env,
+                                               monkeypatch):
+        """A foreign checkpoint whose GLOBAL shapes don't match the model
+        config is skipped (reshard would restore garbage); the walk finds
+        the older good candidate."""
+        import jax
+
+        from tf_operator_tpu.models import checkpoint as ckpt
+        from tf_operator_tpu.models import train as train_mod
+        from tf_operator_tpu.parallel import mesh as mesh_lib
+
+        tmp, read_events = trainer_env
+        ck = tmp / "ck"
+        tx, state = _tiny_state()
+        mesh1, placed1 = _save_on_mesh(ck, 4, state, {"dp": 8}, monkeypatch)
+        _save_on_mesh(ck, 9, state, {"dp": 4, "fsdp": 2}, monkeypatch)
+        sm = ckpt.read_sharding_manifest(str(ck), "step_9")
+        sm["leaves"]["['w']"]["shape"] = [16, 4]  # model-config drift
+        ckpt.write_sharding_manifest(str(ck), "step_9", sm)
+        mesh3 = mesh_lib.make_mesh({"dp": 2, "fsdp": 4})
+        fresh = jax.tree.map(lambda x: x * 0, state)
+        st, start = train_mod._try_resume(str(ck), fresh, tx, mesh=mesh3,
+                                          allow_reshape=True)
+        assert start == 4
+        _leaves_equal(st.params, placed1.params)
+        assert any("reshard_shape_mismatch" in e.get("reason", "")
+                   for e in read_events()
+                   if e["event"] == "resume_fallback")
+
+    def test_sweep_and_prune_cover_sharding_manifests(self, trainer_env,
+                                                      monkeypatch):
+        import os as _os
+
+        from tf_operator_tpu.models import checkpoint as ckpt
+
+        tmp, _ = trainer_env
+        ck = tmp / "ck"
+        tx, state = _tiny_state()
+        for step in (2, 4, 6):
+            _save_on_mesh(ck, step, state, {"dp": 8}, monkeypatch)
+        ckpt.prune_checkpoints(str(ck), keep=1)
+        left = sorted(n for n in _os.listdir(str(ck))
+                      if n.endswith(ckpt.SHARDING_SUFFIX))
+        assert left == ["step_6" + ckpt.SHARDING_SUFFIX]
+        # Torn tmp sharding manifests are swept at startup.
+        stray = _os.path.join(str(ck),
+                              "step_8" + ckpt.SHARDING_SUFFIX + ".tmp123")
+        with open(stray, "w") as f:
+            f.write("{")
+        removed = ckpt.sweep_tmp_dirs(str(ck))
+        assert _os.path.basename(stray) in removed
+
+
+# ----------------------------------------------------------- slow capstones
+
+
+def read_pod_events(tmp_path, pod: str, ns: str = "default") -> list[dict]:
+    path = tmp_path / "logs" / f"{ns}_{pod}.metrics.jsonl"
+    if not path.exists():
+        return []
+    return [json.loads(ln) for ln in path.read_text().splitlines()
+            if ln.strip()]
+
+
+def dist_trainer_cmd(ckpt_dir: str, *extra: str) -> list[str]:
+    return [PY, "-m", "tf_operator_tpu.models.train", "--model", "mnist-mlp",
+            "--steps", str(STEPS), "--batch", "16", "--log-every", "4",
+            "--checkpoint-dir", ckpt_dir, "--checkpoint-every", "8", *extra]
+
+
+def make_session(tmp_path, monkeypatch, chaos: str):
+    from tf_operator_tpu.runtime.session import LocalSession
+
+    monkeypatch.setenv("TPUJOB_PRESPAWN", "0")
+    state_dir = str(tmp_path / "chaos-state")
+    monkeypatch.setenv(chaos_lib.ENV_CHAOS_STATE, state_dir)
+    monkeypatch.setenv(chaos_lib.ENV_CHAOS, chaos)
+    return LocalSession(
+        enable_gang=True,
+        slice_allocator=SliceAllocator.of("1x1", "2x1"),
+        env_overrides={**ONE_DEV, "TPUJOB_CHAOS_STATE": state_dir},
+        log_dir=str(tmp_path / "logs"),
+    )
+
+
+@pytest.mark.slow
+class TestReshapedResumeE2E:
+    """The acceptance capstone: a REAL 2-process jax.distributed gang is
+    SIGKILLed at step 12; a chaos `capacity:` directive took its 2-chip
+    slice offline at the step-8 checkpoint, so the gang roll re-admits at
+    ONE replica on the surviving 1-chip slice (GangReshaped), resumes
+    from the shared step-8 checkpoint with restored params/opt-state
+    digest-equal to the save, and trains to the full step count."""
+
+    def test_kill_then_reshaped_resume(self, tmp_path, monkeypatch):
+        session = make_session(
+            tmp_path, monkeypatch,
+            "capacity:slices=1,at_step=8,job=gangshape")
+        try:
+            ck = str(tmp_path / "ckpt")
+            job = make_elastic_job(
+                "gangshape",
+                cmd=dist_trainer_cmd(
+                    ck, "--chaos", "kill:step=12,signal=KILL,index=1"),
+            )
+            session.submit(job)
+            job = session.wait_for_condition("default", "gangshape", DONE,
+                                             timeout=480)
+            assert is_succeeded(job.status), [
+                (str(c.type), c.reason, c.message)
+                for c in job.status.conditions]
+
+            # Reshaped to 1 worker on the small slice; tallies show the
+            # roll's ONE restart and nothing from the reshape.
+            assert job.status.reshaped_replicas == 1
+            assert job.status.reshaped_topology == "v5e-1"
+            assert job.status.gang_restarts == 1
+            assert has_condition(job.status, JobConditionType.GANG_RESHAPED)
+            events = session.cluster.events_for(
+                "TrainJob", "default", "gangshape")
+            assert any(e.reason == "ChaosCapacity" for e in events)
+            assert any(e.reason == "GangReshaped" for e in events)
+
+            # Worker 0 ran two generations (2-proc, then 1-proc solo);
+            # worker 1 was never recreated after the reshape.
+            ev0 = read_pod_events(tmp_path, "gangshape-worker-0")
+            assert len([e for e in ev0 if e["event"] == "start"]) == 2
+            ev1 = read_pod_events(tmp_path, "gangshape-worker-1")
+            assert len([e for e in ev1 if e["event"] == "start"]) == 1
+
+            # Reshaped resume from the shared step-8 checkpoint,
+            # bit-equal (digest) to what the 2-process gang saved.
+            resumed = [e for e in ev0 if e["event"] == "resumed"][-1]
+            assert resumed["from_step"] == 8
+            assert resumed["reshaped"]["from_processes"] == 2
+            assert resumed["reshaped"]["to_processes"] == 1
+            assert resumed["reshaped"]["from_mesh"] == {"dp": 2}
+            assert resumed["reshaped"]["to_mesh"] == {"dp": 1}
+            assert resumed["params_only"] is False
+            assert resumed["digest"] == resumed["saved_digest"]
+
+            # Full step count at the reduced size.
+            dones = [e for e in ev0 if e["event"] == "done"]
+            assert dones and dones[-1]["steps"] == STEPS
+            assert ('tpujob_restore_reshard_total{direction="shrink",'
+                    'namespace="default"}'
+                    in status_metrics.DEFAULT.expose())
+        finally:
+            session.close()
+
+
+@pytest.mark.slow
+class TestScaleUpE2E:
+    """The other direction: a job admitted DEGRADED (only the small slice
+    online at submit) scales back up when chaos restores the full-class
+    slice at the step-16 checkpoint boundary — the gang rolls to 2
+    workers, reshards the dp=1 checkpoint onto dp=2, and finishes at the
+    spec size."""
+
+    def test_scale_up_when_capacity_returns(self, tmp_path, monkeypatch):
+        session = make_session(
+            tmp_path, monkeypatch,
+            "capacity:slices=1;capacity:slices=2,at_step=10,job=gangup")
+        try:
+            ck = str(tmp_path / "ckpt")
+            job = make_elastic_job("gangup", cmd=dist_trainer_cmd(ck))
+            session.submit(job)
+            job = session.wait_for_condition("default", "gangup", DONE,
+                                             timeout=480)
+            assert is_succeeded(job.status), [
+                (str(c.type), c.reason, c.message)
+                for c in job.status.conditions]
+
+            # Ended at FULL size: reshape cleared, condition lowered.
+            assert job.status.reshaped_replicas is None
+            cond = [c for c in job.status.conditions
+                    if c.type == JobConditionType.GANG_RESHAPED][0]
+            assert cond.status is False and cond.reason == "GangRestored"
+            events = session.cluster.events_for(
+                "TrainJob", "default", "gangup")
+            assert any(e.reason == "GangReshaped" for e in events)
+            assert any(e.reason == "GangRestored" for e in events)
+
+            # Gen 1 ran solo; gen 2 is the 2-process gang that resumed
+            # from the degraded run's checkpoint by resharding 1 -> 2.
+            ev0 = read_pod_events(tmp_path, "gangup-worker-0")
+            assert len([e for e in ev0 if e["event"] == "start"]) == 2
+            ev1 = read_pod_events(tmp_path, "gangup-worker-1")
+            assert len([e for e in ev1 if e["event"] == "start"]) == 1
+            resumed = [e for e in ev0 if e["event"] == "resumed"][-1]
+            assert resumed["from_step"] >= 8
+            assert resumed["reshaped"]["from_processes"] == 1
+            assert resumed["reshaped"]["to_processes"] == 2
+            dones = [e for e in ev0 if e["event"] == "done"]
+            assert dones and dones[-1]["steps"] == STEPS
+            assert ('tpujob_restore_reshard_total{direction="grow",'
+                    'namespace="default"}'
+                    in status_metrics.DEFAULT.expose())
+            # Restart tally untouched: both transitions were planned
+            # placements, not failures.
+            assert job.status.gang_restarts == 0
+        finally:
+            session.close()

@@ -24,6 +24,7 @@ from tf_operator_tpu.api.types import (
     CleanPodPolicy,
     ContainerPort,
     ContainerSpec,
+    ElasticPolicy,
     EnvVar,
     MeshSpec,
     ObjectMeta,
@@ -134,6 +135,7 @@ def job_from_dict(manifest: dict[str, Any], apply_defaults: bool = True) -> Trai
     # schema declares); "scheduling" is accepted as a legacy manifest alias.
     sched_d = rp_d.get("schedulingPolicy") or rp_d.get("scheduling") or {}
     rec_d = rp_d.get("recovery") or {}
+    elastic_d = rec_d.get("elastic") or {}
     run_policy = RunPolicy(
         clean_pod_policy=CleanPodPolicy(cpp) if cpp else None,
         ttl_seconds_after_finished=policy_field("ttlSecondsAfterFinished"),
@@ -158,6 +160,15 @@ def job_from_dict(manifest: dict[str, Any], apply_defaults: bool = True) -> Trai
             progress_threshold_steps=(
                 1 if rec_d.get("progressThresholdSteps") is None
                 else int(rec_d["progressThresholdSteps"])),
+            elastic=ElasticPolicy(
+                # Explicit 0 must reach validation (which rejects < 1),
+                # same contract as progressThresholdSteps above.
+                min_replicas=(
+                    None if elastic_d.get("minReplicas") is None
+                    else int(elastic_d["minReplicas"])),
+                reshape_on_recovery=bool(
+                    elastic_d.get("reshapeOnRecovery") or False),
+            ),
         ),
     )
 
@@ -312,6 +323,11 @@ def job_to_dict(job: TrainJob) -> dict[str, Any]:
                         rp.recovery.pending_timeout_seconds,
                     "progressThresholdSteps":
                         rp.recovery.progress_threshold_steps,
+                    "elastic": {
+                        "minReplicas": rp.recovery.elastic.min_replicas,
+                        "reshapeOnRecovery":
+                            rp.recovery.elastic.reshape_on_recovery,
+                    },
                 },
             },
             "successPolicy": {"policy": job.spec.success_policy.policy},

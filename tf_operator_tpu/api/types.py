@@ -79,6 +79,12 @@ class JobConditionType(str, enum.Enum):
     # explicitly NOT Failed, and NOT counted against backoffLimit.
     QUEUED = "Queued"
     PREEMPTED = "Preempted"
+    # Elastic recovery (recovery.elastic): the gang could not re-place at
+    # full size and was re-admitted at a smaller replica count on whatever
+    # capacity exists. Informational (does NOT displace Running): status
+    # True while degraded, lowered with reason GangRestored once the gang
+    # scales back to full size.
+    GANG_RESHAPED = "GangReshaped"
 
     def __str__(self) -> str:
         return self.value
@@ -206,6 +212,29 @@ class SchedulingPolicy:
 
 
 @dataclass
+class ElasticPolicy:
+    """Elastic gang recovery (recovery.elastic): what the controller may
+    do when a gang cannot re-place at its full size — the original slice
+    class is gone (capacity lost, chaos `capacity:` shrink) or held by
+    others, and only smaller capacity is free.
+
+    reshape_on_recovery: True lets the controller re-admit the gang on a
+    SMALLER slice of the same accelerator with proportionally fewer
+    Worker replicas (GangReshaped condition + event; trainers resume from
+    the shared checkpoint via the sharding-manifest reshard path — pods
+    get TPUJOB_ALLOW_RESHAPE=1). The gang scales back to full size when
+    capacity frees, resuming from the newest checkpoint. False (default):
+    today's behavior bit-for-bit — the job waits for full capacity.
+
+    min_replicas: floor for the reshaped Worker count (None = 1). A
+    shrink that would go below it is not taken; the job keeps waiting.
+    """
+
+    min_replicas: int | None = None
+    reshape_on_recovery: bool = False
+
+
+@dataclass
 class RecoveryPolicy:
     """How replica failure propagates through the gang (beyond the
     reference, whose exit-code policy always restarted a failed replica
@@ -245,6 +274,7 @@ class RecoveryPolicy:
     heartbeat_timeout_seconds: float | None = None
     pending_timeout_seconds: float | None = None
     progress_threshold_steps: int = 1
+    elastic: ElasticPolicy = field(default_factory=ElasticPolicy)
 
 
 @dataclass
@@ -335,6 +365,13 @@ class JobStatus:
     preemptions: int = 0
     last_preemption_time: float | None = None
     pending_preemption_uids: list[str] = field(default_factory=list)
+    # Elastic reshape state (recovery.elastic): while degraded, the
+    # effective Worker replica count and the slice class actually held.
+    # Persisted (not operator memory) so a failover keeps serving the
+    # reshaped gang instead of wedging it between two sizes; None/"" =
+    # running at full spec size.
+    reshaped_replicas: int | None = None
+    reshaped_topology: str = ""
 
 
 @dataclass

@@ -114,6 +114,39 @@ def validate_spec(spec: TrainJobSpec, fleet=None) -> list[str]:
         problems.append("runPolicy.recovery.pendingTimeoutSeconds must be > 0")
     if rec.progress_threshold_steps < 1:
         problems.append("runPolicy.recovery.progressThresholdSteps must be >= 1")
+    elastic = rec.elastic
+    if elastic.min_replicas is not None and elastic.min_replicas < 1:
+        problems.append(
+            "runPolicy.recovery.elastic.minReplicas must be >= 1")
+    if elastic.min_replicas is not None:
+        workers = spec.replica_specs.get(ReplicaType.WORKER)
+        if (workers is not None and workers.replicas is not None
+                and elastic.min_replicas > workers.replicas):
+            problems.append(
+                f"runPolicy.recovery.elastic.minReplicas "
+                f"({elastic.min_replicas}) exceeds Worker replicas "
+                f"({workers.replicas}): the floor can never bind"
+            )
+    if elastic.reshape_on_recovery and rec.policy == "pod":
+        # Reshaping rolls the WHOLE gang onto a new world size; per-pod
+        # replacement semantics cannot express that.
+        problems.append(
+            "runPolicy.recovery.elastic.reshapeOnRecovery requires "
+            "runPolicy.recovery.policy 'gang' (got 'pod': per-pod "
+            "replacement cannot re-shape a gang)"
+        )
+    if elastic.reshape_on_recovery and (
+            ReplicaType.CHIEF in spec.replica_specs
+            or ReplicaType.MASTER in spec.replica_specs):
+        # The reshape arithmetic scales the Worker count against the
+        # slice's chips; a fixed Chief/Master process would skew the
+        # world-size/mesh relation it preserves. Explicitly out of scope
+        # (ROADMAP) rather than silently wrong.
+        problems.append(
+            "runPolicy.recovery.elastic.reshapeOnRecovery supports "
+            "Worker-only gangs (a Chief/Master replica would not scale "
+            "with the slice)"
+        )
 
     if spec.tpu is not None and spec.tpu.topology:
         try:

@@ -46,6 +46,7 @@ __all__ = [
     "from_env", "parse_chaos", "parse_signal",
     "TrainerChaos", "hang", "tear_checkpoint", "staging_stalls_from_env",
     "staging_stall_delay", "apiserver_directives", "preempt_directives",
+    "capacity_directives",
 ]
 
 
@@ -237,3 +238,13 @@ def preempt_directives(env: dict | None = None) -> list[Directive]:
     if not e.get(ENV_CHAOS):
         return []
     return [d for d in from_env(e) if d.kind == "preempt"]
+
+
+def capacity_directives(env: dict | None = None) -> list[Directive]:
+    """`capacity:` directives — the operator-side slice-inventory dial
+    (core/trainjob_controller.py applies step-less ones at construction
+    and polls at_step ones against the named job's heartbeat)."""
+    e = os.environ if env is None else env
+    if not e.get(ENV_CHAOS):
+        return []
+    return [d for d in from_env(e) if d.kind == "capacity"]

@@ -67,6 +67,26 @@ Directive kinds and their keys (all integers/floats unless noted):
                                       defaults to "default". One-shot
                                       like kill/hang.
 
+    capacity   slices=N               OPERATOR-side: dial the fake slice
+               [at_step=S job=NAME]   inventory to its first N entries
+               [namespace=NS]         (slices at index >= N go offline;
+                                      a later directive with a larger N
+                                      brings them back — the
+                                      deterministic stand-in for node
+                                      loss/return in degraded-capacity
+                                      e2es). Held slices are not
+                                      revoked; the holder notices at
+                                      its next gang roll (elastic
+                                      recovery then reshapes onto
+                                      whatever fits). Without at_step
+                                      the dial describes inventory
+                                      STATE and re-applies at EVERY
+                                      operator start (a failover must
+                                      not restore capacity the scenario
+                                      lost); with at_step=S it fires
+                                      once job=NAME's heartbeat reaches
+                                      S (one-shot, like preempt).
+
 One-shot semantics across restarts: when `TPUJOB_CHAOS_STATE` names a
 directory, each fired directive drops a marker file there and never fires
 again — `kill:step=5;kill:step=12` then kills a job exactly twice across
@@ -86,7 +106,8 @@ from dataclasses import dataclass, field
 ENV_CHAOS = "TPUJOB_CHAOS"
 ENV_CHAOS_STATE = "TPUJOB_CHAOS_STATE"
 
-KINDS = ("kill", "hang", "torn", "stall", "apiserver", "preempt")
+KINDS = ("kill", "hang", "torn", "stall", "apiserver", "preempt",
+         "capacity")
 
 _KEYS: dict[str, dict[str, type]] = {
     "kill": {"step": int, "signal": str, "replica": str, "index": int},
@@ -96,6 +117,8 @@ _KEYS: dict[str, dict[str, type]] = {
     "apiserver": {"errors": int, "code": int, "latency": float,
                   "match": str},
     "preempt": {"step": int, "job": str, "namespace": str},
+    "capacity": {"slices": int, "at_step": int, "job": str,
+                 "namespace": str},
 }
 
 TORN_MODES = ("truncate", "unlink")
@@ -215,6 +238,14 @@ def _validate(kind: str, params: dict) -> None:
             raise ValueError("chaos: preempt requires step=N")
         if not params.get("job"):
             raise ValueError("chaos: preempt requires job=NAME")
+    elif kind == "capacity":
+        if "slices" not in params or params["slices"] < 0:
+            raise ValueError("chaos: capacity requires slices=N >= 0")
+        if "at_step" in params and not params.get("job"):
+            raise ValueError(
+                "chaos: capacity: at_step=S needs job=NAME (the step is "
+                "observed on that job's progress heartbeat)"
+            )
 
 
 def from_env(env: dict | None = None) -> list[Directive]:

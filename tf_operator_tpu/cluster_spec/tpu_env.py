@@ -42,6 +42,11 @@ ENV_MESH = "TPUJOB_MESH"
 ENV_JOB_NAME = "TPUJOB_NAME"
 ENV_REPLICA_TYPE = "TPUJOB_REPLICA_TYPE"
 ENV_REPLICA_INDEX = "TPUJOB_REPLICA_INDEX"
+# Elastic recovery: pods of a job whose recovery.elastic allows reshaping
+# get this set, so the trainer's resume accepts a checkpoint saved at a
+# DIFFERENT gang shape (models/train.py --allow-reshape is the standalone
+# spelling) — without it, a reshaped re-admission would cold-start.
+ENV_ALLOW_RESHAPE = "TPUJOB_ALLOW_RESHAPE"
 
 TPU_RESOURCE = "google.com/tpu"
 
@@ -115,6 +120,8 @@ def gen_tpu_env(
         env[ENV_TOPOLOGY] = job.spec.tpu.topology
     if job.spec.mesh is not None and job.spec.mesh.axes:
         env[ENV_MESH] = json.dumps(job.spec.mesh.axes)
+    if job.spec.run_policy.recovery.elastic.reshape_on_recovery:
+        env[ENV_ALLOW_RESHAPE] = "1"
     return env
 
 

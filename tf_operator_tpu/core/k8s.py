@@ -184,6 +184,11 @@ def job_status_to_dict(status: JobStatus) -> dict:
         "preemptions": status.preemptions,
         "lastPreemptionTime": status.last_preemption_time,
         "pendingPreemptionUids": list(status.pending_preemption_uids),
+        # Elastic reshape state: the effective degraded size must survive
+        # failover (a new leader serving the spec size would roll the
+        # reshaped gang back up onto capacity that is not there).
+        "reshapedReplicas": status.reshaped_replicas,
+        "reshapedTopology": status.reshaped_topology,
     }
 
 
@@ -201,6 +206,8 @@ def job_status_from_dict(d: dict) -> JobStatus:
         preemptions=int(d.get("preemptions") or 0),
         last_preemption_time=d.get("lastPreemptionTime"),
         pending_preemption_uids=list(d.get("pendingPreemptionUids") or []),
+        reshaped_replicas=d.get("reshapedReplicas"),
+        reshaped_topology=d.get("reshapedTopology") or "",
     )
     for c in d.get("conditions") or []:
         status.conditions.append(

@@ -48,6 +48,7 @@ from tf_operator_tpu.core.cluster import (
     Service,
     ServicePort,
 )
+from tf_operator_tpu.gang import elastic as elastic_lib
 from tf_operator_tpu.gang import podgroup as gang
 from tf_operator_tpu.status import engine as status_engine
 from tf_operator_tpu.status import metrics
@@ -128,6 +129,21 @@ class TrainJobController(ctrl.JobControllerBase):
         self._chaos_preempts = chaos_lib.preempt_directives()
         self._chaos_state = chaos_lib.OneShotState.from_env()
         self._chaos_preempt_warned: set[str] = set()
+        # Degraded-capacity e2es: `capacity:slices=N` directives dial the
+        # slice inventory (gang.SliceAllocator.set_capacity) without real
+        # node loss. Step-less directives apply at startup; at_step ones
+        # poll the named job's heartbeat like `preempt:` (one-shot).
+        self._chaos_capacity = chaos_lib.capacity_directives()
+        self._chaos_capacity_warned: set[str] = set()
+        for d in self._chaos_capacity:
+            if "at_step" not in d.params:
+                # A step-less dial describes inventory STATE, not an
+                # event: re-apply on EVERY operator start (the allocator
+                # is rebuilt in memory, and a failover silently restoring
+                # capacity the scenario models as lost would scale
+                # reshaped gangs back up onto nothing). Only at_step
+                # dials are one-shot.
+                self._apply_capacity(d)
         # Anything with `job_heartbeat(ns, name) -> {"step", "t", ...} | None`
         # (telemetry.collector.TelemetryCollector). Drives the hang watchdog
         # and the consecutive-restart reset; None disables both (the
@@ -306,17 +322,52 @@ class TrainJobController(ctrl.JobControllerBase):
         # every retry paying a PodGroup GET would be pure apiserver load
         # at fleet scale (the group object exists for external gang
         # schedulers to observe, which only matters once pods exist).
+        # Chaos capacity directives targeting this job's heartbeat fire
+        # BEFORE admission, so the shrunk inventory is what admission sees.
+        self._capacity_tick(job, key)
+
         if self.enable_gang and job.spec.run_policy.scheduling.gang:
-            if self.scheduler is None:
+            pre_synced = False
+            if (self.scheduler is None
+                    and job.status.reshaped_replicas is None):
+                # Reshaped jobs sync their PodGroup AFTER the reshape
+                # fold below — syncing here too would flip minMember
+                # full/degraded/full every pass.
                 gang.sync_podgroup(self.cluster, job)
-            retry_delay = self._admit_slice(job, key)
+                pre_synced = True
+            retry_delay = self._admit_slice(job, key, pods)
             if retry_delay is not None:
                 if job.status != old_status:
                     self.cluster.update_job_status(job)
                 self.queue.add_after(key, retry_delay)
                 return
-            if self.scheduler is not None:
+            # Elastic reshape: while status says the gang runs degraded,
+            # reconcile toward the REDUCED size — the working copy's
+            # worker count, mesh data axis, and slice topology all shrink
+            # together, so pod env (TF_CONFIG/TPUJOB_MESH/JAX world) and
+            # the topology hash stay mutually consistent and the existing
+            # elastic roll machinery does the resizing.
+            self._apply_reshape(job)
+            if self.scheduler is not None or not pre_synced:
+                # AFTER the reshape fold, so a degraded gang's PodGroup
+                # carries the REDUCED minMember (an external gang
+                # scheduler observing the group must not wait for a full
+                # count that will never come). `not pre_synced` also
+                # covers the pass that CLEARS a reshape: minMember must
+                # go back to full in the same sync the roll-up starts.
                 gang.sync_podgroup(self.cluster, job)
+            if job.status.reshaped_replicas is not None:
+                # Degraded gangs keep probing for their full size (kicks
+                # from releases are the fast path; this is the net).
+                self.queue.add_after(key, SLICE_RETRY_DELAY_S)
+
+        metrics.gang_size.labels(
+            namespace=job.namespace, job=job.name
+        ).set(sum(
+            int(s.replicas or 0)
+            for rt, s in job.spec.replica_specs.items()
+            if tpu_env.is_spmd_replica(rt)
+        ))
 
         # Graceful preemption (fleet scheduler eviction or chaos
         # `preempt:` directive): evict, drain, requeue — skipping the
@@ -393,7 +444,118 @@ class TrainJobController(ctrl.JobControllerBase):
             job.status.last_reconcile_time = self._now()
             self.cluster.update_job_status(job)
 
-    def _admit_slice(self, job: TrainJob, key: str) -> float | None:
+    @staticmethod
+    def _elastic_enabled(job: TrainJob) -> bool:
+        rec = job.spec.run_policy.recovery
+        return (rec.policy == "gang" and rec.elastic.reshape_on_recovery
+                and job.spec.tpu is not None
+                and bool(job.spec.tpu.topology))
+
+    def _degraded_candidates(self, job: TrainJob):
+        """(topology, scaled worker count) for every free smaller slice
+        class the gang can cleanly shrink onto, largest first."""
+        workers = job.spec.replica_specs.get(ReplicaType.WORKER)
+        full_workers = int(workers.replicas or 0) if workers else 0
+        if full_workers < 1 or self.slice_allocator is None:
+            return
+        minr = job.spec.run_policy.recovery.elastic.min_replicas or 1
+        axes = job.spec.mesh.axes if job.spec.mesh else None
+        full = job.spec.tpu.topology
+        for cand in self.slice_allocator.free_classes_below(full):
+            plan = elastic_lib.degraded_plan(
+                full, full_workers, cand, axes, minr
+            )
+            if plan is not None:
+                yield cand, plan[0]
+
+    def _record_reshape(self, job: TrainJob, key: str, scaled: int,
+                        topology: str) -> None:
+        """Persist a degraded admission: effective size + slice class,
+        GangReshaped condition/event, and one reshard-transition sample.
+        NEVER touches the restart tallies — a reshape is a placement
+        decision, not a failure."""
+        workers = job.spec.replica_specs.get(ReplicaType.WORKER)
+        prev = job.status.reshaped_replicas
+        if prev is None:
+            prev = int(workers.replicas or 0) if workers else scaled
+        if (job.status.reshaped_replicas == scaled
+                and job.status.reshaped_topology == topology):
+            return
+        job.status.reshaped_replicas = scaled
+        job.status.reshaped_topology = topology
+        now = self._now()
+        direction = "shrink" if scaled < prev else "grow"
+        metrics.restore_reshard_total.labels(
+            namespace=job.namespace, direction=direction).inc()
+        msg = (f"TrainJob {key} re-admitted at {scaled} Worker replica(s) "
+               f"on a {topology} slice (spec size unavailable); will "
+               f"scale back up when capacity frees.")
+        self.cluster.record_event(
+            TrainJob.KIND, job.namespace, job.name, "Normal",
+            status_engine.REASON_GANG_RESHAPED,
+            f"Gang reshaped {prev} -> {scaled} Worker replica(s) onto "
+            f"{topology}; trainers resume from the shared checkpoint via "
+            f"reshard-on-restore",
+        )
+        status_engine.set_condition(
+            job.status, JobConditionType.GANG_RESHAPED,
+            status_engine.REASON_GANG_RESHAPED, msg, now,
+        )
+
+    def _record_full_size(self, job: TrainJob, key: str) -> bool:
+        """Full-size (re)admission: clear any reshape state, lower the
+        GangReshaped condition, count the grow transition. True when a
+        reshape was actually cleared (the upgrade freed a smaller slice
+        someone else may want)."""
+        if job.status.reshaped_replicas is None:
+            return False
+        prev = job.status.reshaped_replicas
+        job.status.reshaped_replicas = None
+        job.status.reshaped_topology = ""
+        now = self._now()
+        metrics.restore_reshard_total.labels(
+            namespace=job.namespace, direction="grow").inc()
+        self.cluster.record_event(
+            TrainJob.KIND, job.namespace, job.name, "Normal",
+            status_engine.REASON_GANG_RESTORED,
+            f"Capacity returned: gang scaling back up from {prev} to its "
+            f"spec size; trainers resume from the newest checkpoint",
+        )
+        status_engine.lower_condition(
+            job.status, JobConditionType.GANG_RESHAPED,
+            status_engine.REASON_GANG_RESTORED,
+            f"TrainJob {key} is back at its spec size.", now,
+        )
+        return True
+
+    def _apply_reshape(self, job: TrainJob) -> None:
+        """Fold status.reshaped_replicas into the WORKING COPY of the
+        spec (never the stored object): worker count, mesh data axis, and
+        slice topology shrink together so everything derived downstream
+        (TF_CONFIG, TPUJOB_MESH, topology hash, TPU resources, podgroup
+        minMember) reflects the degraded gang."""
+        n = job.status.reshaped_replicas
+        if n is None:
+            return
+        spec = job.spec.replica_specs.get(ReplicaType.WORKER)
+        if spec is None:
+            return
+        full = int(spec.replicas or 0)
+        if full <= 0 or n >= full:
+            return
+        if job.spec.mesh is not None and job.spec.mesh.axes:
+            scaled_axes = elastic_lib.scaled_mesh_axes(
+                job.spec.mesh.axes, full, n
+            )
+            if scaled_axes is None:
+                return  # unreachable: admission only reshapes with a plan
+            job.spec.mesh.axes = scaled_axes
+        spec.replicas = n
+        if job.status.reshaped_topology and job.spec.tpu is not None:
+            job.spec.tpu.topology = job.status.reshaped_topology
+
+    def _admit_slice(self, job: TrainJob, key: str,
+                     pods: list[Pod] | None = None) -> float | None:
         """Whole-slice admission: None when pods may be created, else the
         retry delay before this job should re-check.
 
@@ -405,26 +567,86 @@ class TrainJobController(ctrl.JobControllerBase):
         scales with queue position: a job 500-deep re-checking every 15 s
         is pure apiserver load, it cannot possibly admit before hundreds
         of releases each of which would have kicked it. Without a
-        scheduler, this is the original first-come allocator gate."""
+        scheduler, this is the original first-come allocator gate.
+
+        Elastic recovery (recovery.elastic.reshapeOnRecovery) adds the
+        degraded path: when the full-size class has no capacity, admit
+        onto the largest free SMALLER class the gang can cleanly shrink
+        to (>= minReplicas) instead of pinning Pending — and, every sync
+        while degraded, try to upgrade back to full size."""
         if job.spec.tpu is None or not job.spec.tpu.topology:
             return None
+        full_topology = job.spec.tpu.topology
+        elastic = self._elastic_enabled(job)
+        live = any(not p.is_finished() for p in (pods or []))
+
+        # A claim on a slice that went offline (capacity lost, chaos
+        # `capacity:` shrink) survives while the gang's pods do — real
+        # slice loss kills them anyway — and is dropped once the gang has
+        # drained, so re-admission runs fresh (degraded, when elastic).
+        if (self.slice_allocator is not None and not live
+                and self.slice_allocator.held_offline(key)):
+            self.cluster.record_event(
+                TrainJob.KIND, job.namespace, job.name, "Warning",
+                "SliceLost",
+                f"slice {job.metadata.annotations.get(ANNOTATION_SLICE)} "
+                f"went offline while held; releasing the claim for "
+                f"re-admission",
+            )
+            if self.scheduler is not None:
+                # requeue_preempted, not release: a capacity-loss victim
+                # keeps its ORIGINAL submit time exactly like a
+                # preemption victim — losing a slice must not also cost
+                # the gang its FIFO standing among peers.
+                self.scheduler.requeue_preempted(job)
+            else:
+                self.slice_allocator.release(key)
+
+        # Scale-up drain cleanup: a gang that claimed its full-size slice
+        # while the degraded generation was still live holds BOTH (so no
+        # waiter lands on chips the old pods occupy). Once no live pod of
+        # the old generation remains, free the degraded slice and wake
+        # the waiters it can serve — the same drain-before-release
+        # discipline as preemption.
+        if (self.slice_allocator is not None
+                and job.status.reshaped_replicas is None
+                and len(self.slice_allocator.held_slices(key)) > 1):
+            cur_hash = tf_config.topology_hash(job)
+            stale_live = any(
+                p.metadata.labels.get(ctrl.LABEL_SPEC_HASH)
+                not in (None, cur_hash) and not p.is_finished()
+                for p in (pods or [])
+            )
+            if not stale_live and self.slice_allocator.release_except_class(
+                    key, full_topology):
+                self._kick_slice_waiters()
+
         if self.scheduler is None:
-            if self.slice_allocator is None:
-                return None
-            slice_id = self.slice_allocator.admit(key, job.spec.tpu.topology)
-            if slice_id is None:
-                self.cluster.record_event(
-                    TrainJob.KIND, job.namespace, job.name, "Warning",
-                    "SliceUnavailable",
-                    f"no free {job.spec.tpu.topology} slice; gang-waiting",
-                )
-                return SLICE_RETRY_DELAY_S
-            if job.metadata.annotations.get(ANNOTATION_SLICE) != slice_id:
-                job.metadata.annotations[ANNOTATION_SLICE] = slice_id
-            return None
+            return self._admit_slice_allocator(
+                job, key, full_topology, elastic
+            )
 
         decision = self.scheduler.decide(job)
+        if not decision.admit and elastic and decision.reason == "capacity":
+            # Same degraded path for fleet deployments: a capacity-blocked
+            # elastic job (fresh, gang-rolled, or preempted-and-requeued)
+            # takes whatever smaller class the scheduler will grant —
+            # ranked waiters keep their reservations, so this never
+            # steals a slice a higher-priority job was promised.
+            for cand, scaled in self._degraded_candidates(job):
+                d2 = self.scheduler.decide(job, topology=cand)
+                if d2.admit:
+                    self._record_reshape(job, key, scaled, cand)
+                    decision = d2
+                    break
         if decision.admit:
+            running_cls = self.scheduler.running_class(key)
+            if (running_cls is not None
+                    and running_cls == gang.slice_class(full_topology)):
+                # Note: the degraded slice is NOT freed yet — the gang
+                # holds both until the old generation drains; the
+                # cleanup block above releases it and kicks the waiters.
+                self._record_full_size(job, key)
             if (decision.slice_id and job.metadata.annotations.get(
                     ANNOTATION_SLICE) != decision.slice_id):
                 job.metadata.annotations[ANNOTATION_SLICE] = decision.slice_id
@@ -463,6 +685,62 @@ class TrainJobController(ctrl.JobControllerBase):
         return SLICE_RETRY_DELAY_S + min(
             120.0, 0.25 * (decision.position or 0))
 
+    def _admit_slice_allocator(self, job: TrainJob, key: str,
+                               full_topology: str,
+                               elastic: bool) -> float | None:
+        """The scheduler-less admission gate (first-come allocator), with
+        the elastic upgrade/degrade paths folded in."""
+        if self.slice_allocator is None:
+            return None
+        # A FULL-SIZE claim stands wherever it is — online, or offline
+        # under a still-live gang (the drained-offline case released it
+        # above). Never shopping for a different slice here is what keeps
+        # a live gang from being silently migrated onto a same-class
+        # slice its pods don't occupy; only RESHAPED gangs change class.
+        # (A scale-up briefly holds two slices: the full-class one is the
+        # authoritative annotation while the degraded one drains.)
+        if (self.slice_allocator.holding(key) is not None
+                and job.status.reshaped_replicas is None):
+            held = (self.slice_allocator.holding_class(key, full_topology)
+                    or self.slice_allocator.holding(key))
+            if job.metadata.annotations.get(ANNOTATION_SLICE) != held:
+                job.metadata.annotations[ANNOTATION_SLICE] = held
+            return None
+        # Full size first — `claim` is both the fresh admission and the
+        # scale-back-up: a reshaped gang with live pods keeps its
+        # degraded slice held (hold-both) until the drain cleanup in
+        # _admit_slice frees it.
+        slice_id = self.slice_allocator.claim(key, full_topology)
+        if slice_id is not None:
+            self._record_full_size(job, key)
+            if job.metadata.annotations.get(ANNOTATION_SLICE) != slice_id:
+                job.metadata.annotations[ANNOTATION_SLICE] = slice_id
+            return None
+        # Full size unavailable. A reshaped gang's degraded claim stands
+        # (admit is idempotent by holder).
+        held = self.slice_allocator.admit(key, full_topology)
+        if held is not None:
+            if job.metadata.annotations.get(ANNOTATION_SLICE) != held:
+                job.metadata.annotations[ANNOTATION_SLICE] = held
+            return None
+        if elastic:
+            for cand, scaled in self._degraded_candidates(job):
+                sid = self.slice_allocator.upgrade(key, cand)
+                if sid is None:
+                    continue  # raced: try the next class
+                self._record_reshape(job, key, scaled, cand)
+                if job.metadata.annotations.get(ANNOTATION_SLICE) != sid:
+                    job.metadata.annotations[ANNOTATION_SLICE] = sid
+                return None
+        self.cluster.record_event(
+            TrainJob.KIND, job.namespace, job.name, "Warning",
+            "SliceUnavailable",
+            f"no free {full_topology} slice"
+            + (" (and no reshapeable smaller class)" if elastic else "")
+            + "; gang-waiting",
+        )
+        return SLICE_RETRY_DELAY_S
+
     # ------------------------------------------------- gang-coherent recovery
 
     @staticmethod
@@ -487,12 +765,14 @@ class TrainJobController(ctrl.JobControllerBase):
 
     def _purge_job_state(self, job: TrainJob) -> None:
         """Job deleted: drop its stuck-Pending dedup entries (they would
-        otherwise linger for the operator's lifetime)."""
+        otherwise linger for the operator's lifetime) and its gang-size
+        gauge series (a deleted job must stop being scraped)."""
         key = f"{job.namespace}/{job.name}"
         self._stuck_pending_warned = {
             e for e in self._stuck_pending_warned
             if not e.startswith(key + ":")
         }
+        metrics.gang_size.remove(namespace=job.namespace, job=job.name)
 
     def _check_stuck_pending(self, job: TrainJob, pods: list[Pod], key: str) -> None:
         """recovery.pendingTimeoutSeconds: surface pods wedged in Pending
@@ -541,6 +821,61 @@ class TrainJobController(ctrl.JobControllerBase):
             if e.startswith(f"{key}:")
             and e.split(":", 1)[1] not in pending_uids
         }
+
+    # ----------------------------------------------------------- chaos capacity
+
+    def _apply_capacity(self, d) -> None:
+        """Dial the slice inventory to the directive's `slices=N` (the
+        deterministic stand-in for node loss/return). Held slices are not
+        revoked — holders notice via held_offline at their next roll."""
+        if self.slice_allocator is None:
+            return
+        affected = self.slice_allocator.set_capacity(int(d.params["slices"]))
+        # Affected holders re-sync promptly (their claim's availability
+        # changed); restored capacity additionally wakes the waiters.
+        for holder in affected:
+            self.enqueue(holder)
+        self._kick_slice_waiters()
+
+    def _capacity_tick(self, job: TrainJob, key: str) -> None:
+        """Fire armed `capacity:...,at_step=S,job=NAME` directives once
+        the named job's heartbeat crosses S (one-shot; same polling
+        discipline as `preempt:`)."""
+        for d in self._chaos_capacity:
+            if "at_step" not in d.params:
+                continue  # applied at construction
+            if d.params.get("job") != job.name:
+                continue
+            if d.params.get("namespace", "default") != job.namespace:
+                continue
+            if self._chaos_state.fired(d):
+                continue
+            if self.heartbeat_source is None:
+                if key not in self._chaos_capacity_warned:
+                    self._chaos_capacity_warned.add(key)
+                    self.cluster.record_event(
+                        TrainJob.KIND, job.namespace, job.name,
+                        "Warning", "ChaosCapacityUnarmed",
+                        "capacity: directive keys on this job's heartbeat "
+                        "but the operator has no heartbeat source "
+                        "(--log-dir); the step boundary can never be "
+                        "observed",
+                    )
+                continue
+            hb = self._job_heartbeat(job)
+            step = hb.get("step") if hb else None
+            if step is not None and int(step) >= int(d.params["at_step"]):
+                self._chaos_state.mark(d)
+                self.cluster.record_event(
+                    TrainJob.KIND, job.namespace, job.name, "Normal",
+                    "ChaosCapacity",
+                    f"capacity directive fired at step >= "
+                    f"{d.params['at_step']}: slice inventory -> "
+                    f"{d.params['slices']}",
+                )
+                self._apply_capacity(d)
+            else:
+                self.queue.add_after(key, 0.3)
 
     # ------------------------------------------------------ graceful preemption
 

@@ -446,7 +446,14 @@ def elastic_scale_up_down(client: TrainJobClient) -> None:
     """Beyond the reference's eight behaviors (SURVEY §5 'No elasticity'):
     scale a RUNNING job up, see the new replica appear (and every worker
     re-injected with the new topology via the rolling replacement), then
-    back down, see the extra replica and its DNS identity vanish."""
+    back down, see the extra replica and its DNS identity vanish.
+
+    This suite drives the fake workload, so it proves the CONTROL-PLANE
+    half (spec-driven scaling + rolling re-injection). The genuinely
+    reshaped RESUME — real trainers re-admitted at a different gang size
+    resharding their checkpoint onto the new mesh — is the round-14
+    capstone pair in tests/test_reshape.py (TestReshapedResumeE2E /
+    TestScaleUpE2E)."""
     name = "e2e-elastic"
     _cleanup(client, name)
     client.create(manifest(name, {"Worker": (2, WORKLOAD)}))

@@ -1,0 +1,96 @@
+"""Elastic gang reshaping: the replica/mesh arithmetic behind
+`runPolicy.recovery.elastic`.
+
+When a gang cannot re-place at full size (its slice class has no free —
+or even existing — capacity), the controller may re-admit it onto a
+SMALLER slice of the same accelerator with proportionally fewer worker
+replicas, provided the shrink is exact: the worker count and the mesh's
+data axis must both scale by the same integral factor, or the reshaped
+job would build a mesh whose device product no longer matches its world
+size. These helpers are pure functions so the validation matrix and the
+controller share one definition of "reshapeable".
+
+The topology-portable checkpoint layer (models/checkpoint.py sharding
+manifests + the trainer's --allow-reshape resume) is what makes the
+re-admitted gang RESUME rather than restart: the saved trainstate was
+laid out for the old mesh, and restore re-lays-out every leaf onto
+whatever mesh the reshaped gang builds.
+"""
+
+from __future__ import annotations
+
+from tf_operator_tpu.gang.topology import parse_topology
+
+
+def scaled_worker_count(
+    full_workers: int, full_chips: int, granted_chips: int,
+    min_replicas: int = 1,
+) -> int | None:
+    """Worker count for a gang reshaped from a `full_chips` slice onto a
+    `granted_chips` one: proportional, and only when the scale is exact
+    (2 workers on 2 chips -> 1 worker on 1 chip; 3 workers never fit a
+    2/3 shrink). None when the shrink is not representable or would go
+    below `min_replicas`."""
+    if full_workers <= 0 or full_chips <= 0 or granted_chips <= 0:
+        return None
+    if granted_chips >= full_chips:
+        return full_workers
+    scaled = full_workers * granted_chips
+    if scaled % full_chips:
+        return None
+    scaled //= full_chips
+    if scaled < 1 or scaled < max(1, min_replicas):
+        return None
+    return scaled
+
+
+def scaled_mesh_axes(
+    axes: dict[str, int], full_workers: int, new_workers: int
+) -> dict[str, int] | None:
+    """Rescale a mesh's DATA axis for a gang going from `full_workers` to
+    `new_workers` replicas. Only dp (then fsdp) may absorb the change —
+    tp/sp/ep/pp shard model dimensions whose layout a replica-count change
+    must not silently alter. Returns the new axes dict, the input axes
+    unchanged when there is nothing to scale, or None when no data axis
+    divides cleanly (the job is not reshapeable to that size)."""
+    if new_workers == full_workers or not axes:
+        return dict(axes) if axes else axes
+    if full_workers <= 0 or new_workers <= 0:
+        return None
+    out = dict(axes)
+    for ax in ("dp", "fsdp"):
+        size = out.get(ax)
+        if not size:
+            continue
+        scaled = size * new_workers
+        if scaled % full_workers == 0 and scaled // full_workers >= 1:
+            out[ax] = scaled // full_workers
+            return out
+    return None
+
+
+def degraded_plan(
+    full_topology: str, full_workers: int,
+    granted_topology: str,
+    mesh_axes: dict[str, int] | None,
+    min_replicas: int = 1,
+) -> tuple[int, dict[str, int] | None] | None:
+    """Full reshape feasibility check for one candidate slice class:
+    (scaled worker count, scaled mesh axes) or None when the gang cannot
+    shrink onto `granted_topology` (non-integral replica scale, below
+    minReplicas, or a mesh whose data axes cannot absorb the change)."""
+    try:
+        full = parse_topology(full_topology)
+        granted = parse_topology(granted_topology)
+    except ValueError:
+        return None
+    workers = scaled_worker_count(
+        full_workers, full.num_chips, granted.num_chips, min_replicas
+    )
+    if workers is None:
+        return None
+    axes = mesh_axes or {}
+    scaled_axes = scaled_mesh_axes(axes, full_workers, workers)
+    if axes and scaled_axes is None:
+        return None
+    return workers, scaled_axes

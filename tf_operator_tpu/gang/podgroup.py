@@ -70,6 +70,20 @@ class SliceState:
     topology: SliceTopology
     slice_id: str
     held_by: str | None = None  # "{ns}/{podgroup}" when allocated
+    # Capacity loss (maintenance, node failure, a chaos `capacity:`
+    # directive): an offline slice is invisible to fresh admission and to
+    # free_by_class, but a HOLDER keeps it until its claim is released —
+    # real slice loss kills the gang's pods anyway, so the controller
+    # notices at the next gang roll (held_offline) rather than yanking a
+    # healthy running gang out from under itself.
+    offline: bool = False
+
+    def matches(self, want: SliceTopology) -> bool:
+        """Same capacity class as `want` — the ONE definition of what
+        `admit` grants, `claim`/`upgrade` move between, and
+        release_except_class keeps."""
+        return (self.topology.accelerator == want.accelerator
+                and self.topology.num_chips == want.num_chips)
 
 
 @dataclass
@@ -93,21 +107,126 @@ class SliceAllocator:
         )
 
     def admit(self, holder: str, topology: str) -> str | None:
-        """Returns a slice_id, or None when no whole slice is free."""
+        """Returns a slice_id, or None when no whole slice is free.
+
+        Idempotent per holder: a holder re-admitting keeps its slice even
+        when the requested topology differs (the elastic upgrade path
+        goes through `upgrade`, which atomically swaps classes)."""
         want = parse_topology(topology)
         with self._lock:
             for s in self.slices:
                 if s.held_by == holder:
                     return s.slice_id  # idempotent re-admission
             for s in self.slices:
-                if (
-                    s.held_by is None
-                    and s.topology.accelerator == want.accelerator
-                    and s.topology.num_chips == want.num_chips
-                ):
+                if s.held_by is None and not s.offline and s.matches(want):
                     s.held_by = holder
                     return s.slice_id
         return None
+
+    def upgrade(self, holder: str, topology: str) -> str | None:
+        """Move the holder onto a slice of exactly `topology`'s class:
+        returns the held slice when it already matches (and is online),
+        else atomically claims a free online slice of the class and
+        releases every other slice the holder had. None when no such
+        slice is free — the holder keeps what it has. Only safe when the
+        holder's gang is DRAINED (the released slice frees immediately);
+        a live gang scaling up goes through `claim` + a deferred
+        `release_except_class` once its old generation is gone."""
+        want = parse_topology(topology)
+        with self._lock:
+            for s in self.slices:
+                if s.held_by == holder and s.matches(want) and not s.offline:
+                    return s.slice_id
+            for s in self.slices:
+                if s.held_by is None and not s.offline and s.matches(want):
+                    for old in self.slices:
+                        if old.held_by == holder:
+                            old.held_by = None
+                    s.held_by = holder
+                    return s.slice_id
+        return None
+
+    def claim(self, holder: str, topology: str) -> str | None:
+        """Claim a slice of `topology`'s class WITHOUT releasing anything
+        else the holder has (idempotent when one is already held online).
+        The hold-both half of a live scale-up: the old slice stays held —
+        so no waiter can land on chips the old generation still occupies
+        — until release_except_class frees it after the drain."""
+        want = parse_topology(topology)
+        with self._lock:
+            for s in self.slices:
+                if s.held_by == holder and s.matches(want) and not s.offline:
+                    return s.slice_id
+            for s in self.slices:
+                if s.held_by is None and not s.offline and s.matches(want):
+                    s.held_by = holder
+                    return s.slice_id
+        return None
+
+    def held_slices(self, holder: str) -> list[str]:
+        """Every slice_id the holder claims (a scale-up in flight holds
+        two: the new full-class slice and the draining degraded one)."""
+        with self._lock:
+            return [s.slice_id for s in self.slices if s.held_by == holder]
+
+    def release_except_class(self, holder: str, topology: str) -> bool:
+        """Free every slice the holder claims whose class is NOT
+        `topology`'s — the drain-complete half of a live scale-up. True
+        when anything was actually freed (the caller then kicks
+        waiters)."""
+        want = parse_topology(topology)
+        freed = False
+        with self._lock:
+            for s in self.slices:
+                if s.held_by == holder and not s.matches(want):
+                    s.held_by = None
+                    freed = True
+        return freed
+
+    def holding(self, holder: str) -> str | None:
+        """The slice_id the holder currently claims (online or offline),
+        or None."""
+        with self._lock:
+            for s in self.slices:
+                if s.held_by == holder:
+                    return s.slice_id
+        return None
+
+    def holding_class(self, holder: str, topology: str) -> str | None:
+        """The held slice matching `topology`'s class (online or offline;
+        a read, never a claim), or None — how the controller names the
+        authoritative slice while a scale-up briefly holds two."""
+        want = parse_topology(topology)
+        with self._lock:
+            for s in self.slices:
+                if s.held_by == holder and s.matches(want):
+                    return s.slice_id
+        return None
+
+    def held_offline(self, holder: str) -> bool:
+        """Does the holder's claim sit on a slice that has gone offline?
+        (Capacity lost under a running gang: the claim survives until the
+        controller releases it at the next roll/drain.)"""
+        with self._lock:
+            return any(
+                s.held_by == holder and s.offline for s in self.slices
+            )
+
+    def set_capacity(self, count: int) -> list[str]:
+        """Chaos/maintenance capacity dial: slices at inventory index >=
+        `count` go offline (front of the inventory stays), slices below
+        come back online. Held claims are NOT revoked — held_offline
+        surfaces them. Returns the holders whose slices changed
+        availability, so the controller can re-sync them."""
+        affected: list[str] = []
+        with self._lock:
+            for i, s in enumerate(self.slices):
+                off = i >= max(0, count)
+                if off != s.offline:
+                    s.offline = off
+                    if s.held_by is not None:
+                        affected.append(s.held_by)
+        return affected
 
     def release(self, holder: str) -> bool:
         """Free the holder's slices; True if anything was actually held (so
@@ -123,19 +242,38 @@ class SliceAllocator:
 
     def free_slices(self) -> int:
         with self._lock:
-            return sum(1 for s in self.slices if s.held_by is None)
+            return sum(
+                1 for s in self.slices
+                if s.held_by is None and not s.offline
+            )
 
     def free_by_class(self) -> dict[tuple[str, int], int]:
-        """Free slice count per capacity class (accelerator, num_chips) —
-        the granularity `admit` matches on. The fleet scheduler simulates
-        reservations for higher-ranked waiters against this view."""
+        """Free ONLINE slice count per capacity class (accelerator,
+        num_chips) — the granularity `admit` matches on. The fleet
+        scheduler simulates reservations for higher-ranked waiters
+        against this view."""
         out: dict[tuple[str, int], int] = {}
         with self._lock:
             for s in self.slices:
-                if s.held_by is None:
+                if s.held_by is None and not s.offline:
                     k = (s.topology.accelerator, s.topology.num_chips)
                     out[k] = out.get(k, 0) + 1
         return out
+
+    def free_classes_below(self, topology: str) -> list[str]:
+        """Degraded-admission candidates: canonical topology names
+        ("v5e-2") of free online slice classes with the same accelerator
+        and FEWER chips than `topology`, largest first — the order the
+        elastic controller tries them in (least shrink wins)."""
+        want = parse_topology(topology)
+        seen: dict[int, str] = {}
+        with self._lock:
+            for s in self.slices:
+                if (s.held_by is None and not s.offline
+                        and s.topology.accelerator == want.accelerator
+                        and s.topology.num_chips < want.num_chips):
+                    seen.setdefault(s.topology.num_chips, s.topology.name)
+        return [seen[c] for c in sorted(seen, reverse=True)]
 
 def slice_class(topology: str) -> tuple[str, int]:
     """Capacity class of a topology request: (accelerator, chip count) —

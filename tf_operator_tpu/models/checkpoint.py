@@ -46,6 +46,17 @@ _STEP_RE = re.compile(r"^step_(\d+)$")
 # with list_steps' `^step_<N>$` directory match.
 MANIFEST_SUFFIX = ".manifest.json"
 
+# Second sibling, the SHARDING manifest (topology-portable checkpoints):
+# the gang shape the checkpoint was saved from — process/device count,
+# mesh axis layout, per-leaf PartitionSpec + global shape/dtype, and a
+# crc32 digest of the host bytes. Restore reads it to decide same-shape
+# vs reshard (a target mesh that differs re-lays-out every leaf via
+# shard-by-spec device_put), to check global-shape equality before a
+# reshard, and to prove bit-equality of what came back. A checkpoint
+# WITHOUT one (pre-manifest / hand-written) gets the same grace as a
+# missing size census: restorable, but same-shape semantics only.
+SHARDING_SUFFIX = ".sharding.json"
+
 
 def _checkpointer():
     import orbax.checkpoint as ocp
@@ -78,6 +89,87 @@ def write_manifest(ckpt_dir: str, name: str) -> str:
                    "total_bytes": sum(census.values())}, f)
     os.replace(tmp, path)
     return path
+
+
+def _sharding_path(ckpt_dir: str, name: str) -> str:
+    return os.path.join(os.path.abspath(ckpt_dir), name + SHARDING_SUFFIX)
+
+
+def _spec_entry(e):
+    """One PartitionSpec entry -> JSON (None | axis name | [axis names])."""
+    if e is None:
+        return None
+    if isinstance(e, (tuple, list)):
+        return [str(a) for a in e]
+    return str(e)
+
+
+def leaf_shardings(tree: Any) -> dict[str, dict]:
+    """{leaf path: {"spec", "shape", "dtype"}} for a (possibly live,
+    device-resident) tree. Leaves without a NamedSharding (host numpy,
+    scalars) record spec=None — fully replicated, which is exactly how
+    restore would lay them out."""
+    import jax
+
+    out: dict[str, dict] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = jax.tree_util.keystr(path)
+        spec = None
+        sharding = getattr(leaf, "sharding", None)
+        pspec = getattr(sharding, "spec", None)
+        if pspec is not None:
+            spec = [_spec_entry(e) for e in pspec]
+        out[key] = {
+            "spec": spec,
+            "shape": [int(d) for d in getattr(leaf, "shape", ())],
+            "dtype": str(getattr(leaf, "dtype", "")),
+        }
+    return out
+
+
+def tree_digest(tree: Any) -> str:
+    """crc32 over every leaf's raw bytes in deterministic (path-sorted)
+    order — the cheap bit-equality witness the sharding manifest records
+    at save and the `resumed` event reports back after restore. Computed
+    on HOST arrays (call after device_get)."""
+    import zlib
+
+    import jax
+    import numpy as np
+
+    leaves = sorted(
+        (jax.tree_util.keystr(p), leaf)
+        for p, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    )
+    crc = 0
+    for key, leaf in leaves:
+        crc = zlib.crc32(key.encode(), crc)
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        crc = zlib.crc32(arr.tobytes(), crc)
+    return f"{crc:08x}"
+
+
+def write_sharding_manifest(ckpt_dir: str, name: str, info: dict) -> str:
+    """Persist the sharding manifest beside <dir>/<name> (tmp+rename,
+    same atomicity discipline as the size census)."""
+    path = _sharding_path(ckpt_dir, name)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(info, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_sharding_manifest(ckpt_dir: str, name: str) -> dict | None:
+    """The sharding manifest of <dir>/<name>, or None when absent OR torn
+    — a checkpoint whose shape cannot be verified degrades to same-shape-
+    only restore semantics, it never crashes the resume walk."""
+    try:
+        with open(_sharding_path(ckpt_dir, name)) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return manifest if isinstance(manifest, dict) else None
 
 
 def validate_named(ckpt_dir: str, name: str) -> bool:
@@ -228,10 +320,12 @@ def prune_checkpoints(ckpt_dir: str, keep: int) -> list[int]:
     for s in steps[:-keep]:
         for name in (f"step_{s}", f"trainstate_{s}"):
             shutil.rmtree(os.path.join(root, name), ignore_errors=True)
-            try:
-                os.unlink(_manifest_path(ckpt_dir, name))
-            except OSError:
-                pass
+            for mpath in (_manifest_path(ckpt_dir, name),
+                          _sharding_path(ckpt_dir, name)):
+                try:
+                    os.unlink(mpath)
+                except OSError:
+                    pass
         pruned.append(s)
     return pruned
 
@@ -251,6 +345,7 @@ def sweep_tmp_dirs(ckpt_dir: str) -> list[str]:
             ".orbax-checkpoint-tmp" in name
             or name == ".FINAL.tmp"
             or (MANIFEST_SUFFIX + ".tmp") in name
+            or (SHARDING_SUFFIX + ".tmp") in name
         )
         if not is_tmp:
             continue

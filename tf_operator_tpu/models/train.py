@@ -132,6 +132,20 @@ _chaos = None
 # boundary helpers share it); None-path costs one `is None` check.
 _heartbeat = None
 
+# The live mesh, for the checkpoint sharding manifest (every save records
+# the gang shape + per-leaf layout it was taken from, so a restore onto a
+# DIFFERENT shape can reshard instead of guessing). Module-global like
+# _chaos/_heartbeat: _save_checkpoint has ~6 call sites across both loops
+# and the preemption path.
+_mesh = None
+
+# Whether saves also record the crc32 digest (the reshard bit-equality
+# witness). Costs a full host-tree pass per save, so it is paid only when
+# the job actually opted into reshaping (--allow-reshape /
+# TPUJOB_ALLOW_RESHAPE — the operator injects the env on elastic jobs);
+# the sharding manifest itself is cheap and always written.
+_digest_saves = False
+
 
 def _hb(step: int, force: bool = False) -> None:
     if _heartbeat is not None:
@@ -168,12 +182,33 @@ def _save_checkpoint(ckpt_dir: str, step: int, state, final: bool = False,
     from tf_operator_tpu.models import checkpoint as ckpt
 
     t0 = time.monotonic()
-    ckpt.save_named(ckpt_dir, f"trainstate_{step}", jax.device_get(_aux_tree(state)))
-    path = ckpt.save(ckpt_dir, step, jax.device_get(state.params))
+    aux = _aux_tree(state)
+    host_aux = jax.device_get(aux)
+    ckpt.save_named(ckpt_dir, f"trainstate_{step}", host_aux)
+    host_params = jax.device_get(state.params)
+    path = ckpt.save(ckpt_dir, step, host_params)
     # orbax coordinates the collective save, but mark_final/_emit/prune are
     # plain file IO: one writer only, or concurrent os.replace of the
     # shared .FINAL.tmp races (loser raises, failing a finished job).
     if jax.process_index() == 0:
+        # Sharding manifest (topology-portable checkpoints): the gang
+        # shape + per-leaf layout this save came from, and a crc32 of the
+        # host bytes (the bit-equality witness the resumed event reports
+        # back). Written after the orbax rename like the size census.
+        from tf_operator_tpu.parallel import mesh as mesh_lib
+
+        info = {
+            "processCount": jax.process_count(),
+            "deviceCount": jax.device_count(),
+            "mesh": (mesh_lib.shape_dict(_mesh)
+                     if _mesh is not None else {}),
+            "leaves": ckpt.leaf_shardings(state.params),
+            "auxLeaves": ckpt.leaf_shardings(aux),
+        }
+        if _digest_saves:
+            info["digest"] = {"params": ckpt.tree_digest(host_params),
+                              "trainstate": ckpt.tree_digest(host_aux)}
+        ckpt.write_sharding_manifest(ckpt_dir, f"step_{step}", info)
         if final:
             ckpt.mark_final(ckpt_dir, step)
         _emit({"event": "checkpoint", "step": step, "path": path, "final": final})
@@ -193,12 +228,37 @@ def _save_checkpoint(ckpt_dir: str, step: int, state, final: bool = False,
                 )
                 _emit({"event": "chaos_torn_checkpoint", "step": step,
                        "path": damaged})
+    # A finished save is DURABLE progress: force the heartbeat past the
+    # 2 Hz throttle so the operator (hang watchdog, chaos at_step
+    # directives keyed on the heartbeat) sees the checkpointed step
+    # promptly even when steps complete faster than the throttle window.
+    _hb(step, force=True)
     return time.monotonic() - t0
 
 
-def _try_resume(ckpt_dir: str | None, state, tx):
+def _try_resume(ckpt_dir: str | None, state, tx, mesh=None,
+                allow_reshape: bool = False):
     """Restore the newest RESTORABLE checkpoint, if any. Returns
     (state, start_step).
+
+    Topology portability: each checkpoint carries a sharding manifest
+    (gang shape + per-leaf layout, written by _save_checkpoint). A
+    candidate saved at a DIFFERENT shape (process count or mesh axis
+    layout) is a FOREIGN-shape checkpoint: without `allow_reshape`
+    (--allow-reshape / TPUJOB_ALLOW_RESHAPE) it degrades exactly like a
+    corrupt one — skipped with a `resume_fallback` event, walk continues
+    — never a crash. With the flag, restore RESHARDS: per-leaf global
+    shapes are checked against the template first (a model-config change
+    is a skip, not a guess), the host tree restores as usual, and the
+    caller's shard_state lays every leaf out onto the CURRENT mesh by
+    the sharding rules — params and optimizer state together. Leaves
+    whose values depend on the gang size are re-derived, not restored:
+    RNG streams key off the global step and the data loop's shard reader
+    re-splits by the new process count. A checkpoint with NO sharding
+    manifest (pre-manifest, hand-written) gets the census grace:
+    restorable, but same-shape semantics only — with allow_reshape set,
+    a resume_fallback event records that reshape verification was
+    unavailable.
     The reference's contract was 'stable pod identity + restart semantics so
     TF can resume from its own checkpoints' (SURVEY.md §5); here the trainer
     itself resumes, so a pod restarted by the operator's restart policy
@@ -234,24 +294,94 @@ def _try_resume(ckpt_dir: str | None, state, tx):
     all_steps = ckpt.list_steps(ckpt_dir)
     ordered = list(reversed(all_steps))  # newest first
 
-    def next_restorable(start_idx: int) -> tuple[int, int | None]:
-        """(index, step) of the first census-valid candidate at/after
-        start_idx. Lazy on purpose: only checkpoints actually walked PAST
-        are validated (and get a resume_fallback event) — a stale torn
-        step older than the chosen candidate costs nothing and emits
-        nothing, and a long-retention dir is never fully os.walk'd inside
-        the restart path."""
-        i = start_idx
-        while i < len(ordered):
-            s = ordered[i]
-            if ckpt.validate_step(ckpt_dir, s):
-                return i, s
+    from tf_operator_tpu.parallel import mesh as mesh_lib
+
+    cur_shape = {
+        "processCount": jax.process_count(),
+        "mesh": mesh_lib.shape_dict(mesh) if mesh is not None else {},
+    }
+    # Template SHAPES for the reshard global-shape check, read straight
+    # off the live params (master_template changes only DTYPES, never
+    # shapes — going through it, even under eval_shape, would execute
+    # its concrete np.zeros and allocate a full f32 host tree just to
+    # read shapes). Computed lazily the first time a foreign-shape
+    # candidate is considered.
+    tmpl_shapes_memo: list[dict] = []
+
+    def template_shapes() -> dict:
+        if not tmpl_shapes_memo:
+            tmpl_shapes_memo.append({
+                jax.tree_util.keystr(p): [int(d) for d in
+                                          getattr(leaf, "shape", ())]
+                for p, leaf in
+                jax.tree_util.tree_flatten_with_path(state.params)[0]
+            })
+        return tmpl_shapes_memo[0]
+
+    def candidate_gate(s: int) -> tuple[bool, bool, dict | None]:
+        """(restorable, reshaped, sharding manifest) for step s — the
+        census validity plus the topology gate. Deterministic from the
+        shared volume + flags, so every replica reaches the same verdict
+        (the broadcast agreement below then only guards VISIBILITY)."""
+        if not ckpt.validate_step(ckpt_dir, s):
             _emit({"event": "resume_fallback", "skipped_step": s,
                    "reason": "invalid_checkpoint"})
-            i += 1
-        return len(ordered), None
+            return False, False, None
+        sm = ckpt.read_sharding_manifest(ckpt_dir, f"step_{s}")
+        if sm is None:
+            # Pre-manifest / hand-written checkpoint: unverifiable, not
+            # invalid — restorable under same-shape semantics only.
+            if allow_reshape:
+                _emit({"event": "resume_fallback", "step": s,
+                       "reason": "missing_sharding_manifest: shape "
+                                 "unverifiable, same-shape restore only"})
+            return True, False, None
+        saved = {
+            "processCount": int(sm.get("processCount") or 0),
+            "mesh": {k: int(v)
+                     for k, v in (sm.get("mesh") or {}).items()},
+        }
+        if saved == cur_shape:
+            return True, False, sm
+        if not allow_reshape:
+            _emit({"event": "resume_fallback", "skipped_step": s,
+                   "reason": (
+                       f"foreign_shape: saved on "
+                       f"{saved['processCount']} process(es), mesh "
+                       f"{saved['mesh']} (running "
+                       f"{cur_shape['processCount']}, "
+                       f"{cur_shape['mesh']}); pass --allow-reshape to "
+                       f"reshard")})
+            return False, False, sm
+        # Reshard path: the GLOBAL shapes must match the template leaf
+        # for leaf — a mismatch is a model-config change, and walking
+        # past it beats restoring garbage.
+        saved_shapes = {k: v.get("shape")
+                        for k, v in (sm.get("leaves") or {}).items()}
+        if saved_shapes != template_shapes():
+            _emit({"event": "resume_fallback", "skipped_step": s,
+                   "reason": "reshard_shape_mismatch: per-leaf global "
+                             "shapes differ from this model config"})
+            return False, False, sm
+        return True, True, sm
 
-    idx, last = next_restorable(0)
+    def next_restorable(start_idx: int) -> tuple[int, int | None, bool,
+                                                 dict | None]:
+        """(index, step, reshaped, sharding manifest) of the first
+        restorable candidate at/after start_idx. Lazy on purpose: only
+        checkpoints actually walked PAST are validated (and get a
+        resume_fallback event) — a stale torn step older than the chosen
+        candidate costs nothing and emits nothing, and a long-retention
+        dir is never fully os.walk'd inside the restart path."""
+        i = start_idx
+        while i < len(ordered):
+            ok, reshaped, sm = candidate_gate(ordered[i])
+            if ok:
+                return i, ordered[i], reshaped, sm
+            i += 1
+        return len(ordered), None, False, None
+
+    idx, last, reshaped, sharding_m = next_restorable(0)
     if jax.process_count() > 1:
         # Every replica independently reads the checkpoint dir; if visibility
         # differs (non-shared volume, storage lag) the replicas would resume
@@ -299,7 +429,7 @@ def _try_resume(ckpt_dir: str | None, state, tx):
                 raise
             _emit({"event": "resume_fallback", "skipped_step": last,
                    "reason": f"restore_error: {type(e).__name__}: {e}"})
-            idx, last = next_restorable(idx + 1)
+            idx, last, reshaped, sharding_m = next_restorable(idx + 1)
     if params is None:
         print(
             f"warning: every checkpoint under {ckpt_dir} failed to "
@@ -362,7 +492,44 @@ def _try_resume(ckpt_dir: str | None, state, tx):
         opt_state=opt_state, model_state=model_state,
     )
     start = int(step_arr)
-    _emit({"event": "resumed", "from_step": start, "params_only": partial})
+    def _dtypes_match(saved_leaves, tree) -> bool:
+        """crc32 bytes are only comparable when every leaf restored at
+        its SAVED dtype — a master-weights f32 upcast of a bf16 compute
+        checkpoint is a correct restore whose bytes legitimately differ,
+        and reporting that as a digest mismatch would read as
+        corruption."""
+        got = {jax.tree_util.keystr(p): str(getattr(leaf, "dtype", ""))
+               for p, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]}
+        want = {k: v.get("dtype") for k, v in (saved_leaves or {}).items()}
+        return want == got
+
+    event = {"event": "resumed", "from_step": start, "params_only": partial}
+    saved_digest = (sharding_m.get("digest") or {}) if sharding_m else {}
+    if saved_digest:
+        # Bit-equality witness: crc32 of the restored host bytes vs what
+        # the save recorded (only written when the job opted into
+        # reshaping). Equal digests PROVE the round trip (incl. a
+        # resharded one) returned exactly the saved state; trees whose
+        # dtypes changed across the round trip are skipped, not reported
+        # as mismatches.
+        digest = {}
+        if ("params" in saved_digest
+                and _dtypes_match(sharding_m.get("leaves"), params)):
+            digest["params"] = ckpt.tree_digest(params)
+        if (not partial and "trainstate" in saved_digest
+                and _dtypes_match(sharding_m.get("auxLeaves"), aux)):
+            digest["trainstate"] = ckpt.tree_digest(aux)
+        if digest:
+            event["digest"] = digest
+            event["saved_digest"] = {k: saved_digest[k] for k in digest}
+    if reshaped:
+        event["reshaped"] = {
+            "from_processes": int(sharding_m.get("processCount") or 0),
+            "from_mesh": sharding_m.get("mesh") or {},
+            "to_processes": jax.process_count(),
+            "to_mesh": cur_shape["mesh"],
+        }
+    _emit(event)
     return state, start
 
 
@@ -833,6 +1000,16 @@ def main(argv: list[str] | None = None) -> int:
                          "Evaluator replica follows them (--eval)")
     ap.add_argument("--checkpoint-every", type=int, default=0,
                     help="save every N steps (default: once at the end)")
+    ap.add_argument("--allow-reshape", action="store_true",
+                    help="accept a checkpoint saved at a DIFFERENT gang "
+                         "shape (process count / mesh): restore reshards "
+                         "every leaf (params + optimizer state) onto the "
+                         "current mesh via the checkpoint's sharding "
+                         "manifest. Without this flag a foreign-shape "
+                         "checkpoint is skipped by the resume walk like a "
+                         "corrupt one. The operator sets "
+                         "TPUJOB_ALLOW_RESHAPE=1 on pods of jobs with "
+                         "recovery.elastic.reshapeOnRecovery")
     ap.add_argument("--keep-checkpoints", type=int, default=0,
                     help="retention: after each save keep only the newest K "
                          "step checkpoints (params + trainstate + manifests) "
@@ -1003,6 +1180,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.keep_checkpoints and not args.checkpoint_dir:
         ap.error("--keep-checkpoints prunes --checkpoint-dir; without one "
                  "there is nothing to retain")
+    if args.allow_reshape and not args.checkpoint_dir:
+        ap.error("--allow-reshape shapes the --checkpoint-dir resume walk; "
+                 "without one there is nothing to restore")
     from tf_operator_tpu import chaos as chaos_lib
 
     global _chaos
@@ -1050,6 +1230,9 @@ def main(argv: list[str] | None = None) -> int:
         guard.uninstall()
         _chaos = None
         _heartbeat = None
+        global _mesh, _digest_saves
+        _mesh = None
+        _digest_saves = False
         if args.chaos is not None:
             if chaos_env_prev is None:
                 os.environ.pop(chaos_lib.ENV_CHAOS, None)
@@ -1105,6 +1288,11 @@ def _run_trainer(args, guard) -> int:
     enable_compile_cache()
 
     mesh = mesh_lib.mesh_from_env()
+    global _mesh, _digest_saves
+    _mesh = mesh  # checkpoint sharding manifests record the save-time mesh
+    allow_reshape = (args.allow_reshape
+                     or os.environ.get("TPUJOB_ALLOW_RESHAPE") == "1")
+    _digest_saves = allow_reshape
     # Segment timestamps (bench.py turns these into the startup breakdown
     # the north-star latency metric is judged on).
     _emit({"event": "jax_ready", "t": time.time(),
@@ -1370,7 +1558,13 @@ def _run_trainer(args, guard) -> int:
     # chip) — and params materialize already laid out, never replicated.
     st_sh = state_shardings(jax.eval_shape(build_state), mesh, rules)
     state = jax.jit(build_state, out_shardings=st_sh)()
-    state, start_step = _try_resume(args.checkpoint_dir, state, tx)
+    state, start_step = _try_resume(
+        args.checkpoint_dir, state, tx, mesh=mesh,
+        allow_reshape=allow_reshape,
+    )
+    # Shard-by-spec placement: the (possibly resharded) host tree lands
+    # on the CURRENT mesh per the sharding rules — params and optimizer
+    # state re-laid-out together, whatever shape the checkpoint came from.
     state = shard_state(state, mesh, rules)
     _emit({"event": "model_ready", "t": time.time()})
     # Startup liveness milestone: the resumed step is known, the first

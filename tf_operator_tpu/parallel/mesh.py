@@ -93,6 +93,13 @@ def axis_size(mesh: Mesh, name: str) -> int:
     return mesh.shape[name] if name in mesh.axis_names else 1
 
 
+def shape_dict(mesh: Mesh) -> dict[str, int]:
+    """Plain-dict {axis: size} view of a mesh (JSON-serializable — the
+    form the checkpoint sharding manifest records and the reshape-aware
+    resume compares against)."""
+    return {name: int(size) for name, size in mesh.shape.items()}
+
+
 def local_batch_size(mesh: Mesh, global_batch: int) -> int:
     denom = 1
     for a in data_axes(mesh):

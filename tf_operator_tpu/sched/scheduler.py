@@ -174,6 +174,31 @@ class FleetScheduler:
             return False
         return True
 
+    def _free_after_reservations_locked(
+        self, min_priority: int | None = None
+    ) -> dict[tuple[str, int], int]:
+        """Free capacity per class after mentally reserving one slice for
+        every quota-eligible waiter at priority >= `min_priority` — what
+        an already-running job of that priority may take for an elastic
+        upgrade without inverting priority. Equal-priority waiters still
+        reserve: they hold NOTHING while the upgrader is at least
+        running degraded. Lower-priority waiters never block an upgrade
+        (capacity they'd get would be a priority inversion the moment
+        the upgrader asks). Caller holds the lock."""
+        free = self.allocator.free_by_class()
+        jobs_by_ns = self._jobs_by_namespace()
+        reserved: dict[str, tuple[int, int]] = {}
+        for e in self._ranked():
+            if min_priority is not None and e.priority < min_priority:
+                continue
+            if not self._quota_headroom(e.namespace, jobs_by_ns, reserved):
+                continue
+            if free.get(e.slice_cls, 0) > 0:
+                free[e.slice_cls] -= 1
+                rj, rs = reserved.get(e.namespace, (0, 0))
+                reserved[e.namespace] = (rj + 1, rs + 1)
+        return free
+
     def _update_depth_gauge(self) -> None:
         depths = self._waiting.depths()
         for q in self._gauge_queues - set(depths):
@@ -184,17 +209,61 @@ class FleetScheduler:
 
     # -------------------------------------------------------------- decide
 
-    def decide(self, job: TrainJob) -> Decision:
+    def decide(self, job: TrainJob, topology: str | None = None) -> Decision:
+        """Admission verdict for `job`. `topology` overrides the job's
+        requested slice class — the controller's elastic degraded path
+        asks "would you admit this gang on a SMALLER class?" without
+        mutating the spec; the running branch conversely upgrades a
+        degraded gang back toward the requested class when capacity
+        allows.
+
+        An override is a PROBE: the job's waiting-queue entry keeps its
+        requested class (so full-class reservations and kicks stay
+        correct when the probe fails — only a successful probe dequeues
+        it), and a failed probe never marks a preemption victim (the
+        job was only asking, not committing to the smaller class)."""
         key = job.key()
-        topology = job.spec.tpu.topology
+        requested = job.spec.tpu.topology
+        probe = topology is not None and topology != requested
+        topology = topology or requested
         now = self._clock()
         with self._lock:
             if key in self._running:
-                # Idempotent re-admission (every sync of a running job).
-                sid = self.allocator.admit(key, topology)
-                return Decision(admit=True,
-                                slice_id=sid or self._running[key].slice_id)
+                r = self._running[key]
+                want_cls = slice_class(topology)
+                if r.cls == want_cls:
+                    # Idempotent re-admission (every sync of a running
+                    # job). holding_class, not admit: during a scale-up
+                    # hold-both window the job holds TWO slices, and the
+                    # class-matching one is the authoritative slice_id
+                    # (admit returns whichever comes first in inventory
+                    # order — possibly the draining degraded slice).
+                    sid = (self.allocator.holding_class(key, topology)
+                           or self.allocator.admit(key, topology))
+                    return Decision(admit=True, slice_id=sid or r.slice_id)
+                # Class change (elastic upgrade): only when a slice of
+                # the wanted class stays free AFTER reserving for every
+                # equal-or-higher-priority quota-eligible waiter — a
+                # degraded gang must not scale up past jobs the capacity
+                # was promised to, but lower-priority waiters must not
+                # pin a higher-priority gang at degraded size either.
+                # Otherwise it keeps running at its current size.
+                # `claim` (not `upgrade`): the old slice stays held —
+                # its pods are still running on it — until the
+                # controller's drain cleanup releases it.
+                free = self._free_after_reservations_locked(r.priority)
+                if free.get(want_cls, 0) > 0:
+                    sid = self.allocator.claim(key, topology)
+                    if sid is not None:
+                        r.cls = want_cls
+                        r.chips = parse_topology(topology).num_chips
+                        r.slice_id = sid
+                        self._version += 1
+                        return Decision(admit=True, slice_id=sid)
+                return Decision(admit=True, slice_id=r.slice_id)
 
+            # The WAITING entry always carries the requested class —
+            # probes rank and decide on a substituted copy below.
             entry = self._entry_of(job, now)
             cur = self._waiting.get(key)
             if cur is None or (cur.queue, cur.priority, cur.topology) != (
@@ -204,6 +273,9 @@ class FleetScheduler:
                 self._update_depth_gauge()
             else:
                 entry = cur  # unchanged: keep the cached ranking valid
+            if probe:
+                entry = dc_replace(entry, topology=topology,
+                                   slice_cls=slice_class(topology))
             cls = entry.slice_cls
             free = self.allocator.free_by_class()
             jobs_by_ns = self._jobs_by_namespace()
@@ -228,7 +300,9 @@ class FleetScheduler:
                         return Decision(
                             admit=False, reason="quota", position=pos)
                     continue  # quota-blocked waiters reserve nothing
-                e_cls = e.slice_cls
+                # For a probe, OUR ranked entry still carries the
+                # requested class; the decision runs on the probe class.
+                e_cls = entry.slice_cls if mine else e.slice_cls
                 if free.get(e_cls, 0) > 0:
                     if mine:
                         return self._admit_locked(job, entry, cls, now,
@@ -240,7 +314,7 @@ class FleetScheduler:
                     reserved[e.namespace] = (rj + 1, rs + 1)
                 elif mine:
                     victim = None
-                    if cls not in blocked_classes:
+                    if not probe and cls not in blocked_classes:
                         victim = self._maybe_preempt_locked(entry, cls, now)
                     return Decision(
                         admit=False,
@@ -368,6 +442,14 @@ class FleetScheduler:
             self._version += 1
             self._update_depth_gauge()
         self.allocator.release(key)
+
+    def running_class(self, key: str) -> tuple[str, int] | None:
+        """The slice class a running job currently holds (None when not
+        running) — how the controller tells a full-size admission from a
+        degraded (reshaped) one."""
+        with self._lock:
+            r = self._running.get(key)
+            return r.cls if r is not None else None
 
     def eviction_requested(self, key: str) -> str | None:
         with self._lock:

@@ -54,6 +54,12 @@ REASON_STUCK_PENDING = "StuckPending"
 REASON_QUEUED = "WaitingForCapacity"
 REASON_QUOTA = "QuotaExhausted"
 REASON_PREEMPTED = "PreemptedByHigherPriority"
+# Elastic recovery (recovery.elastic): GangReshaped marks a gang
+# re-admitted below its spec size because full capacity is gone;
+# GangRestored marks the scale back to full size once capacity frees.
+# Restart tallies and backoffLimit are NEVER touched by either.
+REASON_GANG_RESHAPED = "GangReshaped"
+REASON_GANG_RESTORED = "GangRestored"
 
 
 def record_gang_restart(job: TrainJob, message: str, now: float) -> bool:
@@ -112,6 +118,24 @@ def set_condition(status: JobStatus, ctype: JobConditionType, reason: str, messa
         keep.append(c)
     keep.append(new_cond)
     status.conditions = keep
+    return True
+
+
+def lower_condition(status: JobStatus, ctype: JobConditionType, reason: str,
+                    message: str, now: float | None = None) -> bool:
+    """Set an existing condition's status to False (the 'no longer true
+    but keep the record' shape k8s uses for informational conditions —
+    here: GangReshaped once the gang is back at full size). No-op when
+    the condition is absent or already False with this reason."""
+    now = time.time() if now is None else now
+    cur = _find(status, ctype)
+    if cur is None or (not cur.status and cur.reason == reason):
+        return False
+    cur.status = False
+    cur.reason = reason
+    cur.message = message
+    cur.last_update_time = now
+    cur.last_transition_time = now
     return True
 
 

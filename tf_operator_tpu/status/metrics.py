@@ -326,6 +326,24 @@ restarts_total = DEFAULT.counter(
     "Replica restarts by cause (reason: preempt | exit_code | backoff | hang)",
     labels_only=True,
 )
+# Elastic recovery (recovery.elastic): one sample per gang reshape
+# transition the controller admits — direction=shrink (re-admitted below
+# spec size on degraded capacity) or grow (scaled back toward full size
+# when capacity freed). The trainer's subsequent restore reshards the
+# checkpoint onto the new mesh (models/checkpoint.py sharding manifests).
+restore_reshard_total = DEFAULT.counter(
+    "tpujob_restore_reshard_total",
+    "Gang reshape transitions admitted (direction: shrink | grow); the "
+    "resumed trainers reshard their checkpoint onto the new gang shape",
+    labels_only=True,
+)
+gang_size = DEFAULT.gauge(
+    "tpujob_gang_size",
+    "Effective gang size (SPMD replica count) the controller is currently "
+    "reconciling toward, per job — diverges from the spec while a "
+    "GangReshaped job runs degraded",
+    labels_only=True,
+)
 is_leader = DEFAULT.gauge(
     "tpujob_operator_is_leader", "1 when this operator instance holds leadership"
 )
