@@ -112,6 +112,67 @@ class TestThreadDiscipline:
         found = threads.run(make_project(tmp_path, bad))
         assert any(f.rule == "TPT201" and "step" in f.key for f in found)
 
+    # Round 15: the async checkpoint writer thread (models/train.py) is a
+    # root too — its write leg must stay host-only (orbax on host numpy +
+    # file IO), same ban as the transfer lanes.
+    CKPT_BAD = {
+        "tf_operator_tpu/__init__.py": "",
+        "tf_operator_tpu/models/__init__.py": "",
+        "tf_operator_tpu/models/train.py": """
+            import threading
+            import jax.numpy as jnp
+
+            def _write_snapshot(item):
+                # a device reduction on the writer thread: dispatch -> ban
+                return jnp.mean(item)
+
+            def _ckpt_writer_main(writer):
+                _write_snapshot(writer)
+
+            class _CkptWriter:
+                def submit(self, item):
+                    t = threading.Thread(target=_ckpt_writer_main,
+                                         args=(self,))
+                    t.start()
+        """,
+    }
+
+    def test_checkpoint_writer_bad_fixture_flags(self, tmp_path):
+        found = threads.run(make_project(tmp_path, self.CKPT_BAD))
+        assert any(f.rule == "TPT201"
+                   and "_ckpt_writer_main" in f.key
+                   and "jax.numpy.mean" in f.key for f in found), found
+
+    def test_checkpoint_writer_good_fixture_clean(self, tmp_path):
+        good = dict(self.CKPT_BAD)
+        good["tf_operator_tpu/models/train.py"] = """
+            import json
+            import threading
+
+            def _write_snapshot(item):
+                # host-only write leg: serialize + publish, no dispatch
+                with open(item["tmp"], "w") as f:
+                    json.dump(item["tree"], f)
+                import os
+                os.replace(item["tmp"], item["path"])
+
+            def _ckpt_writer_main(writer):
+                _write_snapshot(writer)
+
+            class _CkptWriter:
+                def submit(self, item):
+                    t = threading.Thread(target=_ckpt_writer_main,
+                                         args=(self,))
+                    t.start()
+        """
+        assert threads.run(make_project(tmp_path, good)) == []
+
+    def test_real_writer_thread_is_a_root(self, repo_project):
+        # The ckpt-writer must actually be WALKED (a rename that stops
+        # resolving would silently un-gate the invariant).
+        roots = {(m.name, q) for m, q in threads._thread_roots(repo_project)}
+        assert ("tf_operator_tpu.models.train", "_ckpt_writer_main") in roots
+
     def test_callable_argument_checked(self, tmp_path):
         # jax.tree.map(jnp.asarray, ...) dispatches per leaf on the
         # transfer thread even though jnp.asarray is never the call's func

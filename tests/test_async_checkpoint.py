@@ -1,0 +1,461 @@
+"""Zero-stall checkpointing (round 15): the async snapshot-then-write
+pipeline that moved the orbax serialize + census/sharding manifests +
+digests + retention off the step loop onto a dedicated writer thread.
+
+Non-slow tier: the writer-pipeline units (exactly one in-flight save with
+backpressure, error latching, drain semantics), the durable-heartbeat
+ordering (the forced write lands only AFTER the save is published —
+mid-write the checkpoint dir shows exactly the orbax tmp surface a kill
+would strand), and the `stall:ckpt=` chaos grammar/runtime.
+
+Slow tier (runs unfiltered in CI's chaos-smoke stage): the capstones —
+an async-saved run's restored tree is bit-equal to a synchronous-save
+reference while the step loop paid only the snapshot leg
+(hidden_fraction gated > 0.5), SIGTERM drains and ADOPTS an in-flight
+save, and SIGKILL landing mid-async-write (held open deterministically by
+`stall:ckpt=N`) strands only an orbax tmp dir that the restart sweeps
+before resuming from the previous step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tf_operator_tpu import chaos as chaos_lib
+from tf_operator_tpu.chaos.spec import OneShotState, parse_chaos
+from tf_operator_tpu.models import checkpoint as ckpt_lib
+from tf_operator_tpu.models import train as train_mod
+from tf_operator_tpu.utils.preemption import HeartbeatWriter
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+PY = sys.executable
+
+# Trainer pods run on a 1-device CPU mesh regardless of the suite's
+# 8-device XLA_FLAGS (same discipline as tests/test_chaos.py).
+ONE_DEV = {
+    "PYTHONPATH": REPO_ROOT,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+
+def read_events(path) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def run_trainer(tmp_path, tag: str, *extra: str, steps: int = 36,
+                batch: int = 2048, expect_rc: int = 0,
+                env_extra: dict | None = None) -> list[dict]:
+    """One 1-device trainer subprocess; returns its event stream."""
+    metrics = tmp_path / f"{tag}.jsonl"
+    env = dict(os.environ, **ONE_DEV, TPUJOB_METRICS_FILE=str(metrics),
+               **(env_extra or {}))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("TPUJOB_MESH", None)
+    env.pop("TPUJOB_CHAOS", None)
+    cmd = [PY, "-m", "tf_operator_tpu.models.train", "--model", "mnist-mlp",
+           "--steps", str(steps), "--batch", str(batch), "--log-every", "4",
+           *extra]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=240,
+                       env=env, cwd=REPO_ROOT)
+    assert r.returncode == expect_rc, (r.returncode, r.stderr[-2000:])
+    return read_events(metrics)
+
+
+def fake_item(step: int, ckpt_dir: str = "/nonexistent") -> train_mod._SaveItem:
+    return train_mod._SaveItem(
+        ckpt_dir=ckpt_dir, step=step,
+        host_params={"w": np.arange(4, dtype=np.float32) + step},
+        host_aux={"step": np.int32(step), "opt_leaves": [np.zeros(2)]},
+        info={"processCount": 1, "deviceCount": 1, "mesh": {},
+              "leaves": {}, "auxLeaves": {}},
+        final=False, keep=0,
+    )
+
+
+# ------------------------------------------------------- writer pipeline
+
+
+class TestWriterPipeline:
+    def test_single_inflight_with_backpressure(self, monkeypatch):
+        """Exactly one write leg at a time: a submit during an in-flight
+        write blocks until it drains, and the wait is accounted as a
+        drain (the visible share of write time)."""
+        active = [0]
+        peak = [0]
+        order = []
+        lock = threading.Lock()
+
+        def slow_write(item):
+            with lock:
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            time.sleep(0.05)
+            order.append(item.step)
+            with lock:
+                active[0] -= 1
+
+        monkeypatch.setattr(train_mod, "_write_snapshot", slow_write)
+        w = train_mod._CkptWriter()
+        for step in (8, 16, 24):
+            w.submit(fake_item(step))
+        waited = w.drain()
+        s = w.stats()
+        assert peak[0] == 1                      # the pipeline invariant
+        assert order == [8, 16, 24]              # FIFO through the slot
+        assert s["saves"] == 3 and w.last_step == 24
+        assert s["drains"] == 2                  # submits 2 and 3 blocked
+        assert s["drain_wait_s"] > 0.0
+        assert s["write_s"] >= 0.15
+        assert waited >= 0.0
+        w.close()
+
+    def test_final_drain_not_counted_as_backpressure(self, monkeypatch):
+        monkeypatch.setattr(train_mod, "_write_snapshot",
+                            lambda item: time.sleep(0.05))
+        w = train_mod._CkptWriter()
+        w.submit(fake_item(8))
+        w.drain()  # the final-save / teardown drain
+        s = w.stats()
+        assert s["drains"] == 0 and s["drain_wait_s"] == 0.0
+        assert s["hidden_fraction"] == 1.0  # nothing blocked the loop
+        w.close()
+
+    def test_write_error_latches_and_reraises(self, monkeypatch):
+        def boom(item):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(train_mod, "_write_snapshot", boom)
+        w = train_mod._CkptWriter()
+        w.submit(fake_item(8))
+        # The failure surfaces on the step loop at the next interaction —
+        # sync-mode crash semantics for broken storage, just deferred to
+        # the next boundary.
+        with pytest.raises(RuntimeError, match="disk full"):
+            w.submit(fake_item(16))
+        assert isinstance(w.error, OSError)
+        w.drain(raise_error=False)  # preempt path: degrade, don't raise
+        w.close()                   # cleanup path: never raises
+
+    def test_stats_shape_matches_done_event_contract(self, monkeypatch):
+        monkeypatch.setattr(train_mod, "_write_snapshot", lambda item: None)
+        w = train_mod._CkptWriter()
+        w.submit(fake_item(8))
+        w.drain()
+        s = w.stats()
+        assert set(s) == {"mode", "saves", "snapshot_s", "write_s",
+                          "drains", "drain_wait_s", "hidden_fraction"}
+        assert s["mode"] == "async"
+        w.close()
+
+
+# ------------------------------------------- durable-progress heartbeat
+
+
+class TestDurableHeartbeat:
+    def test_forced_heartbeat_only_after_publish(self, tmp_path,
+                                                 monkeypatch):
+        """The durable-progress rule keys on write COMPLETION: while the
+        write leg is held open in the stall:ckpt window the heartbeat
+        must not carry the step, and the checkpoint dir must show exactly
+        the surface a kill would strand — one orbax tmp dir, no step_N."""
+        hb_path = tmp_path / "hb.json"
+        # Huge throttle: ONLY forced writes can land.
+        monkeypatch.setattr(train_mod, "_heartbeat",
+                            HeartbeatWriter(str(hb_path), min_interval_s=1e9))
+        monkeypatch.setenv("TPUJOB_CHAOS", "stall:ckpt=5,delay=0.8")
+        monkeypatch.setattr(chaos_lib, "_ckpt_stall_state", None)
+        ckpt_dir = tmp_path / "ckpt"
+        w = train_mod._CkptWriter()
+        try:
+            w.submit(fake_item(5, str(ckpt_dir)))
+            # Wait for the write leg to reach the stall window: the tmp
+            # dir exists (fully written) but the final name does not.
+            deadline = time.monotonic() + 30
+            tmp_name = f"step_5{ckpt_lib.TMP_PUBLISH_MARKER}-publish"
+            while time.monotonic() < deadline:
+                if (ckpt_dir / tmp_name).is_dir():
+                    break
+                time.sleep(0.01)
+            else:
+                pytest.fail("write leg never reached the publish window")
+            assert not (ckpt_dir / "step_5").exists()
+            assert not hb_path.exists(), \
+                "heartbeat force-written before the save was durable"
+            w.drain()
+            # Published + durable: now (and only now) the forced write.
+            assert (ckpt_dir / "step_5").is_dir()
+            assert ckpt_lib.validate_named(str(ckpt_dir), "step_5")
+            hb = json.loads(hb_path.read_text())
+            assert hb["step"] == 5
+        finally:
+            w.close()
+            monkeypatch.setattr(train_mod, "_heartbeat", None)
+
+    def test_heartbeat_step_never_regresses(self, tmp_path):
+        """A write leg finishing behind the boundary heartbeats refreshes
+        t at the high-water instead of regressing step (the monotonic
+        contract the tally-reset baseline reads)."""
+        hb = HeartbeatWriter(str(tmp_path / "hb.json"))
+        assert hb.write(20, force=True)
+        t1 = json.loads((tmp_path / "hb.json").read_text())
+        assert hb.write(16, force=True)  # the trailing durable save
+        t2 = json.loads((tmp_path / "hb.json").read_text())
+        assert t2["step"] == 20 and t2["t"] >= t1["t"]
+
+
+# ------------------------------------------------- stall:ckpt=N grammar
+
+
+class TestCkptStallChaos:
+    def test_grammar(self):
+        d = parse_chaos("stall:ckpt=16,delay=2.5")[0]
+        assert d.params == {"ckpt": 16, "delay": 2.5}
+
+    @pytest.mark.parametrize("bad", [
+        "stall:ckpt=16,delay=1,lane=0",
+        "stall:ckpt=16,delay=1,batch=2",
+        "stall:ckpt=16,delay=1,every=3",
+        "stall:ckpt=0,delay=1",
+        "stall:delay=1",
+    ])
+    def test_grammar_rejects(self, bad):
+        with pytest.raises(ValueError):
+            parse_chaos(bad)
+
+    def test_ckpt_stalls_split_from_staging(self, monkeypatch):
+        """A ckpt-targeted stall must NEVER reach the staging ring: the
+        ring's lane-only fallthrough would fire it on every batch."""
+        monkeypatch.setenv(
+            "TPUJOB_CHAOS", "stall:ckpt=8,delay=1;stall:every=3,delay=0.1")
+        staging = chaos_lib.staging_stalls_from_env()
+        ckpt = chaos_lib.ckpt_stalls_from_env()
+        assert [d.params for d in staging] == [{"every": 3, "delay": 0.1}]
+        assert [d.params for d in ckpt] == [{"ckpt": 8, "delay": 1.0}]
+
+    def test_one_shot_per_state(self, tmp_path):
+        stalls = parse_chaos("stall:ckpt=8,delay=0.5")
+        state = OneShotState(str(tmp_path / "state"))
+        assert chaos_lib.ckpt_stall_delay(8, stalls, state) == 0.5
+        # Fired: a resumed generation re-saving step 8 must not re-stall.
+        assert chaos_lib.ckpt_stall_delay(8, stalls, state) == 0.0
+        # ...even through a FRESH OneShotState over the same dir (the
+        # restart shape).
+        state2 = OneShotState(str(tmp_path / "state"))
+        assert chaos_lib.ckpt_stall_delay(8, stalls, state2) == 0.0
+        assert chaos_lib.ckpt_stall_delay(9, stalls, state2) == 0.0  # miss
+
+
+# --------------------------------------------------------- slow capstones
+
+
+def _restore_pair(ckpt_dir: str, step: int):
+    params = ckpt_lib.restore(ckpt_dir, step)
+    aux = ckpt_lib.restore_named(ckpt_dir, f"trainstate_{step}")
+    return params, aux
+
+
+def _assert_trees_bit_equal(a, b):
+    import jax
+
+    la = jax.tree_util.tree_flatten_with_path(a)[0]
+    lb = jax.tree_util.tree_flatten_with_path(b)[0]
+    assert [k for k, _ in la] == [k for k, _ in lb]
+    for (key, va), (_, vb) in zip(la, lb):
+        va, vb = np.asarray(va), np.asarray(vb)
+        assert va.dtype == vb.dtype, key
+        assert np.array_equal(va, vb), key
+
+
+@pytest.mark.slow
+class TestAsyncSyncParity:
+    """The tentpole's acceptance bar: checkpoint stall per save drops to
+    the snapshot leg alone (write hidden behind training) while the end
+    state stays bit-equal to fully-synchronous saves."""
+
+    STEPS, EVERY = 36, 12
+
+    def test_async_vs_sync_bit_equal_and_hidden(self, tmp_path):
+        a_dir, s_dir = str(tmp_path / "async"), str(tmp_path / "sync")
+        a_ev = run_trainer(tmp_path, "async", "--checkpoint-dir", a_dir,
+                           "--checkpoint-every", str(self.EVERY),
+                           steps=self.STEPS)
+        s_ev = run_trainer(tmp_path, "sync", "--checkpoint-dir", s_dir,
+                           "--checkpoint-every", str(self.EVERY),
+                           "--checkpoint-mode", "sync", steps=self.STEPS)
+
+        # Same trajectory, bit-equal final state: params AND resume aux.
+        ap, aa = _restore_pair(a_dir, self.STEPS)
+        sp, sa = _restore_pair(s_dir, self.STEPS)
+        _assert_trees_bit_equal(ap, sp)
+        _assert_trees_bit_equal(aa, sa)
+        # ...and bit-equal INTERMEDIATE state. This is the regression pin
+        # for the snapshot-aliasing bug: on the CPU backend device_get
+        # hands back views of the donated device buffers, and without the
+        # owned-copy rule the async writer serialized step-12's snapshot
+        # AFTER later chunks had overwritten it in place (a trainstate_12
+        # whose step read 24). The final save has no subsequent dispatch,
+        # so only intermediate checkpoints could corrupt.
+        ip, ia = _restore_pair(a_dir, self.EVERY)
+        jp, ja = _restore_pair(s_dir, self.EVERY)
+        assert int(np.asarray(ia["step"])) == self.EVERY
+        _assert_trees_bit_equal(ip, jp)
+        _assert_trees_bit_equal(ia, ja)
+
+        a_done = [e for e in a_ev if e["event"] == "done"][-1]
+        s_done = [e for e in s_ev if e["event"] == "done"][-1]
+        ac, sc = a_done["checkpoint"], s_done["checkpoint"]
+        assert ac["mode"] == "async" and sc["mode"] == "sync"
+        assert ac["saves"] == sc["saves"] == 3  # 12, 24, final 36
+
+        # The write leg is real work... and it is HIDDEN: with a save
+        # interval longer than a write, more than half the write time
+        # (in practice ~all of it) rides under training.
+        assert ac["write_s"] > 0
+        assert ac["hidden_fraction"] is not None
+        assert ac["hidden_fraction"] > 0.5, ac
+        # The step loop paid only the snapshot leg (+ backpressure, zero
+        # here): orders of magnitude under the sync save cost.
+        async_stall = ac["snapshot_s"] + ac["drain_wait_s"]
+        sync_stall = sc["snapshot_s"] + sc["write_s"]
+        assert async_stall < sync_stall / 2, (async_stall, sync_stall)
+
+        # Phase taxonomy: async runs bill ckpt_snapshot, never the sync
+        # checkpoint phase — and vice versa (telescoping checked by the
+        # telemetry suite).
+        a_phases = a_done["phase_breakdown"]
+        s_phases = s_done["phase_breakdown"]
+        assert "ckpt_snapshot" in a_phases and "checkpoint" not in a_phases
+        assert "checkpoint" in s_phases and "ckpt_snapshot" not in s_phases
+
+        # Digests: default-on under async (the two tree passes ride the
+        # writer thread); still opt-in (elastic) under sync.
+        am = ckpt_lib.read_sharding_manifest(a_dir, f"step_{self.STEPS}")
+        sm = ckpt_lib.read_sharding_manifest(s_dir, f"step_{self.STEPS}")
+        assert am and "digest" in am
+        assert sm and "digest" not in sm
+        # The async digest is a live witness: it matches a fresh host
+        # digest of what restore returns.
+        assert am["digest"]["params"] == ckpt_lib.tree_digest(ap)
+
+
+@pytest.mark.slow
+class TestDrainOnPreempt:
+    def test_inflight_save_adopted_as_emergency_checkpoint(self, tmp_path):
+        """SIGTERM at the boundary whose periodic save is still on the
+        writer thread: the teardown DRAINS it and adopts it — no second
+        save, emergency_checkpoint honored, then a clean resume."""
+        ckpt_dir = str(tmp_path / "ckpt")
+        ev = run_trainer(
+            tmp_path, "preempt", "--checkpoint-dir", ckpt_dir,
+            "--checkpoint-every", "12", "--preempt-grace", "60",
+            "--chaos", "kill:step=12,signal=TERM",
+            steps=24, expect_rc=143)
+        pre = [e for e in ev if e["event"] == "preempted"]
+        assert len(pre) == 1
+        p = pre[0]
+        assert p["step"] == 12
+        assert p["emergency_checkpoint"] is True
+        assert p["adopted_async_save"] is True
+        assert "drain_s" in p
+        # Adopted, not re-saved: exactly one checkpoint event, step 12.
+        saves = [e for e in ev if e["event"] == "checkpoint"]
+        assert [e["step"] for e in saves] == [12]
+        assert ckpt_lib.validate_step(ckpt_dir, 12)
+
+        ev2 = run_trainer(tmp_path, "preempt-resume",
+                          "--checkpoint-dir", ckpt_dir,
+                          "--checkpoint-every", "12", steps=24)
+        resumed = [e for e in ev2 if e["event"] == "resumed"]
+        assert len(resumed) == 1 and resumed[0]["from_step"] == 12
+        assert [e for e in ev2 if e["event"] == "done"][-1]["steps"] == 24
+
+
+@pytest.mark.slow
+class TestKillMidAsyncWrite:
+    def test_sigkill_mid_write_sweeps_tmp_and_resumes_back(self, tmp_path):
+        """kill: landing while the writer is held in the stall:ckpt
+        window leaves only an orbax tmp dir; the operator restarts the
+        pod (137 is retryable), the startup sweep removes the tmp, and
+        resume walks back to the previous published step."""
+        from tf_operator_tpu.api import defaults
+        from tf_operator_tpu.api.types import (
+            ContainerSpec, JobConditionType, ObjectMeta, PodTemplateSpec,
+            ReplicaSpec, RestartPolicy, TrainJob, TrainJobSpec, is_succeeded,
+        )
+        from tf_operator_tpu.runtime.session import LocalSession
+
+        ckpt = str(tmp_path / "ckpt")
+        # Timing shape: step_8 publishes normally (the resume target);
+        # the save submitted at boundary 16 is held open by the 45 s
+        # stall; the kill targets boundary 24 — whose loop iteration
+        # first BLOCKS fetching the previous chunk's loss (the scanned
+        # loop's boundaries are otherwise host-instant: dispatches return
+        # futures), ~0.9 s of device compute at batch 8192. That is ~4x
+        # the warm writer's path to the stall window, and both sides are
+        # CPU-bound so host-speed swings move them together. Boundary 24
+        # never submits another save (the final save runs after the
+        # loop), so backpressure cannot absorb the kill.
+        cmd = [PY, "-m", "tf_operator_tpu.models.train", "--model",
+               "mnist-mlp", "--steps", "24", "--batch", "8192",
+               "--log-every", "4", "--checkpoint-dir", ckpt,
+               "--checkpoint-every", "8",
+               "--chaos", "stall:ckpt=16,delay=45;kill:step=21,signal=KILL"]
+        job = TrainJob(
+            metadata=ObjectMeta(name="mid-write-kill"),
+            spec=TrainJobSpec(replica_specs={
+                defaults.canonical_replica_type("worker"): ReplicaSpec(
+                    replicas=1, restart_policy=RestartPolicy.EXIT_CODE,
+                    template=PodTemplateSpec(containers=[
+                        ContainerSpec(name="tensorflow", image="local",
+                                      command=cmd)])),
+            }),
+        )
+        job.spec.run_policy.scheduling.gang = False
+        defaults.set_defaults(job)
+        env = dict(ONE_DEV)
+        env["TPUJOB_PRESPAWN"] = "0"
+        # One-shot markers must survive the restart: without the state
+        # dir the resumed generation would re-enter the 30 s stall when
+        # it re-saves step 16.
+        env["TPUJOB_CHAOS_STATE"] = str(tmp_path / "chaos-state")
+        session = LocalSession(env_overrides=env,
+                               log_dir=str(tmp_path / "logs"))
+        try:
+            session.submit(job)
+            final = session.wait_for_condition(
+                "default", "mid-write-kill",
+                (JobConditionType.SUCCEEDED, JobConditionType.FAILED),
+                timeout=240)
+            assert is_succeeded(final.status), [
+                (str(c.type), c.reason, c.message)
+                for c in final.status.conditions]
+        finally:
+            session.close()
+        ev = read_events(
+            tmp_path / "logs" / "default_mid-write-kill-worker-0.metrics.jsonl")
+        # Generation 2 swept the stranded write-leg tmp dir...
+        swept = [e for e in ev if e["event"] == "checkpoint_tmp_swept"]
+        assert swept and any(
+            "orbax-checkpoint-tmp" in entry
+            for e in swept for entry in e["entries"]), swept
+        # ...and resumed from the step BEFORE the torn async write: the
+        # unpublished step_16 never entered the resume walk.
+        resumed = [e for e in ev if e["event"] == "resumed"]
+        assert len(resumed) == 1 and resumed[0]["from_step"] == 8
+        assert [e for e in ev if e["event"] == "done"][-1]["steps"] == 24
+        # The re-saved 16 and the final 24 both published cleanly.
+        assert ckpt_lib.validate_step(ckpt, 24)
+        assert ckpt_lib.final_step(ckpt) == 24

@@ -612,6 +612,14 @@ class TestMultiKillResume:
                 [PY, "-m", "tf_operator_tpu.models.train", "--model",
                  "mnist-mlp", "--steps", str(STEPS), "--batch", "16",
                  "--log-every", "2", "--checkpoint-dir", ckpt,
+                 # sync mode: this capstone pins EXACT resume steps, which
+                 # requires step_4 durable before the boundary-6 SIGKILL —
+                 # the synchronous ordering guarantee. Under async (the
+                 # default) a SIGKILL landing right after a boundary can
+                 # legitimately lose the in-flight save (the mid-write-kill
+                 # e2e in tests/test_async_checkpoint.py covers that
+                 # contract).
+                 "--checkpoint-mode", "sync",
                  "--checkpoint-every", "4", "--preempt-grace", "60",
                  "--chaos",
                  "kill:step=6,signal=KILL;kill:step=14,signal=TERM"],

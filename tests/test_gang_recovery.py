@@ -981,8 +981,15 @@ def progress_losses(events: list[dict]) -> dict[int, float]:
 
 
 def dist_trainer_cmd(ckpt: str, *extra: str) -> list[str]:
+    # batch 256, not 16: with async checkpointing (round 15) the step-8
+    # save's write leg races the moment worker-0 wedges on its dead
+    # peer's collectives (~one chunk after the kill) — at batch 16 a
+    # loaded host runs chunks and the warm write at comparable speed and
+    # the step-8 checkpoint sometimes never lands, cold-starting gen 2.
+    # Compute-bound chunks keep ~6x wall-clock between the submit and the
+    # wedge, and both sides scale together under load.
     return [PY, "-m", "tf_operator_tpu.models.train", "--model", "mnist-mlp",
-            "--steps", str(STEPS), "--batch", "16", "--log-every", "4",
+            "--steps", str(STEPS), "--batch", "256", "--log-every", "4",
             "--checkpoint-dir", ckpt, "--checkpoint-every", "8", *extra]
 
 

@@ -938,10 +938,21 @@ class TestReshapedResumeE2E:
             "capacity:slices=1,at_step=8,job=gangshape")
         try:
             ck = str(tmp_path / "ckpt")
+            # sync mode: this choreography needs step_8 durable AND its
+            # forced heartbeat observed by the operator (so the
+            # capacity at_step=8 dial fires) strictly BEFORE the
+            # boundary-12 SIGKILL — the synchronous ordering guarantee.
+            # Under async (the default) durability trails the boundary
+            # by one write, which is the intended new contract (the
+            # durable-heartbeat and mid-write-kill tests in
+            # tests/test_async_checkpoint.py pin it); the reshaped
+            # RESTORE path itself runs against async-written checkpoints
+            # throughout this suite's non-slow units.
             job = make_elastic_job(
                 "gangshape",
                 cmd=dist_trainer_cmd(
-                    ck, "--chaos", "kill:step=12,signal=KILL,index=1"),
+                    ck, "--checkpoint-mode", "sync",
+                    "--chaos", "kill:step=12,signal=KILL,index=1"),
             )
             session.submit(job)
             job = session.wait_for_condition("default", "gangshape", DONE,

@@ -45,7 +45,8 @@ __all__ = [
     "ENV_CHAOS", "ENV_CHAOS_STATE", "Directive", "OneShotState",
     "from_env", "parse_chaos", "parse_signal",
     "TrainerChaos", "hang", "tear_checkpoint", "staging_stalls_from_env",
-    "staging_stall_delay", "apiserver_directives", "preempt_directives",
+    "staging_stall_delay", "ckpt_stalls_from_env", "ckpt_stall_delay",
+    "reset_ckpt_stall_state", "apiserver_directives", "preempt_directives",
     "capacity_directives",
 ]
 
@@ -192,11 +193,15 @@ def tear_checkpoint(ckpt_dir: str, step: int, mode: str = "truncate") -> str:
 
 def staging_stalls_from_env(env: dict | None = None) -> list[Directive]:
     """`stall:` directives for data/staging.py's transfer thread; [] on
-    the (overwhelmingly common) no-chaos path."""
+    the (overwhelmingly common) no-chaos path. ckpt-targeted stalls are
+    excluded — they belong to the checkpoint writer, and the staging
+    engine's lane-only fallthrough would otherwise fire them on every
+    batch."""
     e = os.environ if env is None else env
     if not e.get(ENV_CHAOS):
         return []
-    return [d for d in from_env(e) if d.kind == "stall"]
+    return [d for d in from_env(e)
+            if d.kind == "stall" and "ckpt" not in d.params]
 
 
 def staging_stall_delay(index: int, stalls: list[Directive],
@@ -219,6 +224,55 @@ def staging_stall_delay(index: int, stalls: list[Directive],
                 total += d.params["delay"]
         else:  # lane-only directive: every batch this lane carries
             total += d.params["delay"]
+    return total
+
+
+def ckpt_stalls_from_env(env: dict | None = None) -> list[Directive]:
+    """`stall:ckpt=N` directives — the checkpoint writer's deterministic
+    mid-write hold (models/checkpoint.py sleeps in the tmp->rename
+    publish window); [] on the no-chaos path."""
+    e = os.environ if env is None else env
+    if not e.get(ENV_CHAOS):
+        return []
+    return [d for d in from_env(e)
+            if d.kind == "stall" and "ckpt" in d.params]
+
+
+# Run-lifetime one-shot memory for ckpt stalls (the env-state-dir
+# variant persists across restarts on its own; without one, this cache is
+# what makes "fires once per run" true across repeated saves). The
+# trainer's teardown calls reset_ckpt_stall_state() so in-process callers
+# (tests, notebooks) get fresh one-shot semantics — and a changed
+# TPUJOB_CHAOS_STATE — on their next run, matching kill/hang (which
+# rebuild their OneShotState per TrainerChaos.from_env).
+_ckpt_stall_state: OneShotState | None = None
+
+
+def reset_ckpt_stall_state() -> None:
+    global _ckpt_stall_state
+    _ckpt_stall_state = None
+
+
+def ckpt_stall_delay(step: int, stalls: list[Directive],
+                     state: OneShotState | None = None) -> float:
+    """Total injected sleep for the checkpoint publishing step `step`.
+    One-shot like kill/hang: a directive fires once per process (or once
+    across restarts when TPUJOB_CHAOS_STATE marks it) — a resumed
+    generation re-saving the same step must not re-stall, or a single
+    mid-write kill scenario would wedge every retry after it."""
+    global _ckpt_stall_state
+    if not stalls:
+        return 0.0
+    if state is None:
+        if _ckpt_stall_state is None:
+            _ckpt_stall_state = OneShotState.from_env()
+        state = _ckpt_stall_state
+    total = 0.0
+    for d in stalls:
+        if d.params.get("ckpt") != step or state.fired(d):
+            continue
+        state.mark(d)
+        total += d.params["delay"]
     return total
 
 

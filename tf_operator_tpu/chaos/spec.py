@@ -40,13 +40,29 @@ Directive kinds and their keys (all integers/floats unless noted):
     stall      delay=S batch=N        sleep S seconds in the staging
                     | every=K         ring's transfer leg for batch N
                [lane=L]               (or every Kth batch). lane=L
-                                      restricts the stall to transfer
+                    | ckpt=N          restricts the stall to transfer
                                       lane L of the multi-lane engine
                                       (how a test wedges ONE lane and
                                       proves the others keep the ring
                                       ordered and live); lane=L alone
                                       (no batch/every) stalls every
-                                      batch that lane carries.
+                                      batch that lane carries. ckpt=N
+                                      targets the CHECKPOINT WRITER
+                                      instead of the staging ring: the
+                                      save of step N sleeps S seconds
+                                      between its finished tmp write
+                                      and the publishing rename —
+                                      deterministically holds the async
+                                      write leg mid-write so a kill:
+                                      landing there strands exactly one
+                                      orbax tmp dir. ckpt= composes with
+                                      nothing else (no batch/every/
+                                      lane) and is one-shot like kill
+                                      (per process without a
+                                      TPUJOB_CHAOS_STATE dir, across
+                                      restarts with one — a resumed
+                                      generation re-saving step N must
+                                      not re-stall).
     apiserver  errors=N code=C        the fake apiserver fails the next N
                latency=S match=SUB    matched requests with HTTP C
                                       (code=0: latency only), sleeping S
@@ -113,7 +129,8 @@ _KEYS: dict[str, dict[str, type]] = {
     "kill": {"step": int, "signal": str, "replica": str, "index": int},
     "hang": {"step": int, "duration": float, "replica": str, "index": int},
     "torn": {"step": int, "mode": str},
-    "stall": {"delay": float, "batch": int, "every": int, "lane": int},
+    "stall": {"delay": float, "batch": int, "every": int, "lane": int,
+              "ckpt": int},
     "apiserver": {"errors": int, "code": int, "latency": float,
                   "match": str},
     "preempt": {"step": int, "job": str, "namespace": str},
@@ -219,15 +236,25 @@ def _validate(kind: str, params: dict) -> None:
             raise ValueError(
                 "chaos: stall takes at most one of batch=N or every=K"
             )
-        if ("batch" not in params and "every" not in params
-                and "lane" not in params):
+        if "ckpt" in params and any(
+                k in params for k in ("batch", "every", "lane")):
             raise ValueError(
-                "chaos: stall needs a target: batch=N, every=K, or lane=L"
+                "chaos: stall: ckpt=N targets the checkpoint writer and "
+                "composes with none of batch/every/lane"
+            )
+        if ("batch" not in params and "every" not in params
+                and "lane" not in params and "ckpt" not in params):
+            raise ValueError(
+                "chaos: stall needs a target: batch=N, every=K, lane=L, "
+                "or ckpt=N"
             )
         if params.get("every", 1) < 1:
             raise ValueError("chaos: stall: every must be >= 1")
         if params.get("lane", 0) < 0:
             raise ValueError("chaos: stall: lane must be >= 0")
+        if params.get("ckpt", 1) < 1:
+            raise ValueError("chaos: stall: ckpt must be >= 1 (saves "
+                             "happen at completed-step boundaries)")
     elif kind == "apiserver":
         if params.get("errors", 1) < 0:
             raise ValueError("chaos: apiserver: errors must be >= 0")

@@ -11,6 +11,17 @@ is complete so it can exit cleanly.
 Layout:  <dir>/step_<N>/...   (orbax PyTree checkpoint, atomic rename)
          <dir>/FINAL          (text: last step number)
 
+Publish discipline (round 15, zero-stall checkpointing): save_named
+writes through a `<name>.orbax-checkpoint-tmp-publish` staging dir and
+publishes with one rename, so the async write leg (models/train.py's
+ckpt-writer thread) can be killed at ANY point — including held open by
+`stall:ckpt=N` chaos — leaving only tmp entries sweep_tmp_dirs removes
+at startup. Multi-process runtimes get PROCESS-LOCAL checkpointers
+(every orbax barrier scoped to the calling process, over the
+jax.distributed gRPC client): the trees saved here are host snapshots of
+fully-replicated leaves, so process 0 writes alone and a gang member's
+death can never wedge a peer's save mid-barrier.
+
 Dtype contract (mixed-precision optimizer state, tf_operator_tpu/optim.py):
 trees save at their in-memory dtypes (bf16 Adam moments persist as bf16,
 the f32 master copy as f32 — a bf16-moment checkpoint is ~half the f32
@@ -30,6 +41,7 @@ import json
 import os
 import re
 import shutil
+import threading
 import time
 from typing import Any
 
@@ -58,10 +70,123 @@ MANIFEST_SUFFIX = ".manifest.json"
 SHARDING_SUFFIX = ".sharding.json"
 
 
+# Publish discipline: every save lands under a tmp name carrying orbax's
+# own tmp marker, then renames to the final name. A kill mid-write (or
+# mid-stall, under `stall:ckpt=N` chaos) strands only this tmp dir —
+# which sweep_tmp_dirs already removes at startup and list_steps'
+# `^step_<N>$` match never sees — so the async write leg can die at ANY
+# point without presenting a torn checkpoint to the resume walk.
+TMP_PUBLISH_MARKER = ".orbax-checkpoint-tmp"
+
+
+# One Checkpointer per process, built lazily: constructing one per save
+# costs a metadata-store + handler setup comparable to a small tree's
+# whole write (measured ~half the mnist save), which the async writer
+# would pay on every periodic save. The instance is USED by exactly one
+# thread at a time (the writer pipeline admits one in-flight save; sync
+# saves and restores happen on the main thread while no write is in
+# flight) — but first-touch can race (the writer thread's warm-up vs the
+# main thread's resume restore), hence the construction lock.
+_CHECKPOINTER = None
+_CHECKPOINTER_LOCK = threading.Lock()
+
+
+def process_local_io() -> bool:
+    """Whether this runtime supports PROCESS-LOCAL checkpoint IO (the
+    round-15 model: every orbax barrier scoped to the calling process,
+    process 0 saving alone). True for single-process runtimes and for
+    multi-process ones initialized through jax.distributed (whose gRPC
+    client carries the scoped barriers). False only for a multi-process
+    world WITHOUT a distributed client (e.g. a raw multi-host TPU pod
+    that never ran jax.distributed.initialize): there _checkpointer()
+    falls back to gang-wide barriers, so EVERY process must enter each
+    save (the legacy rule) and the async writer must stand down — those
+    barriers dispatch XLA collectives, which a background thread must
+    never do."""
+    import jax
+
+    if jax.process_count() == 1:
+        return True
+    from jax._src import distributed
+
+    return distributed.global_state.client is not None
+
+
 def _checkpointer():
+    global _CHECKPOINTER
+    if _CHECKPOINTER is not None:
+        return _CHECKPOINTER
+    with _CHECKPOINTER_LOCK:
+        if _CHECKPOINTER is None:
+            _CHECKPOINTER = _build_checkpointer()
+    return _CHECKPOINTER
+
+
+def _build_checkpointer():
     import orbax.checkpoint as ocp
 
+    import jax
+
+    if jax.process_count() > 1:
+        from jax._src import distributed
+
+        if distributed.global_state.client is not None:
+            # Multi-process runtimes get a PROCESS-LOCAL checkpointer:
+            # active_processes = {me}, so every barrier orbax takes spans
+            # exactly this process (and rides the jax.distributed gRPC
+            # client — never multihost_utils.sync_global_devices, an XLA
+            # psum a background thread must not dispatch).
+            #
+            # Why not a gang-wide collective save? Two reasons, both load
+            # bearing for the async writer thread (models/train.py):
+            #   1. The trees this trainer checkpoints are HOST snapshots
+            #      of leaves that are fully replicated across processes
+            #      (multi-process jobs shard data axes only — the same
+            #      invariant PR 9's reshape support documents), so one
+            #      process holds everything worth writing; the gang-wide
+            #      barriers orbax would take coordinate work that doesn't
+            #      exist here.
+            #   2. A collective write leg inherits the gang's failure
+            #      domain: one member SIGKILLed mid-save leaves every
+            #      peer's writer thread wedged in a barrier waiting for a
+            #      dead process — an async save would then block its
+            #      job's own preemption drain. Process-local writes keep
+            #      a peer's death from touching this process's pipeline.
+            # The per-process key prefix keeps the one shared coordination
+            # service from ever seeing two same-named barriers with
+            # different member sets (e.g. both processes restoring
+            # step_N at resume).
+            from orbax.checkpoint import options as ocp_options
+
+            me = jax.process_index()
+            return ocp.Checkpointer(
+                ocp.PyTreeCheckpointHandler(),
+                multiprocessing_options=ocp_options.MultiprocessingOptions(
+                    primary_host=me,
+                    active_processes={me},
+                    barrier_sync_key_prefix=f"proc{me}",
+                ),
+            )
     return ocp.PyTreeCheckpointer()
+
+
+def _publish_stall(name: str) -> None:
+    """Deterministic chaos window between the finished tmp write and the
+    publishing rename: `stall:ckpt=N,delay=S` sleeps here while saving
+    step N — a `kill:` landing during the sleep leaves exactly one orbax
+    tmp dir, the torn-async-write scenario the startup sweep + backward
+    resume walk must absorb. Zero-cost when TPUJOB_CHAOS is unset."""
+    from tf_operator_tpu import chaos as chaos_lib
+
+    stalls = chaos_lib.ckpt_stalls_from_env()
+    if not stalls:
+        return
+    m = _STEP_RE.match(name)
+    if m is None:
+        return
+    delay = chaos_lib.ckpt_stall_delay(int(m.group(1)), stalls)
+    if delay > 0:
+        time.sleep(delay)
 
 
 def _manifest_path(ckpt_dir: str, name: str) -> str:
@@ -205,18 +330,44 @@ def validate_step(ckpt_dir: str, step: int) -> bool:
 
 
 def save_named(ckpt_dir: str, name: str, tree: Any) -> str:
-    """Atomically persist `tree` under <dir>/<name>; returns the path."""
-    path = os.path.join(os.path.abspath(ckpt_dir), name)
+    """Atomically persist `tree` under <dir>/<name>; returns the path.
+
+    Two-phase publish: orbax writes the full tree under a tmp name
+    (<name>.orbax-checkpoint-tmp-publish, identical on every process —
+    see the barrier-key note below; orbax's own internal tmp+rename runs
+    inside that), then ONE rename publishes the final name and the
+    census manifest follows. A death at any point before the rename —
+    including the async write leg SIGKILLed mid-write, or held in the
+    `stall:ckpt=N` chaos window — leaves only tmp entries the startup
+    sweep removes; readers (resume walk, evaluator poll) never observe a
+    partially-written final name."""
+    root = os.path.abspath(ckpt_dir)
+    path = os.path.join(root, name)
+    # The tmp name must be IDENTICAL on every process: orbax's multihost
+    # barrier keys embed the directory name, so a per-pid suffix would
+    # give each gang member a different barrier and deadlock the save.
+    # Uniqueness across concurrent saves of the same name is not needed —
+    # the writer pipeline admits one in-flight save, and a stale tmp from
+    # a killed generation is replaced by force=True (and swept at start).
+    tmp = os.path.join(root, f"{name}{TMP_PUBLISH_MARKER}-publish")
     # Checkpoint IO is the canonical p99 step stall; the span makes a save
-    # that blocked the step loop visible on the --trace timeline.
+    # that blocked the step loop visible on the --trace timeline (on the
+    # async path it rides the writer thread's timeline instead).
     with telemetry.span("checkpoint/save", ckpt=name):
-        _checkpointer().save(path, tree, force=True)
-        # Manifest from process 0 only (orbax writes from process 0 too;
-        # per-writer tmp names keep even a misconfigured double-writer
-        # safe, since os.replace is atomic).
+        _checkpointer().save(tmp, tree, force=True)
+        # Publish + manifest from process 0 only (orbax writes from
+        # process 0 too, and its save barrier has completed by here, so
+        # every process' data is on disk before the rename).
         import jax
 
         if jax.process_index() == 0:
+            _publish_stall(name)
+            if os.path.isdir(path):
+                # Re-save of an existing name (a resumed generation
+                # re-reaching a saved step): same replace semantics as
+                # orbax force=True, applied at the publish boundary.
+                shutil.rmtree(path)
+            os.rename(tmp, path)
             write_manifest(ckpt_dir, name)
     return path
 

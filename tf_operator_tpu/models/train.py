@@ -17,23 +17,33 @@ examples, SURVEY.md §3.4), TPU-native:
 from __future__ import annotations
 
 import argparse
+import contextlib
+import dataclasses
 import json
 import os
 import sys
+import threading
 import time
+from typing import Any
 
 # Stdlib-only (tracer + phase accounting): safe before the jax import and
 # cheap enough that the disabled path costs one attribute read per call.
 from tf_operator_tpu import telemetry
 
+# The metrics stream has two producers since async checkpointing: the
+# step loop and the ckpt-writer thread (checkpoint/checkpoint_pruned
+# events ride the write leg). The lock keeps whole lines whole.
+_emit_lock = threading.Lock()
+
 
 def _emit(event: dict) -> None:
     line = json.dumps(event)
-    print(line, flush=True)
-    path = os.environ.get("TPUJOB_METRICS_FILE")
-    if path:
-        with open(path, "a") as f:
-            f.write(line + "\n")
+    with _emit_lock:
+        print(line, flush=True)
+        path = os.environ.get("TPUJOB_METRICS_FILE")
+        if path:
+            with open(path, "a") as f:
+                f.write(line + "\n")
 
 
 def _start_profile(profile_dir: str) -> None:
@@ -140,11 +150,26 @@ _heartbeat = None
 _mesh = None
 
 # Whether saves also record the crc32 digest (the reshard bit-equality
-# witness). Costs a full host-tree pass per save, so it is paid only when
-# the job actually opted into reshaping (--allow-reshape /
-# TPUJOB_ALLOW_RESHAPE — the operator injects the env on elastic jobs);
-# the sharding manifest itself is cheap and always written.
+# witness). PR 9 made this opt-in because the two full-tree passes ran on
+# the step loop's critical path; on the async write leg they ride the
+# writer thread instead, so digests are default-ON whenever async
+# checkpointing is active (and, as before, whenever the job opted into
+# reshaping — elastic jobs need the witness even under --checkpoint-mode
+# sync). The sharding manifest itself is cheap and always written.
 _digest_saves = False
+
+# The async checkpoint writer (None = --checkpoint-mode sync, or no
+# checkpoint dir). Module-global like _chaos/_heartbeat/_mesh: the save
+# path has ~6 call sites across both loops and the preemption teardown.
+_ckpt_writer: "_CkptWriter | None" = None
+
+# Sync-mode counterpart of the writer's accounting, so the done event's
+# `checkpoint` block exists in both modes (hidden_fraction is 0.0 by
+# definition when every save blocks the loop). Only the main thread
+# writes it, but the module hosts real threads now — locked on principle
+# (and to keep tpulint's unlocked-state pass honest).
+_sync_ckpt_stats = {"saves": 0, "snapshot_s": 0.0, "write_s": 0.0}
+_sync_ckpt_lock = threading.Lock()
 
 
 def _hb(step: int, force: bool = False) -> None:
@@ -169,71 +194,382 @@ def _boundary_chaos(done: int, start_step: int) -> None:
     _chaos.maybe_kill(done, start_step)
 
 
-def _save_checkpoint(ckpt_dir: str, step: int, state, final: bool = False,
-                     keep: int = 0) -> float:
-    """step_<N> holds params ONLY (the evaluator/external contract — cheap
-    to restore, format-compatible with hand-written checkpoints);
-    trainstate_<N> holds the resume payload. The aux dir is written first
-    so any visible step_<N> has its trainstate beside it. Returns the
-    save's wall-clock seconds — the preemption guard's estimate of what an
-    emergency save will cost against the grace budget."""
+@dataclasses.dataclass
+class _SaveItem:
+    """One checkpoint save, fully detached from the device: host copies
+    of both trees plus everything the write leg needs that must be read
+    from LIVE state (sharding layouts, mesh shape) — captured in the
+    blocking snapshot leg so the writer thread never touches a device
+    tree (or anything else that could dispatch XLA)."""
+
+    ckpt_dir: str
+    step: int
+    host_params: Any
+    host_aux: Any
+    info: dict
+    final: bool
+    keep: int
+
+
+def _snapshot_state(ckpt_dir: str, step: int, state, final: bool,
+                    keep: int, copy_leaves: bool = True) -> _SaveItem:
+    """Blocking snapshot leg: device->host copy of params + optimizer
+    state at a step boundary (the only part of a save that must observe a
+    consistent tree) plus the sharding-manifest payload read off the live
+    leaves. With copy_leaves (the async path) every leaf OWNS its bytes —
+    the step loop is free to donate/mutate the device state the moment
+    this returns; a sync save serializes inline before any further
+    dispatch, so it skips the defensive memcpy."""
+    import jax
+
+    from tf_operator_tpu.models import checkpoint as ckpt
+    from tf_operator_tpu.parallel import mesh as mesh_lib
+
+    import numpy as np
+
+    def owned_host_copy(tree):
+        """device_get + ensure every leaf OWNS its bytes. On the CPU
+        backend device_get returns numpy VIEWS aliasing the live device
+        buffers; with donated train state the next dispatched chunk then
+        overwrites the 'snapshot' in place before the writer thread
+        serializes it (observed: a trainstate_8 whose step read 12 —
+        same aliasing family as restore_named's mandatory-copy rule).
+        Leaves that already own their data (real D2H copies on TPU) pass
+        through without a second memcpy."""
+        def own(leaf):
+            arr = np.asarray(leaf)
+            return arr if arr.flags.owndata else arr.copy()
+
+        return jax.tree.map(own, jax.device_get(tree))
+
+    host_of = owned_host_copy if copy_leaves else jax.device_get
+    aux = _aux_tree(state)
+    host_aux = host_of(aux)
+    host_params = host_of(state.params)
+    info = {
+        "processCount": jax.process_count(),
+        "deviceCount": jax.device_count(),
+        "mesh": (mesh_lib.shape_dict(_mesh)
+                 if _mesh is not None else {}),
+        "leaves": ckpt.leaf_shardings(state.params),
+        "auxLeaves": ckpt.leaf_shardings(aux),
+    }
+    return _SaveItem(ckpt_dir=ckpt_dir, step=step, host_params=host_params,
+                     host_aux=host_aux, info=info, final=final, keep=keep)
+
+
+def _write_snapshot(item: _SaveItem) -> None:
+    """Write leg: serialize the host snapshot to orbax, publish it
+    (tmp->rename discipline in checkpoint.save_named, so the PR 4
+    backward resume walk is untouched), write census + sharding manifests
+    and digests, run retention pruning, and only THEN force the heartbeat
+    — the PR 9 durable-progress rule keys on write COMPLETION, never on
+    save initiation. Runs on the ckpt-writer thread in async mode and
+    inline in sync mode; it must never dispatch an XLA program (tpulint
+    TPT201 roots the writer thread here — same invariant as the PR 2
+    transfer threads): everything below is host numpy, file IO, and (in
+    multi-process runtimes) orbax's gRPC-client barriers."""
     import jax
 
     from tf_operator_tpu.models import checkpoint as ckpt
 
+    with telemetry.span("checkpoint/ckpt_write", step=item.step,
+                        final=item.final):
+        # trainstate first, so any visible step_<N> has its resume
+        # payload beside it (the historical aux-before-params order).
+        ckpt.save_named(item.ckpt_dir, f"trainstate_{item.step}",
+                        item.host_aux)
+        path = ckpt.save(item.ckpt_dir, item.step, item.host_params)
+        # orbax coordinates the collective save, but mark_final/_emit/
+        # prune are plain file IO: one writer only, or concurrent
+        # os.replace of the shared .FINAL.tmp races (loser raises,
+        # failing a finished job).
+        if jax.process_index() == 0:
+            info = dict(item.info)
+            if _digest_saves:
+                # crc32 of the host bytes — the bit-equality witness the
+                # resumed event reports back. On the async leg these two
+                # full-tree passes ride the writer thread, hidden behind
+                # training (why digests could flip back to default-on).
+                info["digest"] = {
+                    "params": ckpt.tree_digest(item.host_params),
+                    "trainstate": ckpt.tree_digest(item.host_aux),
+                }
+            ckpt.write_sharding_manifest(item.ckpt_dir,
+                                         f"step_{item.step}", info)
+            if item.final:
+                ckpt.mark_final(item.ckpt_dir, item.step)
+            _emit({"event": "checkpoint", "step": item.step, "path": path,
+                   "final": item.final})
+            if item.keep:
+                pruned = ckpt.prune_checkpoints(item.ckpt_dir, item.keep)
+                if pruned:
+                    _emit({"event": "checkpoint_pruned", "steps": pruned,
+                           "keep": item.keep})
+            # Single read of the module global: the main thread's finally
+            # nulls _chaos only after close() drains this leg, but a
+            # local binding keeps even a future reordering from turning
+            # the check-then-use into a writer-thread AttributeError.
+            chaos = _chaos
+            if chaos is not None:
+                torn = chaos.tear_for_step(item.step)
+                if torn is not None:
+                    from tf_operator_tpu import chaos as chaos_lib
+
+                    chaos.state.mark(torn)
+                    damaged = chaos_lib.tear_checkpoint(
+                        item.ckpt_dir, item.step,
+                        torn.params.get("mode", "truncate")
+                    )
+                    _emit({"event": "chaos_torn_checkpoint",
+                           "step": item.step, "path": damaged})
+    # A DURABLE save is progress: force the heartbeat past the 2 Hz
+    # throttle so the operator (hang watchdog, chaos at_step directives,
+    # the PR 5 tally-reset baseline) sees the checkpointed step promptly
+    # — and never a step whose checkpoint a crash could still erase
+    # (HeartbeatWriter is thread-safe + step-monotonic, so a write leg
+    # finishing behind the boundary heartbeats only refreshes t).
+    _hb(item.step, force=True)
+
+
+def _warm_checkpointer() -> None:
+    """Build the process's cached orbax Checkpointer ahead of the first
+    save: its construction costs about as much as a small tree's whole
+    write, and paying it lazily would sit exactly in the window between
+    a save's submit and a preemption/kill that decides whether the save
+    survives (the gang-kill e2es race that window against the runtime's
+    drain-grace SIGKILL). Runs on the writer thread at startup — off the
+    step loop AND off the first save. Best-effort: a broken backend
+    surfaces on the real save, with context."""
+    from tf_operator_tpu.models import checkpoint as ckpt
+
+    try:
+        ckpt._checkpointer()
+    except Exception as e:  # noqa: BLE001 — the real save reports it properly
+        print(f"warning: checkpointer warm-up failed "
+              f"({type(e).__name__}: {e}); the first save will rebuild it "
+              f"and surface any real error", file=sys.stderr)
+
+
+def _ckpt_writer_main(writer: "_CkptWriter") -> None:
+    """ckpt-writer thread body: warm the checkpointer, then drain the
+    single-slot queue, timing each write leg. First failure is latched
+    and the thread exits — the next submit/drain re-raises it on the
+    step loop, preserving sync-mode crash semantics for broken
+    storage."""
+    _warm_checkpointer()
+    while True:
+        with writer._cond:
+            while writer._item is None and not writer._stop:
+                writer._cond.wait()
+            if writer._item is None:
+                return  # stopped with an empty slot
+            item = writer._item
+        try:
+            t0 = time.monotonic()
+            _write_snapshot(item)
+            dt = time.monotonic() - t0
+        except BaseException as e:  # noqa: BLE001 — latched + re-raised
+            with writer._cond:
+                writer._error = e
+                writer._item = None
+                writer._cond.notify_all()
+            return
+        with writer._cond:
+            writer.write_s += dt
+            writer.saves += 1
+            writer.last_step = item.step
+            writer._item = None
+            writer._cond.notify_all()
+
+
+class _CkptWriter:
+    """Single-slot async checkpoint write pipeline.
+
+    Exactly ONE save may be in flight: submit() of the next save blocks
+    (backpressure) until the previous write leg drains — two concurrent
+    orbax writes would contend for disk and, multi-process, interleave
+    their barrier sequences. The slot + condition variable make the
+    discipline structural rather than advisory; `drains`/`drain_wait_s`
+    record how often and how long the step loop actually waited, which is
+    exactly the VISIBLE share of write time (hidden_fraction's
+    denominator-complement in the done event)."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._item: _SaveItem | None = None
+        self._stop = False
+        self._error: BaseException | None = None
+        self.last_step: int | None = None  # newest DURABLE step
+        self.saves = 0
+        self.write_s = 0.0
+        self.snapshot_s = 0.0
+        self.drains = 0          # submits that hit backpressure
+        self.drain_wait_s = 0.0  # seconds the step loop blocked on them
+        # Started eagerly (not at first submit) so the thread's
+        # checkpointer warm-up overlaps model build/compile instead of
+        # delaying the first save. Callers construct the writer post-fork
+        # (in _run_trainer), so the thread never crosses a fork.
+        self._thread = threading.Thread(
+            target=_ckpt_writer_main, args=(self,),
+            name="ckpt-writer", daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def error(self) -> BaseException | None:
+        with self._cond:
+            return self._error
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            raise RuntimeError(
+                f"async checkpoint write failed: "
+                f"{type(self._error).__name__}: {self._error}"
+            ) from self._error
+
+    def submit(self, item: _SaveItem) -> None:
+        """Hand a snapshot to the writer; blocks while the previous save
+        is still writing (the backpressure leg of the snapshot phase)."""
+        with self._cond:
+            self._raise_pending()
+            if self._item is not None:
+                self.drains += 1
+                t0 = time.monotonic()
+                while self._item is not None and self._error is None:
+                    self._cond.wait()
+                self.drain_wait_s += time.monotonic() - t0
+                self._raise_pending()
+            self._item = item
+            self._cond.notify_all()
+
+    def drain(self, raise_error: bool = True) -> float:
+        """Block until no write is queued or in flight; returns seconds
+        waited (NOT counted into drain_wait_s — the final-save and
+        preemption drains stall job teardown, not the step loop)."""
+        t0 = time.monotonic()
+        with self._cond:
+            while self._item is not None and self._error is None:
+                self._cond.wait()
+            if raise_error:
+                self._raise_pending()
+        return time.monotonic() - t0
+
+    def mean_write_s(self) -> float:
+        with self._cond:
+            return self.write_s / self.saves if self.saves else 0.0
+
+    def mean_save_s(self) -> float:
+        """Mean FULL save cost (snapshot + write) over completed saves —
+        what a synchronous emergency save is expected to cost."""
+        with self._cond:
+            if not self.saves:
+                return 0.0
+            return (self.snapshot_s + self.write_s) / self.saves
+
+    def note_snapshot(self, seconds: float) -> None:
+        with self._cond:
+            self.snapshot_s += seconds
+
+    def stats(self) -> dict:
+        with self._cond:
+            hidden = (max(0.0, 1.0 - self.drain_wait_s / self.write_s)
+                      if self.write_s > 0 else None)
+            return {
+                "mode": "async",
+                "saves": self.saves,
+                "snapshot_s": round(self.snapshot_s, 6),
+                "write_s": round(self.write_s, 6),
+                "drains": self.drains,
+                "drain_wait_s": round(self.drain_wait_s, 6),
+                "hidden_fraction": (round(hidden, 4)
+                                    if hidden is not None else None),
+            }
+
+    def close(self) -> None:
+        """Cleanup-path teardown: wait out any in-flight write (stranding
+        it mid-publish on a NON-fatal exit would tear nothing, but why
+        risk the disk churn), stop the thread, swallow latched errors —
+        the normal paths already re-raised them at submit/drain time."""
+        self.drain(raise_error=False)
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=60.0)
+
+
+def _ckpt_done_stats() -> dict | None:
+    """The done event's `checkpoint` block, whatever the mode (None when
+    the run never saved)."""
+    if _ckpt_writer is not None:
+        return _ckpt_writer.stats()
+    with _sync_ckpt_lock:
+        s = dict(_sync_ckpt_stats)
+    if not s["saves"]:
+        return None
+    return {
+        "mode": "sync",
+        "saves": s["saves"],
+        "snapshot_s": round(s["snapshot_s"], 6),
+        "write_s": round(s["write_s"], 6),
+        "drains": 0,
+        "drain_wait_s": 0.0,
+        "hidden_fraction": 0.0,
+    }
+
+
+def _save_checkpoint(ckpt_dir: str, step: int, state, final: bool = False,
+                     keep: int = 0, st=None, sync: bool = False) -> float:
+    """step_<N> holds params ONLY (the evaluator/external contract —
+    cheap to restore, format-compatible with hand-written checkpoints);
+    trainstate_<N> holds the resume payload.
+
+    Async mode (the default, when the writer exists): only the snapshot
+    leg + any backpressure wait block the step loop (phase
+    `ckpt_snapshot`); the write leg rides the ckpt-writer thread. A
+    final=True save drains before returning — job completion is durable
+    completion. Sync mode (--checkpoint-mode sync, or sync=True for the
+    preemption fast path) runs both legs inline under the `checkpoint`
+    phase, exactly the historical behavior.
+
+    Returns the estimated wall-clock of a SYNCHRONOUS save (snapshot +
+    write) — the preemption guard's estimate of what an emergency save
+    will cost against the grace budget, whichever mode produced it."""
+    writer = _ckpt_writer
     t0 = time.monotonic()
-    aux = _aux_tree(state)
-    host_aux = jax.device_get(aux)
-    ckpt.save_named(ckpt_dir, f"trainstate_{step}", host_aux)
-    host_params = jax.device_get(state.params)
-    path = ckpt.save(ckpt_dir, step, host_params)
-    # orbax coordinates the collective save, but mark_final/_emit/prune are
-    # plain file IO: one writer only, or concurrent os.replace of the
-    # shared .FINAL.tmp races (loser raises, failing a finished job).
-    if jax.process_index() == 0:
-        # Sharding manifest (topology-portable checkpoints): the gang
-        # shape + per-leaf layout this save came from, and a crc32 of the
-        # host bytes (the bit-equality witness the resumed event reports
-        # back). Written after the orbax rename like the size census.
-        from tf_operator_tpu.parallel import mesh as mesh_lib
-
-        info = {
-            "processCount": jax.process_count(),
-            "deviceCount": jax.device_count(),
-            "mesh": (mesh_lib.shape_dict(_mesh)
-                     if _mesh is not None else {}),
-            "leaves": ckpt.leaf_shardings(state.params),
-            "auxLeaves": ckpt.leaf_shardings(aux),
-        }
-        if _digest_saves:
-            info["digest"] = {"params": ckpt.tree_digest(host_params),
-                              "trainstate": ckpt.tree_digest(host_aux)}
-        ckpt.write_sharding_manifest(ckpt_dir, f"step_{step}", info)
-        if final:
-            ckpt.mark_final(ckpt_dir, step)
-        _emit({"event": "checkpoint", "step": step, "path": path, "final": final})
-        if keep:
-            pruned = ckpt.prune_checkpoints(ckpt_dir, keep)
-            if pruned:
-                _emit({"event": "checkpoint_pruned", "steps": pruned,
-                       "keep": keep})
-        if _chaos is not None:
-            torn = _chaos.tear_for_step(step)
-            if torn is not None:
-                from tf_operator_tpu import chaos as chaos_lib
-
-                _chaos.state.mark(torn)
-                damaged = chaos_lib.tear_checkpoint(
-                    ckpt_dir, step, torn.params.get("mode", "truncate")
-                )
-                _emit({"event": "chaos_torn_checkpoint", "step": step,
-                       "path": damaged})
-    # A finished save is DURABLE progress: force the heartbeat past the
-    # 2 Hz throttle so the operator (hang watchdog, chaos at_step
-    # directives keyed on the heartbeat) sees the checkpointed step
-    # promptly even when steps complete faster than the throttle window.
-    _hb(step, force=True)
-    return time.monotonic() - t0
+    if writer is None or sync:
+        ctx = (st.phase("checkpoint") if st is not None
+               else contextlib.nullcontext())
+        with ctx:
+            item = _snapshot_state(ckpt_dir, step, state, final, keep,
+                                   copy_leaves=False)
+            snap_s = time.monotonic() - t0
+            _write_snapshot(item)
+        total = time.monotonic() - t0
+        with _sync_ckpt_lock:
+            _sync_ckpt_stats["saves"] += 1
+            _sync_ckpt_stats["snapshot_s"] += snap_s
+            _sync_ckpt_stats["write_s"] += total - snap_s
+        return total
+    ctx = (st.phase("ckpt_snapshot") if st is not None
+           else contextlib.nullcontext())
+    with ctx:
+        # The phase covers the whole blocking leg (snapshot + any
+        # backpressure wait inside submit); the done block keeps the two
+        # separable — snapshot_s is the irreducible per-save stall, the
+        # writer's drain_wait_s is the backpressure the save interval
+        # chose.
+        item = _snapshot_state(ckpt_dir, step, state, final, keep)
+        snap_s = time.monotonic() - t0
+        writer.submit(item)
+    writer.note_snapshot(snap_s)
+    if final:
+        # The end-of-run save must be durable before the trainer reports
+        # done (FINAL marker, evaluator handoff, operator completion all
+        # key on it).
+        writer.drain()
+    return snap_s + writer.mean_write_s()
 
 
 def _try_resume(ckpt_dir: str | None, state, tx, mesh=None,
@@ -535,27 +871,47 @@ def _try_resume(ckpt_dir: str | None, state, tx, mesh=None,
 
 def _preempt_exit(args, guard, state, done, saver, last_save_s,
                   last_ckpt_step, st=None) -> int:
-    """Graceful-preemption teardown at a step boundary: write an emergency
-    checkpoint when the grace budget still covers the estimated save cost
-    (skip it when the boundary already has a periodic save), emit the
-    `preempted` event, export any trace, and hand back 128+signum for the
-    operator's EXIT_CODE policy to classify as retryable."""
+    """Graceful-preemption teardown at a step boundary: drain any
+    in-flight async checkpoint write first (its seconds burn the grace
+    budget through guard.elapsed()), ADOPT the drained save as the
+    emergency checkpoint when it is newer-or-equal to this boundary, else
+    run the synchronous fast path when the remaining budget still covers
+    the estimated save cost. Emits the `preempted` event and hands back
+    128+signum for the operator's EXIT_CODE policy to classify as
+    retryable."""
     saved = False
     skipped = None
+    drain_s = None
+    adopted = False
     if saver and args.checkpoint_dir:
-        if done == last_ckpt_step:
-            saved = True  # this boundary's periodic save already landed
-        elif guard.within_grace(last_save_s, args.preempt_grace):
-            if st is not None:
-                with st.phase("checkpoint"):
-                    _save_checkpoint(args.checkpoint_dir, done, state,
-                                     keep=args.keep_checkpoints)
-            else:
+        writer = _ckpt_writer
+        if writer is not None:
+            # Drain, don't abandon: the in-flight write is mostly on disk
+            # already, and an orphaned writer racing process teardown
+            # would strand a tmp dir a clean drain turns into a usable
+            # emergency checkpoint. Errors degrade to the fast path.
+            drain_s = writer.drain(raise_error=False)
+            # Post-drain the writer's means include the write that was in
+            # flight at submit time — last_save_s (estimated at submit,
+            # when the FIRST write's cost was still unknown and read as
+            # 0) can underestimate a sync emergency save by the whole
+            # write leg, exactly the overrun within_grace exists to veto.
+            # mean_save_s = snapshot + write, the full inline cost.
+            last_save_s = max(last_save_s, writer.mean_save_s())
+            if (writer.error is None and writer.last_step is not None
+                    and writer.last_step >= done):
+                saved = True
+                adopted = True
+        if not saved:
+            if writer is None and done == last_ckpt_step:
+                saved = True  # this boundary's periodic sync save landed
+            elif guard.within_grace(last_save_s, args.preempt_grace):
                 _save_checkpoint(args.checkpoint_dir, done, state,
-                                 keep=args.keep_checkpoints)
-            saved = True
-        else:
-            skipped = "grace_budget"
+                                 keep=args.keep_checkpoints, st=st,
+                                 sync=True)
+                saved = True
+            else:
+                skipped = "grace_budget"
     event = {
         "event": "preempted",
         "step": done,
@@ -565,6 +921,10 @@ def _preempt_exit(args, guard, state, done, saver, last_save_s,
         "grace_s": args.preempt_grace,
         "elapsed_s": round(guard.elapsed(), 3),
     }
+    if drain_s is not None:
+        event["drain_s"] = round(drain_s, 3)
+    if adopted:
+        event["adopted_async_save"] = True
     if skipped:
         event["save_skipped"] = skipped
     _emit(event)
@@ -782,11 +1142,13 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
             pending = (done, metrics)
             if (saver and args.checkpoint_every and done < args.steps
                     and done % args.checkpoint_every == 0):
-                with st.phase("checkpoint"):
-                    last_save_s = _save_checkpoint(
-                        args.checkpoint_dir, done, state,
-                        keep=args.keep_checkpoints)
-                    last_ckpt_step = done
+                # _save_checkpoint opens the phase itself: `checkpoint`
+                # in sync mode, `ckpt_snapshot` (the only blocking leg)
+                # under the async writer.
+                last_save_s = _save_checkpoint(
+                    args.checkpoint_dir, done, state,
+                    keep=args.keep_checkpoints, st=st)
+                last_ckpt_step = done
             # Step boundary: the progress heartbeat records the completed
             # step, chaos hang/kill-at-step fire here, and a latched
             # preemption signal (SIGTERM/SIGINT/SIGUSR1 — real or chaos-
@@ -834,6 +1196,12 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
         "step_time_s": telem["step_time_s"] if telem else None,
         "phase_breakdown": telem["phase_breakdown"] if telem else None,
     }
+    ckpt_block = _ckpt_done_stats()
+    if ckpt_block:
+        # Zero-stall checkpointing accounting: snapshot_s is what the
+        # step loop paid, write_s what the writer thread hid (or didn't:
+        # hidden_fraction, drains — see docs/perf.md's stall model).
+        done_event["checkpoint"] = ckpt_block
     if args.input_staging == "staged":
         # First-class transfer + overlap accounting from the staging ring's
         # own timers (data/staging.py): the bench's staged point reads these
@@ -1000,6 +1368,18 @@ def main(argv: list[str] | None = None) -> int:
                          "Evaluator replica follows them (--eval)")
     ap.add_argument("--checkpoint-every", type=int, default=0,
                     help="save every N steps (default: once at the end)")
+    ap.add_argument("--checkpoint-mode", default="async",
+                    choices=["async", "sync"],
+                    help="async (default): a save blocks the step loop "
+                         "only for the device->host snapshot; the orbax "
+                         "write + manifests + digests + retention ride a "
+                         "dedicated writer thread (one in-flight save, "
+                         "backpressure on the next; SIGTERM drains and "
+                         "adopts the in-flight save when newer-or-equal). "
+                         "sync: the historical fully-blocking save — the "
+                         "bit-equality reference for the async pipeline "
+                         "and the fallback if a storage backend mishandles "
+                         "background writes")
     ap.add_argument("--allow-reshape", action="store_true",
                     help="accept a checkpoint saved at a DIFFERENT gang "
                          "shape (process count / mesh): restore reshards "
@@ -1228,11 +1608,24 @@ def main(argv: list[str] | None = None) -> int:
         # a later chaos-free run in the same process stays chaos-free and
         # the host's Ctrl-C semantics survive this function.
         guard.uninstall()
+        global _mesh, _digest_saves, _ckpt_writer
+        if _ckpt_writer is not None:
+            # Never leak the writer thread into an in-process caller
+            # (tests, notebooks); close() also waits out an in-flight
+            # write so an exception-path exit doesn't strand a tmp dir.
+            # MUST run before _heartbeat/_chaos are nulled below: the
+            # draining write leg still force-writes the durable-progress
+            # heartbeat and consults _chaos for torn-checkpoint
+            # directives.
+            _ckpt_writer.close()
+            _ckpt_writer = None
         _chaos = None
+        chaos_lib.reset_ckpt_stall_state()
         _heartbeat = None
-        global _mesh, _digest_saves
         _mesh = None
         _digest_saves = False
+        with _sync_ckpt_lock:
+            _sync_ckpt_stats.update(saves=0, snapshot_s=0.0, write_s=0.0)
         if args.chaos is not None:
             if chaos_env_prev is None:
                 os.environ.pop(chaos_lib.ENV_CHAOS, None)
@@ -1292,6 +1685,12 @@ def _run_trainer(args, guard) -> int:
     _mesh = mesh  # checkpoint sharding manifests record the save-time mesh
     allow_reshape = (args.allow_reshape
                      or os.environ.get("TPUJOB_ALLOW_RESHAPE") == "1")
+    # Digests ride the async write leg off the critical path, so they are
+    # default-on whenever that leg exists; sync-mode jobs pay the two
+    # full-tree passes inline only when elastic recovery needs the
+    # witness (the original PR 9 opt-in rationale). Finalized below once
+    # the writer is (or isn't) created — a requested-async job that falls
+    # back to sync must not pay inline digests either.
     _digest_saves = allow_reshape
     # Segment timestamps (bench.py turns these into the startup breakdown
     # the north-star latency metric is judged on).
@@ -1515,13 +1914,48 @@ def _run_trainer(args, guard) -> int:
     # Single-writer semantics differ by runtime shape. Independent
     # processes (PS-strategy: each worker is its own jax runtime): only the
     # chief/worker-0 touches the shared dir. ONE multi-process runtime
-    # (jax.distributed): EVERY process must enter the save — orbax runs
-    # multihost sync barriers inside save(), and a single process calling it
-    # deadlocks against the others' next collective (orbax itself writes
-    # from process 0 only).
-    saver = args.checkpoint_dir and (
-        _is_checkpoint_writer() or jax.process_count() > 1
-    )
+    # (jax.distributed): process 0 alone — checkpoint IO is PROCESS-LOCAL
+    # since round 15 (the trees are host snapshots of fully-replicated
+    # leaves, and checkpoint._checkpointer scopes every orbax barrier to
+    # the calling process), so the historical every-process-enters-save
+    # rule (which existed only to feed orbax's gang-wide barriers) is
+    # gone — and with it the failure mode where one member's death
+    # wedged every peer's save mid-barrier. EXCEPTION: a multi-process
+    # world without a jax.distributed client (raw multi-host pod, no
+    # operator env) has no scoped barriers — there the legacy rule
+    # stands: every process enters the (gang-wide, collective) save, and
+    # async stands down below.
+    from tf_operator_tpu.models import checkpoint as _ckpt_mod
+
+    plocal_io = _ckpt_mod.process_local_io()
+    if jax.process_count() > 1:
+        saver = args.checkpoint_dir and (
+            jax.process_index() == 0 if plocal_io else True
+        )
+    else:
+        saver = args.checkpoint_dir and _is_checkpoint_writer()
+    global _ckpt_writer
+    if saver and args.checkpoint_mode == "async" and not plocal_io:
+        # Gang-wide collective saves would run their XLA-collective
+        # barriers on the writer thread — the exact deadlock TPT201
+        # bans. Degrade to synchronous saves, loudly.
+        print("warning: async checkpointing requires process-local IO "
+              "(jax.distributed client); multi-process runtime without "
+              "one — falling back to --checkpoint-mode sync",
+              file=sys.stderr)
+    if saver and args.checkpoint_mode == "async" and plocal_io:
+        # Zero-stall checkpointing: the write leg of every save rides
+        # this pipeline's thread. Only the saving process has one —
+        # checkpoint IO is process-local (checkpoint._checkpointer scopes
+        # every orbax barrier to the calling process), so non-saver gang
+        # members neither enter saves nor carry a writer. Constructed
+        # here (post-fork, post-distributed-init) and its thread starts
+        # immediately, warming the orbax checkpointer under the model
+        # build/compile.
+        _ckpt_writer = _CkptWriter()
+    # Digest decision keys on the writer's EXISTENCE, not the flag: an
+    # async request that degraded to sync keeps the elastic-only rule.
+    _digest_saves = allow_reshape or _ckpt_writer is not None
 
     if args.checkpoint_dir and jax.process_index() == 0 \
             and _is_checkpoint_writer():
@@ -1631,18 +2065,13 @@ def _run_trainer(args, guard) -> int:
         marks = done // args.checkpoint_every
         if marks > ckpt_marks:
             ckpt_marks = marks
-            if st is not None:
-                # The phase opens only around an ACTUAL save: timing the
-                # no-op calls too would report a nonzero checkpoint phase
-                # for runs that never saved in the window.
-                with st.phase("checkpoint"):
-                    last_save_s = _save_checkpoint(
-                        args.checkpoint_dir, done, state,
-                        keep=args.keep_checkpoints)
-            else:
-                last_save_s = _save_checkpoint(
-                    args.checkpoint_dir, done, state,
-                    keep=args.keep_checkpoints)
+            # _save_checkpoint opens its own phase (checkpoint /
+            # ckpt_snapshot) and only around an ACTUAL save — the no-op
+            # calls never reach it, so runs that never saved in the
+            # window report no checkpoint phase.
+            last_save_s = _save_checkpoint(
+                args.checkpoint_dir, done, state,
+                keep=args.keep_checkpoints, st=st)
             last_ckpt_step = done
 
     def check_boundary(done: int, st=None) -> int | None:
@@ -1776,22 +2205,28 @@ def _run_trainer(args, guard) -> int:
     # microseconds-denominator lie.
     sps = round(steady / dt, 4) if steady > 0 else None
     telem = acct.summary()
-    _emit(
-        {
-            "event": "done",
-            "t": time.time(),
-            "steps": args.steps,
-            "steady_steps_per_sec": sps,
-            "examples_per_sec": round(steady * args.batch / dt, 4) if steady > 0 else None,  # 4 dp: 2-dp quantized batch-1 long-context rows by +-2.6%
-            "final_loss": float(metrics["loss"]),
-            "total_s": round(time.time() - t_start, 3),
-            # Per-step distribution + telescoping phase breakdown over the
-            # steady window (telemetry/phases.py); None when the run had
-            # no steady chunks, same rule as steady_steps_per_sec.
-            "step_time_s": telem["step_time_s"] if telem else None,
-            "phase_breakdown": telem["phase_breakdown"] if telem else None,
-        }
-    )
+    done_event = {
+        "event": "done",
+        "t": time.time(),
+        "steps": args.steps,
+        "steady_steps_per_sec": sps,
+        "examples_per_sec": round(steady * args.batch / dt, 4) if steady > 0 else None,  # 4 dp: 2-dp quantized batch-1 long-context rows by +-2.6%
+        "final_loss": float(metrics["loss"]),
+        "total_s": round(time.time() - t_start, 3),
+        # Per-step distribution + telescoping phase breakdown over the
+        # steady window (telemetry/phases.py); None when the run had
+        # no steady chunks, same rule as steady_steps_per_sec.
+        "step_time_s": telem["step_time_s"] if telem else None,
+        "phase_breakdown": telem["phase_breakdown"] if telem else None,
+    }
+    ckpt_block = _ckpt_done_stats()
+    if ckpt_block:
+        # Zero-stall checkpointing accounting (docs/perf.md stall model):
+        # the step loop paid snapshot_s (+ drain_wait_s backpressure);
+        # write_s rode the writer thread, hidden_fraction says how much
+        # of it training actually covered.
+        done_event["checkpoint"] = ckpt_block
+    _emit(done_event)
     _maybe_export_trace(args)
     # Synchronized multi-process exit (no-op single-process): see
     # parallel.distributed.distributed_goodbye.

@@ -343,10 +343,20 @@ class LocalProcessRuntime:
         except OSError:
             pass
 
-    def _await_drained(self, ns: str, job: str, grace: float = 2.0,
-                       timeout: float = 8.0) -> None:
+    def _await_drained(self, ns: str, job: str, grace: float = 5.0,
+                       timeout: float = 12.0) -> None:
         """Block until every draining process of (ns, job) is dead (SIGKILL
-        after `grace`), so a new generation can bind the old one's ports."""
+        after `grace`), so a new generation can bind the old one's ports.
+
+        The grace is the local analogue of the kubelet's
+        terminationGracePeriodSeconds: a SIGTERM'd trainer that cannot
+        reach a step boundary (wedged in a collective against a dead
+        peer) still has an independent async checkpoint writer finishing
+        its in-flight save — 2 s (the pre-round-15 value) raced that
+        write's tail on a loaded host and SIGKILLed mid-publish what a
+        real cluster (30 s default grace) would let land. Only WEDGED
+        processes ever pay the full grace; a trainer that latches the
+        SIGTERM at a boundary exits in milliseconds."""
         with self._lock:
             priors = [
                 (key, p) for key, (j, p) in self._draining.items()

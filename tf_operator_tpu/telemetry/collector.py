@@ -56,6 +56,10 @@ TRAINER_GAUGES = {
     "tpujob_trainer_transfer_mb_per_s":
         "Staged-ingest host->device transfer rate (bytes over wire-busy "
         "union across lanes) from the done event's staging accounting",
+    "tpujob_trainer_ckpt_hidden_fraction":
+        "Share of checkpoint write time hidden behind training by the "
+        "async writer (done event's checkpoint block; 0.0 = sync saves, "
+        "1.0 = the step loop paid only the snapshot leg)",
 }
 
 # Pod names are {job}-{type}-{index} (utils/naming.py); anchoring on the
@@ -112,7 +116,8 @@ def summarize_events(events: list[dict]) -> dict | None:
     if loss is not None:
         out["loss"] = loss
     for k in ("steady_steps_per_sec", "examples_per_sec", "total_s",
-              "step_time_s", "phase_breakdown", "staging", "prefetch"):
+              "step_time_s", "phase_breakdown", "staging", "prefetch",
+              "checkpoint"):
         if done.get(k) is not None:
             out[k] = done[k]
     if by.get("trace_done"):
@@ -262,6 +267,7 @@ class TelemetryCollector:
                 continue
             step_time = primary.get("step_time_s") or {}
             staging = primary.get("staging") or {}
+            ckpt = primary.get("checkpoint") or {}
             for gauge_name, value in (
                 ("tpujob_trainer_steps_per_sec",
                  primary.get("steady_steps_per_sec")),
@@ -274,6 +280,8 @@ class TelemetryCollector:
                 ("tpujob_trainer_step_time_p99_s", step_time.get("p99")),
                 ("tpujob_trainer_transfer_mb_per_s",
                  staging.get("transfer_mb_per_s")),
+                ("tpujob_trainer_ckpt_hidden_fraction",
+                 ckpt.get("hidden_fraction")),
             ):
                 if value is not None:
                     self._gauges[gauge_name].labels(**labels).set(float(value))

@@ -25,7 +25,19 @@ Phase taxonomy (PHASES):
                    rest of the loop body).
     device_blocked time blocked on device results (loss fetches — the
                    window-closing host transfers).
-    checkpoint     checkpoint save calls made from the step loop.
+    checkpoint     SYNCHRONOUS checkpoint saves made from the step loop
+                   (--checkpoint-mode sync, and the preemption fast
+                   path): snapshot + serialize + manifests, all blocking.
+    ckpt_snapshot  the BLOCKING leg of an async save (--checkpoint-mode
+                   async, the default): device->host snapshot of the
+                   train state plus any backpressure wait for the
+                   previous save's write leg to drain. The write leg
+                   itself (ckpt_write) rides the dedicated writer thread
+                   — it appears as tracer spans and in the done event's
+                   `checkpoint` block (write_s / hidden_fraction /
+                   drains), never as a step phase, because it does not
+                   spend step wall-clock; the telescoping identity above
+                   is preserved exactly.
     eval           inline evaluation from the step loop (the separate
                    Evaluator replica accounts its own process).
     other          the telescoping residual: loop body time attributed
@@ -54,7 +66,7 @@ __all__ = [
 ]
 
 PHASES = ("data_wait", "h2d_transfer", "dispatch", "device_blocked",
-          "checkpoint", "eval", "other")
+          "checkpoint", "ckpt_snapshot", "eval", "other")
 
 QUANTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
 

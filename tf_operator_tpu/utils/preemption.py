@@ -57,12 +57,23 @@ class HeartbeatWriter:
     are seconds-scale, so sub-second cadence buys nothing). With no path
     configured every call is a no-op — standalone runs pay one `is None`
     check. IO errors are swallowed: a full disk must degrade the liveness
-    signal, never kill the training step that just completed."""
+    signal, never kill the training step that just completed.
+
+    Thread-safe and step-monotonic: the async checkpoint writer
+    force-writes the just-durable save's step from ITS thread (the
+    durable-progress rule keys on write COMPLETION, not save initiation)
+    while the step loop keeps writing boundary heartbeats — the lock
+    serializes the tmp+replace pair, and a forced write whose step trails
+    the boundary high-water refreshes `t` at the high-water instead of
+    regressing `step` (the documented monotonic contract consumers like
+    the tally-reset baseline rely on)."""
 
     def __init__(self, path: str | None, min_interval_s: float = 0.5):
         self.path = path or None
         self.min_interval_s = min_interval_s
         self._last_write = 0.0
+        self._last_step = 0
+        self._lock = threading.Lock()
 
     @classmethod
     def from_env(cls, env: dict | None = None) -> "HeartbeatWriter":
@@ -73,23 +84,26 @@ class HeartbeatWriter:
         """Record `step` as completed; True when a write actually landed."""
         if self.path is None:
             return False
-        now = time.monotonic()
-        if not force and now - self._last_write < self.min_interval_s:
-            return False
-        tmp = f"{self.path}.tmp.{os.getpid()}"
-        try:
-            with open(tmp, "w") as f:
-                json.dump({"step": int(step), "t": time.time(),
-                           "pid": os.getpid()}, f)
-            os.replace(tmp, self.path)
-        except OSError:
+        with self._lock:
+            now = time.monotonic()
+            if not force and now - self._last_write < self.min_interval_s:
+                return False
+            step = max(int(step), self._last_step)
+            tmp = f"{self.path}.tmp.{os.getpid()}"
             try:
-                os.unlink(tmp)
+                with open(tmp, "w") as f:
+                    json.dump({"step": step, "t": time.time(),
+                               "pid": os.getpid()}, f)
+                os.replace(tmp, self.path)
             except OSError:
-                pass
-            return False
-        self._last_write = now
-        return True
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return False
+            self._last_write = now
+            self._last_step = step
+            return True
 
 
 def read_heartbeat(path: str) -> dict | None:
@@ -204,8 +218,12 @@ class PreemptionGuard:
         """Would an emergency save of ~est_save_s still fit the grace
         budget? The budget is measured from signal receipt (the kubelet
         SIGKILLs grace_s after SIGTERM, whatever we are doing), so time
-        already burned finishing the in-flight step counts against it.
-        grace_s <= 0 means no budget: never attempt the save."""
+        already burned finishing the in-flight step counts against it —
+        including seconds spent DRAINING an in-flight async checkpoint
+        write before this call (the drain happens-before the fast-path
+        decision, so it flows through elapsed() with no extra
+        bookkeeping). grace_s <= 0 means no budget: never attempt the
+        save."""
         if grace_s <= 0:
             return False
         return self.elapsed() + max(0.0, est_save_s) < grace_s

@@ -31,8 +31,15 @@ NAME = "thread-discipline"
 RULES = ("TPT201",)
 
 # Modules whose Thread targets are transfer/producer threads under the
-# dispatch ban (the staging lanes and the prefetch producer).
-ROOT_MODULES = ("tf_operator_tpu.data.staging", "tf_operator_tpu.data.prefetch")
+# dispatch ban: the staging lanes, the prefetch producer, and (round 15)
+# the async checkpoint writer — models/train.py's ckpt-writer thread
+# serializes host snapshots to orbax off the step loop and must never
+# dispatch XLA (its multi-process barriers go over the jax.distributed
+# gRPC client precisely to keep this invariant; see
+# models/checkpoint._checkpointer). train.py's backend-dial thread uses a
+# lambda target, which root discovery conservatively skips.
+ROOT_MODULES = ("tf_operator_tpu.data.staging", "tf_operator_tpu.data.prefetch",
+                "tf_operator_tpu.models.train")
 
 # Dispatching APIs: anything that builds/runs an XLA program.
 DISPATCH_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.scipy.", "jax.nn.")
