@@ -394,6 +394,32 @@ class TestSchemaDrift:
         assert any(f.rule == "TPS404" and "restart_policy" in f.key
                    for f in found)
 
+    def test_slices_drift_guarded(self):
+        # Round-16 fixture pair: spec.tpu.slices (multi-slice training)
+        # must stay in sync across types -> compat parse/emit -> CRD, the
+        # same guard successPolicy got in round 13. BAD direction: drop
+        # the emit line / the parse string / the CRD property and the
+        # pass must fail each one; GOOD direction: the live repo aligns
+        # (test_repo_contract_is_aligned covers it, re-asserted here so
+        # this fixture is self-contained).
+        types, compat, validation, crd = self._real()
+        assert schema.analyze_schema(types, compat, validation, crd) == []
+        no_emit = "\n".join(ln for ln in compat.splitlines()
+                            if '"slices": job.spec.tpu.slices' not in ln)
+        assert no_emit != compat, "fixture went stale (emit line moved)"
+        found = schema.analyze_schema(types, no_emit, validation, crd)
+        assert any(f.rule == "TPS402" and f.key == "schema-emit::TPUSpec.slices"
+                   for f in found), [f.render() for f in found]
+        no_parse = compat.replace('tpu_d.get("slices")', "None") \
+                         .replace('int(tpu_d["slices"])', "1")
+        found = schema.analyze_schema(types, no_parse, validation, crd)
+        assert any(f.rule == "TPS401" and "TPUSpec.slices" in f.key
+                   for f in found), [f.render() for f in found]
+        no_crd = crd.replace("slices:", "slicesRenamed:")
+        found = schema.analyze_schema(types, compat, validation, no_crd)
+        assert any(f.rule == "TPS403" and "TPUSpec.slices" in f.key
+                   for f in found), [f.render() for f in found]
+
     def test_new_types_field_without_wire_fails(self):
         # the forward direction: grow types.py, forget compat -> fail
         types, compat, validation, crd = self._real()

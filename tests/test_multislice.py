@@ -1,0 +1,910 @@
+"""Multi-slice training: hierarchical DCN x ICI mesh with overlapped
+cross-slice gradient reduction (round 16).
+
+Layers under test:
+  * api/compat/CRD/validation: spec.tpu.slices end-to-end (the
+    schema-drift fixture pair lives in test_analysis.py);
+  * gang.SliceAllocator.admit_many: atomic all-or-nothing N-slice
+    admission; sched.FleetScheduler: N-slice ranking/reservation without
+    partial holds, 1-slice backfill, slice-counted quota;
+  * cluster_spec.tpu_env: per-slice coordinator topology (slice-local
+    jax world + global DCN coordinator, megascale-style);
+  * core.TrainJobController: atomic admission, per-slice gang recovery
+    (roll ONE slice, per-slice watchdog, slice_restarts);
+  * parallel.multislice: the bucketed DCN exchange — correctness,
+    latency dial, overlap accounting, hold-at-barrier + rewind protocol;
+  * chaos slice= targeting; telemetry dcn gauge.
+
+Slow capstones (CI multislice-smoke): the 2-slice slice-failure e2e
+(kill slice 1 -> ONLY slice 1's gang rolls, slice 0 holds at the barrier
+and rewinds in process, job finishes loss-equal to an uninterrupted
+single-slice reference) and the measured-overlap acceptance run
+(injected DCN latency >= 30% of unoverlapped step time ->
+dcn_hidden_fraction >= 0.5 with phases still telescoping).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tf_operator_tpu.api import compat, defaults, validation
+from tf_operator_tpu.api.types import (
+    ContainerSpec,
+    JobConditionType,
+    MeshSpec,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUSpec,
+    TrainJob,
+    TrainJobSpec,
+    is_succeeded,
+)
+from tf_operator_tpu.chaos import parse_chaos, replica_matches
+from tf_operator_tpu.cluster_spec import tpu_env
+from tf_operator_tpu.core.cluster import InMemoryCluster, PodPhase
+from tf_operator_tpu.core.trainjob_controller import TrainJobController
+from tf_operator_tpu.gang.podgroup import SliceAllocator
+from tf_operator_tpu.parallel.multislice import (
+    DcnExchange,
+    SliceRewind,
+    SliceWorld,
+    partition_buckets,
+)
+from tf_operator_tpu.runtime.session import LocalSession
+from tf_operator_tpu.sched.policy import FleetPolicy, ResourceQuota
+from tf_operator_tpu.sched.scheduler import FleetScheduler
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+PY = sys.executable
+DONE = (JobConditionType.SUCCEEDED, JobConditionType.FAILED)
+
+ONE_DEV = {
+    "PYTHONPATH": REPO_ROOT,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+
+def make_ms_job(name: str, workers: int = 4, slices: int = 2,
+                topology: str = "v5e-1", gang: bool = False,
+                cmd: list[str] | None = None) -> TrainJob:
+    job = TrainJob(
+        metadata=ObjectMeta(name=name),
+        spec=TrainJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers,
+                    restart_policy=RestartPolicy.EXIT_CODE,
+                    template=PodTemplateSpec(containers=[
+                        ContainerSpec(name="tensorflow", image="local",
+                                      command=list(cmd) if cmd else [])
+                    ]),
+                ),
+            },
+            tpu=TPUSpec(topology=topology, slices=slices),
+        ),
+    )
+    job.spec.run_policy.scheduling.gang = gang
+    return defaults.set_defaults(job)
+
+
+def read_events(path) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+# ------------------------------------------------------------------ api
+
+
+class TestSlicesApi:
+    def test_default_single_slice(self):
+        job = compat.job_from_dict({
+            "kind": "TrainJob", "metadata": {"name": "a"},
+            "spec": {"replicaSpecs": {}, "tpu": {"topology": "v5e-8"}},
+        }, apply_defaults=False)
+        assert job.spec.tpu.slices == 1
+
+    def test_roundtrip(self):
+        job = make_ms_job("r", workers=4, slices=2, topology="v5e-4")
+        d = compat.job_to_dict(job)
+        assert d["spec"]["tpu"]["slices"] == 2
+        assert compat.job_from_dict(d).spec.tpu.slices == 2
+
+    def test_valid_multislice(self):
+        job = make_ms_job("ok", workers=4, slices=2, topology="v5e-4")
+        assert validation.validate_job(job) == []
+
+    @pytest.mark.parametrize("mutate, needle", [
+        (lambda j: setattr(j.spec.tpu, "slices", 0), "must be >= 1"),
+        (lambda j: setattr(
+            j.spec.replica_specs[ReplicaType.WORKER], "replicas", 3),
+         "divide evenly"),
+        (lambda j: setattr(
+            j.spec.replica_specs[ReplicaType.WORKER], "replicas", 1),
+         "at least that many"),
+        (lambda j: setattr(j.spec.run_policy.recovery, "policy", "pod"),
+         "requires runPolicy.recovery.policy 'gang'"),
+        (lambda j: setattr(
+            j.spec.run_policy.recovery.elastic, "reshape_on_recovery", True),
+         "conflicts with"),
+        (lambda j: j.spec.replica_specs.__setitem__(
+            ReplicaType.CHIEF, ReplicaSpec(
+                replicas=1, template=PodTemplateSpec(containers=[
+                    ContainerSpec(name="tensorflow", image="x")]))),
+         "Worker-only"),
+    ])
+    def test_validation_rejects(self, mutate, needle):
+        job = make_ms_job("bad", workers=4, slices=2, topology="v5e-4")
+        mutate(job)
+        problems = validation.validate_job(job)
+        assert any(needle in p for p in problems), problems
+
+    def test_mesh_stays_per_slice(self):
+        # mesh.axes describes ONE slice: product == per-slice chips, not
+        # slices x chips (the cross-slice data axis lives above the mesh).
+        job = make_ms_job("m", workers=4, slices=2, topology="v5e-4")
+        job.spec.mesh = MeshSpec(axes={"dp": 4})
+        assert validation.validate_job(job) == []
+        job.spec.mesh = MeshSpec(axes={"dp": 8})
+        assert any("multiply" in p for p in validation.validate_job(job))
+
+    def test_zero_slices_422s_at_the_fake_apiserver(self):
+        import urllib.error
+        import urllib.request
+
+        from tf_operator_tpu.core.k8s import job_to_k8s
+        from tf_operator_tpu.testing.fake_apiserver import FakeApiServer
+
+        job = make_ms_job("z", workers=2, slices=2)
+        job.spec.tpu.slices = 0
+        with FakeApiServer() as server:
+            req = urllib.request.Request(
+                f"{server.url}/apis/{TrainJob.API_VERSION}"
+                f"/namespaces/default/{TrainJob.PLURAL}",
+                data=json.dumps(job_to_k8s(job)).encode(), method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 422
+
+    def test_slice_restarts_status_wire(self):
+        from tf_operator_tpu.core.k8s import (job_status_from_dict,
+                                              job_status_to_dict)
+
+        job = make_ms_job("w")
+        job.status.slice_restarts = {"1": 2, "0": 1}
+        rt = job_status_from_dict(job_status_to_dict(job.status))
+        assert rt.slice_restarts == {"1": 2, "0": 1}
+
+
+# -------------------------------------------------------------- tpu_env
+
+
+class TestPerSliceEnv:
+    def test_per_slice_worlds(self):
+        job = make_ms_job("ms", workers=4, slices=2)
+        seen = []
+        for i in range(4):
+            e = tpu_env.gen_tpu_env(job, ReplicaType.WORKER, i)
+            seen.append((e["TPUJOB_SLICE_ID"], e["JAX_PROCESS_ID"],
+                         e["JAX_NUM_PROCESSES"]))
+            assert e["TPUJOB_NUM_SLICES"] == "2"
+        assert seen == [("0", "0", "2"), ("0", "1", "2"),
+                        ("1", "0", "2"), ("1", "1", "2")]
+        e0 = tpu_env.gen_tpu_env(job, ReplicaType.WORKER, 0)
+        e2 = tpu_env.gen_tpu_env(job, ReplicaType.WORKER, 2)
+        # Each slice coordinates through its OWN first process; the DCN
+        # coordinator is the global first process for everyone.
+        assert "ms-worker-0" in e0["JAX_COORDINATOR_ADDRESS"]
+        assert "ms-worker-2" in e2["JAX_COORDINATOR_ADDRESS"]
+        assert e0["TPUJOB_DCN_COORDINATOR"] == e2["TPUJOB_DCN_COORDINATOR"]
+        assert "ms-worker-0" in e0["TPUJOB_DCN_COORDINATOR"]
+        # Worker hostname scoping: a slice only sees its own block.
+        assert "ms-worker-2" not in e0["TPU_WORKER_HOSTNAMES"]
+        assert "ms-worker-0" not in e2["TPU_WORKER_HOSTNAMES"]
+
+    def test_single_slice_contract_unchanged(self):
+        job = make_ms_job("s1", workers=2, slices=1)
+        e = tpu_env.gen_tpu_env(job, ReplicaType.WORKER, 1)
+        assert e["JAX_PROCESS_ID"] == "1"
+        assert e["JAX_NUM_PROCESSES"] == "2"
+        assert "TPUJOB_SLICE_ID" not in e
+        assert "TPUJOB_DCN_COORDINATOR" not in e
+
+    def test_slice_of_process(self):
+        job = make_ms_job("p", workers=6, slices=3)
+        assert [tpu_env.slice_of_process(job, p) for p in range(6)] == \
+            [0, 0, 1, 1, 2, 2]
+
+
+# ------------------------------------------------------------ allocator
+
+
+class TestAdmitMany:
+    def test_atomic_all_or_nothing(self):
+        alloc = SliceAllocator.of("v5e-8", "v5e-8", "v5e-8")
+        assert alloc.admit_many("a", "v5e-8", 2) is not None
+        assert alloc.free_slices() == 1
+        # 2 wanted, 1 free: NOTHING held (no partial claim).
+        assert alloc.admit_many("b", "v5e-8", 2) is None
+        assert alloc.free_slices() == 1
+        # ...so a 1-slice job still backfills.
+        assert alloc.admit("c", "v5e-8") is not None
+        assert alloc.free_slices() == 0
+
+    def test_idempotent_per_holder(self):
+        alloc = SliceAllocator.of("v5e-8", "v5e-8")
+        first = alloc.admit_many("a", "v5e-8", 2)
+        assert alloc.admit_many("a", "v5e-8", 2) == first
+
+    def test_release_frees_all(self):
+        alloc = SliceAllocator.of("v5e-8", "v5e-8")
+        alloc.admit_many("a", "v5e-8", 2)
+        assert alloc.release("a")
+        assert alloc.free_slices() == 2
+
+    def test_free_of_class(self):
+        alloc = SliceAllocator.of("v5e-8", "v5e-8", "v5e-16")
+        assert alloc.free_of_class("v5e-8") == 2
+        assert alloc.free_of_class("v5e-16") == 1
+
+
+# ------------------------------------------------------------ scheduler
+
+
+def fleet_job(name: str, slices: int = 1, priority: str = "",
+              ns: str = "default") -> TrainJob:
+    job = make_ms_job(name, workers=max(2, 2 * slices), slices=slices,
+                      topology="v5e-8", gang=True)
+    job.metadata.namespace = ns
+    job.spec.run_policy.scheduling.priority_class = priority
+    return job
+
+
+class TestSchedulerMultiSlice:
+    def test_no_partial_hold_and_backfill(self):
+        pol = FleetPolicy.default()
+        pol.preemption_cooldown_seconds = 0.0
+        s = FleetScheduler(SliceAllocator.of("v5e-8"), pol)
+        big = fleet_job("big", slices=2, priority="high")
+        d = s.decide(big)
+        assert not d.admit and d.reason == "capacity"
+        assert s.allocator.free_slices() == 1  # nothing held
+        # A lower-priority 1-slice job backfills past the blocked 2-slice
+        # waiter — NOT an inversion (it could never have used one slice).
+        small = fleet_job("small", slices=1, priority="low")
+        d2 = s.decide(small)
+        assert d2.admit
+        assert s.stats["inversions"] == 0
+
+    def test_admits_when_capacity_complete(self):
+        pol = FleetPolicy.default()
+        s = FleetScheduler(SliceAllocator.of("v5e-8", "v5e-8"), pol)
+        big = fleet_job("big", slices=2)
+        d = s.decide(big)
+        assert d.admit
+        assert len(d.slice_id.split(",")) == 2
+        assert s.allocator.free_slices() == 0
+
+    def test_ranked_multislice_gets_both_when_free(self):
+        # The higher-ranked 2-slice waiter is reserved BOTH freshly-freed
+        # slices before the lower 1-slice job sees either.
+        pol = FleetPolicy.default()
+        s = FleetScheduler(SliceAllocator.of("v5e-8", "v5e-8"), pol)
+        for i in range(2):
+            assert s.decide(fleet_job(f"blk{i}", slices=1)).admit
+        big = fleet_job("big", slices=2, priority="high")
+        small = fleet_job("small", slices=1, priority="low")
+        assert not s.decide(big).admit
+        assert not s.decide(small).admit
+        s.release("default/blk0")
+        s.release("default/blk1")
+        # Both free: the kick targets the 2-slice waiter (it consumes
+        # both), NOT the backfiller.
+        assert s.kick_targets() == ["default/big"]
+        assert s.decide(big).admit
+        d = s.decide(small)
+        assert not d.admit and s.stats["inversions"] == 0
+
+    def test_quota_counts_slices(self):
+        pol = FleetPolicy.default()
+        pol.quotas["default"] = ResourceQuota(
+            namespace="default", max_slices=2, max_jobs=None)
+        s = FleetScheduler(
+            SliceAllocator.of("v5e-8", "v5e-8", "v5e-8", "v5e-8"), pol)
+        assert s.decide(fleet_job("a", slices=2)).admit
+        # Quota 2 slices: a second 1-slice job must be quota-blocked even
+        # though only ONE job runs.
+        d = s.decide(fleet_job("b", slices=1))
+        assert not d.admit and d.reason == "quota"
+
+    def test_kick_targets_skip_partial(self):
+        pol = FleetPolicy.default()
+        s = FleetScheduler(SliceAllocator.of("v5e-8", "v5e-8"), pol)
+        for i in range(2):
+            assert s.decide(fleet_job(f"blk{i}", slices=1)).admit
+        big = fleet_job("big", slices=2, priority="high")
+        small = fleet_job("small", slices=1, priority="low")
+        assert not s.decide(big).admit
+        assert not s.decide(small).admit
+        s.release("default/blk0")
+        # ONE free slice: the 2-slice waiter cannot use it; the kick must
+        # target the 1-slice backfiller instead of waking big for nothing.
+        assert s.kick_targets() == ["default/small"]
+
+
+# ----------------------------------------------------- controller units
+
+
+class TestControllerMultiSlice:
+    def _env(self, slices=2):
+        cluster = InMemoryCluster()
+        alloc = SliceAllocator.of(*["v5e-1"] * slices)
+        controller = TrainJobController(cluster, enable_gang=True,
+                                        slice_allocator=alloc)
+        return cluster, controller, alloc
+
+    def test_atomic_admission_annotation(self):
+        cluster, controller, alloc = self._env(slices=2)
+        job = make_ms_job("ms", workers=2, slices=2, gang=True)
+        cluster.create_job(job)
+        assert controller.run_until_idle(10.0)
+        got = cluster.get_job("default", "ms")
+        ann = got.metadata.annotations.get("tpujob.dev/slice", "")
+        assert sorted(ann.split(",")) == ["slice-0", "slice-1"]
+        pods = cluster.list_pods("default", {"job-name": "ms"})
+        assert len(pods) == 2
+        assert sorted(p.metadata.labels.get("slice-id") for p in pods) == \
+            ["0", "1"]
+
+    def test_insufficient_capacity_holds_nothing(self):
+        cluster, controller, alloc = self._env(slices=1)  # 1 slice only
+        job = make_ms_job("ms", workers=2, slices=2, gang=True)
+        cluster.create_job(job)
+        assert controller.run_until_idle(10.0)
+        assert cluster.list_pods("default", {"job-name": "ms"}) == []
+        assert alloc.free_slices() == 1  # no partial claim
+        events = [e.reason for e in
+                  cluster.events_for("TrainJob", "default", "ms")]
+        assert "SliceUnavailable" in events
+        # ...and a single-slice job still backfills the free slice.
+        one = make_ms_job("one", workers=1, slices=1, gang=True)
+        cluster.create_job(one)
+        assert controller.run_until_idle(10.0)
+        assert len(cluster.list_pods("default", {"job-name": "one"})) == 1
+
+    def test_retryable_failure_rolls_one_slice_only(self):
+        cluster = InMemoryCluster()
+        controller = TrainJobController(cluster, enable_gang=False)
+        job = make_ms_job("roll", workers=4, slices=2)
+        cluster.create_job(job)
+        assert controller.run_until_idle(10.0)
+        pods = {p.name: p for p in
+                cluster.list_pods("default", {"job-name": "roll"})}
+        assert len(pods) == 4
+        survivors = {n: p.metadata.uid for n, p in pods.items()
+                     if p.metadata.labels["slice-id"] == "0"}
+        for name, p in pods.items():
+            if p.metadata.labels["slice-id"] == "0":
+                cluster.set_pod_phase("default", name, PodPhase.RUNNING)
+        # Kill ONE member of slice 1 with a retryable code.
+        doomed = [n for n, p in pods.items()
+                  if p.metadata.labels["slice-id"] == "1"]
+        cluster.set_pod_phase("default", doomed[0], PodPhase.FAILED,
+                              exit_code=137)
+        cluster.set_pod_phase("default", doomed[1], PodPhase.RUNNING)
+        assert controller.run_until_idle(10.0)
+        got = cluster.get_job("default", "roll")
+        assert got.status.gang_restarts == 1
+        assert got.status.slice_restarts == {"1": 1}
+        after = {p.name: p.metadata.uid for p in
+                 cluster.list_pods("default", {"job-name": "roll"})}
+        # Slice 0's pods survived untouched; slice 1's were replaced.
+        for n, uid in survivors.items():
+            assert after.get(n) == uid, (n, after)
+        for n in doomed:
+            assert after.get(n) != pods[n].metadata.uid
+
+    def test_per_slice_watchdog_rolls_stale_slice(self):
+        class Stub:
+            hb = None
+
+            def job_heartbeat(self, ns, name):
+                return self.hb
+
+        cluster = InMemoryCluster()
+        stub = Stub()
+        controller = TrainJobController(cluster, enable_gang=False,
+                                        heartbeat_source=stub)
+        job = make_ms_job("hang", workers=2, slices=2)
+        job.spec.run_policy.recovery.heartbeat_timeout_seconds = 1.5
+        cluster.create_job(job)
+        assert controller.run_until_idle(10.0)
+        pods = {p.name: p for p in
+                cluster.list_pods("default", {"job-name": "hang"})}
+        for n in pods:
+            cluster.set_pod_phase("default", n, PodPhase.RUNNING)
+        assert controller.run_until_idle(10.0)
+        pods = {p.name: p for p in
+                cluster.list_pods("default", {"job-name": "hang"})}
+        # Age the generation past the start-time grace, then report slice
+        # 0's heartbeat FRESH (holding at the barrier pings t) and slice
+        # 1's long stale — only slice 1 may roll.
+        time.sleep(2.0)
+        now = time.time()
+        stub.hb = {
+            "step": 12, "t": now,
+            "replicas": {
+                "hang-worker-0": {"step": 12, "t": now},
+                "hang-worker-1": {"step": 12, "t": now - 60},
+            },
+        }
+        controller.enqueue("default/hang")
+        assert controller.run_until_idle(10.0)
+        got = cluster.get_job("default", "hang")
+        assert got.status.gang_restarts == 1
+        assert got.status.slice_restarts == {"1": 1}
+        after = {p.name: p.metadata.uid for p in
+                 cluster.list_pods("default", {"job-name": "hang"})}
+        assert after["hang-worker-0"] == pods["hang-worker-0"].metadata.uid
+        assert after["hang-worker-1"] != pods["hang-worker-1"].metadata.uid
+
+
+# ---------------------------------------------------------------- chaos
+
+
+class TestChaosSliceTargeting:
+    def test_parse(self):
+        (d,) = parse_chaos("kill:step=12,slice=1,signal=KILL")
+        assert d.params["slice"] == 1
+
+    def test_rejects_negative(self):
+        with pytest.raises(ValueError):
+            parse_chaos("hang:step=3,slice=-1")
+
+    def test_matching(self):
+        (d,) = parse_chaos("kill:step=12,slice=1")
+        assert replica_matches(d, {"TPUJOB_SLICE_ID": "1"})
+        assert not replica_matches(d, {"TPUJOB_SLICE_ID": "0"})
+        assert not replica_matches(d, {})  # unlabeled never fires
+
+    def test_composes_with_index(self):
+        (d,) = parse_chaos("kill:step=12,slice=1,index=0")
+        env = {"TPUJOB_SLICE_ID": "1", "TPUJOB_REPLICA_INDEX": "0"}
+        assert replica_matches(d, env)
+        assert not replica_matches(
+            d, {"TPUJOB_SLICE_ID": "1", "TPUJOB_REPLICA_INDEX": "1"})
+
+
+# ------------------------------------------------------------- exchange
+
+
+class TestDcnExchange:
+    def test_partition_buckets(self):
+        parts = partition_buckets([10, 10, 10, 10], 2)
+        assert parts == [[0, 1], [2, 3]]
+        parts = partition_buckets([100, 1, 1], 3)
+        assert [i for p in parts for i in p] == [0, 1, 2]
+        assert len(parts) <= 3
+        assert partition_buckets([5], 4) == [[0]]
+
+    def _run_pair(self, tmp_path, steps=2, microbatches=2, latency=0.0,
+                  compute_s=0.0):
+        results: dict = {}
+        errors: list = []
+
+        def run(sid):
+            try:
+                w = SliceWorld(slice_id=sid, num_slices=2,
+                               dcn_dir=str(tmp_path), latency_s=latency)
+                ex = DcnExchange(w, resume_step=0,
+                                 microbatches=microbatches, buckets=2,
+                                 peer_timeout_s=30)
+                for step in range(1, steps + 1):
+                    ex.begin_step(step)
+                    for m in range(microbatches):
+                        ex.submit(step, m, [
+                            np.full((8,), sid * 10 + m, np.float32),
+                            np.full((2, 2), step, np.float32),
+                        ])
+                        if compute_s:
+                            time.sleep(compute_s)  # the "backward"
+                    out = ex.collect(step)
+                    ex.step_done(step)
+                    results.setdefault(sid, []).append(
+                        [float(a.mean()) for a in out])
+                results[f"stats{sid}"] = ex.stats()
+                ex.close()
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        ts = [threading.Thread(target=run, args=(s,)) for s in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert not errors, errors
+        return results
+
+    def test_allreduce_mean_correct(self, tmp_path):
+        res = self._run_pair(tmp_path, steps=3, microbatches=2)
+        # contributions: slice*10 + m over {0,1}x{0,1} -> mean 5.5;
+        # second leaf carries the step number on both sides.
+        for sid in (0, 1):
+            for step, (first, second) in enumerate(res[sid], start=1):
+                assert first == pytest.approx(5.5)
+                assert second == pytest.approx(step)
+        assert res["stats0"]["transfers"] == 3 * 2 * 2  # steps x m x buckets
+
+    @pytest.mark.flaky
+    def test_overlap_hides_wire_behind_compute(self, tmp_path):
+        # 30ms wire per microbatch vs 60ms compute: the engine streams
+        # microbatch m while the driver "computes" m+1, so a visible wait
+        # remains only at the tail — hidden_fraction must clear zero by a
+        # wide margin (the precise acceptance gate rides the slow trainer
+        # run; this is the deterministic engine-level witness).
+        res = self._run_pair(tmp_path, steps=3, microbatches=3,
+                             latency=0.015, compute_s=0.06)
+        st = res["stats0"]
+        assert st["dcn_busy_s"] > 0
+        assert st["hidden_fraction"] is not None
+        assert st["hidden_fraction"] >= 0.3, st
+
+    def test_rewind_protocol(self, tmp_path):
+        w0 = SliceWorld(slice_id=0, num_slices=2, dcn_dir=str(tmp_path))
+        w1 = SliceWorld(slice_id=1, num_slices=2, dcn_dir=str(tmp_path))
+        ex0 = DcnExchange(w0, resume_step=0, microbatches=1, buckets=1,
+                          peer_timeout_s=30)
+        ex1 = DcnExchange(w1, resume_step=0, microbatches=1, buckets=1,
+                          peer_timeout_s=30)
+        leaves = lambda v: [np.full((4,), v, np.float32)]  # noqa: E731
+
+        def one_step(ex, step, v):
+            ex.begin_step(step)
+            ex.submit(step, 0, leaves(v))
+            out = ex.collect(step)
+            ex.step_done(step)
+            return out
+
+        done1 = []
+        t = threading.Thread(
+            target=lambda: done1.append(one_step(ex1, 1, 1.0)))
+        t.start()
+        one_step(ex0, 1, 0.0)
+        t.join(30)
+        assert done1 and float(done1[0][0][0]) == pytest.approx(0.5)
+        # Slice 1 "dies" and restarts: new generation resuming at step 0.
+        ex1.close()
+        ex1b = DcnExchange(w1, resume_step=0, microbatches=1, buckets=1,
+                           peer_timeout_s=30)
+        # Slice 0 moves on to step 2 and must be told to rewind.
+        ex0.begin_step(2)
+        ex0.submit(2, 0, leaves(2.0))
+        with pytest.raises(SliceRewind) as exc:
+            ex0.collect(2)
+        assert exc.value.to_step == 0 and exc.value.peer == 1
+        ex0.rewind_to(0)
+        assert ex0.stats()["rewinds"] == 1
+        # Both replay step 1 then advance to step 2 in lockstep.
+        done = {}
+
+        def replay(ex, sid, vals):
+            for step, v in vals:
+                done.setdefault(sid, []).append(one_step(ex, step, v))
+
+        t0 = threading.Thread(
+            target=replay, args=(ex0, 0, [(1, 0.0), (2, 2.0)]))
+        t1 = threading.Thread(
+            target=replay, args=(ex1b, 1, [(1, 1.0), (2, 4.0)]))
+        t0.start(); t1.start()
+        t0.join(30); t1.join(30)
+        assert float(done[0][1][0][0]) == pytest.approx(3.0)
+        assert float(done[1][1][0][0]) == pytest.approx(3.0)
+        ex0.close(); ex1b.close()
+
+    def test_rewind_when_peer_resumes_at_pending_step(self, tmp_path):
+        # A peer can resume AT the survivor's pending step: the checkpoint
+        # for step N is durable once the saver completes N, while the dead
+        # generation's step-N files may never have been published (the
+        # engine publishes after its wire sleep). The survivor must rewind
+        # (resume <= pending), not hold until the peer timeout.
+        w0 = SliceWorld(slice_id=0, num_slices=2, dcn_dir=str(tmp_path))
+        w1 = SliceWorld(slice_id=1, num_slices=2, dcn_dir=str(tmp_path))
+        ex0 = DcnExchange(w0, resume_step=0, microbatches=1, buckets=1,
+                          peer_timeout_s=30)
+        ex1 = DcnExchange(w1, resume_step=0, microbatches=1, buckets=1,
+                          peer_timeout_s=30)
+        # Step 1 completes on both sides (records each other's gen).
+        def one(ex, v):
+            ex.begin_step(1)
+            ex.submit(1, 0, [np.full((2,), v, np.float32)])
+            out = ex.collect(1)
+            ex.step_done(1)
+            return out
+
+        t = threading.Thread(target=lambda: one(ex1, 1.0))
+        t.start()
+        one(ex0, 0.0)
+        t.join(30)
+        # Slice 1 dies and resumes AT step 2 — the step slice 0 is
+        # pending (its files for 2 were never published by the dead gen).
+        ex1.close()
+        ex0.begin_step(2)
+        ex0.submit(2, 0, [np.full((2,), 2.0, np.float32)])
+        ex1b = DcnExchange(w1, resume_step=2, microbatches=1, buckets=1,
+                           peer_timeout_s=30)
+        with pytest.raises(SliceRewind) as exc:
+            ex0.collect(2)
+        assert exc.value.to_step == 2
+        ex0.close()
+        ex1b.close()
+
+    def test_collect_interrupted_by_guard(self, tmp_path):
+        # A latched preemption signal must break a holding slice out of
+        # the barrier (graceful path) instead of wedging until SIGKILL.
+        from tf_operator_tpu.parallel.multislice import DcnInterrupted
+
+        w = SliceWorld(slice_id=0, num_slices=2, dcn_dir=str(tmp_path))
+        ex = DcnExchange(w, resume_step=0, microbatches=1, buckets=1,
+                         peer_timeout_s=30)
+        ex.begin_step(1)
+        ex.submit(1, 0, [np.zeros((2,), np.float32)])
+        t0 = time.monotonic()
+        with pytest.raises(DcnInterrupted):
+            ex.collect(1, should_stop=lambda: True)
+        assert time.monotonic() - t0 < 5.0
+        ex.close()
+
+    def test_world_from_env(self):
+        assert SliceWorld.from_env({"TPUJOB_NUM_SLICES": "1"}) is None
+        w = SliceWorld.from_env({
+            "TPUJOB_NUM_SLICES": "2", "TPUJOB_SLICE_ID": "1",
+            "TPUJOB_DCN_DIR": "/tmp/x", "TPUJOB_DCN_LATENCY_S": "0.5",
+        })
+        assert (w.slice_id, w.num_slices, w.latency_s) == (1, 2, 0.5)
+        with pytest.raises(RuntimeError):
+            SliceWorld.from_env({"TPUJOB_NUM_SLICES": "2"})
+
+
+class TestHierarchicalMesh:
+    def test_data_axis_outermost(self):
+        import jax
+
+        from tf_operator_tpu.parallel import mesh as mesh_lib
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip("needs >= 2 devices")
+        m = mesh_lib.hierarchical_mesh({"dp": len(devs) // 2}, 2, devs)
+        assert m.axis_names[0] == "data"
+        assert m.shape["data"] == 2
+        assert mesh_lib.data_axes(m)[0] == "data"
+
+    def test_rejects_data_in_axes(self):
+        import jax
+
+        from tf_operator_tpu.parallel import mesh as mesh_lib
+
+        with pytest.raises(ValueError):
+            mesh_lib.hierarchical_mesh({"data": 2}, 2, jax.devices())
+
+
+# ----------------------------------------------------------- telemetry
+
+
+class TestDcnTelemetry:
+    def test_dcn_sync_is_a_phase(self):
+        from tf_operator_tpu.telemetry.phases import PHASES
+
+        assert "dcn_sync" in PHASES
+
+    def test_collector_exposes_hidden_fraction(self, tmp_path):
+        from tf_operator_tpu.status import metrics as metrics_mod
+        from tf_operator_tpu.telemetry.collector import TelemetryCollector
+
+        reg = metrics_mod.Registry()
+        col = TelemetryCollector(str(tmp_path), registry=reg)
+        with open(tmp_path / "default_msjob-worker-0.metrics.jsonl",
+                  "w") as f:
+            f.write(json.dumps({"event": "start", "t": 1.0}) + "\n")
+            f.write(json.dumps({
+                "event": "done", "steps": 8, "final_loss": 1.0,
+                "steady_steps_per_sec": 2.0,
+                "dcn": {"hidden_fraction": 0.73, "slices": 2},
+            }) + "\n")
+
+        class FakeCluster:
+            def list_jobs(self):
+                return [make_ms_job("msjob", workers=2, slices=2)]
+
+        col.refresh_gauges(FakeCluster())
+        text = reg.expose()
+        assert ('tpujob_trainer_dcn_hidden_fraction'
+                '{job="msjob",namespace="default"} 0.73') in text
+
+
+# ------------------------------------------------------- e2e capstones
+
+
+@pytest.fixture
+def session(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUJOB_PRESPAWN", "0")
+    s = LocalSession(
+        env_overrides={**ONE_DEV,
+                       "TPUJOB_CHAOS_STATE": str(tmp_path / "chaos-state"),
+                       "TPUJOB_DCN_LATENCY_S": "0.005"},
+        log_dir=str(tmp_path / "logs"),
+    )
+    yield s
+    s.close()
+
+
+def pod_events(tmp_path, pod: str, ns: str = "default") -> list[dict]:
+    return read_events(tmp_path / "logs" / f"{ns}_{pod}.metrics.jsonl")
+
+
+def progress_losses(events: list[dict]) -> dict[int, float]:
+    return {e["step"]: e["loss"] for e in events if e["event"] == "progress"}
+
+
+STEPS = 24
+
+
+def ms_trainer_cmd(ckpt: str, *extra: str) -> list[str]:
+    return [PY, "-m", "tf_operator_tpu.models.train", "--model", "mnist-mlp",
+            "--steps", str(STEPS), "--batch", "256", "--log-every", "4",
+            "--checkpoint-dir", ckpt, "--checkpoint-every", "8",
+            "--dcn-microbatches", "2", "--dcn-buckets", "2", *extra]
+
+
+@pytest.mark.slow
+class TestSliceFailureE2E:
+    """The acceptance capstone: `kill:step=12,slice=1` SIGKILLs slice 1's
+    gang of a 2-slice job. The controller rolls ONLY slice 1 (slice 0's
+    pod never restarts — it holds at the DCN barrier), slice 1's gen-2
+    resumes from the shared step-8 checkpoint, slice 0 rewinds IN PROCESS
+    to meet it, and the job completes at exactly STEPS with losses
+    rtol-1e-3-equal to an uninterrupted SINGLE-slice reference run of the
+    same global batch. gang_restarts counts the incident once."""
+
+    @pytest.mark.flaky
+    def test_kill_slice1_rolls_only_slice1(self, session, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        ref_ckpt = str(tmp_path / "ref-ckpt")
+        chaos_job = make_ms_job(
+            "mskill", workers=2, slices=2,
+            cmd=ms_trainer_cmd(ckpt, "--chaos",
+                               "kill:step=12,slice=1,signal=KILL"),
+        )
+        # Reference: a PLAIN single-slice job over the same GLOBAL batch
+        # (the multislice loop's mean over slice x microbatch row blocks
+        # equals the full-batch mean).
+        ref_job = make_ms_job(
+            "msref", workers=1, slices=1,
+            cmd=[PY, "-m", "tf_operator_tpu.models.train", "--model",
+                 "mnist-mlp", "--steps", str(STEPS), "--batch", "256",
+                 "--log-every", "4", "--checkpoint-dir", ref_ckpt,
+                 "--checkpoint-every", "8"],
+        )
+        ref_job.spec.tpu = None  # no slice machinery at all
+        session.submit(chaos_job)
+        session.submit(ref_job)
+
+        job = session.wait_for_condition("default", "mskill", DONE,
+                                         timeout=480)
+        assert is_succeeded(job.status), [
+            (str(c.type), c.reason, c.message) for c in job.status.conditions
+        ]
+        ref = session.wait_for_condition("default", "msref", DONE,
+                                         timeout=480)
+        assert is_succeeded(ref.status)
+
+        # ONLY slice 1 rolled: slice 0's pod has ONE process generation,
+        # slice 1's has two; the incident counted once, per slice 1.
+        ev0 = pod_events(tmp_path, "mskill-worker-0")
+        ev1 = pod_events(tmp_path, "mskill-worker-1")
+        assert len([e for e in ev0 if e["event"] == "start"]) == 1, \
+            [e["event"] for e in ev0]
+        assert len([e for e in ev1 if e["event"] == "start"]) == 2, \
+            [e["event"] for e in ev1]
+        assert job.status.gang_restarts == 1
+        assert job.status.slice_restarts == {"1": 1}
+        assert len([e for e in session.cluster.events_for(
+            "TrainJob", "default", "mskill")
+            if e.reason == "GangRestart"]) == 1
+
+        # Slice 1's gen-2 resumed from the shared step-8 checkpoint;
+        # slice 0 rewound IN PROCESS to meet it.
+        resumed = [e for e in ev1 if e["event"] == "resumed"]
+        assert resumed and resumed[-1]["from_step"] == 8, resumed
+        rewinds = [e for e in ev0 if e["event"] == "dcn_rewind"]
+        assert rewinds and rewinds[-1]["peer_resume"] == 8, rewinds
+
+        # Completed at EXACTLY the requested step, loss-equal to the
+        # uninterrupted single-slice reference.
+        dones = [e for e in ev0 if e["event"] == "done"]
+        assert dones and dones[-1]["steps"] == STEPS
+        assert dones[-1]["dcn"]["rewinds"] == 1
+        ref_losses = progress_losses(pod_events(tmp_path, "msref-worker-0"))
+        got = progress_losses(ev0)
+        common = sorted(set(ref_losses) & set(got))
+        assert STEPS in common and len(common) >= 3, (ref_losses, got)
+        for s in common:
+            assert got[s] == pytest.approx(ref_losses[s], rel=1e-3), \
+                (s, got, ref_losses)
+
+
+@pytest.mark.slow
+class TestOverlapAcceptance:
+    """The measured-overlap acceptance: with an injected DCN latency that
+    makes the unoverlapped cross-slice sync >= 30% of step time
+    (dcn_busy_s against the counterfactual serial wall), the bucketed
+    microbatch-streamed reduction must report dcn_hidden_fraction >= 0.5
+    — and the phase breakdown still telescopes exactly to step wall."""
+
+    @pytest.mark.flaky
+    def test_hidden_fraction_measured(self, tmp_path):
+        # Config tuned on the 2-core CI host (three consecutive runs:
+        # hidden 0.65-0.68, busy/wall 0.40): λ·M must sit in the band
+        # where the total wire is a real fraction of the step (lower
+        # bound) yet per-microbatch wire stays under per-microbatch
+        # backward so the streaming can hide it (upper bound).
+        dcn = tmp_path / "dcn"
+        dcn.mkdir()
+        procs = []
+        for sid in (0, 1):
+            env = {
+                **os.environ, **ONE_DEV,
+                "TPUJOB_NUM_SLICES": "2",
+                "TPUJOB_SLICE_ID": str(sid),
+                "TPUJOB_DCN_DIR": str(dcn),
+                "TPUJOB_DCN_LATENCY_S": "0.16",
+                "TPUJOB_METRICS_FILE": str(tmp_path / f"s{sid}.jsonl"),
+                "TPUJOB_PRESPAWN": "0",
+            }
+            procs.append(subprocess.Popen(
+                [PY, "-m", "tf_operator_tpu.models.train", "--model",
+                 "mnist-mlp", "--steps", "8", "--batch", "36864",
+                 "--log-every", "4", "--dcn-microbatches", "6",
+                 "--dcn-buckets", "1"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT))
+        try:
+            for p in procs:
+                assert p.wait(timeout=300) == 0
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        (done,) = [e for e in read_events(tmp_path / "s0.jsonl")
+                   if e["event"] == "done"]
+        d, pb = done["dcn"], done["phase_breakdown"]
+        # Telescoping: phases (incl. dcn_sync) sum exactly to step wall.
+        phase_sum = sum(v for k, v in pb.items()
+                        if k not in ("wall_s", "steps"))
+        assert phase_sum == pytest.approx(pb["wall_s"], rel=1e-3)
+        assert pb.get("dcn_sync", 0) == pytest.approx(d["dcn_sync_s"],
+                                                      rel=0.05)
+        # The injected wire is a real fraction of the step: unoverlapped
+        # it would cost dcn_busy_s, >= 30% of the measured step wall
+        # (measured ~0.40; it also clears the stricter counterfactual
+        # denominator wall - visible + busy at ~0.31).
+        assert d["dcn_busy_s"] / pb["wall_s"] >= 0.30, (d, pb)
+        # ...and the streamed reduction hides at least half of it
+        # (measured ~0.65-0.68).
+        assert d["hidden_fraction"] >= 0.5, d

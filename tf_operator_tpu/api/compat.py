@@ -178,6 +178,10 @@ def job_from_dict(manifest: dict[str, Any], apply_defaults: bool = True) -> Trai
             topology=tpu_d.get("topology", ""),
             accelerator=tpu_d.get("accelerator", ""),
             chips_per_host=int(tpu_d.get("chipsPerHost", 0)),
+            # Explicit 0/negative must reach validation (>= 1 rule), same
+            # contract as progressThresholdSteps; absent/null defaults 1.
+            slices=(1 if tpu_d.get("slices") is None
+                    else int(tpu_d["slices"])),
         )
         if tpu_d
         else None
@@ -338,6 +342,7 @@ def job_to_dict(job: TrainJob) -> dict[str, Any]:
             "topology": job.spec.tpu.topology,
             "accelerator": job.spec.tpu.accelerator,
             "chipsPerHost": job.spec.tpu.chips_per_host,
+            "slices": job.spec.tpu.slices,
         }
     if job.spec.mesh is not None:
         out["spec"]["mesh"] = {"axes": job.spec.mesh.axes}

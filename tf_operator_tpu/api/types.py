@@ -182,11 +182,23 @@ class TPUSpec:
     topology: either an accelerator-type string ("v5e-32", "v4-16") or an
     explicit chip grid ("2x2x4"). The gang scheduler treats one slice as an
     atomic unit (SURVEY.md §2: a v5e-32 slice is inherently gang).
+
+    slices: how many slices of `topology`'s class ONE job spans (multi-slice
+    training). The controller admits all N atomically (all-or-nothing — no
+    partial holds), schedules N per-slice worker gangs, and cluster_spec
+    emits per-slice coordinator topology (TPUJOB_SLICE_ID/TPUJOB_NUM_SLICES
+    plus a per-slice JAX coordinator and a global DCN coordinator,
+    megascale-style). Gradients cross slices over DCN — an order of
+    magnitude slower than within-slice ICI — so the trainer runs the
+    hierarchical bucketed reduction (parallel/multislice.py) instead of a
+    flat all-reduce. 1 (the default) is today's single-slice behavior,
+    bit-for-bit.
     """
 
     topology: str = ""
     accelerator: str = ""  # e.g. "v5e"; derived from topology when empty
     chips_per_host: int = 0  # derived from accelerator when 0
+    slices: int = 1
 
 
 @dataclass
@@ -365,6 +377,12 @@ class JobStatus:
     preemptions: int = 0
     last_preemption_time: float | None = None
     pending_preemption_uids: list[str] = field(default_factory=list)
+    # Multi-slice recovery bookkeeping (spec.tpu.slices > 1): per-slice
+    # restart counts ("0" -> 2 means slice 0's gang rolled twice). The
+    # job-level gang_restarts/consecutive_restarts above still count each
+    # incident once (backoffLimit semantics unchanged); this map is the
+    # per-slice visibility the API serves — which slice keeps failing.
+    slice_restarts: dict[str, int] = field(default_factory=dict)
     # Elastic reshape state (recovery.elastic): while degraded, the
     # effective Worker replica count and the slice class actually held.
     # Persisted (not operator memory) so a failover keeps serving the

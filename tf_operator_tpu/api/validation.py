@@ -148,6 +148,38 @@ def validate_spec(spec: TrainJobSpec, fleet=None) -> list[str]:
             "with the slice)"
         )
 
+    if spec.tpu is not None and spec.tpu.slices < 1:
+        problems.append("tpu.slices must be >= 1")
+    if spec.tpu is not None and spec.tpu.slices > 1:
+        # Multi-slice jobs: N equal per-slice worker gangs, one job. The
+        # per-slice process count must be integral, the gang machinery
+        # (per-slice rolls) rides recovery.policy gang, and — like elastic
+        # reshape — a fixed Chief/Master would not partition into slices.
+        workers = spec.replica_specs.get(ReplicaType.WORKER)
+        wreps = int(workers.replicas or 0) if workers is not None else 0
+        if workers is None or wreps < spec.tpu.slices:
+            problems.append(
+                f"tpu.slices ({spec.tpu.slices}) needs at least that many "
+                f"Worker replicas (got {wreps}): each slice runs its own "
+                f"worker gang")
+        elif wreps % spec.tpu.slices:
+            problems.append(
+                f"Worker replicas ({wreps}) must divide evenly into "
+                f"tpu.slices ({spec.tpu.slices}): slices are equal gangs")
+        if rec.policy == "pod":
+            problems.append(
+                "tpu.slices > 1 requires runPolicy.recovery.policy 'gang' "
+                "(got 'pod': per-slice recovery rolls a whole slice gang)")
+        if elastic.reshape_on_recovery:
+            problems.append(
+                "tpu.slices > 1 conflicts with "
+                "runPolicy.recovery.elastic.reshapeOnRecovery (the reshape "
+                "arithmetic scales one slice, not a multi-slice span)")
+        if (ReplicaType.CHIEF in spec.replica_specs
+                or ReplicaType.MASTER in spec.replica_specs):
+            problems.append(
+                "tpu.slices > 1 supports Worker-only gangs (a Chief/Master "
+                "replica belongs to no slice)")
     if spec.tpu is not None and spec.tpu.topology:
         try:
             topo = parse_topology(
@@ -157,6 +189,10 @@ def validate_spec(spec: TrainJobSpec, fleet=None) -> list[str]:
             problems.append(str(e))
         else:
             if spec.mesh is not None and spec.mesh.axes:
+                # mesh.axes describes the PER-SLICE mesh even when
+                # tpu.slices > 1: each slice is its own ICI world; the
+                # cross-slice data axis is implied by `slices` and lives
+                # above the mesh (DCN), never inside it.
                 problems.extend(validate_mesh_axes(spec.mesh.axes, topo.num_chips))
     elif spec.mesh is not None and spec.mesh.axes:
         # Mesh without TPU slice: still check axis names/sizes are sane.

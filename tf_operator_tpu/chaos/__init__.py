@@ -69,6 +69,16 @@ def replica_matches(directive: Directive, env: dict | None = None) -> bool:
                 return False
         except ValueError:
             return False
+    # slice=K (multi-slice jobs): matches TPUJOB_SLICE_ID — how an e2e
+    # fails exactly one slice's gang. Same never-fires-unlabeled rule as
+    # replica/index: single-slice pods carry no slice id.
+    want_slice = directive.params.get("slice")
+    if want_slice is not None:
+        try:
+            if int(e.get("TPUJOB_SLICE_ID", "")) != want_slice:
+                return False
+        except ValueError:
+            return False
     return True
 
 

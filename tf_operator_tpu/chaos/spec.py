@@ -16,7 +16,7 @@ Directive kinds and their keys (all integers/floats unless noted):
     kill       step=N signal=NAME     SIGTERM the trainer once it completes
                [replica=TYPE]         step N (signal: TERM/INT/USR1/KILL/
                [index=I]              SEGV..., bare name, SIG-prefixed, or
-                                      a number). Without a one-shot state
+               [slice=K]              a number). Without a one-shot state
                                       dir the directive only fires in a
                                       process that STARTED before step N,
                                       so a resumed run past N never
@@ -24,7 +24,11 @@ Directive kinds and their keys (all integers/floats unless noted):
                                       directive to the pod whose
                                       TPUJOB_REPLICA_TYPE / _INDEX match —
                                       how a multi-worker job kills exactly
-                                      one gang member.
+                                      one gang member. slice=K matches
+                                      TPUJOB_SLICE_ID (multi-slice jobs:
+                                      fail exactly one slice's gang;
+                                      composes with replica/index to name
+                                      one member of that slice).
     hang       step=N [duration=S]    stop stepping WITHOUT exiting after
                [replica=TYPE]         step N (the wedged-collective
                [index=I]              failure mode exit codes can never
@@ -126,8 +130,10 @@ KINDS = ("kill", "hang", "torn", "stall", "apiserver", "preempt",
          "capacity")
 
 _KEYS: dict[str, dict[str, type]] = {
-    "kill": {"step": int, "signal": str, "replica": str, "index": int},
-    "hang": {"step": int, "duration": float, "replica": str, "index": int},
+    "kill": {"step": int, "signal": str, "replica": str, "index": int,
+             "slice": int},
+    "hang": {"step": int, "duration": float, "replica": str, "index": int,
+             "slice": int},
     "torn": {"step": int, "mode": str},
     "stall": {"delay": float, "batch": int, "every": int, "lane": int,
               "ckpt": int},
@@ -212,6 +218,8 @@ def parse_chaos(text: str) -> list[Directive]:
 def _validate(kind: str, params: dict) -> None:
     if kind in ("kill", "hang") and params.get("index", 0) < 0:
         raise ValueError(f"chaos: {kind}: index must be >= 0")
+    if kind in ("kill", "hang") and params.get("slice", 0) < 0:
+        raise ValueError(f"chaos: {kind}: slice must be >= 0")
     if kind == "kill":
         if "step" not in params:
             raise ValueError("chaos: kill requires step=N")

@@ -60,6 +60,10 @@ def _job_payload(cluster: InMemoryCluster, job: TrainJob,
             # pods stuck Pending past recovery.pendingTimeoutSeconds.
             "gangRestarts": job.status.gang_restarts,
             "consecutiveRestarts": job.status.consecutive_restarts,
+            # Multi-slice: which slice's gang rolled, how often — the
+            # "slice 3 keeps failing" signal (job-level tallies above
+            # stay authoritative for backoffLimit).
+            "sliceRestarts": dict(job.status.slice_restarts),
             "stuckPendingPods": list(job.status.stuck_pending_pods),
             # Preemption visibility (sched/): planned evictions are a
             # first-class lifecycle event, not failures.

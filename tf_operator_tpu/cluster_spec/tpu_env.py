@@ -47,6 +47,24 @@ ENV_REPLICA_INDEX = "TPUJOB_REPLICA_INDEX"
 # DIFFERENT gang shape (models/train.py --allow-reshape is the standalone
 # spelling) — without it, a reshaped re-admission would cold-start.
 ENV_ALLOW_RESHAPE = "TPUJOB_ALLOW_RESHAPE"
+# Multi-slice topology (spec.tpu.slices > 1), megascale-style: each pod
+# knows which slice it belongs to and how many there are; JAX_* coordinate
+# the PER-SLICE world (ICI domain — jax.distributed spans one slice), and
+# the DCN coordinator names the cross-slice rendezvous (global worker-0)
+# the hierarchical gradient reduction exchanges buckets through. The CPU
+# emulation's rendezvous is a shared directory (TPUJOB_DCN_DIR, injected
+# by the runtime under its log_dir); a real deployment points it at a
+# shared volume or replaces it with the platform's DCN transport.
+ENV_SLICE_ID = "TPUJOB_SLICE_ID"
+ENV_NUM_SLICES = "TPUJOB_NUM_SLICES"
+ENV_DCN_COORDINATOR = "TPUJOB_DCN_COORDINATOR"
+ENV_DCN_DIR = "TPUJOB_DCN_DIR"
+# Distinguishes one job INSTANCE's DCN rendezvous from a later
+# resubmission under the same name (derived from the job uid): the local
+# runtime folds it into the TPUJOB_DCN_DIR path, so a fresh job never
+# reads a dead run's stale exchange files — the same staleness class the
+# runtime's heartbeat-file drop exists for.
+ENV_DCN_EPOCH = "TPUJOB_DCN_EPOCH"
 
 TPU_RESOURCE = "google.com/tpu"
 
@@ -89,11 +107,33 @@ def worker_hostnames(job: TrainJob, domain: str | None = None) -> list[str]:
     return [replica_host(job, rt, i, domain) for rt, i in _process_replicas(job)]
 
 
+def num_slices(job: TrainJob) -> int:
+    """spec.tpu.slices, clamped to >= 1 (1 when no TPU block)."""
+    return max(1, job.spec.tpu.slices) if job.spec.tpu is not None else 1
+
+
+def slice_of_process(job: TrainJob, pid: int) -> int:
+    """Which slice a global process id belongs to: processes partition into
+    `slices` contiguous equal blocks in process-id order (validation pins
+    replicas % slices == 0)."""
+    total = len(_process_replicas(job))
+    s = num_slices(job)
+    pps = max(1, total // s)
+    return min(s - 1, pid // pps)
+
+
 def gen_tpu_env(
     job: TrainJob, rtype: ReplicaType, index: int, domain: str | None = None
 ) -> dict[str, str]:
     """All TPU/JAX env vars for one replica. Empty dict for non-SPMD replicas
-    (they still get TF_CONFIG for legacy PS-strategy parity)."""
+    (they still get TF_CONFIG for legacy PS-strategy parity).
+
+    Multi-slice jobs (spec.tpu.slices = S > 1) get PER-SLICE coordination:
+    jax.distributed spans ONE slice (the ICI domain — JAX_PROCESS_ID is
+    slice-local, the coordinator is the slice's first process), and the
+    cross-slice (DCN) layer is addressed separately via TPUJOB_SLICE_ID /
+    TPUJOB_NUM_SLICES / TPUJOB_DCN_COORDINATOR (the global first process,
+    megascale-style). Single-slice jobs are bit-for-bit today's contract."""
     pid = process_id(job, rtype, index)
     env: dict[str, str] = {
         ENV_JOB_NAME: job.name,
@@ -104,23 +144,54 @@ def gen_tpu_env(
         return env
     procs = _process_replicas(job)
     hosts = worker_hostnames(job, domain)
-    coord = coordinator_address(job, domain)
     tf_port = replica_port(job, rtype)
+    slices = num_slices(job)
+    multislice = slices > 1 and len(procs) % slices == 0
+    # ONE env-assembly block, parameterized by the process window this
+    # replica's jax world spans: the whole job (single-slice — today's
+    # contract bit-for-bit), or its slice's contiguous block (slices > 1:
+    # slice-local ids, the slice's own first process as coordinator).
+    if multislice:
+        pps = len(procs) // slices
+        lo = pps * (pid // pps)
+    else:
+        pps, lo = len(procs), 0
+    world_hosts = hosts[lo:lo + pps]
+    rt0, i0 = procs[lo]
+    coord_port = replica_port(job, rt0, defaults.COORDINATOR_PORT_NAME)
     env.update(
         {
-            ENV_COORDINATOR_ADDRESS: coord or "",
-            ENV_PROCESS_ID: str(pid),
-            ENV_NUM_PROCESSES: str(len(procs)),
-            ENV_TPU_WORKER_ID: str(pid),
-            ENV_TPU_WORKER_HOSTNAMES: ",".join(hosts),
-            ENV_TPU_ENDPOINTS: ",".join(f"grpc://{h}:{tf_port}" for h in hosts),
+            ENV_COORDINATOR_ADDRESS:
+                f"{replica_host(job, rt0, i0, domain)}:{coord_port}",
+            ENV_PROCESS_ID: str(pid - lo),
+            ENV_NUM_PROCESSES: str(pps),
+            ENV_TPU_WORKER_ID: str(pid - lo),
+            ENV_TPU_WORKER_HOSTNAMES: ",".join(world_hosts),
+            ENV_TPU_ENDPOINTS: ",".join(
+                f"grpc://{h}:{tf_port}" for h in world_hosts),
         }
     )
     if job.spec.tpu is not None and job.spec.tpu.topology:
         env[ENV_TOPOLOGY] = job.spec.tpu.topology
     if job.spec.mesh is not None and job.spec.mesh.axes:
+        # With slices > 1 this is the PER-SLICE mesh: each slice's jax
+        # world builds it over its own devices; the cross-slice data
+        # axis lives above (the DCN exchange).
         env[ENV_MESH] = json.dumps(job.spec.mesh.axes)
-    if job.spec.run_policy.recovery.elastic.reshape_on_recovery:
+    if multislice:
+        g_rt0, g_i0 = procs[0]
+        dcn_port = replica_port(job, g_rt0, defaults.COORDINATOR_PORT_NAME)
+        env.update(
+            {
+                ENV_SLICE_ID: str(pid // pps),
+                ENV_NUM_SLICES: str(slices),
+                ENV_DCN_COORDINATOR:
+                    f"{replica_host(job, g_rt0, g_i0, domain)}:{dcn_port}",
+                ENV_DCN_EPOCH: (job.uid or "0")[:8],
+            }
+        )
+    elif job.spec.run_policy.recovery.elastic.reshape_on_recovery:
+        # Elastic reshape is single-slice by validation.
         env[ENV_ALLOW_RESHAPE] = "1"
     return env
 

@@ -47,6 +47,10 @@ LABEL_JOB_ROLE = "job-role"
 # is rolled so its injected TF_CONFIG/TPU env matches the spec (elastic
 # scaling — beyond the reference, SURVEY §5 "No elasticity").
 LABEL_SPEC_HASH = "spec-hash"
+# Multi-slice jobs (spec.tpu.slices > 1): which per-slice gang this pod
+# belongs to — the granularity per-slice recovery rolls at and chaos
+# `slice=K` targeting matches against.
+LABEL_SLICE_ID = "slice-id"
 
 
 def gen_labels(job_name: str) -> dict[str, str]:

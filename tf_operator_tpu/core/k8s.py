@@ -176,6 +176,10 @@ def job_status_to_dict(status: JobStatus) -> dict:
         "consecutiveRestarts": status.consecutive_restarts,
         "restartHeartbeatStep": status.restart_heartbeat_step,
         "pendingGangRollUids": list(status.pending_gang_roll_uids),
+        # Multi-slice: per-slice roll counts (visibility — which slice
+        # keeps failing); the job-level tallies above stay authoritative
+        # for backoffLimit.
+        "sliceRestarts": dict(status.slice_restarts),
         "stuckPendingPods": list(status.stuck_pending_pods),
         # Preemption bookkeeping (sched/): count + cooldown anchor + drain
         # latch must survive operator failover exactly like the gang-roll
@@ -202,6 +206,8 @@ def job_status_from_dict(d: dict) -> JobStatus:
         consecutive_restarts=int(d.get("consecutiveRestarts") or 0),
         restart_heartbeat_step=d.get("restartHeartbeatStep"),
         pending_gang_roll_uids=list(d.get("pendingGangRollUids") or []),
+        slice_restarts={str(k): int(v) for k, v in
+                        (d.get("sliceRestarts") or {}).items()},
         stuck_pending_pods=list(d.get("stuckPendingPods") or []),
         preemptions=int(d.get("preemptions") or 0),
         last_preemption_time=d.get("lastPreemptionTime"),

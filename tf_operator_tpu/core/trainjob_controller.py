@@ -610,6 +610,7 @@ class TrainJobController(ctrl.JobControllerBase):
         # discipline as preemption.
         if (self.slice_allocator is not None
                 and job.status.reshaped_replicas is None
+                and tpu_env.num_slices(job) == 1
                 and len(self.slice_allocator.held_slices(key)) > 1):
             cur_hash = tf_config.topology_hash(job)
             stale_live = any(
@@ -692,6 +693,28 @@ class TrainJobController(ctrl.JobControllerBase):
         the elastic upgrade/degrade paths folded in."""
         if self.slice_allocator is None:
             return None
+        n = tpu_env.num_slices(job)
+        if n > 1:
+            # Multi-slice: all N slices or NOTHING (admit_many never takes
+            # a partial hold — a 2-slice job sitting on 1 of 3 slices
+            # would deadlock against another doing the same while 1-slice
+            # waiters starve behind capacity nobody can use). Idempotent
+            # per holder; elastic reshape is excluded by validation.
+            sids = self.slice_allocator.admit_many(key, full_topology, n)
+            if sids is not None:
+                joined = ",".join(sids)
+                if job.metadata.annotations.get(ANNOTATION_SLICE) != joined:
+                    job.metadata.annotations[ANNOTATION_SLICE] = joined
+                return None
+            free = self.slice_allocator.free_of_class(full_topology)
+            self.cluster.record_event(
+                TrainJob.KIND, job.namespace, job.name, "Warning",
+                "SliceUnavailable",
+                f"need {n} free {full_topology} slices admitted atomically "
+                f"({free} free; holding none — no partial claim); "
+                f"gang-waiting",
+            )
+            return SLICE_RETRY_DELAY_S
         # A FULL-SIZE claim stands wherever it is — online, or offline
         # under a still-live gang (the drained-offline case released it
         # above). Never shopping for a different slice here is what keeps
@@ -754,6 +777,29 @@ class TrainJobController(ctrl.JobControllerBase):
             if p.metadata.labels.get(ctrl.LABEL_REPLICA_TYPE, "")
             != str(ReplicaType.EVALUATOR).lower()
         ]
+
+    @staticmethod
+    def _pod_slice(job: TrainJob, pod: Pod) -> int | None:
+        """Which slice gang a pod belongs to (multi-slice jobs): the
+        slice-id label stamped at creation, else derived from the replica
+        index (pre-label pods after an operator upgrade)."""
+        v = pod.metadata.labels.get(ctrl.LABEL_SLICE_ID)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                return None
+        rt = api_defaults.canonical_replica_type(
+            pod.metadata.labels.get(ctrl.LABEL_REPLICA_TYPE, "")
+        )
+        if rt is None:
+            return None
+        try:
+            idx = int(pod.metadata.labels.get(ctrl.LABEL_REPLICA_INDEX, ""))
+        except ValueError:
+            return None
+        pid = tpu_env.process_id(job, rt, idx)
+        return tpu_env.slice_of_process(job, pid) if pid is not None else None
 
     def _job_heartbeat(self, job: TrainJob) -> dict | None:
         if self.heartbeat_source is None:
@@ -1114,6 +1160,14 @@ class TrainJobController(ctrl.JobControllerBase):
 
         members = self._gang_members(pods)
         live = [p for p in members if not p.is_finished()]
+        slices = tpu_env.num_slices(job)
+        # Multi-slice jobs roll at SLICE granularity: a retryable failure
+        # (or a hung heartbeat) dooms only the affected slice's gang while
+        # the other slices hold at the trainer's DCN barrier — their pods
+        # are never deleted, and the restarted slice's resume triggers the
+        # survivors' in-process rewind to the shared checkpoint
+        # (parallel/multislice.py). None = whole-gang roll (slices == 1).
+        affected_slices: set[int] | None = None
 
         # Trigger (a): retryable gang-member failure. A NON-retryable
         # failure wins — fall through to the normal status machine, which
@@ -1144,15 +1198,63 @@ class TrainJobController(ctrl.JobControllerBase):
                     f"pod {pod.name} exited with retryable code {code}",
                 )
 
+        if trigger is not None and slices > 1:
+            affected_slices = {
+                s for s in (self._pod_slice(job, p) for p in failed_retryable)
+                if s is not None
+            } or None
+
         # Trigger (b): the hang watchdog. Armed only once a heartbeat
         # exists; staleness is measured against the freshest of (heartbeat
         # write, live pod start) so a just-rolled gang gets a full quiet
         # window to import/compile/resume before the clock can fire again.
+        # Multi-slice jobs evaluate staleness PER SLICE (the collector's
+        # per-replica map): one wedged slice rolls alone while the others
+        # hold at the DCN barrier — their exchange loop keeps refreshing
+        # their heartbeats, so they read fresh here by construction.
         if (trigger is None and rec.heartbeat_timeout_seconds
                 and live and has_condition(job.status, JobConditionType.RUNNING)):
             hb = heartbeat()
             if hb is None:
                 self.queue.add_after(key, rec.heartbeat_timeout_seconds)
+            elif slices > 1 and hb.get("replicas"):
+                per_pod = hb["replicas"]
+                by_slice: dict[int, list[Pod]] = {}
+                for p in live:
+                    s = self._pod_slice(job, p)
+                    if s is not None:
+                        by_slice.setdefault(s, []).append(p)
+                stale: set[int] = set()
+                soonest: float | None = None
+                for s, spods in sorted(by_slice.items()):
+                    freshest = max(
+                        [float((per_pod.get(p.name) or {}).get("t") or 0.0)
+                         for p in spods]
+                        + [p.status.start_time or p.metadata.creation_timestamp
+                           for p in spods]
+                    )
+                    age = now - freshest
+                    if age >= rec.heartbeat_timeout_seconds:
+                        stale.add(s)
+                    else:
+                        left = rec.heartbeat_timeout_seconds - age
+                        soonest = left if soonest is None else min(soonest, left)
+                if stale:
+                    names = ",".join(str(s) for s in sorted(stale))
+                    self.cluster.record_event(
+                        TrainJob.KIND, job.namespace, job.name, "Warning",
+                        status_engine.REASON_HEARTBEAT_STALE,
+                        f"No trainer progress from slice(s) {names} for "
+                        f">= {rec.heartbeat_timeout_seconds:g}s (job "
+                        f"heartbeat at step {hb.get('step')}): treating "
+                        f"the slice gang(s) as hung",
+                    )
+                    trigger = ("hang",
+                               f"slice(s) {names} heartbeat stale at step "
+                               f"{hb.get('step')}")
+                    affected_slices = stale
+                elif soonest is not None:
+                    self.queue.add_after(key, soonest + 0.25)
             else:
                 freshest = max(
                     [float(hb.get("t") or 0.0)]
@@ -1214,13 +1316,28 @@ class TrainJobController(ctrl.JobControllerBase):
         metrics.restarts_total.labels(
             namespace=job.namespace, reason=reason
         ).inc()
-        doomed = live + failed_retryable
+        scope = ""
+        if affected_slices:
+            # Per-slice roll: only the failed slice(s)' gangs die; the
+            # other slices' pods hold at the trainer's DCN barrier and
+            # rewind in-process once the restarted slice resumes.
+            doomed = [p for p in live
+                      if self._pod_slice(job, p) in affected_slices]
+            doomed += [p for p in failed_retryable if p not in doomed]
+            for s in sorted(affected_slices):
+                job.status.slice_restarts[str(s)] = (
+                    job.status.slice_restarts.get(str(s), 0) + 1)
+            scope = (" [slice(s) "
+                     + ",".join(str(s) for s in sorted(affected_slices))
+                     + f" of {slices}; other slices hold at the barrier]")
+        else:
+            doomed = live + failed_retryable
         self.cluster.record_event(
             TrainJob.KIND, job.namespace, job.name, "Normal",
             status_engine.REASON_GANG_RESTART,
-            f"Gang restart #{job.status.gang_restarts} ({detail}): deleting "
-            f"{len(doomed)} pod(s); consecutive restarts without progress: "
-            f"{job.status.consecutive_restarts}",
+            f"Gang restart #{job.status.gang_restarts} ({detail}){scope}: "
+            f"deleting {len(doomed)} pod(s); consecutive restarts without "
+            f"progress: {job.status.consecutive_restarts}",
         )
         status_engine.record_gang_restart(
             job,
@@ -1555,6 +1672,11 @@ class TrainJobController(ctrl.JobControllerBase):
         }
         if master_role:
             labels[ctrl.LABEL_JOB_ROLE] = "master"
+        if tpu_env.num_slices(job) > 1 and tpu_env.is_spmd_replica(rtype):
+            pid = tpu_env.process_id(job, rtype, index)
+            if pid is not None:
+                labels[ctrl.LABEL_SLICE_ID] = str(
+                    tpu_env.slice_of_process(job, pid))
 
         name = naming.gen_general_name(job.name, str(rtype), index)
 

@@ -123,6 +123,46 @@ class SliceAllocator:
                     return s.slice_id
         return None
 
+    def admit_many(self, holder: str, topology: str, n: int) -> list[str] | None:
+        """Atomic N-slice admission (multi-slice jobs, spec.tpu.slices):
+        grant the holder N whole free online slices of `topology`'s class
+        or NOTHING — a partial hold would deadlock the fleet (two 2-slice
+        jobs each holding one of three slices wait forever, and every
+        1-slice waiter starves behind capacity nobody can use).
+
+        Idempotent per holder: slices already held of the class count
+        toward N (a re-admitting sync gets its ids back); a top-up to N is
+        itself all-or-nothing. Returns the N slice_ids in inventory order,
+        or None with no state change."""
+        if n <= 1:
+            sid = self.admit(holder, topology)
+            return [sid] if sid is not None else None
+        want = parse_topology(topology)
+        with self._lock:
+            held = [s for s in self.slices
+                    if s.held_by == holder and s.matches(want)]
+            if len(held) >= n:
+                return [s.slice_id for s in held[:n]]
+            free = [s for s in self.slices
+                    if s.held_by is None and not s.offline and s.matches(want)]
+            missing = n - len(held)
+            if len(free) < missing:
+                return None  # all-or-nothing: claim NOTHING
+            for s in free[:missing]:
+                s.held_by = holder
+            return [s.slice_id for s in held] + [
+                s.slice_id for s in free[:missing]]
+
+    def free_of_class(self, topology: str) -> int:
+        """Free ONLINE slice count of exactly `topology`'s class — what an
+        N-slice admission needs >= N of."""
+        want = parse_topology(topology)
+        with self._lock:
+            return sum(
+                1 for s in self.slices
+                if s.held_by is None and not s.offline and s.matches(want)
+            )
+
     def upgrade(self, holder: str, topology: str) -> str | None:
         """Move the holder onto a slice of exactly `topology`'s class:
         returns the held slice when it already matches (and is online),

@@ -1284,6 +1284,219 @@ def _train_on_dataset(args, state, start_step, loss_fn, tx, mesh, rules,
     return 0
 
 
+def _train_multislice(args, state, start_step, loss_fn, tx, mesh, rules,
+                      make_batch, rebuild_state, saver, t_start, guard,
+                      world) -> int:
+    """Multi-slice training loop (TPUJOB_NUM_SLICES > 1): this process's
+    jax world spans ONE slice; cross-slice data parallelism rides the
+    emulated DCN exchange (parallel/multislice.py).
+
+    Per global step: M microbatch backwards are dispatched up front
+    (async); as each lands, its within-slice-reduced gradients stream to
+    the exchange — bucket transfers of microbatch m ride under the
+    backward of m+1 — then the step loop blocks only for the exchange
+    tail (`dcn_sync` phase) and applies the DCN-reduced mean with donated
+    state. The mean over all slice x microbatch row blocks of the SAME
+    global batch equals the full-batch mean, so the trajectory matches a
+    single-slice reference run rtol-tight.
+
+    Per-slice recovery: when a peer slice's gang is rolled, collect()
+    holds at the barrier (heartbeat kept fresh via the tick — the
+    operator must NOT roll this slice) until the restarted peer announces
+    its resume from the shared checkpoint; SliceRewind then re-restores
+    the same checkpoint IN PROCESS and the loop replays forward — no pod
+    restart on the surviving slices, `gang_restarts` counts the incident
+    once."""
+    import jax
+    import numpy as np
+
+    from tf_operator_tpu.parallel import multislice as ms_lib
+    from tf_operator_tpu.parallel.train_step import (
+        make_multislice_step_fns,
+        shard_state,
+    )
+
+    S, M = world.num_slices, args.dcn_microbatches
+    if args.batch % (S * M):
+        raise SystemExit(
+            f"--batch {args.batch} not divisible by slices x microbatches "
+            f"({S} x {M})"
+        )
+    rows = args.batch // (S * M)
+    # The within-slice chips share each bucket's DCN transfer after the
+    # ICI reduce-scatter (hierarchical-collective arithmetic): the
+    # bandwidth dial charges the 1/ici_degree fraction only.
+    world.ici_degree = jax.device_count()
+    compile_fns = make_multislice_step_fns(
+        loss_fn, tx, mesh, make_batch, rules=rules, rows=rows,
+        remat=args.remat,
+    )
+    gen_batch, backward, apply_fn = compile_fns(state)
+    ex = ms_lib.DcnExchange(
+        world, resume_step=start_step, microbatches=M,
+        buckets=args.dcn_buckets, peer_timeout_s=args.dcn_peer_timeout,
+    )
+    sid = world.slice_id
+    g_treedef = None
+    done = start_step
+    first_done = False
+    t0 = None
+    steady_start = start_step
+    acct = telemetry.make_step_accounting()
+    last_save_s, last_ckpt_step = 0.0, -1
+    final_loss = None
+
+    def tick():
+        # Holding at the barrier is LIVE: refresh the heartbeat's t (step
+        # unchanged) so the operator's watchdog never rolls a survivor.
+        _hb(done)
+
+    try:
+        while done < args.steps:
+            try:
+                with acct.step(done + 1) as st:
+                    step = done + 1
+                    ex.begin_step(step)
+                    futs = []
+                    with st.phase("dispatch"):
+                        # The step's full batch is generated ONCE; each
+                        # microbatch backward slices its row block out.
+                        batch = gen_batch(done)
+                        for m in range(M):
+                            futs.append(backward(
+                                state, batch, done, (sid * M + m) * rows))
+                    for m in range(M):
+                        # device_get blocks until microbatch m's backward
+                        # lands; the exchange engine streams m-1's buckets
+                        # (and peers' arrivals) meanwhile — that
+                        # concurrency is the overlap being measured.
+                        with st.phase("device_blocked"):
+                            loss_m, grads_m = jax.device_get(futs[m])
+                        if g_treedef is None:
+                            g_treedef = jax.tree.structure(grads_m)
+                        leaves = jax.tree.leaves(grads_m)
+                        ex.submit(step, m, [
+                            np.asarray(loss_m, np.float32).reshape(1)
+                        ] + leaves)
+                    with st.phase("dcn_sync"):
+                        reduced = ex.collect(
+                            step, tick=tick,
+                            should_stop=lambda: guard.triggered)
+                    gloss = float(reduced[0][0])
+                    grads = jax.tree.unflatten(g_treedef, reduced[1:])
+                    with st.phase("dispatch"):
+                        state, _gnorm = apply_fn(state, grads)
+                    done = step
+                    final_loss = gloss
+                    ex.step_done(done)
+                    if not first_done:
+                        first_done = True
+                        t_first = time.time()
+                        _emit({
+                            "event": "first_step",
+                            "t": t_first,
+                            "startup_s": round(t_first - t_start, 3),
+                            "steps_in_first_call": 1,
+                            "loss": gloss,
+                            "mesh": dict(mesh.shape),
+                            "backend": jax.default_backend(),
+                            "device_kind": jax.devices()[0].device_kind,
+                            "n_devices": len(jax.devices()),
+                            "slices": S,
+                            "slice_id": sid,
+                        })
+                        _hb(done, force=True)
+                        t0 = time.time()
+                        steady_start = done
+                    elif done % args.log_every == 0 or done == args.steps:
+                        # The DCN-reduced loss is already on the host: a
+                        # progress emit costs no device fetch here.
+                        _emit({"event": "progress", "step": done,
+                               "loss": gloss})
+                    if (saver and args.checkpoint_every
+                            and done < args.steps
+                            and done % args.checkpoint_every == 0):
+                        last_save_s = _save_checkpoint(
+                            args.checkpoint_dir, done, state,
+                            keep=args.keep_checkpoints, st=st)
+                        last_ckpt_step = done
+                    _hb(done)
+                    _boundary_chaos(done, start_step)
+                    if guard.triggered:
+                        return _preempt_exit(args, guard, state, done,
+                                             saver, last_save_s,
+                                             last_ckpt_step, st)
+            except ms_lib.DcnInterrupted:
+                # Preemption latched while holding at the barrier (a
+                # whole-job eviction SIGTERMs every slice — all of them
+                # sit in collect): abandon the hold and run the graceful
+                # path at the last COMPLETED step. The in-flight step's
+                # partial exchange is discarded; the resumed job replays
+                # it.
+                return _preempt_exit(args, guard, state, done, saver,
+                                     last_save_s, last_ckpt_step)
+            except ms_lib.SliceRewind as rw:
+                # A peer's gang was rolled and resumed behind us: meet it
+                # at the shared checkpoint without restarting this pod.
+                _emit({"event": "dcn_rewind", "from_step": done,
+                       "peer_slice": rw.peer, "peer_resume": rw.to_step})
+                state = rebuild_state()
+                state, done = _try_resume(args.checkpoint_dir, state, tx,
+                                          mesh=mesh)
+                state = shard_state(state, mesh, rules)
+                ex.rewind_to(done)
+                _hb(done, force=True)
+                continue
+    except ms_lib.DcnPeerTimeout as e:
+        # A peer never came back (double failure / operator wedged): exit
+        # retryable so THIS slice's gang rolls too and the job recovers
+        # whole from the shared checkpoint.
+        print(f"dcn exchange: {e}; exiting retryable", file=sys.stderr)
+        _emit({"event": "dcn_peer_timeout", "step": done, "detail": str(e)})
+        _maybe_export_trace(args)
+        from tf_operator_tpu.utils.exit_codes import EXIT_USER_RETRYABLE
+
+        return EXIT_USER_RETRYABLE
+    finally:
+        dcn_stats = ex.stats()
+        ex.close()
+
+    if saver:
+        _save_checkpoint(args.checkpoint_dir, args.steps, state, final=True,
+                         keep=args.keep_checkpoints)
+    _hb(args.steps, force=True)
+    dt = (time.time() - t0) if t0 is not None else 0.0
+    steady = args.steps - steady_start
+    sps = round(steady / dt, 4) if steady > 0 and dt > 0 else None
+    telem = acct.summary()
+    done_event = {
+        "event": "done",
+        "t": time.time(),
+        "steps": args.steps,
+        "steady_steps_per_sec": sps,
+        "examples_per_sec": (round(steady * args.batch / dt, 4)
+                             if steady > 0 and dt > 0 else None),
+        "final_loss": final_loss,
+        "total_s": round(time.time() - t_start, 3),
+        "step_time_s": telem["step_time_s"] if telem else None,
+        "phase_breakdown": telem["phase_breakdown"] if telem else None,
+        # Hierarchical-reduction accounting: dcn_busy_s is the exchange's
+        # total (wire + IO + reduce), dcn_sync_s what the step loop
+        # visibly waited (the dcn_sync phase), hidden_fraction their
+        # complement — the overlap win, measured (docs/perf.md).
+        "dcn": dcn_stats,
+    }
+    ckpt_block = _ckpt_done_stats()
+    if ckpt_block:
+        done_event["checkpoint"] = ckpt_block
+    _emit(done_event)
+    _maybe_export_trace(args)
+    from tf_operator_tpu.parallel.distributed import distributed_goodbye
+
+    distributed_goodbye()
+    return 0
+
+
 def _logits_bytes(args, mesh, vocab_size: int) -> float:
     """Per-device f32 logits bytes for the chunked-CE cutover.
 
@@ -1493,6 +1706,26 @@ def main(argv: list[str] | None = None) -> int:
                          "only MEASURES what a compressed remote wire "
                          "would save (staging.bytes_encoded_mb/"
                          "codec_ratio vs encode_s/decode_s)")
+    ap.add_argument("--dcn-microbatches", type=int, default=2,
+                    help="multi-slice jobs (TPUJOB_NUM_SLICES > 1): split "
+                         "each step's backward into M microbatch "
+                         "dispatches so the cross-slice (DCN) gradient "
+                         "exchange of microbatch m streams while m+1 "
+                         "computes — the compute/communication overlap "
+                         "the done event's dcn.hidden_fraction measures. "
+                         "1 = monolithic backward, exchange fully "
+                         "visible. Ignored single-slice")
+    ap.add_argument("--dcn-buckets", type=int, default=4,
+                    help="gradient buckets per microbatch for the "
+                         "cross-slice exchange (transfer granularity; "
+                         "byte-balanced over the leaves). Ignored "
+                         "single-slice")
+    ap.add_argument("--dcn-peer-timeout", type=float, default=600.0,
+                    help="multi-slice: how long a slice holds at the DCN "
+                         "barrier waiting for its peers before exiting "
+                         "retryable (a rolled peer announces its resume "
+                         "well inside this; the timeout is the net under "
+                         "pathological double failures)")
     ap.add_argument("--wire-dtype", default="auto",
                     choices=["auto", "uint8", "f32"],
                     help="with --data-dir: host->device wire format. auto = "
@@ -1527,6 +1760,12 @@ def main(argv: list[str] | None = None) -> int:
         ap.error("--staging-chunks must be >= 1")
     if args.staging_lanes < 1:
         ap.error("--staging-lanes must be >= 1")
+    if args.dcn_microbatches < 1:
+        ap.error("--dcn-microbatches must be >= 1")
+    if args.dcn_buckets < 1:
+        ap.error("--dcn-buckets must be >= 1")
+    if args.dcn_peer_timeout <= 0:
+        ap.error("--dcn-peer-timeout must be > 0")
     if not args.data_dir and (args.input_staging != "prefetch"
                               or args.wire_dtype != "auto"
                               or args.wire_codec != "none"
@@ -1926,9 +2165,22 @@ def _run_trainer(args, guard) -> int:
     # stands: every process enters the (gang-wide, collective) save, and
     # async stands down below.
     from tf_operator_tpu.models import checkpoint as _ckpt_mod
+    from tf_operator_tpu.parallel import multislice as ms_lib
+
+    # Multi-slice (TPUJOB_NUM_SLICES > 1): this jax world spans ONE slice;
+    # the cross-slice layer is the DCN exchange. Detected here — before
+    # the writer-role decision, which it changes.
+    ms_world = ms_lib.SliceWorld.from_env()
 
     plocal_io = _ckpt_mod.process_local_io()
-    if jax.process_count() > 1:
+    if ms_world is not None:
+        # ONE checkpoint writer across ALL slices: the global worker-0
+        # (slice 0's leader). Every slice's world has its own process 0,
+        # so the per-world rule below would elect one writer PER SLICE —
+        # concurrent orbax writes into the shared dir.
+        saver = (args.checkpoint_dir and _is_checkpoint_writer()
+                 and jax.process_index() == 0)
+    elif jax.process_count() > 1:
         saver = args.checkpoint_dir and (
             jax.process_index() == 0 if plocal_io else True
         )
@@ -2029,6 +2281,26 @@ def _run_trainer(args, guard) -> int:
         # ~33.8M for the dW ragged-dot in the backward; the 16M default
         # fails the compile outright. 48M covers both with margin.
         xla_options.setdefault("xla_tpu_scoped_vmem_limit_kib", "49152")
+    if ms_world is not None:
+        if args.data_dir:
+            raise SystemExit(
+                "multi-slice training (TPUJOB_NUM_SLICES > 1) drives the "
+                "synthetic on-device batch path; --data-dir is not "
+                "supported yet")
+        if state.model_state:
+            raise SystemExit(
+                f"--model {args.model} carries mutable model state "
+                f"(batch stats), which does not cross the DCN exchange; "
+                f"pick a stateless model for multi-slice")
+
+        def rebuild_state():
+            # A SliceRewind re-restores the shared checkpoint into a
+            # FRESH state (the old one was donated into apply).
+            return jax.jit(build_state, out_shardings=st_sh)()
+
+        return _train_multislice(args, state, start_step, loss_fn, tx,
+                                 mesh, rules, make_batch, rebuild_state,
+                                 saver, t_start, guard, ms_world)
     if args.data_dir:
         return _train_on_dataset(args, state, start_step, loss_fn, tx, mesh,
                                  rules, saver, t_start, guard,

@@ -28,10 +28,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tf_operator_tpu.cluster_spec.tpu_env import ENV_MESH
 
-AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+AXIS_ORDER = ("data", "pp", "dp", "fsdp", "ep", "sp", "tp")
 # tp innermost: tensor-parallel collectives are latency-bound and must ride
 # the fastest ICI links; dp outermost so gradient all-reduce crosses DCN only
-# at the slowest level.
+# at the slowest level. "data" is the CROSS-SLICE axis (multi-slice jobs):
+# outermost of all — its collectives ride the data-center network, an order
+# of magnitude slower than any ICI hop, so it must be the slowest-varying
+# dimension of the device grid (SpecLayout's data/fsdp/tp layering).
+DATA_AXIS = "data"
 
 
 def normalize_axes(axes: dict[str, int]) -> dict[str, int]:
@@ -71,9 +75,42 @@ def mesh_from_env(devices=None) -> Mesh:
     return make_mesh(axes, devices)
 
 
+def hierarchical_mesh(axes: dict[str, int] | None, num_slices: int,
+                      devices=None) -> Mesh:
+    """Multi-slice mesh for a SINGLE jax world spanning all slices (real
+    TPU multislice, or the in-process CPU emulation): the cross-slice
+    `data` (DCN) axis outermost over the per-slice `axes` (ICI). Device
+    order must group by slice — jax.devices() on real multislice hardware
+    already does (slice-major), and the emulation partitions the visible
+    devices into `num_slices` contiguous groups.
+
+    The per-slice CPU-emulation path (parallel/multislice.py) does NOT use
+    this — each slice is its own jax world there, and the data axis is
+    realized by the host-level DCN exchange instead of XLA collectives."""
+    if num_slices < 1:
+        raise ValueError(f"num_slices must be >= 1, got {num_slices}")
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) % num_slices:
+        raise ValueError(
+            f"{len(devices)} devices do not partition into "
+            f"{num_slices} slices"
+        )
+    per_slice = len(devices) // num_slices
+    inner = dict(axes) if axes else {"dp": per_slice}
+    if DATA_AXIS in inner:
+        raise ValueError(
+            "mesh axes describe ONE slice; the cross-slice 'data' axis is "
+            "implied by num_slices and may not appear in them"
+        )
+    return make_mesh({DATA_AXIS: num_slices, **inner}, devices)
+
+
 def data_axes(mesh: Mesh) -> tuple[str, ...]:
-    """Axes the global batch is split over."""
-    return tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    """Axes the global batch is split over (the cross-slice data axis
+    first — it is outermost, so slice boundaries align with the coarsest
+    batch split)."""
+    return tuple(a for a in (DATA_AXIS, "dp", "fsdp") if a in mesh.axis_names)
 
 
 def batch_sharding(mesh: Mesh, extra_seq_axis: bool = False) -> NamedSharding:

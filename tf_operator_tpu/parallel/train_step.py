@@ -268,6 +268,99 @@ def make_scanned_train_step(
     return compile_scanned
 
 
+def make_multislice_step_fns(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    make_batch: Callable[[jax.Array], Any],
+    rules: sharding_rules.Rules | None = None,
+    rows: int = 1,
+    remat: bool = False,
+    seed: int = 0,
+):
+    """Backward/apply pair for the multi-slice training loop
+    (models/train.py._train_multislice): the optimizer step is split at
+    the gradient boundary so the cross-slice DCN reduction
+    (parallel/multislice.py) runs BETWEEN the two jitted halves, bucketed
+    and overlapped with the remaining microbatch backwards.
+
+      gen_batch(i) -> the step's FULL global batch, generated ONCE per
+        step from the SAME RNG chain as make_scanned_train_step
+        (fold_in(base, i) -> make_batch key) — generating it inside each
+        microbatch backward would redo the work S x M times per step.
+      backward(state, batch, i, offset) -> (loss, grads) over `rows`
+        rows of that batch starting at `offset`. The mean over all
+        slice x microbatch row blocks equals the full-batch mean — so a
+        multi-slice run's trajectory matches a single-slice reference to
+        fp-association tolerance. Within-slice gradient reduction is
+        XLA-derived (ICI); state and batch are NOT donated (every
+        microbatch reads them).
+      apply(state, grads) -> (state', grad_norm) consumes the
+        DCN-reduced gradients (host arrays re-cast to each param's dtype)
+        with donated state — elementwise optimizers make the update
+        independent of where the reduction ran. The DCN-reduced loss is
+        already a host scalar; it never re-enters the device.
+
+    Returns compile(example_state) -> (gen_batch, backward, apply)."""
+    if remat:
+        loss_fn = jax.checkpoint(loss_fn)
+    batch_sh = mesh_lib.batch_sharding(mesh)
+    repl = mesh_lib.replicated(mesh)
+    base = jax.random.key(seed)
+
+    def _gen_batch(i):
+        return make_batch(jax.random.fold_in(jax.random.fold_in(base, i), 0))
+
+    def _backward(state: TrainState, batch, i, offset):
+        rng = jax.random.fold_in(base, i)
+        sub = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                jax.lax.dynamic_slice_in_dim(x, offset, rows, 0), batch_sh),
+            batch,
+        )
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, state.model_state, sub, jax.random.fold_in(rng, 1)
+        )
+        return loss, grads
+
+    def _apply(state: TrainState, grads):
+        # DCN wire is f32; each leaf goes back to its param's dtype before
+        # the update so mixed-precision configs see the layout they expect.
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                             grads, state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optim_lib.apply_updates(tx, state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt,
+            model_state=state.model_state,
+        )
+        return new_state, gnorm
+
+    def compile_fns(example_state: TrainState):
+        st_sh = state_shardings(example_state, mesh, rules)
+        param_sh = sharding_rules.tree_shardings(
+            example_state.params, mesh, rules)
+        gen_batch = jax.jit(
+            _gen_batch, in_shardings=(repl,), out_shardings=batch_sh)
+        backward = jax.jit(
+            _backward,
+            in_shardings=(st_sh, batch_sh, repl, repl),
+            out_shardings=(repl, param_sh),
+        )
+        apply = jax.jit(
+            _apply,
+            in_shardings=(st_sh, param_sh),
+            out_shardings=(st_sh, repl),
+            donate_argnums=(0,),
+        )
+        return gen_batch, backward, apply
+
+    return compile_fns
+
+
 def make_eval_step(
     metric_fn: Callable, mesh: Mesh, rules: sharding_rules.Rules | None = None
 ):

@@ -422,6 +422,18 @@ class LocalProcessRuntime:
             env["TPUJOB_HEARTBEAT_FILE"] = os.path.join(
                 self.log_dir, f"{pod.namespace}_{pod.name}.heartbeat.json"
             )
+        # Multi-slice DCN rendezvous (parallel/multislice.py): one shared
+        # directory per JOB INSTANCE — the operator-injected epoch token
+        # (job uid) keeps a resubmitted same-name job from inheriting a
+        # dead run's exchange files. A real cluster points this at a
+        # shared volume instead.
+        if (self.log_dir and not env.get("TPUJOB_DCN_DIR")
+                and env.get("TPUJOB_NUM_SLICES", "1") not in ("", "0", "1")):
+            job = pod.metadata.labels.get("job-name", "")
+            epoch = env.get("TPUJOB_DCN_EPOCH", "0")
+            env["TPUJOB_DCN_DIR"] = os.path.join(
+                self.log_dir, f"{pod.namespace}_{job}.dcn-{epoch}"
+            )
         return env
 
     def _own_host(self, pod: Pod, pm: PortMap) -> tuple[str | None, dict[str, int]]:

@@ -46,6 +46,12 @@ class QueueEntry:
     # ranked admission scan touches every entry per decision and must not
     # re-parse topology strings per entry per call.
     slice_cls: tuple = ("", 0)
+    # How many slices of slice_cls the job needs AT ONCE (spec.tpu.slices).
+    # Admission is all-or-nothing: the ranked scan reserves capacity for
+    # this entry only when `slices` whole slices are free — a partially
+    # servable multi-slice waiter reserves NOTHING, so smaller jobs keep
+    # backfilling behind it instead of deadlocking the class.
+    slices: int = 1
     seq: int = 0
 
 

@@ -77,6 +77,7 @@ class _Running:
     slice_id: str
     admitted_at: float
     first_submit: float
+    slices: int = 1  # slices of `cls` held at once (spec.tpu.slices)
 
 
 class FleetScheduler:
@@ -122,12 +123,17 @@ class FleetScheduler:
             submit_time=now,
             priority_class=sched.priority_class,
             slice_cls=slice_class(job.spec.tpu.topology),
+            slices=max(1, job.spec.tpu.slices),
         )
 
-    def _jobs_by_namespace(self) -> dict[str, int]:
-        out: dict[str, int] = {}
+    def _jobs_by_namespace(self) -> dict[str, tuple[int, int]]:
+        """ns -> (running jobs, running SLICES). The two diverge once
+        multi-slice jobs exist: quota's maxSlices must count what a job
+        actually holds, not 1 per job."""
+        out: dict[str, tuple[int, int]] = {}
         for r in self._running.values():
-            out[r.namespace] = out.get(r.namespace, 0) + 1
+            j, s = out.get(r.namespace, (0, 0))
+            out[r.namespace] = (j + 1, s + r.slices)
         return out
 
     def _share_by_queue(self) -> dict[str, float]:
@@ -156,21 +162,21 @@ class FleetScheduler:
         self._ranked()
         return self._rank_index.get(key)
 
-    def _quota_headroom(self, ns: str, jobs_by_ns: dict[str, int],
-                        reserved: dict[str, tuple[int, int]]) -> bool:
-        """True when `ns` may take one more (job, slice) given current
-        running state (precomputed once per scan — the ranked loop calls
-        this per entry) plus simulated reservations for higher-ranked
-        waiters."""
+    def _quota_headroom(self, ns: str, jobs_by_ns: dict[str, tuple[int, int]],
+                        reserved: dict[str, tuple[int, int]],
+                        n_slices: int = 1) -> bool:
+        """True when `ns` may take one more job holding `n_slices` slices
+        given current running state (precomputed once per scan — the
+        ranked loop calls this per entry) plus simulated reservations for
+        higher-ranked waiters."""
         q = self.policy.quota_for(ns)
         if q is None:
             return True
-        jobs = jobs_by_ns.get(ns, 0)
-        slices = jobs  # one slice per job today (multi-slice: roadmap)
+        jobs, slices = jobs_by_ns.get(ns, (0, 0))
         rj, rs = reserved.get(ns, (0, 0))
         if q.max_jobs is not None and jobs + rj + 1 > q.max_jobs:
             return False
-        if q.max_slices is not None and slices + rs + 1 > q.max_slices:
+        if q.max_slices is not None and slices + rs + n_slices > q.max_slices:
             return False
         return True
 
@@ -191,12 +197,13 @@ class FleetScheduler:
         for e in self._ranked():
             if min_priority is not None and e.priority < min_priority:
                 continue
-            if not self._quota_headroom(e.namespace, jobs_by_ns, reserved):
+            if not self._quota_headroom(e.namespace, jobs_by_ns, reserved,
+                                        e.slices):
                 continue
-            if free.get(e.slice_cls, 0) > 0:
-                free[e.slice_cls] -= 1
+            if free.get(e.slice_cls, 0) >= e.slices:
+                free[e.slice_cls] -= e.slices
                 rj, rs = reserved.get(e.namespace, (0, 0))
-                reserved[e.namespace] = (rj + 1, rs + 1)
+                reserved[e.namespace] = (rj + 1, rs + e.slices)
         return free
 
     def _update_depth_gauge(self) -> None:
@@ -231,6 +238,11 @@ class FleetScheduler:
             if key in self._running:
                 r = self._running[key]
                 want_cls = slice_class(topology)
+                if r.slices > 1:
+                    # Multi-slice gangs never change class (no elastic
+                    # probes — validation forbids the combination):
+                    # idempotent re-admission returns the joined ids.
+                    return Decision(admit=True, slice_id=r.slice_id)
                 if r.cls == want_cls:
                     # Idempotent re-admission (every sync of a running
                     # job). holding_class, not admit: during a scale-up
@@ -291,8 +303,12 @@ class FleetScheduler:
 
             for pos, e in enumerate(ranked, start=1):
                 mine = e.key == key
+                # For a probe, OUR ranked entry still carries the
+                # requested class; the decision runs on the probe class.
+                e_cls = entry.slice_cls if mine else e.slice_cls
+                e_need = entry.slices if mine else e.slices
                 if not self._quota_headroom(e.namespace, jobs_by_ns,
-                                            reserved):
+                                            reserved, e_need):
                     if mine:
                         self.stats["quota_blocked"] += 1
                         metrics.sched_quota_blocked_total.labels(
@@ -300,21 +316,23 @@ class FleetScheduler:
                         return Decision(
                             admit=False, reason="quota", position=pos)
                     continue  # quota-blocked waiters reserve nothing
-                # For a probe, OUR ranked entry still carries the
-                # requested class; the decision runs on the probe class.
-                e_cls = entry.slice_cls if mine else e.slice_cls
-                if free.get(e_cls, 0) > 0:
+                if free.get(e_cls, 0) >= e_need:
                     if mine:
                         return self._admit_locked(job, entry, cls, now,
                                                   unserved_ahead, reserved)
-                    # Reserve the slice (and quota headroom) for the
+                    # Reserve the slices (and quota headroom) for the
                     # higher-ranked waiter: this is the no-inversion rule.
-                    free[e_cls] -= 1
+                    free[e_cls] -= e_need
                     rj, rs = reserved.get(e.namespace, (0, 0))
-                    reserved[e.namespace] = (rj + 1, rs + 1)
+                    reserved[e.namespace] = (rj + 1, rs + e_need)
                 elif mine:
                     victim = None
-                    if not probe and cls not in blocked_classes:
+                    # A multi-slice waiter preempts only when ONE eviction
+                    # closes the gap (free == need-1): evicting k victims
+                    # for one arrival would thrash k healthy gangs while
+                    # the atomicity rule holds nothing in between.
+                    if (not probe and cls not in blocked_classes
+                            and free.get(cls, 0) >= entry.slices - 1):
                         victim = self._maybe_preempt_locked(entry, cls, now)
                     return Decision(
                         admit=False,
@@ -323,10 +341,15 @@ class FleetScheduler:
                 else:
                     # A higher-ranked eligible waiter is capacity-blocked
                     # on this class: lower-ranked same-class jobs must not
-                    # preempt on their own behalf (the freed slice would
-                    # belong to the higher-ranked waiter anyway).
+                    # preempt on their own behalf (the freed capacity would
+                    # belong to the higher-ranked waiter anyway). A
+                    # PARTIALLY-servable multi-slice waiter reserves
+                    # nothing (all-or-nothing admission means it cannot
+                    # use a lone slice), so smaller same-class jobs keep
+                    # backfilling — the audit below records the free count
+                    # at this turn to tell real inversions from backfill.
                     blocked_classes.add(e_cls)
-                    unserved_ahead.append(e)
+                    unserved_ahead.append((e, free.get(e_cls, 0)))
             # Unreachable: our entry is always in ranked. Defensive only.
             return Decision(admit=False, reason="capacity")
 
@@ -334,9 +357,10 @@ class FleetScheduler:
                       cls: tuple[str, int], now: float, ahead: list,
                       reserved: dict) -> Decision:
         key = job.key()
-        sid = self.allocator.admit(key, entry.topology)
-        if sid is None:  # allocator raced us (foreign holder): stay queued
+        sids = self.allocator.admit_many(key, entry.topology, entry.slices)
+        if sids is None:  # allocator raced us (foreign holder): stay queued
             return Decision(admit=False, reason="capacity")
+        sid = ",".join(sids)
         # This job found capacity WITHOUT its requested eviction (an
         # unrelated release freed a slice first): spare the marked victim
         # — evicting it now would cost a healthy gang a checkpoint cycle
@@ -344,22 +368,26 @@ class FleetScheduler:
         for victim, preemptor in list(self._evictions.items()):
             if preemptor == key:
                 del self._evictions[victim]
-        # Inversion audit: `ahead` holds the quota-eligible higher-ranked
-        # waiters that got NO reservation (capacity-blocked at their
-        # turn). Admitting on the same class past one of those is a real
-        # inversion — impossible by construction (free hit 0 at their
-        # turn and never recovers within one scan), so any non-zero count
-        # is a scheduler bug the fleet bench gates on.
-        for e in ahead:
-            if e.slice_cls == cls and e.priority > entry.priority:
+        # Inversion audit: `ahead` holds (waiter, free-at-their-turn) for
+        # the quota-eligible higher-ranked waiters that got NO reservation
+        # (capacity-blocked at their turn). Admitting on the same class
+        # past one that HAD enough free capacity at its turn is a real
+        # inversion — impossible by construction (free only decreases
+        # within a scan), so any non-zero count is a scheduler bug the
+        # fleet bench gates on. A multi-slice waiter blocked with fewer
+        # free slices than it needs is NOT inverted by a smaller job
+        # backfilling capacity it could never have used.
+        for e, free_then in ahead:
+            if (e.slice_cls == cls and e.priority > entry.priority
+                    and free_then >= e.slices):
                 self.stats["inversions"] += 1
-        chips = parse_topology(entry.topology).num_chips
+        chips = parse_topology(entry.topology).num_chips * entry.slices
         self._running[key] = _Running(
             namespace=entry.namespace, queue=entry.queue,
             priority=entry.priority,
             priority_class=job.spec.run_policy.scheduling.priority_class,
             chips=chips, cls=cls, slice_id=sid, admitted_at=now,
-            first_submit=entry.submit_time,
+            first_submit=entry.submit_time, slices=entry.slices,
         )
         self._waiting.remove(key)
         self._version += 1
@@ -370,10 +398,13 @@ class FleetScheduler:
         # Post-admit quota audit (counts ONLY real running state).
         q = self.policy.quota_for(entry.namespace)
         if q is not None:
-            n = sum(1 for r in self._running.values()
-                    if r.namespace == entry.namespace)
-            if ((q.max_jobs is not None and n > q.max_jobs)
-                    or (q.max_slices is not None and n > q.max_slices)):
+            nj = ns_sl = 0
+            for r in self._running.values():
+                if r.namespace == entry.namespace:
+                    nj += 1
+                    ns_sl += r.slices
+            if ((q.max_jobs is not None and nj > q.max_jobs)
+                    or (q.max_slices is not None and ns_sl > q.max_slices)):
                 self.stats["quota_violations"] += 1
         metrics.sched_admitted_total.labels(queue=entry.queue).inc()
         metrics.sched_queue_wait_seconds.observe(
@@ -480,21 +511,25 @@ class FleetScheduler:
             reserved: dict[str, tuple[int, int]] = {}
             for e in self._ranked():
                 if not self._quota_headroom(e.namespace, jobs_by_ns,
-                                            reserved):
+                                            reserved, e.slices):
                     continue
                 e_cls = e.slice_cls
-                if free.get(e_cls, 0) > 0:
-                    free[e_cls] -= 1
+                if free.get(e_cls, 0) >= e.slices:
+                    free[e_cls] -= e.slices
                     rj, rs = reserved.get(e.namespace, (0, 0))
-                    reserved[e.namespace] = (rj + 1, rs + 1)
+                    reserved[e.namespace] = (rj + 1, rs + e.slices)
                     targets.append(e.key)
                     if not any(free.values()):
                         break
             return targets
 
     def running_by_namespace(self) -> dict[str, int]:
+        """ns -> running SLICE count (== job count until multi-slice jobs
+        exist) — what exp_fleet's independent quota monitor samples
+        against maxSlices."""
         with self._lock:
-            return self._jobs_by_namespace()
+            return {ns: s
+                    for ns, (_, s) in self._jobs_by_namespace().items()}
 
     def job_view(self, key: str) -> dict | None:
         """The API's per-job scheduling block: live state, queue,

@@ -60,6 +60,11 @@ TRAINER_GAUGES = {
         "Share of checkpoint write time hidden behind training by the "
         "async writer (done event's checkpoint block; 0.0 = sync saves, "
         "1.0 = the step loop paid only the snapshot leg)",
+    "tpujob_trainer_dcn_hidden_fraction":
+        "Multi-slice jobs: share of cross-slice (DCN) gradient-exchange "
+        "time hidden behind backward compute by the bucketed reduction "
+        "(done event's dcn block; 0.0 = fully visible sync, 1.0 = the "
+        "step loop never waited on the wire)",
 }
 
 # Pod names are {job}-{type}-{index} (utils/naming.py); anchoring on the
@@ -117,7 +122,7 @@ def summarize_events(events: list[dict]) -> dict | None:
         out["loss"] = loss
     for k in ("steady_steps_per_sec", "examples_per_sec", "total_s",
               "step_time_s", "phase_breakdown", "staging", "prefetch",
-              "checkpoint"):
+              "checkpoint", "dcn"):
         if done.get(k) is not None:
             out[k] = done[k]
     if by.get("trace_done"):
@@ -268,6 +273,7 @@ class TelemetryCollector:
             step_time = primary.get("step_time_s") or {}
             staging = primary.get("staging") or {}
             ckpt = primary.get("checkpoint") or {}
+            dcn = primary.get("dcn") or {}
             for gauge_name, value in (
                 ("tpujob_trainer_steps_per_sec",
                  primary.get("steady_steps_per_sec")),
@@ -282,6 +288,8 @@ class TelemetryCollector:
                  staging.get("transfer_mb_per_s")),
                 ("tpujob_trainer_ckpt_hidden_fraction",
                  ckpt.get("hidden_fraction")),
+                ("tpujob_trainer_dcn_hidden_fraction",
+                 dcn.get("hidden_fraction")),
             ):
                 if value is not None:
                     self._gauges[gauge_name].labels(**labels).set(float(value))

@@ -38,6 +38,13 @@ Phase taxonomy (PHASES):
                    drains), never as a step phase, because it does not
                    spend step wall-clock; the telescoping identity above
                    is preserved exactly.
+    dcn_sync       the VISIBLE share of the cross-slice (DCN) gradient
+                   exchange (multi-slice jobs, parallel/multislice.py):
+                   time the step loop blocked in collect() waiting for
+                   bucket transfers that did not hide under backward
+                   compute. The exchange's own clock (dcn_busy_s in the
+                   done event's `dcn` block) is the TOTAL; their ratio is
+                   the measured hidden_fraction.
     eval           inline evaluation from the step loop (the separate
                    Evaluator replica accounts its own process).
     other          the telescoping residual: loop body time attributed
@@ -66,7 +73,7 @@ __all__ = [
 ]
 
 PHASES = ("data_wait", "h2d_transfer", "dispatch", "device_blocked",
-          "checkpoint", "ckpt_snapshot", "eval", "other")
+          "checkpoint", "ckpt_snapshot", "dcn_sync", "eval", "other")
 
 QUANTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
 
