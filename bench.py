@@ -385,6 +385,33 @@ def main() -> int:
         return 1
 
 
+def _bench_serving(stage_seconds: float = 5.0) -> dict:
+    """The round-17 serving point: run tools/exp_serve.py in a
+    subprocess (its own jax world — the bench process may hold the chip)
+    and surface its JSON. CPU serving: the point measures the operator/
+    autoscaler/batcher stack, not chip forward throughput."""
+    import subprocess
+
+    try:
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "TPUJOB_PRESPAWN": "0"}
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "exp_serve.py"),
+             "--stage-seconds", str(stage_seconds)],
+            env=env, capture_output=True, text=True, timeout=420)
+        if r.returncode != 0 and not r.stdout.strip():
+            return {"ok": False,
+                    "error": f"exp_serve rc={r.returncode}: "
+                             f"{r.stderr[-500:]}"}
+        out = json.loads(r.stdout)
+        # The full scale trajectory is bench_detail material; the point
+        # keeps the summary.
+        out.pop("scale_trajectory", None)
+        return out
+    except Exception as e:  # noqa: BLE001 - report, don't fail bench
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
 def _bench_multislice(on_tpu: bool, steps: int = 8, batch: int = 36864,
                       latency_s: float = 0.16) -> dict:
     """The round-16 multislice point: 2 emulated slices over the
@@ -742,6 +769,23 @@ def _main() -> int:
     # the step-time ratio vs single-slice. CPU emulation only — on a real
     # chip the exchange needs the platform DCN transport (docs/perf.md
     # multi-slice model).
+    # --- Serving (round 17): the InferenceService load-gen point — an
+    # offered-QPS ramp against a real autoscaled serving stack (operator
+    # + serve controller + server subprocesses), reporting p50/p99 vs
+    # offered QPS, achieved QPS, and the scale trajectory. The
+    # "millions of users" story's first measurable request-latency
+    # surface (docs/serving.md "Reading the bench").
+    log("bench: serving (offered-QPS ramp vs autoscaled InferenceService)...")
+    serve_point = _bench_serving()
+    if serve_point.get("ok"):
+        last = serve_point["stages"][-1]
+        log(f"  offered={last['offered_qps']} "
+            f"achieved={last['achieved_qps']} "
+            f"p99={last['latency_p99_ms']}ms "
+            f"scaled_to={serve_point['scaled_to']}")
+    else:
+        log(f"  serving point: {serve_point.get('error')}")
+
     log("bench: multislice (2 emulated slices, injected DCN latency)...")
     ms_point = _bench_multislice(on_tpu)
     if ms_point.get("ok"):
@@ -1188,6 +1232,10 @@ def _main() -> int:
         # injected cross-slice latency; dcn_hidden_fraction is the share
         # of the exchange the bucketed reduction hid behind backward.
         "multislice": ms_point,
+        # Round 17: the serving workload kind — offered-QPS ramp vs an
+        # autoscaled InferenceService (p50/p99, achieved QPS, scale
+        # trajectory summary); docs/serving.md explains how to read it.
+        "serving": serve_point,
         "resnet50_ok": resnet["ok"],
         "resnet50_images_per_sec": rn_ips,
         "resnet50_batch": rn_batch,

@@ -431,6 +431,77 @@ class TestSchemaDrift:
         assert "schema-emit::TPUSpec.brand_new_knob" in keys
         assert "schema-parse::TPUSpec.brand_new_knob" in keys
 
+    # ---------------- InferenceService root (round 17 fixture pair) ----
+
+    def _infsvc(self, types=None, compat=None, validation=None, crd=None):
+        t, c, v, _ = self._real()
+        crd_text = crd if crd is not None else (
+            REPO / "manifests/inferenceservice-crd.yaml").read_text()
+        return schema.analyze_schema(
+            types or t, compat or c, validation or v, crd_text,
+            root_class=schema.INFSVC_ROOT_CLASS, emit_fn="infsvc_to_dict",
+            check_validation=False)
+
+    def test_infsvc_contract_is_aligned(self):
+        found = self._infsvc()
+        assert found == [], [f.render() for f in found]
+
+    def test_infsvc_removing_emit_line_fails(self):
+        _, compat, _, _ = self._real()
+        no_emit = "\n".join(
+            ln for ln in compat.splitlines()
+            if '"batchMaxSize": spec.serving.batch_max_size' not in ln)
+        assert no_emit != compat, "fixture went stale (emit line moved)"
+        found = self._infsvc(compat=no_emit)
+        assert any(f.rule == "TPS402"
+                   and f.key == "schema-emit::ServingSpec.batch_max_size"
+                   for f in found), [f.render() for f in found]
+
+    def test_infsvc_removing_parse_fails(self):
+        # "targetInflightPerReplica" appears ONLY in the infsvc parser:
+        # blanking it must fail the parse direction.
+        _, compat, _, _ = self._real()
+        no_parse = compat.replace(
+            'auto_d.get("targetInflightPerReplica")', "None").replace(
+            'float(auto_d["targetInflightPerReplica"])', "4.0")
+        assert no_parse != compat, "fixture went stale (parse line moved)"
+        found = self._infsvc(compat=no_parse)
+        assert any(
+            f.rule == "TPS401"
+            and "AutoscaleSpec.target_inflight_per_replica" in f.key
+            for f in found), [f.render() for f in found]
+
+    def test_infsvc_shared_wire_name_needs_own_parse(self):
+        # "heartbeatTimeoutSeconds" is parsed by BOTH kinds; dropping the
+        # SERVING parse line must fail the infsvc direction even though
+        # the recovery parser still reads the same string (per-kind parse
+        # scoping — FOREIGN_PARSE_FNS).
+        _, compat, _, _ = self._real()
+        mutated = compat.replace(
+            'heartbeat_timeout_seconds=serving_d.get(\n'
+            '                    "heartbeatTimeoutSeconds"),',
+            "heartbeat_timeout_seconds=None,")
+        assert mutated != compat, "fixture went stale (serving parse moved)"
+        found = self._infsvc(compat=mutated)
+        assert any(
+            f.rule == "TPS401"
+            and "ServingSpec.heartbeat_timeout_seconds" in f.key
+            for f in found), [f.render() for f in found]
+        # ...and the TrainJob direction stays green (its own parse stands).
+        t, _, v, crd = self._real()
+        assert schema.analyze_schema(t, mutated, v, crd) == []
+
+    def test_infsvc_removing_crd_property_fails(self):
+        infsvc_crd = (REPO / "manifests/inferenceservice-crd.yaml").read_text()
+        no_crd = infsvc_crd.replace("scaleDownStabilizationSeconds:",
+                                    "renamedKnob:")
+        assert no_crd != infsvc_crd
+        found = self._infsvc(crd=no_crd)
+        assert any(
+            f.rule == "TPS403"
+            and "AutoscaleSpec.scale_down_stabilization_seconds" in f.key
+            for f in found), [f.render() for f in found]
+
 
 # --------------------------------------------------------------------------
 class TestDonationSafety:

@@ -32,6 +32,13 @@ class TestManifests:
             policy = fleet_policy_from_yaml(path.read_text())
             assert policy.validate() == []
             return
+        import yaml as _yaml
+
+        if (_yaml.safe_load(path.read_text()) or {}).get(
+                "kind") == "InferenceService":
+            svc = compat.infsvc_from_yaml(path.read_text())
+            assert validation.validate_inference_service(svc) == []
+            return
         job = compat.job_from_yaml(path.read_text())
         assert validation.validate_job(job) == []
 

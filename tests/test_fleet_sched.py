@@ -335,6 +335,73 @@ class TestFleetScheduler:
         assert d2.preempting == "default/low"
         assert s.stats["preemptions_requested"] == 1
 
+    def test_k_victim_preemption_closes_multi_slice_gap(self):
+        """ROADMAP item 1 leftover, landed in round 17: a high-priority
+        2-slice arrival behind two 1-slice low-priority jobs used to wait
+        forever (preemption only closed a gap of ONE, free==N-1); now the
+        k cheapest victims are marked together."""
+        alloc = SliceAllocator.of("v5e-8", "v5e-8")
+        s = FleetScheduler(alloc, thrash_free_policy())
+        low_a = make_slice_job("low-a", pc="low")
+        low_b = make_slice_job("low-b", pc="low")
+        assert s.decide(low_a).admit
+        assert s.decide(low_b).admit
+        hi = make_slice_job("hi", pc="high")
+        hi.spec.tpu.slices = 2
+        d = s.decide(hi)
+        assert not d.admit and d.reason == "preempting"
+        assert set(d.victims) == {"default/low-a", "default/low-b"}
+        assert d.preempting in d.victims
+        assert s.eviction_requested("default/low-a") == "default/hi"
+        assert s.eviction_requested("default/low-b") == "default/hi"
+        # One eviction SET in flight per preemptor: a retry re-returns
+        # the same victims without double-marking.
+        d2 = s.decide(hi)
+        assert set(d2.victims) == set(d.victims)
+        assert s.stats["preemptions_requested"] == 2
+        # Both victims drain -> the 2-slice job admits atomically.
+        s.requeue_preempted(low_a)
+        s.requeue_preempted(low_b)
+        d3 = s.decide(hi)
+        assert d3.admit and len(d3.slice_id.split(",")) == 2
+        assert s.stats["inversions"] == 0
+
+    def test_k_victim_selection_is_minimal(self):
+        """Greedy cheapest-first would pick the 1-slice job and THEN the
+        3-slice job that alone covers the gap; the minimality pass must
+        spare the redundant small victim."""
+        alloc = SliceAllocator.of(*["v5e-8"] * 4)
+        s = FleetScheduler(alloc, thrash_free_policy())
+        big_low = make_slice_job("big-low", pc="low")
+        big_low.spec.tpu.slices = 3
+        small_low = make_slice_job("small-low", pc="low")
+        assert s.decide(small_low).admit
+        assert s.decide(big_low).admit
+        assert alloc.free_slices() == 0
+        hi = make_slice_job("hi", pc="high")
+        hi.spec.tpu.slices = 2
+        d = s.decide(hi)
+        assert not d.admit and d.reason == "preempting"
+        assert d.victims == ("default/big-low",), d.victims
+        assert s.eviction_requested("default/small-low") is None
+        assert s.stats["preemptions_requested"] == 1
+
+    def test_unclosable_multi_slice_gap_marks_nothing(self):
+        """When no victim set can close the gap (one slice held at equal
+        priority), NOTHING is marked — evicting the one low job would
+        thrash it without unblocking the arrival."""
+        alloc = SliceAllocator.of("v5e-8", "v5e-8")
+        s = FleetScheduler(alloc, thrash_free_policy())
+        assert s.decide(make_slice_job("peer", pc="high")).admit
+        assert s.decide(make_slice_job("low", pc="low")).admit
+        hi = make_slice_job("hi", pc="high")
+        hi.spec.tpu.slices = 2
+        d = s.decide(hi)
+        assert not d.admit and d.reason == "capacity"
+        assert d.victims == () and d.preempting is None
+        assert s.eviction_requested("default/low") is None
+        assert s.stats["preemptions_requested"] == 0
+
     def test_never_policy_does_not_preempt(self):
         s = FleetScheduler(SliceAllocator.of("v5e-8"),
                            thrash_free_policy())

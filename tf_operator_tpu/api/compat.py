@@ -227,6 +227,213 @@ def job_from_yaml(text: str, apply_defaults: bool = True) -> TrainJob:
     return job_from_dict(yaml.safe_load(text), apply_defaults=apply_defaults)
 
 
+def infsvc_from_dict(manifest: dict[str, Any],
+                     apply_defaults: bool = True):
+    """Build an InferenceService from a parsed manifest. Same tolerance
+    contract as job_from_dict: unknown values survive to validation so
+    the controller can mark the object Failed instead of crashing."""
+    from tf_operator_tpu.api.types import (
+        AutoscaleSpec,
+        InferenceService,
+        InferenceServiceSpec,
+        ModelSpec,
+        ServingSpec,
+    )
+
+    meta_d = manifest.get("metadata", {}) or {}
+    spec_d = manifest.get("spec", {}) or {}
+    model_d = spec_d.get("model", {}) or {}
+    serving_d = spec_d.get("serving", {}) or {}
+    auto_d = spec_d.get("autoscale", {}) or {}
+    sched_d = (spec_d.get("schedulingPolicy")
+               or spec_d.get("scheduling") or {})
+    tpu_d = spec_d.get("tpu")
+    svc = InferenceService(
+        metadata=ObjectMeta(
+            name=meta_d.get("name", ""),
+            namespace=meta_d.get("namespace", "default"),
+            labels=dict(meta_d.get("labels", {}) or {}),
+            annotations=dict(meta_d.get("annotations", {}) or {}),
+        ),
+        spec=InferenceServiceSpec(
+            model=ModelSpec(
+                checkpoint_dir=model_d.get("checkpointDir", ""),
+                from_train_job=model_d.get("fromTrainJob", ""),
+                model=model_d.get("model", ""),
+            ),
+            serving=ServingSpec(
+                # Explicit 0 must reach validation (>= 1 rule) — the
+                # None-only-default contract every numeric knob follows.
+                batch_max_size=(8 if serving_d.get("batchMaxSize") is None
+                                else int(serving_d["batchMaxSize"])),
+                batch_timeout_ms=(
+                    5.0 if serving_d.get("batchTimeoutMs") is None
+                    else float(serving_d["batchTimeoutMs"])),
+                port=(8500 if serving_d.get("port") is None
+                      else int(serving_d["port"])),
+                heartbeat_timeout_seconds=serving_d.get(
+                    "heartbeatTimeoutSeconds"),
+            ),
+            autoscale=AutoscaleSpec(
+                min_replicas=(1 if auto_d.get("minReplicas") is None
+                              else int(auto_d["minReplicas"])),
+                max_replicas=(
+                    # Absent maxReplicas follows minReplicas (a fixed-size
+                    # service); explicit values reach validation.
+                    int(auto_d["maxReplicas"])
+                    if auto_d.get("maxReplicas") is not None
+                    else (1 if auto_d.get("minReplicas") is None
+                          else int(auto_d["minReplicas"]))),
+                target_inflight_per_replica=(
+                    4.0
+                    if auto_d.get("targetInflightPerReplica") is None
+                    else float(auto_d["targetInflightPerReplica"])),
+                scale_down_stabilization_seconds=(
+                    60.0
+                    if auto_d.get("scaleDownStabilizationSeconds") is None
+                    else float(auto_d["scaleDownStabilizationSeconds"])),
+            ),
+            template=_template_from_dict(spec_d.get("template", {}) or {}),
+            tpu=(
+                TPUSpec(
+                    topology=tpu_d.get("topology", ""),
+                    accelerator=tpu_d.get("accelerator", ""),
+                    chips_per_host=int(tpu_d.get("chipsPerHost", 0)),
+                    slices=(1 if tpu_d.get("slices") is None
+                            else int(tpu_d["slices"])),
+                )
+                if tpu_d
+                else None
+            ),
+            scheduling=SchedulingPolicy(
+                gang=bool(sched_d.get("gang", True)),
+                queue=sched_d.get("queue", ""),
+                priority_class=sched_d.get("priorityClass", ""),
+                min_available=sched_d.get("minAvailable"),
+            ),
+        ),
+    )
+    if apply_defaults:
+        defaults.set_infsvc_defaults(svc)
+    return svc
+
+
+def infsvc_from_yaml(text: str, apply_defaults: bool = True):
+    import yaml
+
+    return infsvc_from_dict(yaml.safe_load(text),
+                            apply_defaults=apply_defaults)
+
+
+def infsvc_to_dict(svc) -> dict[str, Any]:
+    """Serialize an InferenceService to a native manifest dict
+    (round-trippable through infsvc_from_dict). The template emit is
+    inlined — not shared with job_to_dict — because the schema-drift
+    pass gates each kind's emit vocabulary on its OWN serializer
+    function: a dropped line here must fail the InferenceService
+    direction regardless of what the TrainJob serializer still emits."""
+    from tf_operator_tpu.api.types import InferenceService
+
+    spec = svc.spec
+    t = spec.template
+    out: dict[str, Any] = {
+        "apiVersion": InferenceService.API_VERSION,
+        "kind": InferenceService.KIND,
+        "metadata": {
+            "name": svc.metadata.name,
+            "namespace": svc.metadata.namespace,
+            "labels": svc.metadata.labels,
+            "annotations": svc.metadata.annotations,
+        },
+        "spec": {
+            "model": {
+                "checkpointDir": spec.model.checkpoint_dir,
+                "fromTrainJob": spec.model.from_train_job,
+                "model": spec.model.model,
+            },
+            "serving": {
+                "batchMaxSize": spec.serving.batch_max_size,
+                "batchTimeoutMs": spec.serving.batch_timeout_ms,
+                "port": spec.serving.port,
+                "heartbeatTimeoutSeconds":
+                    spec.serving.heartbeat_timeout_seconds,
+            },
+            "autoscale": {
+                "minReplicas": spec.autoscale.min_replicas,
+                "maxReplicas": spec.autoscale.max_replicas,
+                "targetInflightPerReplica":
+                    spec.autoscale.target_inflight_per_replica,
+                "scaleDownStabilizationSeconds":
+                    spec.autoscale.scale_down_stabilization_seconds,
+            },
+            "schedulingPolicy": {
+                "gang": spec.scheduling.gang,
+                "queue": spec.scheduling.queue,
+                "priorityClass": spec.scheduling.priority_class,
+                "minAvailable": spec.scheduling.min_available,
+            },
+            "template": {
+                "metadata": {
+                    "labels": t.labels,
+                    "annotations": t.annotations,
+                },
+                "spec": {
+                    "schedulerName": t.scheduler_name,
+                    "nodeSelector": t.node_selector,
+                    "restartPolicy": t.restart_policy,
+                    "volumes": [
+                        {
+                            "name": v.name,
+                            **({"hostPath": {"path": v.host_path}}
+                               if v.host_path else {}),
+                            **({"persistentVolumeClaim":
+                                {"claimName": v.claim_name}}
+                               if v.claim_name else {}),
+                            **({"emptyDir": {}} if v.empty_dir else {}),
+                        }
+                        for v in t.volumes
+                    ],
+                    "containers": [
+                        {
+                            "name": c.name,
+                            "image": c.image,
+                            "command": c.command,
+                            "args": c.args,
+                            "env": [{"name": e.name, "value": e.value}
+                                    for e in c.env],
+                            "ports": [
+                                {"name": p.name,
+                                 "containerPort": p.container_port}
+                                for p in c.ports
+                            ],
+                            "resources": {"limits": c.resources},
+                            "volumeMounts": [
+                                {
+                                    "name": v.name,
+                                    "mountPath": v.mount_path,
+                                    "subPath": v.sub_path,
+                                    "readOnly": v.read_only,
+                                }
+                                for v in c.volume_mounts
+                            ],
+                            "workingDir": c.working_dir,
+                        }
+                        for c in t.containers
+                    ],
+                },
+            },
+        },
+    }
+    if spec.tpu is not None:
+        out["spec"]["tpu"] = {
+            "topology": spec.tpu.topology,
+            "accelerator": spec.tpu.accelerator,
+            "chipsPerHost": spec.tpu.chips_per_host,
+            "slices": spec.tpu.slices,
+        }
+    return out
+
+
 def job_to_dict(job: TrainJob) -> dict[str, Any]:
     """Serialize a TrainJob to a native-format manifest dict (round-trippable
     through job_from_dict for the fields we model)."""

@@ -131,3 +131,51 @@ def set_defaults(job: TrainJob) -> TrainJob:
     """Defaults the job in place and returns it (ref SetDefaults_TFJob)."""
     set_defaults_spec(job.spec)
     return job
+
+
+# ------------------------------------------------------------ InferenceService
+
+# The serving container the controller injects config into; "serve" first,
+# then the training names so a template reusing a trainer image still works.
+SERVE_CONTAINER_NAMES = ("serve",) + DEFAULT_CONTAINER_NAMES
+# The HTTP serving port's name on the container (the runtime's port map
+# rewrites it to a localhost port like every other declared port).
+SERVE_PORT_NAME = "serve-port"
+DEFAULT_SERVE_MODEL = "mnist-mlp"
+
+
+def serving_container(template) -> "ContainerSpecOrNone":
+    for candidate in SERVE_CONTAINER_NAMES:
+        c = template.container(candidate)
+        if c is not None:
+            return c
+    return None
+
+
+def set_infsvc_defaults(svc) -> "object":
+    """Defaults an InferenceService in place and returns it: serving
+    knobs floor at sane values upstream of validation only when unset,
+    the serve port is declared on the container (the local runtime's
+    port map needs it), and a TPU request derives accelerator/chips like
+    the TrainJob path."""
+    spec = svc.spec
+    c = serving_container(spec.template)
+    if c is not None:
+        names = {p.name for p in c.ports}
+        if SERVE_PORT_NAME not in names:
+            c.ports.append(ContainerPort(
+                name=SERVE_PORT_NAME,
+                container_port=int(spec.serving.port or 8500)))
+    if spec.tpu is not None and spec.tpu.topology:
+        try:
+            topo = parse_topology(
+                spec.tpu.topology, spec.tpu.accelerator,
+                spec.tpu.chips_per_host)
+        except ValueError:
+            topo = None  # validation reports it; defaulting must not crash
+        if topo is not None:
+            if not spec.tpu.accelerator:
+                spec.tpu.accelerator = topo.accelerator
+            if not spec.tpu.chips_per_host:
+                spec.tpu.chips_per_host = topo.chips_per_host
+    return svc

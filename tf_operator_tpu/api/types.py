@@ -455,6 +455,151 @@ class TrainJob:
         return sum(int(s.replicas or 0) for s in self.spec.replica_specs.values())
 
 
+# --------------------------------------------------------------------------
+# InferenceService — the second workload kind through the generic controller
+# layer (ROADMAP item 5). Long-running, stateless serving replicas that load
+# a checkpoint a TrainJob produced, serve batched requests, and autoscale on
+# load signals from the telemetry collector. The reference's L4 was an
+# explicitly framework-agnostic job-controller interface; this kind is the
+# proof our port of it is genuinely generic.
+
+
+@dataclass
+class ModelSpec:
+    """What the serving replicas load.
+
+    checkpoint_dir: directory of `step_<N>` checkpoints (the trainer's
+    --checkpoint-dir). The server resolves the NEWEST VALIDATED step via
+    models/checkpoint.latest_valid_checkpoint — the same torn/corrupt
+    census validation the trainer's resume walk applies, so serving can
+    never load a checkpoint the trainer itself would skip.
+
+    from_train_job: "name" or "ns/name" of a TrainJob instead of an
+    explicit directory — the controller resolves the finished job's
+    --checkpoint-dir (and --model, when `model` is unset) from its Worker
+    command line: the train->serve handoff. Mutually exclusive with
+    checkpoint_dir.
+
+    model: architecture name (the trainer's --model vocabulary, e.g.
+    "mnist-mlp"); empty = inherit from the TrainJob or default mnist-mlp.
+    """
+
+    checkpoint_dir: str = ""
+    from_train_job: str = ""
+    model: str = ""
+
+
+@dataclass
+class ServingSpec:
+    """Batch-serving knobs for serve/server.py.
+
+    batch_max_size: micro-batch ceiling — requests are assembled into one
+    padded device batch of at most this many rows per jitted apply.
+    batch_timeout_ms: how long the batcher waits after the FIRST queued
+    request for peers to coalesce before dispatching a partial batch
+    (latency bound under low load).
+    port: the HTTP serving port (containerPort `serve-port`).
+    heartbeat_timeout_seconds: per-replica hang watchdog — a Running
+    server replica whose heartbeat is older than this is restarted
+    (None disables), the serving analogue of recovery.heartbeatTimeoutSeconds.
+    """
+
+    batch_max_size: int = 8
+    batch_timeout_ms: float = 5.0
+    port: int = 8500
+    heartbeat_timeout_seconds: float | None = None
+
+
+@dataclass
+class AutoscaleSpec:
+    """Replica autoscaling on collector load signals (serve/autoscale.py).
+
+    Desired replicas = ceil(total inflight / target_inflight_per_replica),
+    clamped to [min_replicas, max_replicas]. Scale-UP applies immediately;
+    scale-DOWN only after the computed desired count has stayed below the
+    current one for scale_down_stabilization_seconds (hysteresis — a
+    bursty load must not thrash replicas and their checkpoint loads).
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_inflight_per_replica: float = 4.0
+    scale_down_stabilization_seconds: float = 60.0
+
+
+@dataclass
+class InferenceServiceSpec:
+    model: ModelSpec = field(default_factory=ModelSpec)
+    serving: ServingSpec = field(default_factory=ServingSpec)
+    autoscale: AutoscaleSpec = field(default_factory=AutoscaleSpec)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    # Per-REPLICA slice request: each serving replica claims one slice of
+    # this class through the same FleetScheduler/SliceAllocator train jobs
+    # admit through, so train and serve compete under one priority/quota/
+    # preemption regime. None = no admission gate (CPU serving).
+    tpu: TPUSpec | None = None
+    # Queue/priorityClass for the fleet scheduler (wire: schedulingPolicy).
+    scheduling: SchedulingPolicy = field(default_factory=SchedulingPolicy)
+
+
+@dataclass
+class InferenceServiceStatus:
+    conditions: list[JobCondition] = field(default_factory=list)
+    # Pod-derived counts: created server replicas / Running ones.
+    replicas: int = 0
+    ready_replicas: int = 0
+    # The autoscaler's current target (None until the first reconcile;
+    # defaults to autoscale.min_replicas). Persisted so an operator
+    # failover keeps serving at the scaled size, not the spec floor.
+    desired_replicas: int | None = None
+    last_scale_time: float | None = None
+    # Hysteresis latch: when the computed desired count first dropped
+    # below the current target (None = load supports the current size).
+    # Persisted for the same failover reason as desired_replicas.
+    low_load_since: float | None = None
+    # Lifetime server-replica restarts (per-replica replacement of failed
+    # pods — stateless serving always restarts; this is the visibility).
+    restarts: int = 0
+    start_time: float | None = None
+    last_reconcile_time: float | None = None
+
+
+@dataclass
+class InferenceService:
+    """Kind `InferenceService`, group `tpujob.dev/v1` — reconciled by
+    serve/controller.py through the same generic JobControllerBase the
+    TrainJob controller runs on."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: InferenceServiceSpec = field(default_factory=InferenceServiceSpec)
+    status: InferenceServiceStatus = field(
+        default_factory=InferenceServiceStatus)
+
+    API_GROUP = "tpujob.dev"
+    API_VERSION = "tpujob.dev/v1"
+    KIND = "InferenceService"
+    SINGULAR = "inferenceservice"
+    PLURAL = "inferenceservices"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def deep_copy(self) -> "InferenceService":
+        return copy.deepcopy(self)
+
+
 def has_condition(status: JobStatus, cond_type: JobConditionType) -> bool:
     return any(c.type == cond_type and c.status for c in status.conditions)
 

@@ -231,3 +231,102 @@ def must_validate(job: TrainJob, fleet=None) -> None:
     problems = validate_job(job, fleet=fleet)
     if problems:
         raise ValidationError(problems)
+
+
+# ------------------------------------------------------------ InferenceService
+
+
+def validate_inference_service(svc, fleet=None) -> list[str]:
+    """All problems with an InferenceService (empty list = valid). Same
+    report-everything contract as validate_job; `fleet` adds the
+    priorityClass-must-exist and zero-quota checks serve replicas share
+    with train jobs (they admit through the same scheduler)."""
+    from tf_operator_tpu.api.defaults import (
+        SERVE_CONTAINER_NAMES,
+        serving_container,
+    )
+
+    problems: list[str] = []
+    if not is_valid_dns_name(svc.metadata.name):
+        problems.append(
+            f"service name {svc.metadata.name!r} is not a valid DNS-1035 "
+            "label (lowercase alphanumerics and '-', <= 63 chars)"
+        )
+    spec = svc.spec
+    model = spec.model
+    if model.checkpoint_dir and model.from_train_job:
+        problems.append(
+            "model.checkpointDir and model.fromTrainJob are mutually "
+            "exclusive (one source of truth for the checkpoint)")
+    if not model.checkpoint_dir and not model.from_train_job:
+        problems.append(
+            "model requires one of model.checkpointDir or "
+            "model.fromTrainJob")
+    if model.from_train_job:
+        name = model.from_train_job.split("/", 1)[-1]
+        if not is_valid_dns_name(name):
+            problems.append(
+                f"model.fromTrainJob {model.from_train_job!r} does not "
+                f"name a valid TrainJob ('name' or 'namespace/name')")
+    if not spec.template.containers:
+        problems.append("template has no containers")
+    elif serving_container(spec.template) is None:
+        problems.append(
+            f"no serving container (need one named "
+            f"{' / '.join(SERVE_CONTAINER_NAMES)})")
+    serving = spec.serving
+    if serving.batch_max_size < 1:
+        problems.append("serving.batchMaxSize must be >= 1")
+    if serving.batch_timeout_ms < 0:
+        problems.append("serving.batchTimeoutMs must be >= 0")
+    if not (0 < serving.port < 65536):
+        problems.append("serving.port must be in 1..65535")
+    if (serving.heartbeat_timeout_seconds is not None
+            and serving.heartbeat_timeout_seconds <= 0):
+        problems.append("serving.heartbeatTimeoutSeconds must be > 0")
+    auto = spec.autoscale
+    if auto.min_replicas < 1:
+        problems.append("autoscale.minReplicas must be >= 1")
+    if auto.max_replicas < auto.min_replicas:
+        problems.append(
+            f"autoscale.maxReplicas ({auto.max_replicas}) must be >= "
+            f"autoscale.minReplicas ({auto.min_replicas})")
+    if auto.target_inflight_per_replica <= 0:
+        problems.append("autoscale.targetInflightPerReplica must be > 0")
+    if auto.scale_down_stabilization_seconds < 0:
+        problems.append(
+            "autoscale.scaleDownStabilizationSeconds must be >= 0")
+    if spec.tpu is not None and spec.tpu.slices != 1:
+        problems.append(
+            "tpu.slices must be 1 for an InferenceService (each serving "
+            "replica claims exactly one slice)")
+    if spec.tpu is not None and spec.tpu.topology:
+        try:
+            parse_topology(spec.tpu.topology, spec.tpu.accelerator,
+                           spec.tpu.chips_per_host)
+        except ValueError as e:
+            problems.append(str(e))
+    sched = spec.scheduling
+    for label, value in (("queue", sched.queue),
+                         ("priorityClass", sched.priority_class)):
+        if value and not is_valid_dns_name(value):
+            problems.append(
+                f"schedulingPolicy.{label} {value!r} is not a valid "
+                "DNS-1035 label")
+    if fleet is not None:
+        if sched.priority_class and not fleet.knows_class(
+                sched.priority_class):
+            known = ", ".join(sorted(fleet.priority_classes)) or "<none>"
+            problems.append(
+                f"schedulingPolicy.priorityClass "
+                f"{sched.priority_class!r} names no PriorityClass in the "
+                f"fleet policy (known: {known})")
+        if spec.tpu is not None and spec.tpu.topology:
+            quota = fleet.quota_for(svc.metadata.namespace)
+            if quota is not None and (quota.max_slices == 0
+                                      or quota.max_jobs == 0):
+                problems.append(
+                    f"namespace {svc.metadata.namespace!r} has a zero "
+                    f"ResourceQuota for TPU slices: no serving replica "
+                    "can ever be admitted")
+    return problems

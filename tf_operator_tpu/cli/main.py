@@ -38,7 +38,32 @@ def _load_job(path: str):
         return compat.job_from_yaml(f.read())
 
 
+def _manifest_kind(path: str) -> str:
+    import yaml
+
+    with open(path) as f:
+        return (yaml.safe_load(f.read()) or {}).get("kind", "TrainJob")
+
+
 def cmd_validate(args) -> int:
+    if _manifest_kind(args.manifest) == "InferenceService":
+        with open(args.manifest) as f:
+            svc = compat.infsvc_from_yaml(f.read())
+        problems = validation.validate_inference_service(svc)
+        if problems:
+            for p in problems:
+                print(f"INVALID: {p}")
+            return 1
+        print(f"OK: InferenceService {svc.namespace}/{svc.name} is valid")
+        print(f"  model: {svc.spec.model.checkpoint_dir or svc.spec.model.from_train_job}")
+        print(f"  serving: batchMaxSize={svc.spec.serving.batch_max_size} "
+              f"batchTimeoutMs={svc.spec.serving.batch_timeout_ms:g} "
+              f"port={svc.spec.serving.port}")
+        print(f"  autoscale: {svc.spec.autoscale.min_replicas}.."
+              f"{svc.spec.autoscale.max_replicas} @ "
+              f"{svc.spec.autoscale.target_inflight_per_replica:g} "
+              f"inflight/replica")
+        return 0
     job = _load_job(args.manifest)
     problems = validation.validate_job(job)
     if problems:
@@ -181,6 +206,20 @@ def cmd_operator(args) -> int:
             from tf_operator_tpu.telemetry.collector import TelemetryCollector
 
             heartbeat_source = TelemetryCollector(args.log_dir)
+        # Two workload kinds share one scheduler/allocator: the shared
+        # router (core.controller.make_enqueue_router) dispatches
+        # capacity kicks and preemption victims to whichever controller
+        # owns the key (serve-replica claims carry the claim separator).
+        from tf_operator_tpu.core.controller import make_enqueue_router
+        from tf_operator_tpu.serve.controller import (
+            InferenceServiceController,
+        )
+
+        train_controller_ref: list = []
+        serve_controller_ref: list = []
+        _route = make_enqueue_router(train_controller_ref,
+                                     serve_controller_ref)
+
         controller = TrainJobController(
             cluster,
             enable_gang=args.enable_gang_scheduling,
@@ -190,7 +229,18 @@ def cmd_operator(args) -> int:
             scheduler=scheduler,
             queue_shards=args.queue_shards,
             fleet_policy=fleet_policy,
+            enqueue_router=_route,
         )
+        train_controller_ref.append(controller)
+        serve_controller = InferenceServiceController(
+            cluster,
+            slice_allocator=allocator,
+            scheduler=scheduler,
+            heartbeat_source=heartbeat_source,
+            fleet_policy=fleet_policy,
+            enqueue_router=_route,
+        )
+        serve_controller_ref.append(serve_controller)
         runtime = None
         if on_k8s:
             cluster.start()
@@ -221,11 +271,13 @@ def cmd_operator(args) -> int:
         api.start()
         log.info("REST/metrics API on %s:%d", args.bind, api.port)
         controller.run(workers=args.threadiness)
+        serve_controller.run(workers=1)
         log.info("controllers running (threadiness=%d)", args.threadiness)
         stop.wait()
         if runtime is not None:
             runtime.stop()
         controller.stop()
+        serve_controller.stop()
         if on_k8s:
             cluster.stop()
         api.stop()
@@ -341,6 +393,19 @@ def cmd_get(args) -> int:
 
 
 def cmd_submit(args) -> int:
+    if _manifest_kind(args.manifest) == "InferenceService":
+        with open(args.manifest) as f:
+            svc = compat.infsvc_from_yaml(f.read())
+        body = json.dumps(compat.infsvc_to_dict(svc)).encode()
+        req = urllib.request.Request(
+            f"http://{args.server}/api/inferenceservices",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            print(json.dumps(json.loads(r.read()), indent=2)[:2000])
+        return 0
     job = _load_job(args.manifest)
     body = json.dumps(compat.job_to_dict(job)).encode()
     req = urllib.request.Request(

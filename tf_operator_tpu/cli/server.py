@@ -2,6 +2,9 @@
 
 Dashboard-backend parity (dashboard/backend/handler/api_handler.go:42-267):
   GET    /api/trainjobs                      list all jobs (all namespaces)
+  GET    /api/inferenceservices[/{ns}[/{n}]] list/get serving workloads
+  POST   /api/inferenceservices              submit an InferenceService
+  DELETE /api/inferenceservices/{ns}/{name}  delete a serving workload
   GET    /api/trainjobs/{ns}                 list jobs in a namespace
   GET    /api/trainjobs/{ns}/{name}          one job (spec + status + events)
   POST   /api/trainjobs                      submit a manifest (JSON body)
@@ -86,6 +89,44 @@ def _job_payload(cluster: InMemoryCluster, job: TrainJob,
         # Fleet-scheduler view: live state (Admitted/Queued), queue,
         # priority, and — for waiters — the 1-based queue position.
         payload["scheduling"] = scheduler.job_view(job.key())
+    return payload
+
+
+def _infsvc_payload(cluster, svc, telemetry=None) -> dict:
+    from tf_operator_tpu.api.types import InferenceService
+
+    payload = {
+        "manifest": compat.infsvc_to_dict(svc),
+        "status": {
+            "conditions": [
+                {
+                    "type": str(c.type),
+                    "status": c.status,
+                    "reason": c.reason,
+                    "message": c.message,
+                }
+                for c in svc.status.conditions
+            ],
+            "replicas": svc.status.replicas,
+            "readyReplicas": svc.status.ready_replicas,
+            "desiredReplicas": svc.status.desired_replicas,
+            "lastScaleTime": svc.status.last_scale_time,
+            "restarts": svc.status.restarts,
+            "startTime": svc.status.start_time,
+        },
+        "events": [
+            {"type": e.type, "reason": e.reason, "message": e.message,
+             "ts": e.timestamp}
+            for e in cluster.events_for(
+                InferenceService.KIND, svc.namespace, svc.name)
+        ],
+    }
+    if telemetry is not None:
+        load_fn = getattr(telemetry, "service_load", None)
+        if load_fn is not None:
+            # Per-replica serve stats (inflight, request totals, latency
+            # percentiles) — the same snapshot the autoscaler consumes.
+            payload["serving"] = load_fn(svc.namespace, svc.name)
     return payload
 
 
@@ -255,6 +296,23 @@ class ApiServer:
                         )
                     elif parts[:2] == ["api", "trainjobs"] and len(parts) == 4:
                         self._get_job_maybe_wait(parts[2], parts[3])
+                    elif (parts[:2] == ["api", "inferenceservices"]
+                          and len(parts) in (2, 3)):
+                        items = outer.cluster.list_infsvcs(
+                            parts[2] if len(parts) == 3 else None)
+                        self._send({"items": [
+                            _infsvc_payload(outer.cluster, s0)
+                            for s0 in items
+                        ]})
+                    elif (parts[:2] == ["api", "inferenceservices"]
+                          and len(parts) == 4):
+                        svc = outer.cluster.try_get_infsvc(
+                            parts[2], parts[3])
+                        if svc is None:
+                            self._send({"error": "not found"}, 404)
+                        else:
+                            self._send(_infsvc_payload(
+                                outer.cluster, svc, outer.telemetry))
                     elif parts[:2] == ["api", "pods"] and len(parts) == 3:
                         pods = outer.cluster.list_pods(parts[2])
                         self._send(
@@ -403,6 +461,30 @@ class ApiServer:
                     except Exception as e:
                         self._send({"error": f"{type(e).__name__}: {e}"}, 400)
                     return
+                if parts[:2] == ["api", "inferenceservices"]:
+                    try:
+                        length = int(self.headers.get("Content-Length", "0"))
+                        raw = self.rfile.read(length)
+                        ctype = self.headers.get("Content-Type",
+                                                 "application/json")
+                        if "yaml" in ctype:
+                            svc = compat.infsvc_from_yaml(raw.decode())
+                        else:
+                            svc = compat.infsvc_from_dict(json.loads(raw))
+                        defaults.set_infsvc_defaults(svc)
+                        problems = validation.validate_inference_service(
+                            svc, fleet=outer.fleet)
+                        if problems:
+                            self._send({"error": "invalid InferenceService",
+                                        "problems": problems}, 400)
+                            return
+                        created = outer.cluster.create_infsvc(svc)
+                        self._send(_infsvc_payload(outer.cluster, created),
+                                   201)
+                    except Exception as e:
+                        self._send({"error": f"{type(e).__name__}: {e}"},
+                                   400)
+                    return
                 if parts[:2] != ["api", "trainjobs"]:
                     self._send({"error": "not found"}, 404)
                     return
@@ -436,6 +518,13 @@ class ApiServer:
                 if parts[:2] == ["api", "trainjobs"] and len(parts) == 4:
                     try:
                         outer.cluster.delete_job(parts[2], parts[3])
+                        self._send({"deleted": f"{parts[2]}/{parts[3]}"})
+                    except Exception as e:
+                        self._send({"error": str(e)}, 404)
+                elif (parts[:2] == ["api", "inferenceservices"]
+                        and len(parts) == 4):
+                    try:
+                        outer.cluster.delete_infsvc(parts[2], parts[3])
                         self._send({"deleted": f"{parts[2]}/{parts[3]}"})
                     except Exception as e:
                         self._send({"error": str(e)}, 404)

@@ -180,6 +180,7 @@ Handler = Callable[[Any], None]
 UpdateHandler = Callable[[Any, Any], None]
 
 KIND_JOB = "TrainJob"
+KIND_INFSVC = "InferenceService"
 KIND_POD = "Pod"
 KIND_SERVICE = "Service"
 KIND_PODGROUP = "PodGroup"
@@ -204,6 +205,7 @@ class InMemoryCluster:
         self._lock = threading.RLock()
         self._stores: dict[str, dict[tuple[str, str], Any]] = {
             KIND_JOB: {},
+            KIND_INFSVC: {},
             KIND_POD: {},
             KIND_SERVICE: {},
             KIND_PODGROUP: {},
@@ -338,6 +340,42 @@ class InMemoryCluster:
 
     def list_jobs(self, namespace: str | None = None) -> list[TrainJob]:
         return self._list(KIND_JOB, namespace, None)
+
+    # ---- inference services (the second workload kind; same CRUD shape
+    # ---- as jobs, including the status-subresource write semantics) ----
+
+    def create_infsvc(self, svc) -> Any:
+        return self._create(KIND_INFSVC, svc)
+
+    def get_infsvc(self, namespace: str, name: str) -> Any:
+        return self._get(KIND_INFSVC, namespace, name)
+
+    def try_get_infsvc(self, namespace: str, name: str) -> Any | None:
+        return self._try_get(KIND_INFSVC, namespace, name)
+
+    def update_infsvc(self, svc) -> Any:
+        return self._update(KIND_INFSVC, svc)
+
+    def update_infsvc_status(self, svc) -> Any:
+        with self._lock:
+            key = (svc.metadata.namespace, svc.metadata.name)
+            old = self._stores[KIND_INFSVC].get(key)
+            if old is None:
+                raise NotFoundError(
+                    f"InferenceService {key[0]}/{key[1]} not found")
+            new = copy.deepcopy(old)
+            new.status = copy.deepcopy(svc.status)
+            new.metadata.annotations = dict(svc.metadata.annotations)
+            new.metadata.resource_version = next(self._rv)
+            self._stores[KIND_INFSVC][key] = new
+        self._fire_update(KIND_INFSVC, old, new)
+        return copy.deepcopy(new)
+
+    def delete_infsvc(self, namespace: str, name: str) -> Any:
+        return self._delete(KIND_INFSVC, namespace, name)
+
+    def list_infsvcs(self, namespace: str | None = None) -> list[Any]:
+        return self._list(KIND_INFSVC, namespace, None)
 
     # ---- pods ----
 

@@ -9,7 +9,7 @@ raising so the reconciler can keep going and rely on requeue.
 
 from __future__ import annotations
 
-from tf_operator_tpu.api.types import OwnerReference, TrainJob
+from tf_operator_tpu.api.types import OwnerReference
 from tf_operator_tpu.core.cluster import (
     ApiError,
     InMemoryCluster,
@@ -27,11 +27,13 @@ EVENT_SUCCESSFUL_DELETE_SERVICE = "SuccessfulDeleteService"
 EVENT_FAILED_DELETE_SERVICE = "FailedDeleteService"
 
 
-def gen_owner_reference(job: TrainJob) -> OwnerReference:
-    """Controller ownership marker (ref GenOwnerReference, jobcontroller.go:198)."""
+def gen_owner_reference(job) -> OwnerReference:
+    """Controller ownership marker (ref GenOwnerReference, jobcontroller.go:198).
+    Kind-generic: `job` is any owner object carrying KIND/API_VERSION
+    class attributes (TrainJob, InferenceService)."""
     return OwnerReference(
-        api_version=TrainJob.API_VERSION,
-        kind=TrainJob.KIND,
+        api_version=job.API_VERSION,
+        kind=job.KIND,
         name=job.name,
         uid=job.uid,
         controller=True,
@@ -43,33 +45,33 @@ class PodControl:
     def __init__(self, cluster: InMemoryCluster):
         self.cluster = cluster
 
-    def create_pod(self, pod: Pod, job: TrainJob) -> bool:
+    def create_pod(self, pod: Pod, job) -> bool:
         pod.metadata.owner_references = [gen_owner_reference(job)]
         try:
             self.cluster.create_pod(pod)
         except ApiError as e:
             self.cluster.record_event(
-                TrainJob.KIND, job.namespace, job.name, "Warning",
+                job.KIND, job.namespace, job.name, "Warning",
                 EVENT_FAILED_CREATE_POD, f"Error creating pod {pod.name}: {e}",
             )
             return False
         self.cluster.record_event(
-            TrainJob.KIND, job.namespace, job.name, "Normal",
+            job.KIND, job.namespace, job.name, "Normal",
             EVENT_SUCCESSFUL_CREATE_POD, f"Created pod: {pod.name}",
         )
         return True
 
-    def delete_pod(self, namespace: str, name: str, job: TrainJob) -> bool:
+    def delete_pod(self, namespace: str, name: str, job) -> bool:
         try:
             self.cluster.delete_pod(namespace, name)
         except ApiError as e:
             self.cluster.record_event(
-                TrainJob.KIND, job.namespace, job.name, "Warning",
+                job.KIND, job.namespace, job.name, "Warning",
                 EVENT_FAILED_DELETE_POD, f"Error deleting pod {name}: {e}",
             )
             return False
         self.cluster.record_event(
-            TrainJob.KIND, job.namespace, job.name, "Normal",
+            job.KIND, job.namespace, job.name, "Normal",
             EVENT_SUCCESSFUL_DELETE_POD, f"Deleted pod: {name}",
         )
         return True
@@ -79,33 +81,33 @@ class ServiceControl:
     def __init__(self, cluster: InMemoryCluster):
         self.cluster = cluster
 
-    def create_service(self, svc: Service, job: TrainJob) -> bool:
+    def create_service(self, svc: Service, job) -> bool:
         svc.metadata.owner_references = [gen_owner_reference(job)]
         try:
             self.cluster.create_service(svc)
         except ApiError as e:
             self.cluster.record_event(
-                TrainJob.KIND, job.namespace, job.name, "Warning",
+                job.KIND, job.namespace, job.name, "Warning",
                 EVENT_FAILED_CREATE_SERVICE, f"Error creating service {svc.name}: {e}",
             )
             return False
         self.cluster.record_event(
-            TrainJob.KIND, job.namespace, job.name, "Normal",
+            job.KIND, job.namespace, job.name, "Normal",
             EVENT_SUCCESSFUL_CREATE_SERVICE, f"Created service: {svc.name}",
         )
         return True
 
-    def delete_service(self, namespace: str, name: str, job: TrainJob) -> bool:
+    def delete_service(self, namespace: str, name: str, job) -> bool:
         try:
             self.cluster.delete_service(namespace, name)
         except ApiError as e:
             self.cluster.record_event(
-                TrainJob.KIND, job.namespace, job.name, "Warning",
+                job.KIND, job.namespace, job.name, "Warning",
                 EVENT_FAILED_DELETE_SERVICE, f"Error deleting service {name}: {e}",
             )
             return False
         self.cluster.record_event(
-            TrainJob.KIND, job.namespace, job.name, "Normal",
+            job.KIND, job.namespace, job.name, "Normal",
             EVENT_SUCCESSFUL_DELETE_SERVICE, f"Deleted service: {name}",
         )
         return True

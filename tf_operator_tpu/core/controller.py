@@ -25,7 +25,6 @@ from tf_operator_tpu.api.types import TrainJob
 from tf_operator_tpu.core.cluster import (
     KIND_POD,
     KIND_SERVICE,
-    KIND_JOB,
     InMemoryCluster,
     Pod,
     Service,
@@ -60,11 +59,58 @@ def gen_labels(job_name: str) -> dict[str, str]:
     }
 
 
-class JobControllerBase:
-    """Reconcile engine: workqueue + expectations + claim/adopt."""
+# Slice-claim keys of serving replicas are `{ns}/{name}#r{i}` — the "#"
+# marks a per-replica sub-claim of an InferenceService, so capacity kicks
+# and preemption targets route to the owning kind's controller (the part
+# before "#" is the service's sync key). TrainJob keys never contain "#".
+CLAIM_SEP = "#"
 
-    def __init__(self, cluster: InMemoryCluster, queue_shards: int = 1):
+
+def claim_owner_key(key: str) -> str:
+    """The sync key that owns a scheduler claim key (identity for plain
+    job keys; the service key for `ns/name#rI` serve-replica claims)."""
+    return key.split(CLAIM_SEP, 1)[0]
+
+
+def make_enqueue_router(train_controller_ref, serve_controller_ref):
+    """THE cross-kind enqueue router (one definition, shared by
+    cmd_operator and LocalSession): scheduler kick targets and preemption
+    victims dispatch to whichever controller owns the key — serve-replica
+    claims carry CLAIM_SEP and collapse to their service's sync key,
+    everything else is a TrainJob key. The refs are one-element lists so
+    the router can be handed to the first controller's constructor before
+    the second controller exists."""
+    def route(key: str) -> None:
+        if CLAIM_SEP in key and serve_controller_ref:
+            serve_controller_ref[0].enqueue(claim_owner_key(key))
+        elif train_controller_ref:
+            train_controller_ref[0].enqueue(key)
+    return route
+
+
+class JobControllerBase:
+    """Reconcile engine: workqueue + expectations + claim/adopt.
+
+    Kind-generic (the reference's ControllerInterface promise, made
+    real): `OWNER_KIND` plus the three owner accessors below are the
+    whole per-kind surface — TrainJobController keeps the defaults,
+    serve/controller.py's InferenceServiceController overrides them.
+    """
+
+    # The owner kind this controller reconciles: informer registration,
+    # controller-ref resolution, and claim/adopt all key on it.
+    OWNER_KIND = TrainJob.KIND
+
+    def __init__(self, cluster: InMemoryCluster, queue_shards: int = 1,
+                 enqueue_router=None):
         self.cluster = cluster
+        # Cross-kind enqueue routing: with two controllers sharing one
+        # scheduler/allocator, a freed slice's kick targets (and
+        # preemption victims) may belong to the OTHER kind — the router
+        # (make_enqueue_router above) dispatches each key to the
+        # controller that owns it. None = route to our own queue
+        # (single-kind deployments, tests).
+        self.enqueue_router = enqueue_router
         # queue_shards > 1: fleet-scale mode — keys route to stable shards
         # and each worker thread services its own (core/workqueue.py
         # ShardedRateLimitingQueue), so reconcile workers stop contending
@@ -84,12 +130,23 @@ class JobControllerBase:
     def sync_job(self, key: str) -> None:
         raise NotImplementedError
 
+    def _try_get_owner(self, namespace: str, name: str):
+        """The owner object this controller reconciles (None if gone)."""
+        return self.cluster.try_get_job(namespace, name)
+
+    def _list_owners(self) -> list:
+        return self.cluster.list_jobs()
+
+    def _owner_replica_types(self, obj) -> list[str]:
+        """Replica-type strings the owner's expectations are keyed by."""
+        return [str(rt) for rt in obj.spec.replica_specs]
+
     # ---- informer wiring ----
 
     def _register_handlers(self) -> None:
-        self.cluster.on_add(KIND_JOB, self._on_job_add)
-        self.cluster.on_update(KIND_JOB, self._on_job_update)
-        self.cluster.on_delete(KIND_JOB, self._on_job_delete)
+        self.cluster.on_add(self.OWNER_KIND, self._on_job_add)
+        self.cluster.on_update(self.OWNER_KIND, self._on_job_update)
+        self.cluster.on_delete(self.OWNER_KIND, self._on_job_delete)
         self.cluster.on_add(KIND_POD, self._on_pod_add)
         self.cluster.on_update(KIND_POD, self._on_pod_update)
         self.cluster.on_delete(KIND_POD, self._on_pod_delete)
@@ -100,20 +157,29 @@ class JobControllerBase:
     def enqueue(self, key: str) -> None:
         self.queue.add(key)
 
+    def route_enqueue(self, key: str) -> None:
+        """Enqueue a key that may belong to ANOTHER kind's controller
+        (scheduler kick targets, preemption victims). Serve-replica claim
+        keys collapse to their owning service key either way."""
+        if self.enqueue_router is not None:
+            self.enqueue_router(key)
+        else:
+            self.enqueue(claim_owner_key(key))
+
     def _on_job_add(self, job: TrainJob) -> None:
         self.enqueue(job.key())
 
     def _on_job_update(self, old: TrainJob, new: TrainJob) -> None:
         self.enqueue(new.key())
 
-    def _on_job_delete(self, job: TrainJob) -> None:
+    def _on_job_delete(self, job) -> None:
         key = job.key()
-        for rtype in job.spec.replica_specs:
+        for rtype in self._owner_replica_types(job):
             self.expectations.delete_expectations(
-                naming.gen_expectation_pods_key(key, str(rtype))
+                naming.gen_expectation_pods_key(key, rtype)
             )
             self.expectations.delete_expectations(
-                naming.gen_expectation_services_key(key, str(rtype))
+                naming.gen_expectation_services_key(key, rtype)
             )
         self.queue.forget(key)
         # Cascade deletion: the reference relied on the K8s garbage collector
@@ -149,12 +215,12 @@ class JobControllerBase:
         self.enqueue(key)
 
     def _owner_key(self, obj: Pod | Service) -> tuple[str, str] | None:
-        """(job_key, replica_type) for an object owned by one of our jobs
-        (ref resolveControllerRef, jobcontroller/pod.go:20-67)."""
+        """(owner_key, replica_type) for an object owned by one of our
+        owners (ref resolveControllerRef, jobcontroller/pod.go:20-67)."""
         ref = obj.controller_ref()
-        if ref is None or ref.kind != TrainJob.KIND:
+        if ref is None or ref.kind != self.OWNER_KIND:
             return None
-        job = self.cluster.try_get_job(obj.metadata.namespace, ref.name)
+        job = self._try_get_owner(obj.metadata.namespace, ref.name)
         if job is None or (ref.uid and job.uid and job.uid != ref.uid):
             return None
         rtype = obj.metadata.labels.get(LABEL_REPLICA_TYPE, "")
@@ -216,17 +282,82 @@ class JobControllerBase:
 
     # ---- claim/adopt (ref ClaimPods/ClaimServices + ref managers) ----
 
-    def get_pods_for_job(self, job: TrainJob) -> list[Pod]:
+    def get_pods_for_job(self, job) -> list[Pod]:
         selector = gen_labels(job.name)
         pods = self.cluster.list_pods(job.namespace, selector)
         return self._claim(pods, job, self.cluster.update_pod)
 
-    def get_services_for_job(self, job: TrainJob) -> list[Service]:
+    def get_services_for_job(self, job) -> list[Service]:
         selector = gen_labels(job.name)
         services = self.cluster.list_services(job.namespace, selector)
         return self._claim(services, job, self.cluster.update_service)
 
-    def _claim(self, objs, job: TrainJob, updater: Callable | None):
+    # ---- tracked create/delete (expectation bookkeeping chokepoints) ----
+    #
+    # Factored from the TrainJob controller (round 17): the raise-
+    # expectation / act / roll-back-on-failure dance appeared at every
+    # call site and is identical for both workload kinds.
+
+    def _tracked_delete_pod(self, owner, pod: Pod) -> None:
+        rt = pod.metadata.labels.get(LABEL_REPLICA_TYPE, "")
+        exp_key = naming.gen_expectation_pods_key(owner.key(), rt)
+        self.expectations.raise_expectations(exp_key, 0, 1)
+        if not self.pod_control.delete_pod(pod.namespace, pod.name, owner):
+            self.expectations.deletion_observed(exp_key)
+
+    def _tracked_delete_service(self, owner, svc: Service) -> None:
+        rt = svc.metadata.labels.get(LABEL_REPLICA_TYPE, "")
+        exp_key = naming.gen_expectation_services_key(owner.key(), rt)
+        self.expectations.raise_expectations(exp_key, 0, 1)
+        if not self.service_control.delete_service(
+                svc.namespace, svc.name, owner):
+            self.expectations.deletion_observed(exp_key)
+
+    def _tracked_create_pod(self, owner, pod: Pod, rtype: str) -> bool:
+        exp_key = naming.gen_expectation_pods_key(owner.key(), rtype)
+        self.expectations.raise_expectations(exp_key, 1, 0)
+        if not self.pod_control.create_pod(pod, owner):
+            # Creation failed: lower the expectation so the owner isn't
+            # stuck until the 5-minute expectation timeout.
+            self.expectations.creation_observed(exp_key)
+            return False
+        return True
+
+    def _tracked_create_service(self, owner, svc: Service,
+                                rtype: str) -> bool:
+        exp_key = naming.gen_expectation_services_key(owner.key(), rtype)
+        self.expectations.raise_expectations(exp_key, 1, 0)
+        if not self.service_control.create_service(svc, owner):
+            self.expectations.creation_observed(exp_key)
+            return False
+        return True
+
+    def _delete_out_of_range(
+        self, owner, objs, replicas: int, exp_key: str, delete_fn,
+        event_reason: str | None = None,
+    ) -> None:
+        """Delete pods/services whose replica-index is >= the current
+        count (elastic/autoscale scale-down), with delete-expectation
+        bookkeeping. Shared by both workload kinds."""
+        for obj in objs:
+            try:
+                idx = int(obj.metadata.labels.get(LABEL_REPLICA_INDEX, ""))
+            except ValueError:
+                continue
+            if idx < replicas:
+                continue
+            if event_reason:
+                self.cluster.record_event(
+                    self.OWNER_KIND, owner.namespace, owner.name, "Normal",
+                    event_reason,
+                    f"Deleting {obj.name}: index {idx} >= {replicas} "
+                    f"replicas",
+                )
+            self.expectations.raise_expectations(exp_key, 0, 1)
+            if not delete_fn(obj.metadata.namespace, obj.name, owner):
+                self.expectations.deletion_observed(exp_key)
+
+    def _claim(self, objs, job, updater: Callable | None):
         """Keep objects our controller ref owns; adopt label-matching orphans
         (ref service_ref_manager.go:83-160). Objects owned by another
         controller are left alone."""
@@ -285,11 +416,11 @@ class JobControllerBase:
 
     def run(self, workers: int = 1) -> None:
         self._stop.clear()
-        # Initial resync: jobs that existed before this controller was
+        # Initial resync: owners that existed before this controller was
         # constructed (operator restart, late leader) must still reconcile —
         # informer handlers only cover future events (WaitForCacheSync +
         # initial-list parity, controller.go:192).
-        for job in self.cluster.list_jobs():
+        for job in self._list_owners():
             self.enqueue(job.key())
         for i in range(workers):
             t = threading.Thread(

@@ -50,6 +50,7 @@ from tf_operator_tpu.api.types import (
     VolumeMount,
 )
 from tf_operator_tpu.core.cluster import (
+    KIND_INFSVC,
     KIND_JOB,
     KIND_POD,
     KIND_PODGROUP,
@@ -233,6 +234,73 @@ def job_status_from_dict(d: dict) -> JobStatus:
             failed=rs.get("failed", 0),
         )
     return status
+
+
+def infsvc_status_to_dict(status) -> dict:
+    """InferenceService status wire form. Like the TrainJob status, the
+    autoscaler's state (desiredReplicas + the lowLoadSince hysteresis
+    latch) must survive operator failover — a new leader serving the
+    spec floor would collapse a scaled-up service mid-burst."""
+    return {
+        "conditions": [
+            {
+                "type": str(c.type),
+                "status": "True" if c.status else "False",
+                "reason": c.reason,
+                "message": c.message,
+                "lastUpdateTime": c.last_update_time,
+                "lastTransitionTime": c.last_transition_time,
+            }
+            for c in status.conditions
+        ],
+        "replicas": status.replicas,
+        "readyReplicas": status.ready_replicas,
+        "desiredReplicas": status.desired_replicas,
+        "lastScaleTime": status.last_scale_time,
+        "lowLoadSince": status.low_load_since,
+        "restarts": status.restarts,
+        "startTime": status.start_time,
+    }
+
+
+def infsvc_status_from_dict(d: dict):
+    from tf_operator_tpu.api.types import InferenceServiceStatus
+
+    status = InferenceServiceStatus(
+        replicas=int(d.get("replicas") or 0),
+        ready_replicas=int(d.get("readyReplicas") or 0),
+        desired_replicas=d.get("desiredReplicas"),
+        last_scale_time=d.get("lastScaleTime"),
+        low_load_since=d.get("lowLoadSince"),
+        restarts=int(d.get("restarts") or 0),
+        start_time=d.get("startTime"),
+    )
+    for c in d.get("conditions") or []:
+        status.conditions.append(
+            JobCondition(
+                type=JobConditionType(c["type"]),
+                status=str(c.get("status")) == "True",
+                reason=c.get("reason", ""),
+                message=c.get("message", ""),
+                last_update_time=c.get("lastUpdateTime") or 0.0,
+                last_transition_time=c.get("lastTransitionTime") or 0.0,
+            )
+        )
+    return status
+
+
+def infsvc_to_k8s(svc) -> dict:
+    out = compat.infsvc_to_dict(svc)
+    out["metadata"] = _meta_to_dict(svc.metadata)
+    out["status"] = infsvc_status_to_dict(svc.status)
+    return _omit_nulls(out)
+
+
+def infsvc_from_k8s(d: dict):
+    svc = compat.infsvc_from_dict(d, apply_defaults=False)
+    svc.metadata = _meta_from_dict(d.get("metadata") or {})
+    svc.status = infsvc_status_from_dict(d.get("status") or {})
+    return svc
 
 
 def _omit_nulls(v):
@@ -897,6 +965,7 @@ class K8sCluster:
 
     _CODECS = {
         KIND_JOB: (job_to_k8s, job_from_k8s),
+        KIND_INFSVC: (infsvc_to_k8s, infsvc_from_k8s),
         KIND_POD: (pod_to_k8s, pod_from_k8s),
         KIND_SERVICE: (service_to_k8s, service_from_k8s),
         KIND_PODGROUP: (podgroup_to_k8s, podgroup_from_k8s),
@@ -929,6 +998,11 @@ class K8sCluster:
         if kind == KIND_JOB:
             return (f"/apis/{TrainJob.API_VERSION}/namespaces/{namespace}/"
                     f"{TrainJob.PLURAL}")
+        if kind == KIND_INFSVC:
+            from tf_operator_tpu.api.types import InferenceService
+
+            return (f"/apis/{InferenceService.API_VERSION}/namespaces/"
+                    f"{namespace}/{InferenceService.PLURAL}")
         if kind == KIND_PODGROUP:
             return f"/apis/{PODGROUP_API}/namespaces/{namespace}/podgroups"
         return f"/api/v1/namespaces/{namespace}/{self._RESOURCES[kind]}"
@@ -939,6 +1013,11 @@ class K8sCluster:
             return self._ns_path(kind, self.namespace)
         if kind == KIND_JOB:
             return f"/apis/{TrainJob.API_VERSION}/{TrainJob.PLURAL}"
+        if kind == KIND_INFSVC:
+            from tf_operator_tpu.api.types import InferenceService
+
+            return (f"/apis/{InferenceService.API_VERSION}/"
+                    f"{InferenceService.PLURAL}")
         if kind == KIND_PODGROUP:
             return f"/apis/{PODGROUP_API}/podgroups"
         return f"/api/v1/{self._RESOURCES[kind]}"
@@ -977,12 +1056,15 @@ class K8sCluster:
 
     # ------------------------------------------------------ informer mgmt
 
-    def start(self, kinds: tuple[str, ...] = (KIND_JOB, KIND_POD, KIND_SERVICE)) -> None:
+    def start(self, kinds: tuple[str, ...] = (
+            KIND_JOB, KIND_INFSVC, KIND_POD, KIND_SERVICE)) -> None:
         from tf_operator_tpu.core.controller import LABEL_GROUP_NAME
 
         own = {LABEL_GROUP_NAME: TrainJob.API_GROUP}
         for kind in kinds:
-            selector = None if kind == KIND_JOB else own
+            # Owner kinds (jobs, inference services) are unlabeled; the
+            # child kinds filter to our group's objects.
+            selector = None if kind in (KIND_JOB, KIND_INFSVC) else own
             inf = _Informer(self, kind, selector=selector)
             self._informers.append(inf)
             inf.start()
@@ -1128,6 +1210,45 @@ class K8sCluster:
 
     def list_jobs(self, namespace: str | None = None) -> list[TrainJob]:
         return self._list(KIND_JOB, namespace, None)
+
+    # ----------------------------------------- inference services (serve/)
+
+    def create_infsvc(self, svc):
+        return self._create(KIND_INFSVC, svc)
+
+    def get_infsvc(self, namespace: str, name: str):
+        return self._get(KIND_INFSVC, namespace, name)
+
+    def try_get_infsvc(self, namespace: str, name: str):
+        return self._try_get(KIND_INFSVC, namespace, name)
+
+    def update_infsvc(self, svc):
+        return self._update(KIND_INFSVC, svc)
+
+    def update_infsvc_status(self, svc):
+        """Same merge-patch discipline as update_job_status: the
+        controller owns status + its annotations; spec editors keep
+        their resourceVersion lane."""
+        if svc.metadata.annotations:
+            try:
+                self._patch(
+                    KIND_INFSVC, svc.namespace, svc.name,
+                    {"metadata": {"annotations":
+                                  dict(svc.metadata.annotations)}},
+                )
+            except NotFoundError:
+                pass
+        return self._patch(
+            KIND_INFSVC, svc.namespace, svc.name,
+            {"status": infsvc_status_to_dict(svc.status)},
+            subresource="status",
+        )
+
+    def delete_infsvc(self, namespace: str, name: str):
+        return self._delete(KIND_INFSVC, namespace, name)
+
+    def list_infsvcs(self, namespace: str | None = None) -> list:
+        return self._list(KIND_INFSVC, namespace, None)
 
     # ----------------------------------------------------------- pods
 

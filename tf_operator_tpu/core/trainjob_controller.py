@@ -97,8 +97,10 @@ class TrainJobController(ctrl.JobControllerBase):
         scheduler=None,
         queue_shards: int = 1,
         fleet_policy=None,
+        enqueue_router=None,
     ):
-        super().__init__(cluster, queue_shards=queue_shards)
+        super().__init__(cluster, queue_shards=queue_shards,
+                         enqueue_router=enqueue_router)
         self.enable_gang = enable_gang
         self.gang_scheduler_name = gang_scheduler_name
         # Fleet scheduler (sched.FleetScheduler): priority/quota/fair-share
@@ -266,19 +268,9 @@ class TrainJobController(ctrl.JobControllerBase):
                     "Suspended", f"Suspending: deleting {len(pods)} pod(s)",
                 )
             for pod in pods:
-                rt = pod.metadata.labels.get(ctrl.LABEL_REPLICA_TYPE, "")
-                exp_key = naming.gen_expectation_pods_key(key, rt)
-                self.expectations.raise_expectations(exp_key, 0, 1)
-                if not self.pod_control.delete_pod(pod.namespace, pod.name, job):
-                    self.expectations.deletion_observed(exp_key)
+                self._tracked_delete_pod(job, pod)
             for svc in services:
-                rt = svc.metadata.labels.get(ctrl.LABEL_REPLICA_TYPE, "")
-                exp_key = naming.gen_expectation_services_key(key, rt)
-                self.expectations.raise_expectations(exp_key, 0, 1)
-                if not self.service_control.delete_service(
-                    svc.namespace, svc.name, job
-                ):
-                    self.expectations.deletion_observed(exp_key)
+                self._tracked_delete_service(job, svc)
             if self.enable_gang:
                 gang.delete_podgroup(self.cluster, job)
             self._release_capacity(key)
@@ -393,19 +385,11 @@ class TrainJobController(ctrl.JobControllerBase):
                     f"Deleting pod {pod.name}: replica type {rt!r} removed "
                     f"from spec",
                 )
-                exp_key = naming.gen_expectation_pods_key(key, rt)
-                self.expectations.raise_expectations(exp_key, 0, 1)
-                if not self.pod_control.delete_pod(pod.namespace, pod.name, job):
-                    self.expectations.deletion_observed(exp_key)
+                self._tracked_delete_pod(job, pod)
         for svc in services:
             rt = svc.metadata.labels.get(ctrl.LABEL_REPLICA_TYPE, "")
             if rt and rt not in known:
-                exp_key = naming.gen_expectation_services_key(key, rt)
-                self.expectations.raise_expectations(exp_key, 0, 1)
-                if not self.service_control.delete_service(
-                    svc.namespace, svc.name, job
-                ):
-                    self.expectations.deletion_observed(exp_key)
+                self._tracked_delete_service(job, svc)
 
         # Stuck-Pending detection (recovery.pendingTimeoutSeconds): a pod
         # wedged in Pending — unschedulable slice, image pull failure —
@@ -680,9 +664,12 @@ class TrainJobController(ctrl.JobControllerBase):
                     "Queued", f"{msg} (position {decision.position})",
                 )
         if decision.preempting:
-            # Run the victim's eviction promptly (its own sync executes it
-            # through the graceful SIGTERM -> emergency-checkpoint path).
-            self.enqueue(decision.preempting)
+            # Run the victims' evictions promptly (each one's own sync
+            # executes it through the graceful SIGTERM -> emergency-
+            # checkpoint path); a victim may be a serve replica — route
+            # by key shape. k-victim preemption can mark several.
+            for victim in (decision.victims or (decision.preempting,)):
+                self.route_enqueue(victim)
         return SLICE_RETRY_DELAY_S + min(
             120.0, 0.25 * (decision.position or 0))
 
@@ -1353,11 +1340,7 @@ class TrainJobController(ctrl.JobControllerBase):
     def _delete_gang_pods(self, job: TrainJob, key: str,
                           doomed: list[Pod]) -> None:
         for pod in doomed:
-            rt = pod.metadata.labels.get(ctrl.LABEL_REPLICA_TYPE, "")
-            exp_key = naming.gen_expectation_pods_key(key, rt)
-            self.expectations.raise_expectations(exp_key, 0, 1)
-            if not self.pod_control.delete_pod(pod.namespace, pod.name, job):
-                self.expectations.deletion_observed(exp_key)
+            self._tracked_delete_pod(job, pod)
 
     # ---------------------------------------------------------- limit checks
 
@@ -1381,7 +1364,9 @@ class TrainJobController(ctrl.JobControllerBase):
         is O(n²) sync work at 10k concurrent jobs."""
         if self.scheduler is not None:
             for key in self.scheduler.kick_targets():
-                self.enqueue(key)
+                # A freed slice may serve the OTHER kind's waiter (a
+                # serve-replica claim): route by key shape.
+                self.route_enqueue(key)
             return
         try:
             jobs = self.cluster.list_jobs()
@@ -1540,7 +1525,7 @@ class TrainJobController(ctrl.JobControllerBase):
                     if masters_present
                     else (rtype is ReplicaType.WORKER and index == 0)
                 )
-                self._create_new_pod(job, rtype, index, spec, master_role, exp_key)
+                self._create_new_pod(job, rtype, index, spec, master_role)
                 continue
             if len(pod_slice) > 1:
                 # Duplicate index: keep the oldest, delete the rest.
@@ -1616,29 +1601,6 @@ class TrainJobController(ctrl.JobControllerBase):
             job, rtype, replicas, restart, worker0_completed, self._now()
         )
 
-    def _delete_out_of_range(
-        self, job: TrainJob, objs, replicas: int, exp_key: str, delete_fn,
-        event_reason: str | None = None,
-    ) -> None:
-        """Delete pods/services whose replica-index is >= the current count
-        (elastic scale-down), with delete-expectation bookkeeping."""
-        for obj in objs:
-            try:
-                idx = int(obj.metadata.labels.get(ctrl.LABEL_REPLICA_INDEX, ""))
-            except ValueError:
-                continue
-            if idx < replicas:
-                continue
-            if event_reason:
-                self.cluster.record_event(
-                    TrainJob.KIND, job.namespace, job.name, "Normal",
-                    event_reason,
-                    f"Deleting {obj.name}: index {idx} >= {replicas} replicas",
-                )
-            self.expectations.raise_expectations(exp_key, 0, 1)
-            if not delete_fn(obj.metadata.namespace, obj.name, job):
-                self.expectations.deletion_observed(exp_key)
-
     def _worker0_completed(self, job: TrainJob, pods: list[Pod]) -> bool:
         """worker-0 success detection (pod.go:159-162)."""
         for pod in self.filter_pods_for_replica_type(pods, str(ReplicaType.WORKER)):
@@ -1657,11 +1619,8 @@ class TrainJobController(ctrl.JobControllerBase):
         index: int,
         spec: ReplicaSpec,
         master_role: bool,
-        exp_key: str,
     ) -> None:
         """createNewPod (pod.go:171-258)."""
-        self.expectations.raise_expectations(exp_key, 1, 0)
-
         template = copy.deepcopy(spec.template)
         labels = {
             **template.labels,
@@ -1725,10 +1684,7 @@ class TrainJobController(ctrl.JobControllerBase):
             spec=template,
             scheduler_name=scheduler_name,
         )
-        if not self.pod_control.create_pod(pod, job):
-            # Creation failed: lower the expectation so the job isn't stuck
-            # until the 5-minute expectation timeout.
-            self.expectations.creation_observed(exp_key)
+        self._tracked_create_pod(job, pod, str(rtype))
 
     # ------------------------------------------------------------- services
 
@@ -1750,7 +1706,6 @@ class TrainJobController(ctrl.JobControllerBase):
         for index, svc_slice in enumerate(slices):
             if svc_slice:
                 continue
-            self.expectations.raise_expectations(exp_key, 1, 0)
             name = naming.gen_general_name(job.name, str(rtype), index)
             selector = {
                 **ctrl.gen_labels(job.name),
@@ -1775,5 +1730,4 @@ class TrainJobController(ctrl.JobControllerBase):
                     ),
                 ],
             )
-            if not self.service_control.create_service(svc, job):
-                self.expectations.creation_observed(exp_key)
+            self._tracked_create_service(job, svc, str(rtype))

@@ -442,6 +442,45 @@ def latest_step(ckpt_dir: str) -> int | None:
     return steps[-1] if steps else None
 
 
+def latest_valid_checkpoint(
+    ckpt_dir: str, template_shapes: dict[str, list[int]] | None = None,
+) -> int | None:
+    """The newest step that passes the resume walk's VALIDATION — the
+    public consumer surface (serving restore, tooling) of the trainer's
+    backward walk, so no consumer can ever load a checkpoint the trainer
+    itself would skip (a raw `latest_step` can name a torn save).
+
+    Walks list_steps newest-first:
+      * a step whose census manifest fails validate_step (torn write,
+        truncated leaf, missing file) is skipped — exactly the trainer's
+        `invalid_checkpoint` fallback;
+      * with `template_shapes` ({leaf path: global shape}, the shape of
+        the model the caller intends to apply), a step whose sharding
+        manifest records DIFFERENT per-leaf global shapes is skipped —
+        the trainer's `reshard_shape_mismatch` gate (a model-config
+        change, not a restorable candidate). Steps with no sharding
+        manifest (pre-manifest/hand-written) get the same grace as in
+        the resume walk: unverifiable, not invalid.
+
+    Foreign GANG shapes (different process count/mesh) are deliberately
+    NOT skipped: the trees this repo checkpoints are host snapshots of
+    fully-replicated leaves, so a single-process consumer restores them
+    regardless of the saving gang's shape (the same property PR 9's
+    reshard path relies on). Returns None when nothing validates."""
+    for s in reversed(list_steps(ckpt_dir)):
+        if not validate_step(ckpt_dir, s):
+            continue
+        if template_shapes is not None:
+            sm = read_sharding_manifest(ckpt_dir, f"step_{s}")
+            if sm is not None and sm.get("leaves"):
+                saved = {k: v.get("shape")
+                         for k, v in sm["leaves"].items()}
+                if saved != template_shapes:
+                    continue
+        return s
+    return None
+
+
 def mark_final(ckpt_dir: str, step: int) -> None:
     tmp = os.path.join(ckpt_dir, ".FINAL.tmp")
     with open(tmp, "w") as f:

@@ -54,6 +54,7 @@ restart) and both sides replay forward deterministically.
 from __future__ import annotations
 
 import json
+import itertools
 import os
 import threading
 import time
@@ -169,6 +170,10 @@ class _Pending:
     submitted: int = 0  # own microbatches handed to the engine
 
 
+# Per-process generation sequence (see DcnExchange._gen).
+_GEN_SEQ = itertools.count(1)
+
+
 class DcnExchange:
     """Bucketed cross-slice gradient all-reduce over the emulated DCN.
 
@@ -192,7 +197,15 @@ class DcnExchange:
         self.microbatches = max(1, microbatches)
         self.num_buckets = max(1, buckets)
         self.peer_timeout_s = peer_timeout_s
-        self._gen = f"{os.getpid():x}-{int(time.time() * 1e3) & 0xffffffff:x}"
+        # Generation token: unique ACROSS processes (pid + wall ms) and —
+        # via the per-process counter — across constructions inside one
+        # process. Millisecond resolution alone collided on a warm host
+        # (two exchanges built < 1 ms apart read as the SAME generation,
+        # so the peers' restart detection never fired and the survivor
+        # held until the peer timeout — a real in-process-restart/e2e
+        # hazard, found as a now-you-see-it tier-1 flake in round 17).
+        self._gen = (f"{os.getpid():x}-{next(_GEN_SEQ):x}-"
+                     f"{int(time.time() * 1e3) & 0xffffffff:x}")
         self._resume_step = resume_step
         self._cond = threading.Condition()
         self._queue: list[tuple[int, int, list]] = []  # (step, m, leaves)
@@ -514,11 +527,22 @@ class DcnExchange:
             # checkpoint already contains N's result, we re-restore it and
             # continue at N+1 — waiting instead would stall both sides
             # until the peer timeout and roll the whole job.
-            if resume <= p.step:
-                with self._cond:
-                    if self._rewind is None:
-                        self._rewind = SliceRewind(resume, sid)
-                        self._cond.notify_all()
+            #
+            # Judged against the LIVE pending step, not the engine's `p`
+            # snapshot: the step loop can begin_step(N+1) while this scan
+            # still works the completed step-N object, and evaluating the
+            # one-shot generation change against the stale step swallows
+            # it (`resume > p.step` looks like a restart AHEAD of us, the
+            # new gen becomes the baseline, and the real `resume <= N+1`
+            # comparison never happens — the survivor then holds until
+            # the peer timeout; found as a host-speed-dependent flake of
+            # test_rewind_when_peer_resumes_at_pending_step, round 17).
+            with self._cond:
+                live = self._pending
+                step_ref = live.step if live is not None else p.step
+                if resume <= step_ref and self._rewind is None:
+                    self._rewind = SliceRewind(resume, sid)
+                    self._cond.notify_all()
 
     def _prune(self, older_than_step: int) -> None:
         """Bound the rendezvous dir: drop OWN bucket files for steps well

@@ -336,12 +336,18 @@ class LocalProcessRuntime:
         (they are the append-only post-mortem record)."""
         if not self.log_dir:
             return
-        try:
-            os.unlink(os.path.join(
-                self.log_dir, f"{pod.namespace}_{pod.name}.heartbeat.json"
-            ))
-        except OSError:
-            pass
+        for suffix in ("heartbeat.json", "serve.json"):
+            # serve.json rides the same rule: a deleted replica's stale
+            # inflight snapshot would keep inflating the autoscaler's
+            # load sum (the controller also filters by live pods — this
+            # is the belt to that suspender).
+            try:
+                os.unlink(os.path.join(
+                    self.log_dir,
+                    f"{pod.namespace}_{pod.name}.{suffix}"
+                ))
+            except OSError:
+                pass
 
     def _await_drained(self, ns: str, job: str, grace: float = 5.0,
                        timeout: float = 12.0) -> None:
@@ -404,6 +410,12 @@ class LocalProcessRuntime:
                 env["TPUJOB_LISTEN_PORT"] = str(tf_local)
             if coord_local is not None:
                 env["TPUJOB_COORD_LISTEN_PORT"] = str(coord_local)
+            # Serving replicas (serve/server.py): the localhost port the
+            # replica's serve-port DNS identity was rewritten to.
+            serve_local = pm.local_port(
+                own_host, port_by_name.get("serve-port", 8500))
+            if serve_local is not None:
+                env["TPUJOB_SERVE_LISTEN_PORT"] = str(serve_local)
         env.update(self.env_overrides)
         # Per-pod trainer event file beside the pod's log: the operator's
         # telemetry collector reads it back into the job's API `telemetry`
@@ -421,6 +433,14 @@ class LocalProcessRuntime:
         if self.log_dir and not env.get("TPUJOB_HEARTBEAT_FILE"):
             env["TPUJOB_HEARTBEAT_FILE"] = os.path.join(
                 self.log_dir, f"{pod.namespace}_{pod.name}.heartbeat.json"
+            )
+        # Serve stats (serve/server.py, same pattern): the server
+        # os.replace's its {inflight, latency} snapshot here; the
+        # collector reads it back as the autoscaler's load signal.
+        # Trainers simply never write it.
+        if self.log_dir and not env.get("TPUJOB_SERVE_STATS_FILE"):
+            env["TPUJOB_SERVE_STATS_FILE"] = os.path.join(
+                self.log_dir, f"{pod.namespace}_{pod.name}.serve.json"
             )
         # Multi-slice DCN rendezvous (parallel/multislice.py): one shared
         # directory per JOB INSTANCE — the operator-injected epoch token

@@ -52,16 +52,43 @@ class LocalSession:
         # scheduler (sched.FleetScheduler): priority/quota/fair-share
         # admission + graceful preemption over the slice fleet.
         self.scheduler = scheduler
+        # Cross-kind enqueue routing: TrainJob and InferenceService share
+        # the scheduler/allocator, so a freed slice's kick targets (and
+        # preemption victims) may belong to either controller — one
+        # shared router definition (core.controller.make_enqueue_router).
+        from tf_operator_tpu.core.controller import make_enqueue_router
+
+        train_ref: list = []
+        serve_ref: list = []
+        _route = make_enqueue_router(train_ref, serve_ref)
+
         self.controller = TrainJobController(
             self.cluster, enable_gang=enable_gang,
             slice_allocator=slice_allocator,
             heartbeat_source=self.telemetry,
             scheduler=scheduler,
+            enqueue_router=_route,
         )
+        train_ref.append(self.controller)
+        # The second workload kind, through the same generic base +
+        # shared capacity plane (serve/controller.py).
+        from tf_operator_tpu.serve.controller import (
+            InferenceServiceController,
+        )
+
+        self.serve_controller = InferenceServiceController(
+            self.cluster,
+            slice_allocator=slice_allocator,
+            scheduler=scheduler,
+            heartbeat_source=self.telemetry,
+            enqueue_router=_route,
+        )
+        serve_ref.append(self.serve_controller)
         self.runtime = LocalProcessRuntime(
             self.cluster, env_overrides=env_overrides, log_dir=log_dir
         )
         self.controller.run(workers=workers)
+        self.serve_controller.run(workers=1)
 
     # ------------------------------------------------------------- client API
 
@@ -96,6 +123,45 @@ class LocalSession:
             f"job {namespace}/{name} did not reach {[str(c) for c in conditions]} "
             f"within {timeout}s"
         )
+
+    # ------------------------------------------------- InferenceService API
+
+    def submit_service(self, svc):
+        return self.cluster.create_infsvc(svc)
+
+    def get_service(self, namespace: str, name: str):
+        return self.cluster.try_get_infsvc(namespace, name)
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        self.cluster.delete_infsvc(namespace, name)
+
+    def wait_for_service_condition(
+        self,
+        namespace: str,
+        name: str,
+        conditions: tuple[JobConditionType, ...],
+        timeout: float = 60.0,
+        poll: float = 0.05,
+    ):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            svc = self.cluster.try_get_infsvc(namespace, name)
+            if svc is not None:
+                for c in svc.status.conditions:
+                    if c.status and c.type in conditions:
+                        return svc
+            time.sleep(poll)
+        raise TimeoutError_(
+            f"service {namespace}/{name} did not reach "
+            f"{[str(c) for c in conditions]} within {timeout}s"
+        )
+
+    def server_address(self, service: str, namespace: str, index: int,
+                       port: int = 8500) -> str | None:
+        """127.0.0.1:port address of one serving replica (the serve-port
+        localhost rewrite, same port-map contract as replica_address)."""
+        return self.replica_address(service, namespace, "server", index,
+                                    port=port)
 
     def wait_for_delete(self, namespace: str, name: str, timeout: float = 60.0) -> None:
         deadline = time.monotonic() + timeout
@@ -167,6 +233,7 @@ class LocalSession:
     def close(self) -> None:
         self.runtime.stop()
         self.controller.stop()
+        self.serve_controller.stop()
 
     def __enter__(self) -> "LocalSession":
         return self

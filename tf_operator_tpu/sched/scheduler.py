@@ -64,6 +64,11 @@ class Decision:
     reason: str = ""
     position: int | None = None
     preempting: str | None = None
+    # ALL victims marked on this job's behalf (k-victim preemption: a
+    # multi-slice arrival may need k cheapest evictions to close its
+    # gap). `preempting` stays the first victim for back-compat; the
+    # controller enqueues every entry here.
+    victims: tuple[str, ...] = ()
 
 
 @dataclass
@@ -326,18 +331,26 @@ class FleetScheduler:
                     rj, rs = reserved.get(e.namespace, (0, 0))
                     reserved[e.namespace] = (rj + 1, rs + e_need)
                 elif mine:
-                    victim = None
-                    # A multi-slice waiter preempts only when ONE eviction
-                    # closes the gap (free == need-1): evicting k victims
-                    # for one arrival would thrash k healthy gangs while
-                    # the atomicity rule holds nothing in between.
-                    if (not probe and cls not in blocked_classes
-                            and free.get(cls, 0) >= entry.slices - 1):
-                        victim = self._maybe_preempt_locked(entry, cls, now)
+                    victims: tuple[str, ...] = ()
+                    # k-victim preemption: an N-slice arrival behind k
+                    # smaller lower-priority gangs picks the k CHEAPEST
+                    # victims whose combined slices close its gap
+                    # (gap-of-one was the old rule — a high-priority
+                    # 2-slice arrival behind two 1-slice low jobs waited
+                    # forever). If no victim set can close the gap, NONE
+                    # is marked: evicting gangs that cannot unblock the
+                    # arrival would be pure thrash (the atomicity rule
+                    # holds nothing in between).
+                    if not probe and cls not in blocked_classes:
+                        gap = entry.slices - free.get(cls, 0)
+                        victims = self._maybe_preempt_locked(
+                            entry, cls, now, gap)
                     return Decision(
                         admit=False,
-                        reason="preempting" if victim else "capacity",
-                        position=pos, preempting=victim)
+                        reason="preempting" if victims else "capacity",
+                        position=pos,
+                        preempting=victims[0] if victims else None,
+                        victims=victims)
                 else:
                     # A higher-ranked eligible waiter is capacity-blocked
                     # on this class: lower-ranked same-class jobs must not
@@ -412,16 +425,25 @@ class FleetScheduler:
         return Decision(admit=True, slice_id=sid)
 
     def _maybe_preempt_locked(self, entry: QueueEntry, cls: tuple[str, int],
-                              now: float) -> str | None:
-        """Pick (and mark) a victim for `entry`, or return the one already
-        marked on its behalf. None when preemption is not allowed or no
-        eligible victim exists."""
-        for victim, preemptor in self._evictions.items():
-            if preemptor == entry.key:
-                return victim  # one eviction in flight per preemptor
+                              now: float, gap: int = 1) -> tuple[str, ...]:
+        """Pick (and mark) the CHEAPEST victim set whose combined slices
+        close `gap`, or return the set already marked on this preemptor's
+        behalf. Empty when preemption is not allowed or no eligible set
+        can close the gap (then nothing is marked — partial eviction
+        would thrash healthy gangs without unblocking the arrival)."""
+        if gap < 1:
+            return ()
+        marked = tuple(sorted(
+            victim for victim, preemptor in self._evictions.items()
+            if preemptor == entry.key))
+        if marked:
+            # One eviction SET in flight per preemptor: the marked
+            # victims drain first; a shortfall (capacity shifted under
+            # us) re-evaluates once they are gone.
+            return marked
         pc = self.policy.resolve(entry.priority_class)
         if pc.preemption_policy != PREEMPT_LOWER:
-            return None
+            return ()
         cooldown = self.policy.preemption_cooldown_seconds
         cands = [
             (k, r) for k, r in self._running.items()
@@ -429,16 +451,36 @@ class FleetScheduler:
             and k not in self._evictions
             and now - r.admitted_at >= cooldown
         ]
-        if not cands:
-            return None
-        # Cheapest victim: lowest priority, then smallest slice, then the
-        # youngest admission (least progress lost).
-        victim = min(cands,
-                     key=lambda kr: (kr[1].priority, kr[1].chips,
-                                     -kr[1].admitted_at))[0]
-        self._evictions[victim] = entry.key
-        self.stats["preemptions_requested"] += 1
-        return victim
+        # Cheapest first: lowest priority, then smallest slice, then the
+        # youngest admission (least work lost); greedily take until the
+        # gap closes.
+        cands.sort(key=lambda kr: (kr[1].priority, kr[1].chips,
+                                   -kr[1].admitted_at))
+        chosen: list[tuple[str, _Running]] = []
+        freed = 0
+        for k, r in cands:
+            chosen.append((k, r))
+            freed += r.slices
+            if freed >= gap:
+                break
+        if freed < gap:
+            return ()  # unclosable gap: mark nothing
+        # Minimality pass: greedy cheapest-first can pick a small victim
+        # and THEN a multi-slice one that alone covers the gap — drop any
+        # victim whose eviction is no longer needed (cheapest dropped
+        # first), so nothing is thrashed beyond what unblocks the
+        # arrival.
+        kept: list[tuple[str, _Running]] = []
+        for i, (k, r) in enumerate(chosen):
+            rest = sum(r2.slices for _, r2 in chosen[i + 1:])
+            have = sum(r2.slices for _, r2 in kept)
+            if have + rest >= gap:
+                continue  # redundant victim: the rest covers the gap
+            kept.append((k, r))
+        for k, _ in kept:
+            self._evictions[k] = entry.key
+        self.stats["preemptions_requested"] += len(kept)
+        return tuple(k for k, _ in kept)
 
     # ----------------------------------------------------- state transitions
 

@@ -1,0 +1,11 @@
+"""Serving workload layer (ROADMAP item 5): the InferenceService kind.
+
+  controller.py  — operator-side reconcile (stateless replicas, rolling
+                   replace, per-replica slice admission through the shared
+                   FleetScheduler/SliceAllocator, autoscale tick)
+  autoscale.py   — the pure desired-replica/hysteresis math
+  server.py      — the in-pod batch inference server (jitted forward,
+                   micro-batch assembly, per-request demux)
+"""
+
+from tf_operator_tpu.serve.autoscale import ScalePlan, plan_replicas  # noqa: F401

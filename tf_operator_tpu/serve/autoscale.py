@@ -1,0 +1,74 @@
+"""Autoscaler math: load signal -> desired replica count, with hysteresis.
+
+Pure functions over plain values so the policy is unit-testable without a
+controller: the reconcile tick feeds in the collector's total inflight and
+the persisted hysteresis latch, and applies whatever comes back.
+
+The policy (docs/serving.md "Autoscaling"):
+
+  raw = ceil(total_inflight / targetInflightPerReplica), clamped to
+        [minReplicas, maxReplicas]
+
+  * raw > current: scale UP immediately (queued requests are latency).
+  * raw < current: scale DOWN only after the computed target has stayed
+    below the current count for scaleDownStabilizationSeconds without
+    interruption — `low_load_since` latches the first low sample and any
+    sample at/above current clears it. A bursty load must not thrash
+    replicas (each scale-up pays a checkpoint load + jit compile).
+  * raw == current: steady; the latch clears.
+
+The latch is PERSISTED in status.low_load_since (wire: lowLoadSince) so an
+operator failover mid-stabilization neither resets the window (slow-leak
+scale-down forever) nor scales down instantly (no window at all).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+
+@dataclass
+class ScalePlan:
+    """One autoscale tick's verdict."""
+
+    desired: int                 # target after this tick
+    raw: int                     # clamped load-derived target, pre-hysteresis
+    low_load_since: float | None  # updated stabilization latch
+    changed: bool                # desired != current (a scale event)
+
+
+def raw_target(total_inflight: float, target_per_replica: float,
+               min_replicas: int, max_replicas: int) -> int:
+    """The clamped load-derived replica target (no hysteresis)."""
+    if target_per_replica <= 0:  # validation rejects this; stay safe
+        return min_replicas
+    want = math.ceil(max(0.0, total_inflight) / target_per_replica)
+    return max(min_replicas, min(max_replicas, want))
+
+
+def plan_replicas(current: int, total_inflight: float, *,
+                  target_per_replica: float, min_replicas: int,
+                  max_replicas: int, stabilization_s: float,
+                  low_load_since: float | None, now: float) -> ScalePlan:
+    """One tick of the autoscale policy (see module docstring)."""
+    raw = raw_target(total_inflight, target_per_replica,
+                     min_replicas, max_replicas)
+    current = max(min_replicas, min(max_replicas, current))
+    if raw > current:
+        return ScalePlan(desired=raw, raw=raw, low_load_since=None,
+                         changed=True)
+    if raw == current:
+        return ScalePlan(desired=current, raw=raw, low_load_since=None,
+                         changed=False)
+    # raw < current: hold until the low signal has been sustained.
+    if low_load_since is None:
+        return ScalePlan(desired=current, raw=raw, low_load_since=now,
+                         changed=False)
+    if now - low_load_since >= stabilization_s:
+        # Apply the CURRENT sample (not the lowest seen): the most recent
+        # load is the best estimate of what the service needs now.
+        return ScalePlan(desired=raw, raw=raw, low_load_since=None,
+                         changed=True)
+    return ScalePlan(desired=current, raw=raw,
+                     low_load_since=low_load_since, changed=False)

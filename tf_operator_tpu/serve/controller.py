@@ -1,0 +1,818 @@
+"""InferenceService controller: stateless serving replicas through the
+generic controller layer.
+
+The second workload kind the JobControllerBase reconciles (ROADMAP item 5
+— the proof the L4 port is genuinely framework-agnostic). Semantics are
+deliberately NOT gang semantics:
+
+  * per-replica restart — a failed server pod is replaced alone (stateless
+    serving has no collective to wedge); restarts are counted for
+    visibility, never against a backoff limit (serving must stay up);
+  * rolling replace on spec change — at most ONE stale-hash live replica
+    is deleted per sync, so a config rollout never drops the whole
+    service below capacity at once;
+  * per-replica slice admission — each replica claims ONE slice
+    (`{ns}/{name}#r{i}` claim keys) through the SAME FleetScheduler /
+    SliceAllocator train jobs use, so train and serve compete under one
+    priority/quota/preemption regime (a serve replica can be preempted by
+    a higher-priority train job, and vice versa);
+  * autoscaling — a reconcile tick reads per-replica inflight from the
+    telemetry collector and resizes through the NORMAL reconcile path
+    (serve/autoscale.py is the pure policy; scale events + status
+    replicas/readyReplicas/desiredReplicas are wire-persisted).
+
+The train->serve handoff: `spec.model.fromTrainJob` resolves the finished
+job's --checkpoint-dir (and --model) from its Worker command line; the
+server process then loads the newest VALIDATED checkpoint via
+models/checkpoint.latest_valid_checkpoint — the same torn/corrupt census
+validation the trainer's own resume walk applies.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+import time
+
+from tf_operator_tpu.api import compat as api_compat
+from tf_operator_tpu.api import defaults as api_defaults
+from tf_operator_tpu.api import validation as api_validation
+from tf_operator_tpu.api.types import (
+    InferenceService,
+    JobConditionType,
+    ObjectMeta,
+    ReplicaType,
+    RunPolicy,
+    TrainJob,
+    TrainJobSpec,
+    has_condition,
+    is_succeeded,
+)
+from tf_operator_tpu.core import controller as ctrl
+from tf_operator_tpu.core.cluster import (
+    InMemoryCluster,
+    Pod,
+    PodPhase,
+    Service,
+    ServicePort,
+)
+from tf_operator_tpu.status import engine as status_engine
+from tf_operator_tpu.status import metrics
+from tf_operator_tpu.utils import naming
+from tf_operator_tpu.utils.exit_codes import (
+    EXIT_USER_RETRYABLE,
+    is_signal_exit,
+)
+
+# The one replica type of a serving workload. Lowercase is the label/DNS
+# form (pods are `{name}-server-{i}`), matching the trainer vocabulary.
+SERVER_REPLICA = "server"
+
+# Condition reasons (stable API surface, like status/engine.py's).
+REASON_CREATED = "InferenceServiceCreated"
+REASON_READY = "InferenceServiceReady"
+REASON_INVALID = "InferenceServiceFailedValidation"
+REASON_WAITING_JOB = "WaitingForTrainJob"
+REASON_TRAINJOB_FAILED = "FromTrainJobFailed"
+REASON_SCALED = "Autoscaled"
+REASON_QUEUED = "WaitingForCapacity"
+REASON_PREEMPTED = "PreemptedByHigherPriority"
+
+SLICE_RETRY_DELAY_S = 15.0
+# Autoscale re-tick while pods serve: load changes without pod events, so
+# the controller polls the collector on this cadence (only while an
+# autoscale RANGE exists — fixed-size services pay nothing).
+AUTOSCALE_TICK_S = 1.0
+# Env the controller injects into server pods (serve/server.py reads them).
+ENV_CKPT_DIR = "TPUJOB_SERVE_CHECKPOINT_DIR"
+ENV_MODEL = "TPUJOB_SERVE_MODEL"
+ENV_PORT = "TPUJOB_SERVE_PORT"
+ENV_BATCH_MAX = "TPUJOB_SERVE_BATCH_MAX"
+ENV_BATCH_TIMEOUT_MS = "TPUJOB_SERVE_BATCH_TIMEOUT_MS"
+ENV_ENDPOINT = "TPUJOB_SERVE_ENDPOINT"
+# fromTrainJob resolution cache (annotations, persisted with status): a
+# service that already resolved — and may already be SERVING — must not
+# wedge when the finished TrainJob is later deleted (routine cleanup).
+ANNOTATION_RESOLVED_CKPT = "tpujob.dev/resolved-checkpoint-dir"
+ANNOTATION_RESOLVED_MODEL = "tpujob.dev/resolved-model"
+
+
+def serve_spec_hash(svc: InferenceService) -> str:
+    """Fingerprint of everything a server POD derives from the spec
+    (model source, serving knobs, template, tpu class) — the serving
+    analogue of cluster_spec.tf_config.topology_hash. Autoscale and
+    scheduling knobs are deliberately EXCLUDED: a changed replica range
+    or queue must not roll healthy replicas."""
+    d = api_compat.infsvc_to_dict(svc)["spec"]
+    d.pop("autoscale", None)
+    d.pop("schedulingPolicy", None)
+    blob = json.dumps(d, sort_keys=True, default=str).encode()
+    return hashlib.sha1(blob).hexdigest()[:10]
+
+
+def _arg_value(argv: list[str], flag: str) -> str | None:
+    """`--flag=X` or `--flag X` from a command/args list."""
+    for i, a in enumerate(argv):
+        if a.startswith(flag + "="):
+            return a.split("=", 1)[1]
+        if a == flag and i + 1 < len(argv):
+            return argv[i + 1]
+    return None
+
+
+class InferenceServiceController(ctrl.JobControllerBase):
+    OWNER_KIND = InferenceService.KIND
+
+    def __init__(
+        self,
+        cluster: InMemoryCluster,
+        slice_allocator=None,
+        scheduler=None,
+        heartbeat_source=None,
+        fleet_policy=None,
+        queue_shards: int = 1,
+        enqueue_router=None,
+    ):
+        super().__init__(cluster, queue_shards=queue_shards,
+                         enqueue_router=enqueue_router)
+        self.scheduler = scheduler
+        if scheduler is not None and slice_allocator is None:
+            slice_allocator = scheduler.allocator
+        self.slice_allocator = slice_allocator
+        self.fleet_policy = fleet_policy or (
+            scheduler.policy if scheduler is not None else None)
+        # TelemetryCollector (or anything with job_heartbeat/service_load):
+        # drives the autoscaler and the per-replica hang watchdog.
+        self.heartbeat_source = heartbeat_source
+        self._now = time.time
+        # claim keys this controller has taken per service (in-memory,
+        # like the scheduler's own state: rebuilt from syncs after a
+        # failover — claims re-admit idempotently by holder key).
+        self._claims: dict[str, set[str]] = {}
+        # eviction drains in flight: claim keys whose pod we already
+        # deleted for a preemption (requeue fires once the pod is gone).
+        self._evicting: set[str] = set()
+
+    # ---- owner accessors (the whole per-kind surface of the base) ----
+
+    def _try_get_owner(self, namespace: str, name: str):
+        return self.cluster.try_get_infsvc(namespace, name)
+
+    def _list_owners(self) -> list:
+        return self.cluster.list_infsvcs()
+
+    def _owner_replica_types(self, obj) -> list[str]:
+        return [SERVER_REPLICA]
+
+    # --------------------------------------------------------------- sync
+
+    def sync_job(self, key: str) -> None:
+        metrics.reconcile_total.inc()
+        ns, name = naming.split_job_key(key)
+        shared = self.cluster.try_get_infsvc(ns, name)
+        if shared is None:
+            self.expectations.delete_expectations(
+                naming.gen_expectation_pods_key(key, SERVER_REPLICA))
+            self.expectations.delete_expectations(
+                naming.gen_expectation_services_key(key, SERVER_REPLICA))
+            self._release_all_claims(key)
+            metrics.serve_ready_replicas.remove(namespace=ns, service=name)
+            return
+
+        svc = shared.deep_copy()
+        api_defaults.set_infsvc_defaults(svc)
+
+        problems = api_validation.validate_inference_service(
+            svc, fleet=self.fleet_policy)
+        if problems:
+            msg = "; ".join(problems)
+            self.cluster.record_event(
+                InferenceService.KIND, ns, name, "Warning",
+                REASON_INVALID, msg)
+            if status_engine.set_condition(
+                svc.status, JobConditionType.FAILED, REASON_INVALID, msg,
+                self._now(),
+            ):
+                self.cluster.update_infsvc_status(svc)
+            return
+
+        if not self.expectations.satisfied(
+            naming.gen_expectation_pods_key(key, SERVER_REPLICA)
+        ) or not self.expectations.satisfied(
+            naming.gen_expectation_services_key(key, SERVER_REPLICA)
+        ):
+            return
+
+        self.reconcile(svc)
+
+    # ---------------------------------------------------------- reconcile
+
+    def reconcile(self, svc: InferenceService) -> None:
+        key = svc.key()
+        now = self._now()
+        old_status = copy.deepcopy(svc.status)
+        old_annotations = dict(svc.metadata.annotations)
+        status_engine.set_condition(
+            svc.status, JobConditionType.CREATED, REASON_CREATED,
+            f"InferenceService {key} is created.", now)
+        if svc.status.start_time is None:
+            svc.status.start_time = now
+
+        pods = self.get_pods_for_job(svc)
+        services = self.get_services_for_job(svc)
+
+        if has_condition(svc.status, JobConditionType.FAILED):
+            for pod in pods:
+                self._tracked_delete_pod(svc, pod)
+            for s in services:
+                self._tracked_delete_service(svc, s)
+            self._release_all_claims(key)
+            if svc.status != old_status:
+                self.cluster.update_infsvc_status(svc)
+            return
+
+        # Train->serve handoff: resolve the checkpoint source before any
+        # pod exists (server pods bake it into their env).
+        resolved = self._resolve_model(svc, key)
+        if resolved is None:
+            if (svc.status != old_status
+                    or svc.metadata.annotations != old_annotations):
+                self.cluster.update_infsvc_status(svc)
+            return
+        ckpt_dir, model_name = resolved
+
+        desired = svc.status.desired_replicas
+        if desired is None:
+            desired = svc.spec.autoscale.min_replicas
+        # A spec edit may have moved the replica range: the persisted
+        # target re-clamps into [min, max] (a shrunken range must
+        # actually shrink the fleet).
+        desired = max(svc.spec.autoscale.min_replicas,
+                      min(svc.spec.autoscale.max_replicas, desired))
+        svc.status.desired_replicas = desired
+
+        live = [p for p in pods if not p.is_finished()]
+
+        # Autoscale BEFORE the replica loop so this sync reconciles
+        # toward the fresh target.
+        desired = self._autoscale_tick(svc, key, live, desired, now)
+
+        # Preemption drains: a claim whose eviction we executed requeues
+        # once its pod is gone (stateless — no checkpoint drain latch).
+        # A pass that ACTED stops here, like the TrainJob preemption
+        # tick: folding the now-stale pod list into status would set a
+        # Running condition that displaces the fresh Preempted record.
+        if self._eviction_tick(svc, key, pods):
+            if (svc.status != old_status
+                    or svc.metadata.annotations != old_annotations):
+                svc.status.last_reconcile_time = now
+                self.cluster.update_infsvc_status(svc)
+            return
+
+        # Per-replica hang watchdog (serving.heartbeatTimeoutSeconds).
+        self._watchdog_tick(svc, key, live, now)
+
+        spec_hash = serve_spec_hash(svc)
+        exp_pods = naming.gen_expectation_pods_key(key, SERVER_REPLICA)
+        exp_svcs = naming.gen_expectation_services_key(key, SERVER_REPLICA)
+
+        # Scale-down: replicas beyond the target go away, claims released.
+        self._delete_out_of_range(
+            svc, self.filter_pods_for_replica_type(pods, SERVER_REPLICA),
+            desired, exp_pods, self.pod_control.delete_pod,
+            event_reason="ScaleDown")
+        self._delete_out_of_range(
+            svc, self.filter_services_for_replica_type(
+                services, SERVER_REPLICA),
+            desired, exp_svcs, self.service_control.delete_service)
+        # Release by TRACKED claims, not the current spec range: a spec
+        # edit may have shrunk maxReplicas below indices already held.
+        # Drain-gated (same discipline as preemption and the PR-9
+        # hold-both rule): the claim frees — and waiters are kicked —
+        # only once NO pod object of that index remains, so a waiter can
+        # never admit onto chips a terminating server still occupies (on
+        # K8s a pod sits in Terminating until its process exits).
+        held_indices = {
+            p.metadata.labels.get(ctrl.LABEL_REPLICA_INDEX)
+            for p in self.filter_pods_for_replica_type(pods,
+                                                       SERVER_REPLICA)}
+        for ck in sorted(self._claims.get(key, set())):
+            idx = int(ck.rsplit(f"{ctrl.CLAIM_SEP}r", 1)[1])
+            if idx >= desired and str(idx) not in held_indices:
+                self._release_claim(svc, key, idx)
+
+        # Rolling replace: at most ONE stale-hash replica rolls at a
+        # time, and only while every replacement already created is
+        # Running and every slot is filled — a config rollout never
+        # drops the service below desired-1 live replicas.
+        rolled = False
+        live = [p for p in pods if not p.is_finished()]
+        stale_live = [
+            p for p in live
+            if p.metadata.labels.get(ctrl.LABEL_SPEC_HASH)
+            not in (None, spec_hash)]
+        replacements_settling = any(
+            p.metadata.labels.get(ctrl.LABEL_SPEC_HASH) == spec_hash
+            and p.status.phase != PodPhase.RUNNING
+            for p in live)
+        if (stale_live and len(live) >= desired
+                and not replacements_settling):
+            pod = stale_live[0]
+            self.cluster.record_event(
+                InferenceService.KIND, svc.namespace, svc.name,
+                "Normal", "RollingUpdate",
+                f"Rolling replica {pod.name}: serving spec changed "
+                f"(-> {spec_hash}); one replica at a time")
+            self._tracked_delete_pod(svc, pod)
+            rolled = True
+
+        rpods = self.filter_pods_for_replica_type(pods, SERVER_REPLICA)
+        slices = self.get_pod_slices(rpods, desired)
+        queued = 0
+        for index, pod_slice in enumerate(slices):
+            live_here = [p for p in pod_slice if not p.is_finished()]
+            failed = [p for p in pod_slice
+                      if p.status.phase == PodPhase.FAILED]
+            if live_here:
+                if len(live_here) > 1:
+                    live_here.sort(
+                        key=lambda p: p.metadata.creation_timestamp)
+                    for dup in live_here[1:]:
+                        self._tracked_delete_pod(svc, dup)
+                # Re-admit the LIVE replica's claim idempotently: after
+                # an operator failover the scheduler/allocator rebuild
+                # empty, and without this the slice under a running
+                # server would read as free (a queued train job admits
+                # onto occupied chips, and later release no-ops). The
+                # TrainJob controller re-admits its hold every sync for
+                # the same reason. A live replica whose re-admission is
+                # REFUSED (another holder re-admitted first after a
+                # genuine capacity change) lost the race: restart it
+                # through the normal empty-slot path.
+                admitted, _, _ = self._admit_replica(
+                    svc, key, index, event_on_refusal=False)
+                if not admitted:
+                    self.cluster.record_event(
+                        InferenceService.KIND, svc.namespace, svc.name,
+                        "Warning", "SliceLost",
+                        f"Replica {live_here[0].name}'s slice claim "
+                        f"could not be re-established; restarting the "
+                        f"replica")
+                    self._tracked_delete_pod(svc, live_here[0])
+                continue
+            if failed:
+                # Per-replica restart: stateless serving always replaces
+                # a dead server (no backoff limit — availability first);
+                # restarts counted for visibility, cause-labeled like the
+                # trainer path.
+                pod = failed[0]
+                code = pod.main_exit_code()
+                infra = (code is not None and is_signal_exit(code)
+                         and code != EXIT_USER_RETRYABLE)
+                metrics.restarts_total.labels(
+                    namespace=svc.namespace,
+                    reason="preempt" if infra else "exit_code").inc()
+                svc.status.restarts += 1
+                self.cluster.record_event(
+                    InferenceService.KIND, svc.namespace, svc.name,
+                    "Normal", "ServerRestart",
+                    f"Replica {pod.name} exited with code {code}; "
+                    f"restarting (restart #{svc.status.restarts})")
+                self._tracked_delete_pod(svc, pod)
+                continue
+            if rolled:
+                # The rolling slot drains first; its replacement (and any
+                # other creations this pass) wait for the next sync so a
+                # rollout replaces strictly one replica at a time.
+                continue
+            # Admission: one slice per replica through the shared
+            # scheduler/allocator (train and serve compete as equals).
+            admitted, slice_id, delay = self._admit_replica(svc, key, index)
+            if not admitted:
+                queued += 1
+                if delay is not None:
+                    self.queue.add_after(key, delay)
+                continue
+            self._create_server_pod(svc, index, spec_hash, ckpt_dir,
+                                    model_name, slice_id)
+
+        # One headless service per replica (stable DNS identity, same
+        # contract as train replicas).
+        rsvcs = self.filter_services_for_replica_type(
+            services, SERVER_REPLICA)
+        svc_slices = self.get_service_slices(rsvcs, desired)
+        for index, svc_slice in enumerate(svc_slices):
+            if svc_slice:
+                continue
+            name = naming.gen_general_name(svc.name, SERVER_REPLICA, index)
+            selector = {
+                **ctrl.gen_labels(svc.name),
+                ctrl.LABEL_REPLICA_TYPE: SERVER_REPLICA,
+                ctrl.LABEL_REPLICA_INDEX: str(index),
+            }
+            self._tracked_create_service(svc, Service(
+                metadata=ObjectMeta(
+                    name=name, namespace=svc.namespace,
+                    labels=dict(selector)),
+                selector=selector,
+                ports=[ServicePort(name=api_defaults.SERVE_PORT_NAME,
+                                   port=svc.spec.serving.port)],
+            ), SERVER_REPLICA)
+
+        # Status fold: counts, gauge, conditions.
+        rpods = [p for p in rpods if not p.is_finished()]
+        ready = sum(1 for p in rpods
+                    if p.status.phase == PodPhase.RUNNING)
+        svc.status.replicas = len(rpods)
+        svc.status.ready_replicas = ready
+        metrics.serve_ready_replicas.labels(
+            namespace=svc.namespace, service=svc.name).set(ready)
+        if queued and ready == 0:
+            # A freshly-preempted service keeps Preempted as its activity
+            # state while it waits — Queued would overwrite the one
+            # visible record that the disruption was planned (same rule
+            # as the TrainJob controller).
+            if not has_condition(
+                svc.status, JobConditionType.PREEMPTED
+            ) and status_engine.set_condition(
+                svc.status, JobConditionType.QUEUED, REASON_QUEUED,
+                f"{queued} replica(s) waiting for slice capacity", now,
+            ):
+                self.cluster.record_event(
+                    InferenceService.KIND, svc.namespace, svc.name,
+                    "Normal", "Queued",
+                    f"{queued} replica(s) waiting for slice capacity")
+        elif ready > 0:
+            status_engine.set_condition(
+                svc.status, JobConditionType.RUNNING, REASON_READY,
+                f"InferenceService {key} is serving "
+                f"({ready}/{desired} ready).", now)
+
+        if (svc.status != old_status
+                or svc.metadata.annotations != old_annotations):
+            svc.status.last_reconcile_time = now
+            self.cluster.update_infsvc_status(svc)
+
+    # ----------------------------------------------------- model handoff
+
+    def _resolve_model(self, svc: InferenceService,
+                       key: str) -> tuple[str, str] | None:
+        """(checkpoint_dir, model name) the server pods load, or None
+        when not resolvable yet (condition/event recorded; a retry is
+        scheduled when waiting makes sense)."""
+        model = svc.spec.model
+        if model.checkpoint_dir:
+            return model.checkpoint_dir, (
+                model.model or api_defaults.DEFAULT_SERVE_MODEL)
+        cached = svc.metadata.annotations.get(ANNOTATION_RESOLVED_CKPT)
+        if cached:
+            # Resolved once already (possibly by a previous leader): the
+            # handoff is DONE — deleting the finished TrainJob afterwards
+            # must not wedge a serving workload back into Waiting.
+            return cached, (
+                svc.metadata.annotations.get(ANNOTATION_RESOLVED_MODEL)
+                or api_defaults.DEFAULT_SERVE_MODEL)
+        ref = model.from_train_job
+        ns, _, jname = ref.rpartition("/")
+        ns = ns or svc.namespace
+        job = self.cluster.try_get_job(ns, jname)
+        now = self._now()
+        if job is None or not is_succeeded(job.status):
+            if job is not None and has_condition(
+                    job.status, JobConditionType.FAILED):
+                self.cluster.record_event(
+                    InferenceService.KIND, svc.namespace, svc.name,
+                    "Warning", REASON_TRAINJOB_FAILED,
+                    f"fromTrainJob {ns}/{jname} is Failed; nothing to "
+                    f"serve")
+                status_engine.set_condition(
+                    svc.status, JobConditionType.FAILED,
+                    REASON_TRAINJOB_FAILED,
+                    f"TrainJob {ns}/{jname} failed; no checkpoint to "
+                    f"serve.", now)
+                return None
+            status_engine.set_condition(
+                svc.status, JobConditionType.QUEUED, REASON_WAITING_JOB,
+                f"waiting for TrainJob {ns}/{jname} to succeed", now)
+            self.queue.add_after(key, 1.0)
+            return None
+        workers = job.spec.replica_specs.get(ReplicaType.WORKER)
+        argv: list[str] = []
+        if workers is not None:
+            c = api_defaults.training_container(workers)
+            if c is not None:
+                argv = list(c.command) + list(c.args)
+        ckpt = _arg_value(argv, "--checkpoint-dir")
+        if not ckpt:
+            status_engine.set_condition(
+                svc.status, JobConditionType.FAILED, REASON_INVALID,
+                f"TrainJob {ns}/{jname} declares no --checkpoint-dir; "
+                f"nothing to serve.", now)
+            self.cluster.record_event(
+                InferenceService.KIND, svc.namespace, svc.name, "Warning",
+                REASON_INVALID,
+                f"fromTrainJob {ns}/{jname} has no --checkpoint-dir in "
+                f"its Worker command")
+            return None
+        model_name = (model.model or _arg_value(argv, "--model")
+                      or api_defaults.DEFAULT_SERVE_MODEL)
+        svc.metadata.annotations[ANNOTATION_RESOLVED_CKPT] = ckpt
+        svc.metadata.annotations[ANNOTATION_RESOLVED_MODEL] = model_name
+        return ckpt, model_name
+
+    # ------------------------------------------------------- slice claims
+
+    def _claim_key(self, key: str, index: int) -> str:
+        return f"{key}{ctrl.CLAIM_SEP}r{index}"
+
+    def _claim_proxy(self, svc: InferenceService, index: int) -> TrainJob:
+        """The duck-typed per-replica admission unit the FleetScheduler
+        ranks: carries the service's slice class, queue, and priority
+        under the claim key `{ns}/{name}#r{i}`."""
+        return TrainJob(
+            metadata=ObjectMeta(
+                name=f"{svc.name}{ctrl.CLAIM_SEP}r{index}",
+                namespace=svc.namespace),
+            spec=TrainJobSpec(
+                tpu=copy.deepcopy(svc.spec.tpu),
+                run_policy=RunPolicy(
+                    scheduling=copy.deepcopy(svc.spec.scheduling)),
+            ),
+        )
+
+    def _admit_replica(self, svc: InferenceService, key: str,
+                       index: int, event_on_refusal: bool = True,
+                       ) -> tuple[bool, str | None, float | None]:
+        """(admitted, slice id, retry delay). Admitted trivially when the
+        service requests no TPU slice. event_on_refusal=False silences
+        the SliceUnavailable event (the live-replica re-admission probe
+        emits its own SliceLost instead)."""
+        if svc.spec.tpu is None or not svc.spec.tpu.topology:
+            return True, None, None
+        ck = self._claim_key(key, index)
+        if self.scheduler is not None:
+            d = self.scheduler.decide(self._claim_proxy(svc, index))
+            if d.admit:
+                self._claims.setdefault(key, set()).add(ck)
+                return True, d.slice_id, None
+            for victim in (d.victims or
+                           ((d.preempting,) if d.preempting else ())):
+                self.route_enqueue(victim)
+            return False, None, SLICE_RETRY_DELAY_S + min(
+                120.0, 0.25 * (d.position or 0))
+        if self.slice_allocator is not None:
+            sid = self.slice_allocator.admit(ck, svc.spec.tpu.topology)
+            if sid is not None:
+                self._claims.setdefault(key, set()).add(ck)
+                return True, sid, None
+            if event_on_refusal:
+                self.cluster.record_event(
+                    InferenceService.KIND, svc.namespace, svc.name,
+                    "Warning", "SliceUnavailable",
+                    f"no free {svc.spec.tpu.topology} slice for replica "
+                    f"{index}; waiting")
+            return False, None, SLICE_RETRY_DELAY_S
+        return True, None, None
+
+    def _release_claim(self, svc: InferenceService, key: str,
+                       index: int) -> None:
+        ck = self._claim_key(key, index)
+        if ck not in self._claims.get(key, set()):
+            return
+        self._claims[key].discard(ck)
+        self._evicting.discard(ck)
+        freed = (self.scheduler.release(ck) if self.scheduler is not None
+                 else (self.slice_allocator.release(ck)
+                       if self.slice_allocator is not None else False))
+        if freed:
+            self._kick_waiters()
+
+    def _release_all_claims(self, key: str) -> None:
+        freed = False
+        for ck in sorted(self._claims.pop(key, set())):
+            self._evicting.discard(ck)
+            if self.scheduler is not None:
+                freed = self.scheduler.release(ck) or freed
+            elif self.slice_allocator is not None:
+                freed = self.slice_allocator.release(ck) or freed
+        if freed:
+            # Only when capacity actually moved: an unconditional kick
+            # here turns every stray not-found sync into a kick storm.
+            self._kick_waiters()
+
+    def _kick_waiters(self) -> None:
+        if self.scheduler is not None:
+            for k in self.scheduler.kick_targets():
+                self.route_enqueue(k)
+        else:
+            for s in self._list_owners():
+                if s.spec.tpu is not None and s.spec.tpu.topology:
+                    self.enqueue(s.key())
+
+    def _eviction_tick(self, svc: InferenceService, key: str,
+                       pods: list[Pod]) -> bool:
+        """Graceful preemption of serve replicas: the scheduler marked one
+        of our claims for a higher-priority arrival — delete that
+        replica's pod (the runtime SIGTERMs it; the server drains in-
+        flight requests and exits), then requeue the claim once the pod
+        is gone so it re-admits when capacity frees. Returns True when
+        this pass acted (the caller skips the replica loop — deletions
+        drive the next sync)."""
+        if self.scheduler is None:
+            return False
+        acted = False
+        by_index = {
+            p.metadata.labels.get(ctrl.LABEL_REPLICA_INDEX): p
+            for p in pods if not p.is_finished()
+        }
+        for index in range(svc.spec.autoscale.max_replicas):
+            ck = self._claim_key(key, index)
+            if ck not in self._claims.get(key, set()):
+                continue
+            preemptor = self.scheduler.eviction_requested(ck)
+            if preemptor is None and ck not in self._evicting:
+                continue
+            pod = by_index.get(str(index))
+            if pod is not None:
+                if ck not in self._evicting:
+                    self._evicting.add(ck)
+                    metrics.sched_preemptions_total.labels(
+                        namespace=svc.namespace).inc()
+                    self.cluster.record_event(
+                        InferenceService.KIND, svc.namespace, svc.name,
+                        "Normal", REASON_PREEMPTED,
+                        f"Replica {pod.name} preempted by {preemptor}; "
+                        f"it will re-admit when capacity frees")
+                    status_engine.set_condition(
+                        svc.status, JobConditionType.PREEMPTED,
+                        REASON_PREEMPTED,
+                        f"replica {index} preempted by {preemptor}",
+                        self._now())
+                    self._tracked_delete_pod(svc, pod)
+                acted = True
+            else:
+                # Drained: hand the slice back and let the claim requeue
+                # with its standing preserved.
+                self._evicting.discard(ck)
+                self._claims[key].discard(ck)
+                self.scheduler.requeue_preempted(
+                    self._claim_proxy(svc, index))
+                self._kick_waiters()
+                self.queue.add_after(key, 0.2)
+                acted = True
+        return acted
+
+    # ---------------------------------------------------------- autoscale
+
+    def _service_load(self, svc: InferenceService,
+                      live: list[Pod]) -> float | None:
+        """Total inflight across LIVE replicas from the collector's
+        per-replica serve stats; None when no signal exists yet."""
+        if self.heartbeat_source is None:
+            return None
+        load_fn = getattr(self.heartbeat_source, "service_load", None)
+        if load_fn is None:
+            return None
+        per_pod = load_fn(svc.namespace, svc.name) or {}
+        names = {p.name for p in live}
+        seen = [s for pod, s in per_pod.items() if pod in names]
+        if not seen:
+            return None
+        return float(sum(s.get("inflight") or 0 for s in seen))
+
+    def _autoscale_tick(self, svc: InferenceService, key: str,
+                        live: list[Pod], desired: int, now: float) -> int:
+        auto = svc.spec.autoscale
+        if auto.max_replicas <= auto.min_replicas:
+            return max(desired, auto.min_replicas)
+        if self.heartbeat_source is None:
+            # No collector (operator without --log-dir): no load signal
+            # can ever arrive — polling would be a 1 Hz no-op forever.
+            return desired
+        total = self._service_load(svc, live)
+        if total is None:
+            # No load signal yet (replicas still starting): hold, and
+            # keep ticking so the first stats are noticed promptly.
+            if live:
+                self.queue.add_after(key, AUTOSCALE_TICK_S)
+            return desired
+        from tf_operator_tpu.serve.autoscale import plan_replicas
+
+        plan = plan_replicas(
+            desired, total,
+            target_per_replica=auto.target_inflight_per_replica,
+            min_replicas=auto.min_replicas,
+            max_replicas=auto.max_replicas,
+            stabilization_s=auto.scale_down_stabilization_seconds,
+            low_load_since=svc.status.low_load_since, now=now)
+        svc.status.low_load_since = plan.low_load_since
+        if plan.changed:
+            direction = "up" if plan.desired > desired else "down"
+            metrics.serve_scale_events_total.labels(
+                namespace=svc.namespace, direction=direction).inc()
+            self.cluster.record_event(
+                InferenceService.KIND, svc.namespace, svc.name, "Normal",
+                REASON_SCALED,
+                f"Autoscaling {direction}: {desired} -> {plan.desired} "
+                f"replica(s) (inflight={total:g}, "
+                f"target/replica={auto.target_inflight_per_replica:g})")
+            svc.status.desired_replicas = plan.desired
+            svc.status.last_scale_time = now
+            desired = plan.desired
+        self.queue.add_after(key, AUTOSCALE_TICK_S)
+        return desired
+
+    # ----------------------------------------------------------- watchdog
+
+    def _watchdog_tick(self, svc: InferenceService, key: str,
+                       live: list[Pod], now: float) -> None:
+        timeout = svc.spec.serving.heartbeat_timeout_seconds
+        if not timeout or self.heartbeat_source is None or not live:
+            return
+        try:
+            hb = self.heartbeat_source.job_heartbeat(svc.namespace,
+                                                     svc.name)
+        except Exception:
+            return
+        per_pod = (hb or {}).get("replicas") or {}
+        soonest: float | None = None
+        for pod in live:
+            if pod.status.phase != PodPhase.RUNNING:
+                continue
+            freshest = max(
+                float((per_pod.get(pod.name) or {}).get("t") or 0.0),
+                pod.status.start_time or pod.metadata.creation_timestamp,
+            )
+            age = now - freshest
+            if age >= timeout:
+                svc.status.restarts += 1
+                metrics.restarts_total.labels(
+                    namespace=svc.namespace, reason="hang").inc()
+                self.cluster.record_event(
+                    InferenceService.KIND, svc.namespace, svc.name,
+                    "Warning", status_engine.REASON_HEARTBEAT_STALE,
+                    f"Replica {pod.name} heartbeat stale for "
+                    f"{int(age)}s (>= {timeout:g}s): restarting it")
+                self._tracked_delete_pod(svc, pod)
+            else:
+                left = timeout - age
+                soonest = left if soonest is None else min(soonest, left)
+        if soonest is not None:
+            self.queue.add_after(key, soonest + 0.25)
+
+    # ------------------------------------------------------- pod creation
+
+    def _create_server_pod(self, svc: InferenceService, index: int,
+                           spec_hash: str, ckpt_dir: str, model_name: str,
+                           slice_id: str | None) -> None:
+        template = copy.deepcopy(svc.spec.template)
+        labels = {
+            **template.labels,
+            **ctrl.gen_labels(svc.name),
+            ctrl.LABEL_REPLICA_TYPE: SERVER_REPLICA,
+            ctrl.LABEL_REPLICA_INDEX: str(index),
+            ctrl.LABEL_SPEC_HASH: spec_hash,
+        }
+        name = naming.gen_general_name(svc.name, SERVER_REPLICA, index)
+        serving = svc.spec.serving
+        c = api_defaults.serving_container(template)
+        if c is not None:
+            c.set_env(ENV_CKPT_DIR, ckpt_dir)
+            c.set_env(ENV_MODEL, model_name)
+            c.set_env(ENV_PORT, str(serving.port))
+            c.set_env(ENV_BATCH_MAX, str(serving.batch_max_size))
+            c.set_env(ENV_BATCH_TIMEOUT_MS, str(serving.batch_timeout_ms))
+            # Own DNS identity: the local runtime's port map rewrites this
+            # (and allocates the replica's localhost listen port from it).
+            c.set_env(ENV_ENDPOINT,
+                      f"{name}.{svc.namespace}.svc:{serving.port}")
+            c.set_env("TPUJOB_REPLICA_TYPE", SERVER_REPLICA)
+            c.set_env("TPUJOB_REPLICA_INDEX", str(index))
+            if svc.spec.tpu is not None and svc.spec.tpu.topology:
+                chips = None
+                try:
+                    from tf_operator_tpu.gang.topology import parse_topology
+
+                    chips = parse_topology(
+                        svc.spec.tpu.topology, svc.spec.tpu.accelerator,
+                        svc.spec.tpu.chips_per_host).num_chips
+                except ValueError:
+                    pass
+                if chips is not None:
+                    from tf_operator_tpu.cluster_spec import tpu_env
+
+                    c.resources.setdefault(tpu_env.TPU_RESOURCE, chips)
+        annotations = dict(template.annotations)
+        if slice_id:
+            annotations[f"tpujob.dev/slice-r{index}"] = slice_id
+        template.annotations = annotations
+        # Server pods never self-restart: replacement is the controller's
+        # per-replica restart path (restart accounting lives up there).
+        template.restart_policy = "Never"
+        self._tracked_create_pod(svc, Pod(
+            metadata=ObjectMeta(
+                name=name, namespace=svc.namespace, labels=labels,
+                annotations=annotations),
+            spec=template,
+        ), SERVER_REPLICA)

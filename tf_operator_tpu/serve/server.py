@@ -1,0 +1,524 @@
+"""The in-pod batch inference server: `python -m tf_operator_tpu.serve.server`.
+
+One serving replica of an InferenceService. Pipeline:
+
+  HTTP handler threads --(queue)--> one BATCHER thread --(events)--> handlers
+
+  * handlers parse `POST /predict {"instances": [[...], ...]}` rows,
+    enqueue them, and block on a per-request event;
+  * the single batcher thread assembles micro-batches — it waits up to
+    `--batch-timeout-ms` after the FIRST queued row for peers to
+    coalesce, caps at `--batch-max-size` rows, PADS to the fixed batch
+    shape (one jit compilation, ever), runs ONE jitted forward, and
+    demuxes per-request results.
+
+  Thread discipline (the PR-2 rule, repo-wide): the batcher is the ONLY
+  thread that dispatches XLA programs. Handler threads never touch jax.
+
+Checkpoint contract: the newest VALIDATED step under --checkpoint-dir is
+resolved via models/checkpoint.latest_valid_checkpoint — the trainer's
+resume-walk census validation — and restored raw (host snapshot of
+fully-replicated leaves), then placed on device once. A torn newest save
+falls back to the previous valid step exactly like the trainer would.
+
+Liveness + load surfaces:
+  * heartbeat (TPUJOB_HEARTBEAT_FILE, utils/preemption.HeartbeatWriter):
+    ticked every batcher wake-up — step = dispatched batches — so the
+    controller's serving watchdog covers a wedged server like the hang
+    watchdog covers a wedged trainer;
+  * serve stats (TPUJOB_SERVE_STATS_FILE, atomic tmp+replace JSON):
+    {inflight, requests_total, served_total, p50/p99 ms, t} — the
+    collector reads it back per replica and the autoscaler sums inflight;
+  * /metrics: tpujob_serve_{requests_total,inflight,batch_size,
+    latency_seconds} from the shared registry (status/metrics.py), one
+    child series per replica;
+  * metrics events (TPUJOB_METRICS_FILE): start/serve_ready/done lines,
+    same append-only record the trainer writes.
+
+Graceful shutdown: SIGTERM latches a stop flag; the batcher drains the
+queued requests (each gets a response), writes a final stats snapshot and
+`done` event, and the process exits 0. Chaos `kill:step=N` (optionally
+`replica=server`) fires after N dispatched batches — deterministic
+serve-replica restart e2es ride the same grammar as trainer kills.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+from tf_operator_tpu.status import metrics as metrics_mod
+from tf_operator_tpu.utils.preemption import HeartbeatWriter
+
+ENV_STATS_FILE = "TPUJOB_SERVE_STATS_FILE"
+
+
+def _emit(event: dict) -> None:
+    """Append one JSON event line to TPUJOB_METRICS_FILE (the trainer's
+    event-stream contract; the collector reads it back)."""
+    path = os.environ.get("TPUJOB_METRICS_FILE")
+    if not path:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(event) + "\n")
+    except OSError:
+        pass
+
+
+class _Pending:
+    """One queued request: rows in, predictions out via the event."""
+
+    __slots__ = ("rows", "event", "result", "error", "t_in")
+
+    def __init__(self, rows):
+        self.rows = rows
+        self.event = threading.Event()
+        self.result = None
+        self.error: str | None = None
+        self.t_in = time.monotonic()
+
+
+class BatchQueue:
+    """The handler->batcher queue plus the micro-batch assembly wait.
+
+    take_batch blocks until at least one request is queued, then waits up
+    to `timeout_s` (from the FIRST row's arrival) for more, returning at
+    most `max_rows` ROWS' worth of requests. A request whose row count
+    exceeds max_rows is rejected at submit (the caller 413s)."""
+
+    def __init__(self, max_rows: int, timeout_s: float):
+        self.max_rows = max_rows
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._items: list[_Pending] = []
+        self._closed = False
+
+    def submit(self, item: _Pending) -> bool:
+        if len(item.rows) > self.max_rows:
+            return False
+        with self._cond:
+            if self._closed:
+                return False
+            self._items.append(item)
+            self._cond.notify()
+        return True
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def take_batch(self, poll_s: float = 0.05) -> list[_Pending] | None:
+        """The next micro-batch (None when closed AND drained). Without
+        traffic, wakes every `poll_s` so the caller can tick liveness."""
+        with self._cond:
+            # Arrival wait: bounded by poll_s so the idle batcher still
+            # ticks its heartbeat/stats.
+            poll_deadline = time.monotonic() + poll_s
+            while (not self._items and not self._closed
+                   and poll_deadline - time.monotonic() > 0):
+                self._cond.wait(timeout=poll_deadline - time.monotonic())
+            if not self._items:
+                return None if self._closed else []
+            # Assembly wait: from the FIRST row's arrival, up to the
+            # batch timeout, for peers to coalesce.
+            deadline = self._items[0].t_in + self.timeout_s
+            while (sum(len(i.rows) for i in self._items) < self.max_rows
+                   and not self._closed
+                   and deadline - time.monotonic() > 0):
+                self._cond.wait(timeout=deadline - time.monotonic())
+            batch: list[_Pending] = []
+            taken = 0
+            while self._items and taken + len(self._items[0].rows) <= self.max_rows:
+                item = self._items.pop(0)
+                taken += len(item.rows)
+                batch.append(item)
+            return batch
+
+
+class InferenceServer:
+    def __init__(self, model_name: str, ckpt_dir: str, port: int,
+                 batch_max: int, batch_timeout_ms: float,
+                 replica: str = ""):
+        self.model_name = model_name
+        self.ckpt_dir = ckpt_dir
+        self.port = port
+        self.replica = replica or "{}-{}".format(
+            os.environ.get("TPUJOB_REPLICA_TYPE", "server"),
+            os.environ.get("TPUJOB_REPLICA_INDEX", "0"))
+        self.queue = BatchQueue(batch_max, batch_timeout_ms / 1000.0)
+        self.batch_max = batch_max
+        self.stop = threading.Event()
+        self.ready = threading.Event()
+        self.loaded_step: int | None = None
+        self._hb = HeartbeatWriter.from_env()
+        self._stats_path = os.environ.get(ENV_STATS_FILE)
+        self._stats_lock = threading.Lock()
+        self._latencies_ms: list[float] = []  # bounded ring, see _note
+        self._requests = 0
+        self._served = 0
+        self._batches = 0
+        self._inflight = 0
+        # Time-averaged inflight over the current stats window: an
+        # instantaneous snapshot right after a batch drains reads ~0
+        # under steady open-loop load (the queue empties every window),
+        # so the autoscaler would never see the Little's-law load. The
+        # integral of inflight*dt between stats writes is the honest
+        # signal.
+        self._infl_integral = 0.0
+        self._infl_last_t = time.monotonic()
+        self._infl_window_t0 = self._infl_last_t
+        labels = {"replica": self.replica}
+        self.m_requests = metrics_mod.serve_requests_total.labels(**labels)
+        self.m_inflight = metrics_mod.serve_inflight.labels(**labels)
+        self.m_batch = metrics_mod.serve_batch_size.labels(**labels)
+        self.m_latency = metrics_mod.serve_latency_seconds.labels(**labels)
+        from tf_operator_tpu import chaos as chaos_lib
+
+        self._chaos = chaos_lib.TrainerChaos.from_env()
+        self._apply = None
+        self._input_shape: tuple[int, ...] = ()
+
+    # ------------------------------------------------------------- model
+
+    def load(self) -> None:
+        """Resolve the newest VALIDATED checkpoint, restore it host-side,
+        place it on device, and jit the padded-batch forward."""
+        import jax
+        import jax.numpy as jnp
+
+        from tf_operator_tpu.models import checkpoint as ckpt
+
+        step = ckpt.latest_valid_checkpoint(self.ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(
+                f"no valid checkpoint under {self.ckpt_dir} (torn/empty "
+                f"dirs are skipped exactly as the trainer's resume walk "
+                f"would)")
+        if self.model_name in ("mnist-mlp", "mnist-conv"):
+            from tf_operator_tpu.models import mnist as M
+
+            model = M.MLP() if self.model_name == "mnist-mlp" else M.ConvNet()
+            self._input_shape = (28, 28)
+        else:
+            raise ValueError(
+                f"serving model {self.model_name!r} not supported (mnist-"
+                f"mlp / mnist-conv today; the contract is the trainer's "
+                f"--model vocabulary)")
+        # Walk back past steps whose restore raises (census-valid but
+        # unreadable), like the trainer does.
+        params = None
+        while step is not None:
+            try:
+                params = ckpt.restore(self.ckpt_dir, step)
+                break
+            except Exception as e:  # noqa: BLE001 — torn trees raise anything
+                _emit({"event": "serve_fallback", "skipped_step": step,
+                       "reason": f"restore_error: {type(e).__name__}: {e}"})
+                older = [s for s in ckpt.list_steps(self.ckpt_dir)
+                         if s < step]
+                step = None
+                for s in reversed(older):
+                    if ckpt.validate_step(self.ckpt_dir, s):
+                        step = s
+                        break
+        if params is None:
+            raise FileNotFoundError(
+                f"every checkpoint under {self.ckpt_dir} failed to restore")
+        self.loaded_step = step
+        params = jax.device_put(params)
+
+        def forward(p, x):
+            return jnp.argmax(model.apply({"params": p}, x), axis=-1)
+
+        jitted = jax.jit(forward)
+        # Warm the compile cache at the FIXED padded shape so the first
+        # real request doesn't pay compilation.
+        import numpy as np
+
+        pad = np.zeros((self.batch_max, *self._input_shape), np.float32)
+        jitted(params, pad).block_until_ready()
+
+        def apply(x_np):
+            return np.asarray(jitted(params, jnp.asarray(x_np)))
+
+        self._apply = apply
+
+    # ------------------------------------------------------------ batcher
+
+    def _note_latency(self, ms: float) -> None:
+        with self._stats_lock:
+            self._latencies_ms.append(ms)
+            if len(self._latencies_ms) > 2048:
+                del self._latencies_ms[:1024]
+
+    def _shift_inflight(self, delta: int) -> int:
+        """Adjust the inflight count, accumulating the time integral
+        (caller does NOT hold the stats lock). Returns the new count."""
+        with self._stats_lock:
+            now = time.monotonic()
+            self._infl_integral += self._inflight * (now - self._infl_last_t)
+            self._infl_last_t = now
+            self._inflight += delta
+            return self._inflight
+
+    def _write_stats(self) -> None:
+        if not self._stats_path:
+            return
+        with self._stats_lock:
+            now = time.monotonic()
+            self._infl_integral += self._inflight * (now - self._infl_last_t)
+            self._infl_last_t = now
+            window = now - self._infl_window_t0
+            # `inflight` is the TIME-AVERAGED count over the window since
+            # the last write (the autoscaler's signal); `inflight_now` is
+            # the instantaneous queue depth (debugging).
+            avg = (self._infl_integral / window if window > 1e-6
+                   else float(self._inflight))
+            self._infl_integral = 0.0
+            self._infl_window_t0 = now
+            lat = sorted(self._latencies_ms[-512:])
+            snap = {
+                "t": time.time(),
+                "inflight": round(avg, 3),
+                "inflight_now": self._inflight,
+                "requests_total": self._requests,
+                "served_total": self._served,
+                "batches_total": self._batches,
+                "loaded_step": self.loaded_step,
+                "latency_p50_ms": lat[len(lat) // 2] if lat else None,
+                "latency_p99_ms": lat[int(len(lat) * 0.99)] if lat else None,
+            }
+        tmp = f"{self._stats_path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(snap, f)
+            os.replace(tmp, self._stats_path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _batch_loop(self) -> None:
+        """The one XLA-dispatching thread: assemble, pad, apply, demux."""
+        import numpy as np
+
+        last_stats = 0.0
+        while True:
+            batch = self.queue.take_batch()
+            if batch is None:
+                break  # closed and drained
+            if batch:
+                try:
+                    # Assembly INSIDE the per-batch guard: a ragged or
+                    # wrong-shaped row raises in concatenate/reshape, and
+                    # an uncaught raise here would kill the one batcher
+                    # thread — a single malformed request must 500 its
+                    # own batch, never take the replica down.
+                    rows = np.concatenate(
+                        [np.asarray(i.rows, np.float32) for i in batch])
+                    n = rows.shape[0]
+                    padded = np.zeros((self.batch_max,
+                                       *self._input_shape), np.float32)
+                    padded[:n] = rows.reshape((n, *self._input_shape))
+                    preds = self._apply(padded)[:n]
+                except Exception as e:  # noqa: BLE001 — reported per request
+                    for item in batch:
+                        item.error = f"{type(e).__name__}: {e}"
+                        item.event.set()
+                    # Errored requests leave the inflight count (they are
+                    # answered) but never count as served.
+                    self._shift_inflight(-len(batch))
+                    continue
+                self._batches += 1
+                self.m_batch.observe(float(n))
+                off = 0
+                now = time.monotonic()
+                for item in batch:
+                    k = len(item.rows)
+                    item.result = [int(v) for v in preds[off:off + k]]
+                    off += k
+                    ms = (now - item.t_in) * 1000.0
+                    self.m_latency.observe(ms / 1000.0)
+                    self._note_latency(ms)
+                with self._stats_lock:
+                    self._served += len(batch)
+                inflight = self._shift_inflight(-len(batch))
+                self.m_inflight.set(float(max(0, inflight)))
+                for item in batch:
+                    item.event.set()
+                if self._chaos is not None:
+                    # `kill:step=N[,replica=server]`: deterministic
+                    # serve-replica faults, N = dispatched batches.
+                    self._chaos.maybe_kill(self._batches, 0)
+            self._hb.write(self._batches)
+            now = time.monotonic()
+            if now - last_stats > 0.25 or batch:
+                self._write_stats()
+                last_stats = now
+            if self.stop.is_set():
+                self.queue.close()
+
+    # --------------------------------------------------------------- http
+
+    def _make_handler(server):  # noqa: N805 — closure over the server
+        from http.server import BaseHTTPRequestHandler
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: N802
+                pass
+
+            def _send(self, payload: dict, code: int = 200,
+                      raw: str | None = None) -> None:
+                body = (raw if raw is not None
+                        else json.dumps(payload)).encode()
+                self.send_response(code)
+                ctype = ("text/plain; version=0.0.4" if raw is not None
+                         else "application/json")
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    self._send({
+                        "ok": server.ready.is_set(),
+                        "model": server.model_name,
+                        "checkpoint_step": server.loaded_step,
+                        "inflight": server._inflight,
+                    }, 200 if server.ready.is_set() else 503)
+                elif self.path == "/metrics":
+                    self._send({}, raw=metrics_mod.DEFAULT.expose())
+                else:
+                    self._send({"error": "not found"}, 404)
+
+            def do_POST(self):  # noqa: N802
+                if self.path != "/predict":
+                    return self._send({"error": "not found"}, 404)
+                if not server.ready.is_set() or server.stop.is_set():
+                    return self._send({"error": "not serving"}, 503)
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    rows = req["instances"]
+                    assert isinstance(rows, list) and rows
+                except Exception:
+                    return self._send(
+                        {"error": "body must be "
+                                  '{"instances": [[...], ...]}'}, 400)
+                item = _Pending(rows)
+                with server._stats_lock:
+                    server._requests += 1
+                inflight = server._shift_inflight(+1)
+                server.m_requests.inc()
+                server.m_inflight.set(float(inflight))
+                if not server.queue.submit(item):
+                    server._shift_inflight(-1)
+                    return self._send(
+                        {"error": f"batch of {len(rows)} rows exceeds "
+                                  f"batchMaxSize {server.batch_max} (or "
+                                  f"the server is draining)"}, 413)
+                if not item.event.wait(timeout=30.0):
+                    return self._send({"error": "timed out"}, 504)
+                if item.error is not None:
+                    return self._send({"error": item.error}, 500)
+                self._send({"predictions": item.result,
+                            "model": server.model_name,
+                            "checkpoint_step": server.loaded_step})
+
+        return Handler
+
+    # ---------------------------------------------------------------- run
+
+    def run(self) -> int:
+        from http.server import ThreadingHTTPServer
+
+        _emit({"event": "start", "t": time.time(), "role": "serve",
+               "model": self.model_name})
+        self._hb.write(0, force=True)
+        self.load()
+        batcher = threading.Thread(target=self._batch_loop,
+                                   name="serve-batcher", daemon=True)
+        batcher.start()
+
+        # The runtime allocates this replica's localhost listen port from
+        # its DNS identity (TPUJOB_SERVE_ENDPOINT); standalone runs bind
+        # the declared port directly.
+        port = int(os.environ.get("TPUJOB_SERVE_LISTEN_PORT", self.port))
+        httpd = ThreadingHTTPServer(("127.0.0.1", port),
+                                    self._make_handler())
+        httpd.daemon_threads = True
+
+        def _sigterm(*_a):
+            self.stop.set()
+            self.queue.close()
+
+        signal.signal(signal.SIGTERM, _sigterm)
+        signal.signal(signal.SIGINT, _sigterm)
+        threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="serve-http").start()
+        self.ready.set()
+        self._hb.write(0, force=True)
+        self._write_stats()
+        _emit({"event": "serve_ready", "t": time.time(),
+               "checkpoint_step": self.loaded_step, "port": port})
+        print(f"serving {self.model_name} step {self.loaded_step} on "
+              f"127.0.0.1:{port}", flush=True)
+        while not self.stop.is_set():
+            self.stop.wait(timeout=0.5)
+        # Drain: the batcher answers everything queued, then exits.
+        self.queue.close()
+        batcher.join(timeout=10.0)
+        httpd.shutdown()
+        self._write_stats()
+        _emit({"event": "done", "t": time.time(),
+               "served": self._served, "batches": self._batches})
+        return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    env = os.environ
+    ap = argparse.ArgumentParser(prog="tf_operator_tpu.serve.server",
+                                 description=__doc__)
+    ap.add_argument("--model",
+                    default=env.get("TPUJOB_SERVE_MODEL", "mnist-mlp"))
+    ap.add_argument("--checkpoint-dir",
+                    default=env.get("TPUJOB_SERVE_CHECKPOINT_DIR", ""))
+    ap.add_argument("--port", type=int,
+                    default=int(env.get("TPUJOB_SERVE_PORT", "8500")))
+    ap.add_argument("--batch-max-size", type=int,
+                    default=int(env.get("TPUJOB_SERVE_BATCH_MAX", "8")))
+    ap.add_argument("--batch-timeout-ms", type=float,
+                    default=float(env.get("TPUJOB_SERVE_BATCH_TIMEOUT_MS",
+                                          "5.0")))
+    args = ap.parse_args(argv)
+    if not args.checkpoint_dir:
+        print("error: --checkpoint-dir (or TPUJOB_SERVE_CHECKPOINT_DIR) "
+              "is required", file=sys.stderr)
+        return 2
+    server = InferenceServer(
+        args.model, args.checkpoint_dir, args.port,
+        args.batch_max_size, args.batch_timeout_ms,
+        replica=env.get("TPUJOB_POD_NAME", ""))
+    try:
+        return server.run()
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

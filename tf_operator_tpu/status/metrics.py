@@ -387,3 +387,47 @@ sched_queue_wait_seconds = DEFAULT.histogram(
     "Submit-to-admission wait of slice jobs through the fair-share queue",
     buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0, 7200.0),
 )
+
+# --- Serving (serve/): the InferenceService workload kind. The four
+# tpujob_serve_* request families are emitted by the SERVER process
+# (serve/server.py) on its own /metrics port, one child series per
+# replica; defining them here keeps one registry as the source of truth
+# the metrics-doc CI guard audits. The operator-side families
+# (ready_replicas, scale_events) are emitted by serve/controller.py.
+serve_requests_total = DEFAULT.counter(
+    "tpujob_serve_requests_total",
+    "Inference requests accepted by a serving replica (per replica)",
+    labels_only=True,
+)
+serve_inflight = DEFAULT.gauge(
+    "tpujob_serve_inflight",
+    "Requests accepted but not yet answered on a serving replica — the "
+    "autoscaler's load signal (per replica)",
+    labels_only=True,
+)
+serve_batch_size = DEFAULT.histogram(
+    "tpujob_serve_batch_size",
+    "Rows per dispatched micro-batch (assembly under batchTimeoutMs up "
+    "to batchMaxSize)",
+    labels_only=True,
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0),
+)
+serve_latency_seconds = DEFAULT.histogram(
+    "tpujob_serve_latency_seconds",
+    "Request latency: accept -> response ready (queue wait + batch "
+    "assembly + jitted forward + demux)",
+    labels_only=True,
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0),
+)
+serve_ready_replicas = DEFAULT.gauge(
+    "tpujob_serve_ready_replicas",
+    "Running server replicas per InferenceService (operator-side; series "
+    "removed when the service is deleted)",
+    labels_only=True,
+)
+serve_scale_events_total = DEFAULT.counter(
+    "tpujob_serve_scale_events_total",
+    "Autoscale decisions applied (direction: up | down)",
+    labels_only=True,
+)

@@ -69,8 +69,8 @@ TRAINER_GAUGES = {
 
 # Pod names are {job}-{type}-{index} (utils/naming.py); anchoring on the
 # replica-type vocabulary keeps job "a" from claiming job "a-worker"'s
-# files.
-_REPLICA_RE = r"(chief|master|worker|ps|evaluator)-\d+"
+# files. "server" is the InferenceService replica type (serve/).
+_REPLICA_RE = r"(chief|master|worker|ps|evaluator|server)-\d+"
 
 
 def _read_events(path: str) -> list[dict]:
@@ -206,6 +206,25 @@ class TelemetryCollector:
             "age_seconds": max(0.0, time.time() - float(t)),
             "replicas": per_pod,
         }
+
+    def service_load(self, namespace: str, service: str) -> dict[str, dict]:
+        """pod name -> latest serve-stats snapshot ({inflight,
+        requests_total, served_total, latency_p50_ms/_p99_ms, t}) for an
+        InferenceService's server replicas. The server writes the file
+        atomically (tmp+replace), so a torn read means 'no stats yet'.
+        The serve controller sums `inflight` over LIVE replicas — this
+        is the autoscaler's load signal."""
+        out: dict[str, dict] = {}
+        for pod, path in sorted(self._job_files(
+                namespace, service, suffix=r"\.serve\.json").items()):
+            try:
+                with open(path) as f:
+                    snap = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if isinstance(snap, dict):
+                out[pod] = snap
+        return out
 
     def job_telemetry(self, namespace: str, job: str) -> dict | None:
         """The per-job `telemetry` block for GET /api/trainjobs/{ns}/{name}:

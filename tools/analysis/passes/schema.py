@@ -44,19 +44,39 @@ TYPES = REPO / "tf_operator_tpu" / "api" / "types.py"
 COMPAT = REPO / "tf_operator_tpu" / "api" / "compat.py"
 VALIDATION = REPO / "tf_operator_tpu" / "api" / "validation.py"
 CRD = REPO / "manifests" / "trainjob-crd.yaml"
+INFSVC_CRD = REPO / "manifests" / "inferenceservice-crd.yaml"
 
 ROOT_CLASS = "TrainJobSpec"
+# Round 17: the second workload kind walks the same four-way agreement
+# (types / parse / emit / CRD) from its own root against its own CRD and
+# serializer — a dropped infsvc emit line must fail regardless of what
+# the TrainJob serializer still emits.
+INFSVC_ROOT_CLASS = "InferenceServiceSpec"
+# Every serializer function whose string constants are EMIT vocabulary
+# (and therefore never count as parse coverage).
+EMIT_FNS = ("job_to_dict", "infsvc_to_dict")
+# The OTHER kind's parser functions, per root: their strings must not
+# count as THIS kind's parse coverage (both kinds parse e.g.
+# "heartbeatTimeoutSeconds"; dropping one kind's line must still fail
+# that kind's direction). Shared helpers (_template_from_dict &c) stay
+# common vocabulary — reuse there is real coverage for both.
+FOREIGN_PARSE_FNS = {
+    "TrainJobSpec": ("infsvc_from_dict", "infsvc_from_yaml"),
+    "InferenceServiceSpec": ("job_from_dict", "job_from_yaml"),
+}
 
 # snake field -> wire name, where plain snake->camel is not the rule.
 WIRE_OVERRIDES = {
     ("RunPolicy", "scheduling"): "schedulingPolicy",
+    ("InferenceServiceSpec", "scheduling"): "schedulingPolicy",
 }
 
 # Dataclasses that are NOT wire contract: server-owned metadata and the
 # status block, whose wire form lives in core/k8s.py (status latches are
 # read-modify-write server state, not manifest round-trip).
 SKIP_CLASSES = {"ObjectMeta", "JobStatus", "JobCondition", "ReplicaStatus",
-                "OwnerReference", "TrainJob"}
+                "OwnerReference", "TrainJob", "InferenceService",
+                "InferenceServiceStatus"}
 
 
 def snake_to_camel(name: str) -> str:
@@ -111,15 +131,23 @@ def _strings_in(node: ast.AST) -> set[str]:
             if isinstance(n, ast.Constant) and isinstance(n.value, str)}
 
 
-def _compat_string_sets(tree: ast.Module) -> tuple[set[str], set[str]]:
-    """(parse-side strings, emit-side strings): every string constant in
-    job_to_dict is emit vocabulary; everything else in the module is
-    parse vocabulary."""
+def _compat_string_sets(tree: ast.Module,
+                        emit_fn: str = "job_to_dict",
+                        foreign_parse: tuple[str, ...] = (),
+                        ) -> tuple[set[str], set[str]]:
+    """(parse-side strings, emit-side strings) for one kind: every string
+    constant in `emit_fn` is that kind's emit vocabulary; parse
+    vocabulary is everything outside EVERY serializer and outside the
+    OTHER kind's parser functions (`foreign_parse`) — a wire name both
+    kinds read must be covered by each kind's OWN parser."""
     parse: set[str] = set()
     emit: set[str] = set()
     for node in tree.body:
-        if isinstance(node, ast.FunctionDef) and node.name == "job_to_dict":
+        if isinstance(node, ast.FunctionDef) and node.name == emit_fn:
             emit |= _strings_in(node)
+        elif isinstance(node, ast.FunctionDef) and (
+                node.name in EMIT_FNS or node.name in foreign_parse):
+            pass  # another kind's vocabulary: neither parse nor emit
         else:
             parse |= _strings_in(node)
     return parse, emit
@@ -153,26 +181,54 @@ def _child_schema(schema: dict | None, wire: str) -> dict | None:
 _DOTTED = re.compile(r"^[a-z][a-zA-Z0-9]*(\.[a-zA-Z0-9{}!r']+)+$")
 
 
+def _reachable_wire_names(dcs: dict, root: str) -> set[str]:
+    """Every wire name reachable from `root`'s dataclass tree — the
+    vocabulary the TPS405 stale-reference check accepts (validation
+    messages may quote EITHER kind's paths)."""
+    out: set[str] = set()
+    seen: set[str] = set()
+    stack = [root]
+    while stack:
+        cls = stack.pop()
+        if cls in seen or cls in SKIP_CLASSES or cls not in dcs:
+            continue
+        seen.add(cls)
+        for field, ann in dcs[cls]:
+            out.add(WIRE_OVERRIDES.get((cls, field), snake_to_camel(field)))
+            for child in dcs:
+                if child != cls and re.search(rf"\b{child}\b", ann):
+                    stack.append(child)
+    return out
+
+
 def analyze_schema(types_src: str, compat_src: str, validation_src: str,
-                   crd_text: str) -> list[Finding]:
+                   crd_text: str, root_class: str = ROOT_CLASS,
+                   emit_fn: str = "job_to_dict",
+                   check_validation: bool = True) -> list[Finding]:
     import yaml
 
     findings: list[Finding] = []
     types_tree = ast.parse(types_src)
     dcs = _dataclasses(types_tree)
     enums = _enums(types_tree)
-    parse_strings, emit_strings = _compat_string_sets(ast.parse(compat_src))
+    parse_strings, emit_strings = _compat_string_sets(
+        ast.parse(compat_src), emit_fn=emit_fn,
+        foreign_parse=FOREIGN_PARSE_FNS.get(root_class, ()))
     crd_root = _crd_schema(yaml.safe_load(crd_text))
     spec_schema = (crd_root.get("properties") or {}).get("spec")
 
     known_wire: set[str] = {"spec", "metadata", "status"}
+    # Validation messages quote BOTH kinds' wire paths; the stale-ref
+    # vocabulary spans every root present in types.py.
+    for root in (ROOT_CLASS, INFSVC_ROOT_CLASS):
+        known_wire |= _reachable_wire_names(dcs, root)
     rel_types = "tf_operator_tpu/api/types.py"
 
     # Walk the spec dataclass tree. Each visit carries the CRD schema node
     # for the class (None once we've passed through a field the CRD does
     # not model structurally).
     seen: set[str] = set()
-    stack: list[tuple[str, dict | None]] = [(ROOT_CLASS, spec_schema)]
+    stack: list[tuple[str, dict | None]] = [(root_class, spec_schema)]
     while stack:
         cls, schema = stack.pop()
         if cls in seen or cls in SKIP_CLASSES or cls not in dcs:
@@ -222,7 +278,10 @@ def analyze_schema(types_src: str, compat_src: str, validation_src: str,
                         rf"\b{child_cls}\b", ann):
                     stack.append((child_cls, child))
 
-    # Stale dotted wire paths quoted in validation messages.
+    # Stale dotted wire paths quoted in validation messages (run once,
+    # from the TrainJob root's pass — known_wire already spans both kinds).
+    if not check_validation:
+        return findings
     val_tree = ast.parse(validation_src)
     for s in sorted(_strings_in(val_tree)):
         parts_of_s = s.split()
@@ -256,6 +315,13 @@ def _field_line(types_src: str, cls: str, field: str) -> int:
 
 
 def run(project) -> list[Finding]:
-    return analyze_schema(
-        TYPES.read_text(), COMPAT.read_text(), VALIDATION.read_text(),
-        CRD.read_text())
+    types_src = TYPES.read_text()
+    compat_src = COMPAT.read_text()
+    validation_src = VALIDATION.read_text()
+    findings = analyze_schema(
+        types_src, compat_src, validation_src, CRD.read_text())
+    findings.extend(analyze_schema(
+        types_src, compat_src, validation_src, INFSVC_CRD.read_text(),
+        root_class=INFSVC_ROOT_CLASS, emit_fn="infsvc_to_dict",
+        check_validation=False))
+    return findings

@@ -1,0 +1,356 @@
+#!/usr/bin/env python3
+"""Serving load-generator bench: offered-QPS ramp against a real
+InferenceService through the operator (LocalSession + serve controller +
+real server subprocesses).
+
+For each ramp stage, an open-loop generator fires `POST /predict`
+requests at the offered rate (round-robin across the live replicas'
+endpoints), recording per-request latency; between samples it tracks the
+autoscaler's desired/ready trajectory. Output (one JSON object on
+stdout):
+
+  stages[]:  offered_qps, achieved_qps, ok/err counts, p50/p99 ms
+  scale_trajectory[]: (t, desired, ready) samples
+  scaled_to: max desired reached;  scaled_back: True when the service
+  returned to minReplicas after the ramp (within the drain window)
+
+Gates (exit 1 on violation): --gate-p99-ms on the FINAL stage's p99,
+--gate-scale-to on the max desired reached. This is the "millions of
+users" story's measurable surface — the `serving` bench point runs it in
+a small configuration (bench.py), CI's serve-smoke stage gates it.
+
+By default the model is a checkpoint this tool writes itself (fast,
+deterministic); --train runs a real trainer first and serves ITS
+checkpoint — the full train->serve handoff (that path is also proven by
+the CI capstone in tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+ONE_DEV = {
+    "PYTHONPATH": REPO,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_checkpoint(ckpt_dir: str, train: bool, steps: int = 12) -> int:
+    """A served checkpoint: either save an init tree directly (fast) or
+    run the real trainer (--train). Returns the step that will serve."""
+    if train:
+        import subprocess
+
+        env = {**os.environ, **ONE_DEV, "TPUJOB_PRESPAWN": "0"}
+        rc = subprocess.run(
+            [sys.executable, "-m", "tf_operator_tpu.models.train",
+             "--model", "mnist-mlp", "--steps", str(steps), "--batch",
+             "16", "--checkpoint-dir", ckpt_dir, "--checkpoint-every",
+             str(max(1, steps // 2))],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT).returncode
+        if rc != 0:
+            raise RuntimeError(f"trainer exited {rc}")
+    else:
+        import jax
+        import jax.numpy as jnp
+
+        from tf_operator_tpu.models import checkpoint as ckpt
+        from tf_operator_tpu.models import mnist as M
+
+        params = M.MLP().init(jax.random.PRNGKey(0),
+                              jnp.zeros((1, 28, 28)))["params"]
+        ckpt.save(ckpt_dir, steps, jax.device_get(params))
+    from tf_operator_tpu.models import checkpoint as ckpt
+
+    step = ckpt.latest_valid_checkpoint(ckpt_dir)
+    if step is None:
+        raise RuntimeError("no valid checkpoint produced")
+    return step
+
+
+def serve_manifest(name: str, ckpt_dir: str, max_replicas: int,
+                   target: float, stabilization: float,
+                   batch_timeout_ms: float):
+    from tf_operator_tpu.api import compat
+
+    return compat.infsvc_from_dict({
+        "apiVersion": "tpujob.dev/v1", "kind": "InferenceService",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "model": {"checkpointDir": ckpt_dir, "model": "mnist-mlp"},
+            "serving": {"batchMaxSize": 8,
+                        "batchTimeoutMs": batch_timeout_ms,
+                        "port": 8500},
+            "autoscale": {
+                "minReplicas": 1, "maxReplicas": max_replicas,
+                "targetInflightPerReplica": target,
+                "scaleDownStabilizationSeconds": stabilization,
+            },
+            "template": {"spec": {"containers": [{
+                "name": "serve", "image": "local",
+                "command": [sys.executable, "-m",
+                            "tf_operator_tpu.serve.server"],
+            }]}},
+        },
+    })
+
+
+def wait_healthy(addr: str, timeout: float = 90.0) -> dict:
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(f"http://{addr}/healthz",
+                                        timeout=2) as r:
+                h = json.loads(r.read())
+            if h.get("ok"):
+                return h
+        except Exception as e:  # noqa: BLE001 — startup race, retry
+            last = e
+        time.sleep(0.2)
+    raise RuntimeError(f"server at {addr} never became healthy: {last}")
+
+
+def run_stage(session, name: str, offered_qps: float, seconds: float,
+              rows, lat_out: list, scale_out: list) -> dict:
+    """One open-loop ramp stage: fire at `offered_qps` spread over the
+    live replica endpoints; sample the scale trajectory."""
+    body = json.dumps({"instances": rows}).encode()
+    lock = threading.Lock()
+    ok = [0]
+    err = [0]
+    lats: list[float] = []
+
+    def addresses() -> list[str]:
+        # Round-robin across READY replicas only (a freshly-created pod
+        # that has not bound its port yet would just produce errors).
+        svc = session.get_service("default", name)
+        out = []
+        for i in range(max(1, svc.status.ready_replicas)):
+            a = session.server_address(name, "default", i, port=8500)
+            if a is not None:
+                out.append(a)
+        return out or ["127.0.0.1:1"]
+
+    def fire(addr: str) -> None:
+        t0 = time.monotonic()
+        try:
+            req = urllib.request.Request(
+                f"http://{addr}/predict", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=15) as r:
+                r.read()
+        except Exception:  # noqa: BLE001 — counted, not raised
+            with lock:
+                err[0] += 1
+            return
+        ms = (time.monotonic() - t0) * 1000.0
+        with lock:
+            ok[0] += 1
+            lats.append(ms)
+
+    interval = 1.0 / max(offered_qps, 0.001)
+    t_start = time.monotonic()
+    t_end = t_start + seconds
+    next_fire = t_start
+    next_sample = t_start
+    addrs = addresses()
+    addr_refresh = t_start
+    i = 0
+    threads: list[threading.Thread] = []
+    while time.monotonic() < t_end:
+        now = time.monotonic()
+        if now >= next_fire:
+            t = threading.Thread(target=fire,
+                                 args=(addrs[i % len(addrs)],),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+            i += 1
+            next_fire += interval
+            if now - next_fire > 2.0:
+                next_fire = now  # generator fell behind: don't burst-spiral
+        if now >= next_sample:
+            svc = session.get_service("default", name)
+            scale_out.append({
+                "t": round(now - t_start, 2),
+                "desired": svc.status.desired_replicas,
+                "ready": svc.status.ready_replicas,
+            })
+            next_sample = now + 0.25
+        if now - addr_refresh > 1.0:
+            addrs = addresses()
+            addr_refresh = now
+        time.sleep(min(0.002, max(0.0, next_fire - time.monotonic())))
+    for t in threads:
+        t.join(timeout=20)
+    wall = time.monotonic() - t_start
+    lats.sort()
+    lat_out.extend(lats)
+    return {
+        "offered_qps": offered_qps,
+        "achieved_qps": round(ok[0] / wall, 2),
+        "ok": ok[0], "errors": err[0],
+        "latency_p50_ms": round(lats[len(lats) // 2], 3) if lats else None,
+        "latency_p99_ms": (round(lats[int(len(lats) * 0.99)], 3)
+                           if lats else None),
+    }
+
+
+def run_serve_bench(qps_ramp: list[float], stage_seconds: float,
+                    max_replicas: int = 3, target: float = 1.0,
+                    stabilization: float = 3.0,
+                    batch_timeout_ms: float = 40.0,
+                    ckpt_dir: str | None = None, train: bool = False,
+                    drain_seconds: float = 25.0) -> dict:
+    from tf_operator_tpu.api.types import JobConditionType
+    from tf_operator_tpu.runtime.session import LocalSession
+
+    work = tempfile.mkdtemp(prefix="tpujob-serve-bench-")
+    own_ckpt = ckpt_dir is None
+    ckpt_dir = ckpt_dir or os.path.join(work, "ckpt")
+    result: dict = {"qps_ramp": qps_ramp, "stage_seconds": stage_seconds,
+                    "max_replicas": max_replicas,
+                    "target_inflight_per_replica": target}
+    session = None
+    try:
+        if own_ckpt:
+            log("exp_serve: producing checkpoint"
+                + (" via real trainer" if train else " (direct save)"))
+            result["served_step"] = make_checkpoint(ckpt_dir, train)
+        session = LocalSession(env_overrides=ONE_DEV,
+                               log_dir=os.path.join(work, "logs"))
+        name = "bench-serve"
+        session.submit_service(serve_manifest(
+            name, ckpt_dir, max_replicas, target, stabilization,
+            batch_timeout_ms))
+        session.wait_for_service_condition(
+            "default", name, (JobConditionType.RUNNING,), timeout=120)
+        addr = session.server_address(name, "default", 0, port=8500)
+        h = wait_healthy(addr)
+        result.setdefault("served_step", h.get("checkpoint_step"))
+        log(f"exp_serve: replica 0 healthy at {addr} "
+            f"(step {h.get('checkpoint_step')})")
+
+        import numpy as np
+
+        rows = np.random.default_rng(3).normal(
+            size=(2, 28, 28)).astype(np.float32).tolist()
+        scale_traj: list[dict] = []
+        all_lats: list[float] = []
+        stages = []
+        for qps in qps_ramp:
+            log(f"exp_serve: stage offered_qps={qps} "
+                f"for {stage_seconds:g}s")
+            st = run_stage(session, name, qps, stage_seconds, rows,
+                           all_lats, scale_traj)
+            stages.append(st)
+            log(f"  achieved={st['achieved_qps']} "
+                f"p50={st['latency_p50_ms']}ms "
+                f"p99={st['latency_p99_ms']}ms errors={st['errors']}")
+        result["stages"] = stages
+        result["scale_trajectory"] = scale_traj
+        result["scaled_to"] = max(
+            (s["desired"] or 1) for s in scale_traj) if scale_traj else 1
+
+        # Drain: the stabilization window must bring the service back to
+        # its floor once the load stops.
+        deadline = time.monotonic() + drain_seconds
+        scaled_back = False
+        while time.monotonic() < deadline:
+            svc = session.get_service("default", name)
+            if (svc.status.desired_replicas == 1
+                    and svc.status.replicas == 1):
+                scaled_back = True
+                break
+            time.sleep(0.5)
+        result["scaled_back"] = scaled_back
+        all_lats.sort()
+        result["latency_p99_ms_overall"] = (
+            round(all_lats[int(len(all_lats) * 0.99)], 3)
+            if all_lats else None)
+        result["ok"] = True
+        return result
+    except Exception as e:  # noqa: BLE001 — the JSON contract survives
+        result["ok"] = False
+        result["error"] = f"{type(e).__name__}: {e}"
+        return result
+    finally:
+        if session is not None:
+            session.close()
+        import shutil
+
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="exp_serve.py", description=__doc__)
+    ap.add_argument("--qps-ramp", default="10,60,120",
+                    help="comma-separated offered QPS per stage")
+    ap.add_argument("--stage-seconds", type=float, default=6.0)
+    ap.add_argument("--max-replicas", type=int, default=3)
+    ap.add_argument("--target-inflight", type=float, default=1.0)
+    ap.add_argument("--stabilization", type=float, default=3.0)
+    ap.add_argument("--batch-timeout-ms", type=float, default=40.0,
+                help="server micro-batch window; also the latency "
+                     "floor, so offered QPS x window ~ inflight "
+                     "(the autoscale signal, Little's law)")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="serve an existing checkpoint dir instead of "
+                         "producing one")
+    ap.add_argument("--train", action="store_true",
+                    help="produce the checkpoint via a REAL trainer run "
+                         "(the full train->serve handoff)")
+    ap.add_argument("--gate-p99-ms", type=float, default=None,
+                    help="fail unless the FINAL stage's p99 is under this")
+    ap.add_argument("--gate-scale-to", type=int, default=None,
+                    help="fail unless the autoscaler reached this many "
+                         "desired replicas")
+    args = ap.parse_args(argv)
+    ramp = [float(x) for x in args.qps_ramp.split(",") if x.strip()]
+    result = run_serve_bench(
+        ramp, args.stage_seconds, max_replicas=args.max_replicas,
+        target=args.target_inflight, stabilization=args.stabilization,
+        batch_timeout_ms=args.batch_timeout_ms,
+        ckpt_dir=args.checkpoint_dir, train=args.train)
+    print(json.dumps(result, indent=2))
+    if not result.get("ok"):
+        return 1
+    rc = 0
+    if args.gate_p99_ms is not None:
+        p99 = result["stages"][-1]["latency_p99_ms"]
+        if p99 is None or p99 > args.gate_p99_ms:
+            log(f"GATE FAILED: final-stage p99 {p99}ms > "
+                f"{args.gate_p99_ms}ms")
+            rc = 1
+    if args.gate_scale_to is not None:
+        if result["scaled_to"] < args.gate_scale_to:
+            log(f"GATE FAILED: scaled_to {result['scaled_to']} < "
+                f"{args.gate_scale_to}")
+            rc = 1
+        elif not result.get("scaled_back"):
+            log("GATE FAILED: service never scaled back to minReplicas")
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
