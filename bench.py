@@ -779,10 +779,17 @@ def _main() -> int:
     serve_point = _bench_serving()
     if serve_point.get("ok"):
         last = serve_point["stages"][-1]
+        light = serve_point.get("light_load") or {}
         log(f"  offered={last['offered_qps']} "
             f"achieved={last['achieved_qps']} "
             f"p99={last['latency_p99_ms']}ms "
-            f"scaled_to={serve_point['scaled_to']}")
+            f"scaled_to={serve_point['scaled_to']} "
+            f"errors={serve_point.get('errors_total')}")
+        if light:
+            log(f"  light-load single-row p50: "
+                f"bucketed={(light.get('bucketed') or {}).get('latency_p50_ms')}ms "
+                f"padmax={(light.get('padmax') or {}).get('latency_p50_ms')}ms "
+                f"speedup={light.get('speedup_p50')}x")
     else:
         log(f"  serving point: {serve_point.get('error')}")
 

@@ -502,6 +502,56 @@ class TestSchemaDrift:
             and "AutoscaleSpec.scale_down_stabilization_seconds" in f.key
             for f in found), [f.render() for f in found]
 
+    def test_follow_and_bucketing_drift_guarded(self):
+        # Round-18 fixture pair: model.follow/followPollSeconds +
+        # serving.bucketing (the serving fast path's spec knobs) — each
+        # of the emit / parse / CRD directions must fail when its line
+        # is dropped, per PR-13's two-root scoping.
+        _, compat, _, _ = self._real()
+        infsvc_crd = (REPO / "manifests/inferenceservice-crd.yaml").read_text()
+        # EMIT direction.
+        for needle, key in (
+            ('"follow": spec.model.follow,', "ModelSpec.follow"),
+            ('"followPollSeconds": spec.model.follow_poll_seconds,',
+             "ModelSpec.follow_poll_seconds"),
+            ('"bucketing": spec.serving.bucketing,',
+             "ServingSpec.bucketing"),
+        ):
+            no_emit = "\n".join(ln for ln in compat.splitlines()
+                                if needle not in ln)
+            assert no_emit != compat, f"fixture stale: {needle}"
+            found = self._infsvc(compat=no_emit)
+            assert any(f.rule == "TPS402"
+                       and f.key == f"schema-emit::{key}"
+                       for f in found), [f.render() for f in found]
+        # PARSE direction.
+        no_parse = compat.replace(
+            'follow=bool(model_d.get("follow", False)),', "follow=False,")
+        assert no_parse != compat, "fixture stale (follow parse moved)"
+        found = self._infsvc(compat=no_parse)
+        assert any(f.rule == "TPS401" and "ModelSpec.follow" in f.key
+                   for f in found), [f.render() for f in found]
+        no_parse = compat.replace(
+            'bucketing=bool(serving_d.get("bucketing", True)),',
+            "bucketing=True,")
+        assert no_parse != compat, "fixture stale (bucketing parse moved)"
+        found = self._infsvc(compat=no_parse)
+        assert any(f.rule == "TPS401" and "ServingSpec.bucketing" in f.key
+                   for f in found), [f.render() for f in found]
+        # CRD direction (the fake apiserver PRUNES unknown fields, so a
+        # missing property silently eats the knob on the wire).
+        for prop, key in (("follow:", "ModelSpec.follow"),
+                          ("followPollSeconds:",
+                           "ModelSpec.follow_poll_seconds"),
+                          ("bucketing:", "ServingSpec.bucketing")):
+            no_crd = infsvc_crd.replace(f"                    {prop}",
+                                        "                    renamedKnob:")
+
+            assert no_crd != infsvc_crd, f"fixture stale: {prop}"
+            found = self._infsvc(crd=no_crd)
+            assert any(f.rule == "TPS403" and key in f.key
+                       for f in found), [f.render() for f in found]
+
 
 # --------------------------------------------------------------------------
 class TestDonationSafety:

@@ -300,8 +300,8 @@ class TestBatcher:
 
     def test_malformed_rows_error_the_batch_not_the_batcher(self):
         """A ragged/wrong-shaped request must 500 its own batch — the
-        assembly raise is caught per batch, the ONE batcher thread
-        survives, and the next (well-formed) batch still serves."""
+        assembly raise is caught per batch, the pipeline threads
+        survive, and the next (well-formed) batch still serves."""
         import numpy as np
 
         from tf_operator_tpu.serve.server import InferenceServer, _Pending
@@ -309,25 +309,25 @@ class TestBatcher:
         srv = InferenceServer("mnist-mlp", "/nope", 0, batch_max=8,
                               batch_timeout_ms=5.0, replica="t-1")
         srv._input_shape = (2,)
-        srv._apply = lambda x: np.asarray([int(v[0]) for v in x])
+        srv._apply = lambda p, x: np.asarray([int(v[0]) for v in x])
         bad = _Pending([[1, 2], [3]])  # ragged: concatenate raises
         srv.queue.submit(bad)
         srv._shift_inflight(+1)
-        t = threading.Thread(target=srv._batch_loop, daemon=True)
-        t.start()
+        threads = srv.start_pipeline()
         assert bad.event.wait(5.0)
         assert bad.error is not None and bad.result is None
         good = _Pending([[7, 0]])
         srv.queue.submit(good)
         srv._shift_inflight(+1)
-        assert good.event.wait(5.0), "batcher died on the malformed batch"
+        assert good.event.wait(5.0), "pipeline died on the malformed batch"
         assert good.result == [7]
         assert srv._inflight == 0, "errored requests must leave inflight"
         srv.queue.close()
-        t.join(5.0)
+        for t in threads:
+            t.join(5.0)
 
     def test_demux_orders_per_request(self):
-        """The batch loop demuxes one padded forward back into
+        """The two-stage pipeline demuxes one padded forward back into
         per-request results, in row order (stub apply — no jax)."""
         import numpy as np
 
@@ -336,15 +336,18 @@ class TestBatcher:
         srv = InferenceServer("mnist-mlp", "/nope", 0, batch_max=8,
                               batch_timeout_ms=10.0, replica="t-0")
         srv._input_shape = (1,)
-        srv._apply = lambda x: np.asarray([int(v[0]) * 10 for v in x])
+        srv._apply = lambda p, x: np.asarray([int(v[0]) * 10 for v in x])
         a, b = _Pending([[1], [2]]), _Pending([[3]])
         srv.queue.submit(a)
         srv.queue.submit(b)
         srv.queue.close()
-        srv._batch_loop()
+        for t in srv.start_pipeline():
+            t.join(5.0)
         assert a.result == [10, 20]
         assert b.result == [30]
         assert srv._served == 2 and srv._batches == 1
+        # 3 useful rows rode a bucket-4 pad (buckets 1,2,4,8 for max 8).
+        assert (srv._rows_useful, srv._rows_padded) == (3, 4)
 
 
 # ------------------------------------------------------------ autoscale math
@@ -890,7 +893,10 @@ class TestServeMetrics:
                     "tpujob_serve_batch_size",
                     "tpujob_serve_latency_seconds",
                     "tpujob_serve_ready_replicas",
-                    "tpujob_serve_scale_events_total"):
+                    "tpujob_serve_scale_events_total",
+                    "tpujob_serve_pad_efficiency",
+                    "tpujob_serve_router_requests_total",
+                    "tpujob_serve_ckpt_follow_total"):
             assert fam in names
             assert fam in doc
 

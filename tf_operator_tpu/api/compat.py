@@ -260,6 +260,10 @@ def infsvc_from_dict(manifest: dict[str, Any],
                 checkpoint_dir=model_d.get("checkpointDir", ""),
                 from_train_job=model_d.get("fromTrainJob", ""),
                 model=model_d.get("model", ""),
+                follow=bool(model_d.get("follow", False)),
+                follow_poll_seconds=(
+                    2.0 if model_d.get("followPollSeconds") is None
+                    else float(model_d["followPollSeconds"])),
             ),
             serving=ServingSpec(
                 # Explicit 0 must reach validation (>= 1 rule) — the
@@ -273,6 +277,9 @@ def infsvc_from_dict(manifest: dict[str, Any],
                       else int(serving_d["port"])),
                 heartbeat_timeout_seconds=serving_d.get(
                     "heartbeatTimeoutSeconds"),
+                # Absent = bucketed (the fast path); explicit false is
+                # the pad-to-max baseline exp_serve measures against.
+                bucketing=bool(serving_d.get("bucketing", True)),
             ),
             autoscale=AutoscaleSpec(
                 min_replicas=(1 if auto_d.get("minReplicas") is None
@@ -350,6 +357,8 @@ def infsvc_to_dict(svc) -> dict[str, Any]:
                 "checkpointDir": spec.model.checkpoint_dir,
                 "fromTrainJob": spec.model.from_train_job,
                 "model": spec.model.model,
+                "follow": spec.model.follow,
+                "followPollSeconds": spec.model.follow_poll_seconds,
             },
             "serving": {
                 "batchMaxSize": spec.serving.batch_max_size,
@@ -357,6 +366,7 @@ def infsvc_to_dict(svc) -> dict[str, Any]:
                 "port": spec.serving.port,
                 "heartbeatTimeoutSeconds":
                     spec.serving.heartbeat_timeout_seconds,
+                "bucketing": spec.serving.bucketing,
             },
             "autoscale": {
                 "minReplicas": spec.autoscale.min_replicas,

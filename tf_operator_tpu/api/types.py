@@ -482,11 +482,22 @@ class ModelSpec:
 
     model: architecture name (the trainer's --model vocabulary, e.g.
     "mnist-mlp"); empty = inherit from the TrainJob or default mnist-mlp.
+
+    follow: checkpoint FOLLOWING — the server polls
+    latest_valid_checkpoint every follow_poll_seconds and hot-swaps
+    params between batches (background host load, atomic ref swap, no
+    recompile: shapes are unchanged), so serving tracks a live TrainJob
+    with zero dropped requests. With fromTrainJob, the handoff resolves
+    as soon as the job EXISTS (Running included) instead of waiting for
+    Succeeded, and the server waits for the first valid checkpoint
+    before readiness.
     """
 
     checkpoint_dir: str = ""
     from_train_job: str = ""
     model: str = ""
+    follow: bool = False
+    follow_poll_seconds: float = 2.0
 
 
 @dataclass
@@ -502,12 +513,18 @@ class ServingSpec:
     heartbeat_timeout_seconds: per-replica hang watchdog — a Running
     server replica whose heartbeat is older than this is restarted
     (None disables), the serving analogue of recovery.heartbeatTimeoutSeconds.
+    bucketing: shape-bucketed compilation — pad each micro-batch to the
+    smallest power-of-two bucket <= batch_max_size instead of always the
+    max (the small, fixed bucket-shape set is warmed before readiness),
+    so light-load latency and wasted FLOPs drop with occupancy. False =
+    the pad-to-max baseline (one compiled shape).
     """
 
     batch_max_size: int = 8
     batch_timeout_ms: float = 5.0
     port: int = 8500
     heartbeat_timeout_seconds: float | None = None
+    bucketing: bool = True
 
 
 @dataclass
@@ -560,6 +577,12 @@ class InferenceServiceStatus:
     # Lifetime server-replica restarts (per-replica replacement of failed
     # pods — stateless serving always restarts; this is the visibility).
     restarts: int = 0
+    # The shared front-end router's address ("host:port") when the
+    # operator runs one (local runtime): the single endpoint clients hit;
+    # it routes each request to the READY replica with least
+    # time-averaged inflight. None on substrates where the front-end is
+    # an external Service/LB (K8s).
+    router_endpoint: str | None = None
     start_time: float | None = None
     last_reconcile_time: float | None = None
 

@@ -268,6 +268,8 @@ def validate_inference_service(svc, fleet=None) -> list[str]:
             problems.append(
                 f"model.fromTrainJob {model.from_train_job!r} does not "
                 f"name a valid TrainJob ('name' or 'namespace/name')")
+    if model.follow_poll_seconds <= 0:
+        problems.append("model.followPollSeconds must be > 0")
     if not spec.template.containers:
         problems.append("template has no containers")
     elif serving_container(spec.template) is None:

@@ -251,6 +251,16 @@ def cmd_operator(args) -> int:
             log.info("K8s informers synced (%s)", args.kube_api or "in-cluster")
         else:
             runtime = LocalProcessRuntime(cluster, log_dir=args.log_dir)
+            # Local runtime: the serve controller runs an in-process
+            # front-end router per InferenceService, with backends
+            # resolved through the runtime's port map (on K8s the
+            # front-end is a readiness-probed Service/LB instead).
+            from tf_operator_tpu.serve.router import (
+                local_endpoint_resolver,
+            )
+
+            serve_controller.endpoint_resolver = (
+                local_endpoint_resolver(runtime))
         # Leadership won and informers synced: hand the port from the
         # standby /healthz stub to the real ApiServer HERE (not at the top
         # of lead() — controller construction + informer sync can take tens

@@ -112,6 +112,9 @@ def _infsvc_payload(cluster, svc, telemetry=None) -> dict:
             "desiredReplicas": svc.status.desired_replicas,
             "lastScaleTime": svc.status.last_scale_time,
             "restarts": svc.status.restarts,
+            # The shared front-end: the ONE address clients should hit
+            # (least-loaded, readiness-gated routing — serve/router.py).
+            "routerEndpoint": svc.status.router_endpoint,
             "startTime": svc.status.start_time,
         },
         "events": [

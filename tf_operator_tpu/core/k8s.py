@@ -259,6 +259,7 @@ def infsvc_status_to_dict(status) -> dict:
         "lastScaleTime": status.last_scale_time,
         "lowLoadSince": status.low_load_since,
         "restarts": status.restarts,
+        "routerEndpoint": status.router_endpoint,
         "startTime": status.start_time,
     }
 
@@ -273,6 +274,7 @@ def infsvc_status_from_dict(d: dict):
         last_scale_time=d.get("lastScaleTime"),
         low_load_since=d.get("lowLoadSince"),
         restarts=int(d.get("restarts") or 0),
+        router_endpoint=d.get("routerEndpoint"),
         start_time=d.get("startTime"),
     )
     for c in d.get("conditions") or []:

@@ -139,6 +139,22 @@ class PortMap:
     def local_port(self, host: str, port: int) -> int | None:
         return self.ports.get(host, {}).get(port)
 
+    def local_addr(self, host_prefix: str, port: int) -> str | None:
+        """'127.0.0.1:p' for the first host matching `host_prefix` (a
+        replica's DNS identity, sans port), preferring the declared
+        `port` and falling back to the host's lowest mapped port. The
+        ONE lookup both LocalSession.replica_address and the front-end
+        router's endpoint resolver share — the two consumers must never
+        drift on the prefix/fallback rules."""
+        for h, mapping in self.ports.items():
+            if h.startswith(host_prefix):
+                local = mapping.get(port)
+                if local is None and mapping:
+                    local = sorted(mapping.values())[0]
+                return (f"127.0.0.1:{local}" if local is not None
+                        else None)
+        return None
+
     def rewrite(self, value: str) -> str:
         # host:port pairs first (longest match), then bare hostnames.
         for host, mapping in self.ports.items():

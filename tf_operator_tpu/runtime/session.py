@@ -76,17 +76,22 @@ class LocalSession:
             InferenceServiceController,
         )
 
+        # The runtime comes up before the serve controller so the
+        # front-end router's backends can resolve through its port map.
+        self.runtime = LocalProcessRuntime(
+            self.cluster, env_overrides=env_overrides, log_dir=log_dir
+        )
+        from tf_operator_tpu.serve.router import local_endpoint_resolver
+
         self.serve_controller = InferenceServiceController(
             self.cluster,
             slice_allocator=slice_allocator,
             scheduler=scheduler,
             heartbeat_source=self.telemetry,
             enqueue_router=_route,
+            endpoint_resolver=local_endpoint_resolver(self.runtime),
         )
         serve_ref.append(self.serve_controller)
-        self.runtime = LocalProcessRuntime(
-            self.cluster, env_overrides=env_overrides, log_dir=log_dir
-        )
         self.controller.run(workers=workers)
         self.serve_controller.run(workers=1)
 
@@ -163,6 +168,17 @@ class LocalSession:
         return self.replica_address(service, namespace, "server", index,
                                     port=port)
 
+    def service_address(self, service: str,
+                        namespace: str = "default") -> str | None:
+        """The service's SHARED front-end endpoint (serve/router.py):
+        one address, least-loaded + readiness-gated routing over the
+        replicas — what clients should hit instead of per-replica
+        round-robin. None until the first reconcile publishes it."""
+        svc = self.cluster.try_get_infsvc(namespace, service)
+        if svc is None:
+            return None
+        return svc.status.router_endpoint
+
     def wait_for_delete(self, namespace: str, name: str, timeout: float = 60.0) -> None:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
@@ -182,13 +198,7 @@ class LocalSession:
         if pm is None:
             return None
         host = f"{gen_general_name(job_name, rtype, index)}.{namespace}.svc"
-        for h, mapping in pm.ports.items():
-            if h.startswith(host):
-                local = mapping.get(port)
-                if local is None and mapping:
-                    local = sorted(mapping.values())[0]
-                return f"127.0.0.1:{local}" if local is not None else None
-        return None
+        return pm.local_addr(host, port)
 
     def replica_http(self, job_name: str, namespace: str, rtype: str, index: int,
                      path: str, timeout: float = 5.0) -> dict:

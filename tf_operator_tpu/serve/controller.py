@@ -91,6 +91,9 @@ ENV_PORT = "TPUJOB_SERVE_PORT"
 ENV_BATCH_MAX = "TPUJOB_SERVE_BATCH_MAX"
 ENV_BATCH_TIMEOUT_MS = "TPUJOB_SERVE_BATCH_TIMEOUT_MS"
 ENV_ENDPOINT = "TPUJOB_SERVE_ENDPOINT"
+ENV_BUCKETING = "TPUJOB_SERVE_BUCKETING"
+ENV_FOLLOW = "TPUJOB_SERVE_FOLLOW"
+ENV_FOLLOW_POLL = "TPUJOB_SERVE_FOLLOW_POLL_S"
 # fromTrainJob resolution cache (annotations, persisted with status): a
 # service that already resolved — and may already be SERVING — must not
 # wedge when the finished TrainJob is later deleted (routine cleanup).
@@ -133,9 +136,17 @@ class InferenceServiceController(ctrl.JobControllerBase):
         fleet_policy=None,
         queue_shards: int = 1,
         enqueue_router=None,
+        endpoint_resolver=None,
     ):
         super().__init__(cluster, queue_shards=queue_shards,
                          enqueue_router=enqueue_router)
+        # (namespace, service, pod name, port) -> "host:port" for the
+        # front-end router's backends (serve/router.py). The local
+        # runtime provides one (router.local_endpoint_resolver); on K8s
+        # the front-end is a readiness-probed Service/LB instead and
+        # this stays None (no in-process router).
+        self.endpoint_resolver = endpoint_resolver
+        self._routers: dict[str, object] = {}
         self.scheduler = scheduler
         if scheduler is not None and slice_allocator is None:
             slice_allocator = scheduler.allocator
@@ -153,6 +164,11 @@ class InferenceServiceController(ctrl.JobControllerBase):
         # eviction drains in flight: claim keys whose pod we already
         # deleted for a preemption (requeue fires once the pod is gone).
         self._evicting: set[str] = set()
+
+    def stop(self) -> None:
+        super().stop()
+        for key in list(self._routers):
+            self._close_router(key)
 
     # ---- owner accessors (the whole per-kind surface of the base) ----
 
@@ -177,6 +193,7 @@ class InferenceServiceController(ctrl.JobControllerBase):
             self.expectations.delete_expectations(
                 naming.gen_expectation_services_key(key, SERVER_REPLICA))
             self._release_all_claims(key)
+            self._close_router(key)
             metrics.serve_ready_replicas.remove(namespace=ns, service=name)
             return
 
@@ -190,10 +207,13 @@ class InferenceServiceController(ctrl.JobControllerBase):
             self.cluster.record_event(
                 InferenceService.KIND, ns, name, "Warning",
                 REASON_INVALID, msg)
-            if status_engine.set_condition(
+            # An invalid spec never reaches reconcile again: close the
+            # front door here so a dead port is not advertised.
+            changed = status_engine.set_condition(
                 svc.status, JobConditionType.FAILED, REASON_INVALID, msg,
-                self._now(),
-            ):
+                self._now())
+            changed = self._close_router(key, svc) or changed
+            if changed:
                 self.cluster.update_infsvc_status(svc)
             return
 
@@ -228,6 +248,7 @@ class InferenceServiceController(ctrl.JobControllerBase):
             for s in services:
                 self._tracked_delete_service(svc, s)
             self._release_all_claims(key)
+            self._close_router(key, svc)
             if svc.status != old_status:
                 self.cluster.update_infsvc_status(svc)
             return
@@ -253,6 +274,11 @@ class InferenceServiceController(ctrl.JobControllerBase):
         svc.status.desired_replicas = desired
 
         live = [p for p in pods if not p.is_finished()]
+
+        # Front-end router: sync the backend set from the live pods and
+        # publish the endpoint. Before the autoscale tick — the router's
+        # time-averaged inflight is a load signal.
+        self._router_tick(svc, key, live)
 
         # Autoscale BEFORE the replica loop so this sync reconciles
         # toward the fresh target.
@@ -470,6 +496,35 @@ class InferenceServiceController(ctrl.JobControllerBase):
             # Resolved once already (possibly by a previous leader): the
             # handoff is DONE — deleting the finished TrainJob afterwards
             # must not wedge a serving workload back into Waiting.
+            # One exception: a FOLLOW service that has NEVER served
+            # (follow resolves the moment the job exists, so the cache
+            # is written before any checkpoint does) whose trainer then
+            # fails before its first save would wait forever —
+            # heartbeat-fresh (the wait loop ticks liveness) and
+            # invisible to every alert. Surface Failed for that state;
+            # a service that HAS served keeps serving (availability
+            # first — the trainer may be resubmitted and continue).
+            ever_served = any(
+                c.type == JobConditionType.RUNNING
+                for c in svc.status.conditions)
+            if svc.spec.model.follow and not ever_served:
+                ref = svc.spec.model.from_train_job
+                jns, _, jname = ref.rpartition("/")
+                jns = jns or svc.namespace
+                job = self.cluster.try_get_job(jns, jname)
+                if job is not None and has_condition(
+                        job.status, JobConditionType.FAILED):
+                    self.cluster.record_event(
+                        InferenceService.KIND, svc.namespace, svc.name,
+                        "Warning", REASON_TRAINJOB_FAILED,
+                        f"fromTrainJob {jns}/{jname} failed before its "
+                        f"first checkpoint; nothing to follow")
+                    status_engine.set_condition(
+                        svc.status, JobConditionType.FAILED,
+                        REASON_TRAINJOB_FAILED,
+                        f"TrainJob {jns}/{jname} failed before saving a "
+                        f"checkpoint; nothing to follow.", self._now())
+                    return None
             return cached, (
                 svc.metadata.annotations.get(ANNOTATION_RESOLVED_MODEL)
                 or api_defaults.DEFAULT_SERVE_MODEL)
@@ -478,9 +533,22 @@ class InferenceServiceController(ctrl.JobControllerBase):
         ns = ns or svc.namespace
         job = self.cluster.try_get_job(ns, jname)
         now = self._now()
-        if job is None or not is_succeeded(job.status):
-            if job is not None and has_condition(
-                    job.status, JobConditionType.FAILED):
+        job_failed = job is not None and has_condition(
+            job.status, JobConditionType.FAILED)
+        if job is None or job_failed or (
+                not model.follow and not is_succeeded(job.status)):
+            # Follow mode tracks a LIVE trainer: the handoff resolves as
+            # soon as the job EXISTS (the server waits for its first
+            # valid checkpoint, then follows every periodic save) — only
+            # load-once serving must wait for Succeeded. A job that is
+            # already FAILED at resolve time surfaces Failed in BOTH
+            # modes (a follow replica would otherwise wait forever for a
+            # first save that may never come, heartbeat-fresh and
+            # invisible to every alert). A job failing AFTER resolution
+            # is different: the annotation cache keeps an
+            # already-serving follower serving — the trainer may be
+            # resubmitted and continue.
+            if job_failed:
                 self.cluster.record_event(
                     InferenceService.KIND, svc.namespace, svc.name,
                     "Warning", REASON_TRAINJOB_FAILED,
@@ -663,32 +731,91 @@ class InferenceServiceController(ctrl.JobControllerBase):
                 acted = True
         return acted
 
+    # ------------------------------------------------------------- router
+
+    def _router_tick(self, svc: InferenceService, key: str,
+                     live: list[Pod]) -> None:
+        """Create/sync this service's front-end router (serve/router.py)
+        when the operator has an endpoint resolver: backends = live
+        RUNNING pods' resolved addresses (the router's own probe gates
+        readiness on the server actually answering — pod Running !=
+        warmed), endpoint published in status.routerEndpoint."""
+        if self.endpoint_resolver is None:
+            return
+        router = self._routers.get(key)
+        if router is None:
+            from tf_operator_tpu.serve.router import FrontEndRouter
+
+            router = FrontEndRouter(service=key)
+            self._routers[key] = router
+            self.cluster.record_event(
+                InferenceService.KIND, svc.namespace, svc.name,
+                "Normal", "RouterReady",
+                f"front-end router on {router.endpoint} (least-loaded, "
+                f"readiness-gated)")
+        backends: dict[str, str] = {}
+        for p in live:
+            if p.status.phase != PodPhase.RUNNING:
+                continue
+            addr = self.endpoint_resolver(
+                svc.namespace, svc.name, p.name, svc.spec.serving.port)
+            if addr:
+                backends[p.name] = addr
+        router.set_backends(backends)
+        svc.status.router_endpoint = router.endpoint
+
+    def _close_router(self, key: str, svc=None) -> bool:
+        """Close the service's router AND clear the advertised endpoint
+        in one place — every early-return path that closes the front
+        door must stop advertising the dead port, and hand-pairing the
+        two at each site is how that invariant gets lost. Returns True
+        when `svc`'s status changed."""
+        router = self._routers.pop(key, None)
+        if router is not None:
+            router.close()
+        if svc is not None and svc.status.router_endpoint is not None:
+            svc.status.router_endpoint = None
+            return True
+        return False
+
     # ---------------------------------------------------------- autoscale
 
     def _service_load(self, svc: InferenceService,
                       live: list[Pod]) -> float | None:
-        """Total inflight across LIVE replicas from the collector's
-        per-replica serve stats; None when no signal exists yet."""
-        if self.heartbeat_source is None:
-            return None
-        load_fn = getattr(self.heartbeat_source, "service_load", None)
-        if load_fn is None:
-            return None
-        per_pod = load_fn(svc.namespace, svc.name) or {}
+        """Total inflight across LIVE replicas: the MAX of the
+        collector's per-replica serve stats and the front-end router's
+        own time-averaged inflight. Both count the same requests (a
+        routed request is inflight at the router AND on its replica), so
+        max — never sum — avoids double-counting while covering traffic
+        that bypasses the router (direct replica clients) and traffic
+        the stats file hasn't flushed yet. None when no signal exists."""
         names = {p.name for p in live}
-        seen = [s for pod, s in per_pod.items() if pod in names]
-        if not seen:
-            return None
-        return float(sum(s.get("inflight") or 0 for s in seen))
+        total: float | None = None
+        load_fn = getattr(self.heartbeat_source, "service_load", None) \
+            if self.heartbeat_source is not None else None
+        if load_fn is not None:
+            per_pod = load_fn(svc.namespace, svc.name) or {}
+            seen = [s for pod, s in per_pod.items() if pod in names]
+            if seen:
+                total = float(sum(s.get("inflight") or 0 for s in seen))
+        router = self._routers.get(svc.key())
+        if router is not None:
+            per_backend = router.load()
+            seen_r = [v for n, v in per_backend.items() if n in names]
+            if seen_r:
+                r_total = float(sum(seen_r))
+                total = r_total if total is None else max(total, r_total)
+        return total
 
     def _autoscale_tick(self, svc: InferenceService, key: str,
                         live: list[Pod], desired: int, now: float) -> int:
         auto = svc.spec.autoscale
         if auto.max_replicas <= auto.min_replicas:
             return max(desired, auto.min_replicas)
-        if self.heartbeat_source is None:
-            # No collector (operator without --log-dir): no load signal
-            # can ever arrive — polling would be a 1 Hz no-op forever.
+        if self.heartbeat_source is None and key not in self._routers:
+            # No collector (operator without --log-dir) and no router:
+            # no load signal can ever arrive — polling would be a 1 Hz
+            # no-op forever.
             return desired
         total = self._service_load(svc, live)
         if total is None:
@@ -783,6 +910,11 @@ class InferenceServiceController(ctrl.JobControllerBase):
             c.set_env(ENV_PORT, str(serving.port))
             c.set_env(ENV_BATCH_MAX, str(serving.batch_max_size))
             c.set_env(ENV_BATCH_TIMEOUT_MS, str(serving.batch_timeout_ms))
+            c.set_env(ENV_BUCKETING, "1" if serving.bucketing else "0")
+            if svc.spec.model.follow:
+                c.set_env(ENV_FOLLOW, "1")
+                c.set_env(ENV_FOLLOW_POLL,
+                          str(svc.spec.model.follow_poll_seconds))
             # Own DNS identity: the local runtime's port map rewrites this
             # (and allocates the replica's localhost listen port from it).
             c.set_env(ENV_ENDPOINT,

@@ -1,0 +1,384 @@
+"""Shared front-end router: ONE operator-managed endpoint per
+InferenceService.
+
+Before round 18 every client round-robined the per-replica endpoints
+itself — and paid for it: a pod that is Running but still warming its
+jit cache answers nothing, so every scale-out produced a documented
+error burst (PR-13's known-error). The router kills that class:
+
+  * READINESS-GATED — a probe thread polls each backend's /healthz;
+    only replicas that answer ok:true receive traffic. Pod Running !=
+    server ready (checkpoint load + bucket warmup take seconds); the
+    probe is the truth.
+  * LEAST-LOADED — each request routes to the ready replica with the
+    least TIME-AVERAGED inflight (exponentially-weighted inflight·dt,
+    tau ~1 s; instantaneous count breaks ties). The same Little's-law
+    lesson as the autoscale signal: an instantaneous count read between
+    batches is ~0 for everyone and routes blind.
+  * RE-ROUTING — a forward that fails at the socket level marks the
+    backend not-ready (the probe re-admits it when it answers again)
+    and retries the next ready replica, so a replica dying or being
+    preempted mid-request costs a retry, not a client error. /predict
+    is pure inference — idempotent — so retry-after-send is safe.
+
+The serve controller owns one router per service (created lazily when
+the operator runs with an endpoint resolver — the local runtime's port
+map; on K8s the front-end is a readiness-probed Service/LB instead) and
+syncs its backend set every reconcile from the live pods. The router's
+address is published in status.routerEndpoint, and its per-backend
+time-averaged inflight doubles as an autoscale load signal
+(`router.load()`), so scaling reacts to traffic the moment it enters
+the front door — no stats-file round trip.
+
+Metrics: tpujob_serve_router_requests_total{replica} counts forwards
+per backend (the router runs inside the operator process, so the
+series lands on the operator's /metrics like the scheduler's).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import math
+import socket
+import threading
+import time
+
+from tf_operator_tpu.status import metrics as metrics_mod
+
+# Exponential window for the time-averaged inflight (seconds): long
+# enough to smooth between-batch zeros, short enough that a drained
+# replica looks drained within a couple of batch windows.
+LOAD_TAU_S = 1.0
+
+
+class _ReadTimeout(Exception):
+    """The backend accepted the connection but did not answer within
+    request_timeout_s. The request may well still be EXECUTING on an
+    alive-but-slow replica — failing over would re-send the work to an
+    equally loaded survivor (retry amplification: one slow replica turns
+    N queued requests into 2N) exactly when the service is saturated, so
+    the router answers 504 instead and leaves the backend ready."""
+
+
+class _Backend:
+    __slots__ = ("name", "addr", "ready", "inflight", "ewma", "last_t",
+                 "requests", "failures", "timeouts_consec")
+
+    def __init__(self, name: str, addr: str):
+        self.name = name
+        self.addr = addr
+        self.ready = False
+        self.inflight = 0
+        self.ewma = 0.0            # time-averaged inflight (EW)
+        self.last_t = time.monotonic()
+        self.requests = 0
+        self.failures = 0
+        # Consecutive read-timeouts: a timeout doesn't gate readiness
+        # (alive-but-slow != dead, and the probe would re-admit a wedged
+        # dispatch thread anyway — /healthz still answers), but _pick
+        # demotes a repeat offender to last resort so it can't become a
+        # 504 black hole that keeps winning least-loaded (every timeout
+        # releases its inflight). Any successful answer resets it.
+        self.timeouts_consec = 0
+
+    def touch(self, now: float) -> None:
+        """Advance the EW time-average to `now` (caller holds the
+        router lock)."""
+        dt = max(0.0, now - self.last_t)
+        if dt > 0:
+            alpha = 1.0 - math.exp(-dt / LOAD_TAU_S)
+            self.ewma += (self.inflight - self.ewma) * alpha
+            self.last_t = now
+
+
+class FrontEndRouter:
+    """One service's front door. Thread shape: N handler threads
+    (ThreadingHTTPServer) pick/forward/account, one probe thread flips
+    readiness. All shared state behind one lock; no lock is ever held
+    across a network call."""
+
+    def __init__(self, service: str, probe_interval_s: float = 0.25,
+                 request_timeout_s: float = 30.0):
+        self.service = service
+        self.probe_interval_s = probe_interval_s
+        self.request_timeout_s = request_timeout_s
+        self._lock = threading.Lock()
+        self._backends: dict[str, _Backend] = {}
+        self._stop = threading.Event()
+        from http.server import ThreadingHTTPServer
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                          self._make_handler())
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name=f"serve-router-{service}").start()
+        threading.Thread(target=self._probe_loop, daemon=True,
+                         name=f"serve-router-probe-{service}").start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    # ---------------------------------------------------------- backends
+
+    def set_backends(self, backends: dict[str, str]) -> None:
+        """Sync the backend set (pod name -> host:port). New backends
+        start NOT ready (the probe admits them — pod Running != server
+        ready); a removed or re-addressed pod drops immediately
+        (re-routing on replica death/preemption/restart)."""
+        with self._lock:
+            for name in list(self._backends):
+                b = self._backends[name]
+                if name not in backends or backends[name] != b.addr:
+                    del self._backends[name]
+            for name, addr in backends.items():
+                if name not in self._backends:
+                    self._backends[name] = _Backend(name, addr)
+
+    def backends(self) -> dict[str, dict]:
+        with self._lock:
+            now = time.monotonic()
+            out = {}
+            for b in self._backends.values():
+                b.touch(now)
+                out[b.name] = {
+                    "addr": b.addr, "ready": b.ready,
+                    "inflight": b.inflight,
+                    "avg_inflight": round(b.ewma, 3),
+                    "requests": b.requests, "failures": b.failures,
+                }
+            return out
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return sum(1 for b in self._backends.values() if b.ready)
+
+    def load(self) -> dict[str, float]:
+        """pod name -> time-averaged inflight AT THE ROUTER — the
+        autoscale signal for traffic entering through the front door
+        (includes queue wait on the replica, per Little's law)."""
+        with self._lock:
+            now = time.monotonic()
+            out = {}
+            for b in self._backends.values():
+                b.touch(now)
+                # The EW average lags a step arrival by ~tau; the
+                # instantaneous count floors it so a sudden burst is
+                # never under-read at the tick that matters (scale-up
+                # is latency).
+                out[b.name] = max(b.ewma, float(b.inflight))
+            return out
+
+    # ----------------------------------------------------------- probing
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                targets = [(b.name, b.addr) for b in
+                           self._backends.values()]
+            for name, addr in targets:
+                ok = self._probe_one(addr)
+                with self._lock:
+                    b = self._backends.get(name)
+                    if b is not None and b.addr == addr:
+                        b.ready = ok
+            self._stop.wait(timeout=self.probe_interval_s)
+
+    def _probe_one(self, addr: str) -> bool:
+        host, _, port = addr.rpartition(":")
+        try:
+            conn = http.client.HTTPConnection(host, int(port), timeout=1.0)
+            try:
+                conn.request("GET", "/healthz")
+                r = conn.getresponse()
+                body = r.read()
+                if r.status != 200:
+                    return False
+                return bool(json.loads(body).get("ok"))
+            finally:
+                conn.close()
+        except Exception:  # noqa: BLE001 — any probe failure = not ready
+            return False
+
+    # ----------------------------------------------------------- routing
+
+    def _pick(self, exclude: set[str]) -> _Backend | None:
+        """The READY backend with least time-averaged inflight
+        (instantaneous inflight, then lifetime requests, break ties —
+        the latter spreads the very first burst before any average
+        exists). Returns with inflight already incremented so a
+        concurrent pick sees the load."""
+        with self._lock:
+            now = time.monotonic()
+            best: _Backend | None = None
+            best_key = None
+            for b in self._backends.values():
+                if not b.ready or b.name in exclude:
+                    continue
+                b.touch(now)
+                # The instantaneous count FLOORS the EW average (same
+                # rule as load()): a just-admitted backend's ewma~0 lags
+                # its rising queue by ~tau, and comparing raw ewma would
+                # dump the whole stream on the cold replica while warm
+                # ones idle. A backend on a read-timeout streak sorts
+                # behind every healthy one regardless of load — it only
+                # receives traffic when it is the last replica standing
+                # (and one answer un-demotes it).
+                key = (1 if b.timeouts_consec >= 2 else 0,
+                       max(b.ewma, float(b.inflight)), b.inflight,
+                       b.requests)
+                if best is None or key < best_key:
+                    best, best_key = b, key
+            if best is not None:
+                best.inflight += 1
+                best.requests += 1
+            return best
+
+    def _settle(self, name: str, failed: bool, gate: bool = True,
+                timed_out: bool = False) -> None:
+        with self._lock:
+            b = self._backends.get(name)
+            if b is None:
+                return
+            b.touch(time.monotonic())
+            b.inflight = max(0, b.inflight - 1)
+            if timed_out:
+                b.timeouts_consec += 1
+            elif not failed:
+                b.timeouts_consec = 0  # any real answer clears the streak
+            if failed:
+                b.failures += 1
+                if gate:
+                    # The probe re-admits it when it answers again.
+                    b.ready = False
+
+    def _forward(self, backend: _Backend, method: str, path: str,
+                 body: bytes | None) -> tuple[int, bytes]:
+        host, _, port = backend.addr.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port),
+                                          timeout=self.request_timeout_s)
+        try:
+            # Connect-phase failures (refused, dead pod, connect
+            # timeout) happen BEFORE any work was handed over — safe to
+            # fail over. A timeout AFTER the request was sent is not:
+            # the backend is alive and may still be computing.
+            conn.connect()
+            try:
+                headers = ({"Content-Type": "application/json"}
+                           if body else {})
+                conn.request(method, path, body=body, headers=headers)
+                r = conn.getresponse()
+                return r.status, r.read()
+            except (socket.timeout, TimeoutError) as e:
+                raise _ReadTimeout from e
+        finally:
+            conn.close()
+
+    def route(self, method: str, path: str,
+              body: bytes | None) -> tuple[int, bytes]:
+        """Forward to the least-loaded ready replica, failing over to
+        the next one when the chosen replica dies mid-request (socket
+        errors only — an HTTP status from the server, even a 5xx, IS
+        the answer and is relayed verbatim). A backend that accepted the
+        request but exceeded request_timeout_s answers 504 WITHOUT
+        failover or readiness gating: the work is likely still running
+        there, and replaying it on an equally loaded survivor amplifies
+        exactly the overload that caused the slowness."""
+        tried: set[str] = set()
+        while True:
+            backend = self._pick(tried)
+            if backend is None:
+                return 503, json.dumps(
+                    {"error": f"no ready replica for {self.service} "
+                              f"({len(tried)} tried)"}).encode()
+            try:
+                status, payload = self._forward(backend, method, path,
+                                                body)
+            except _ReadTimeout:
+                # The request WAS handed over (and may still execute
+                # there): it counts as a forward to this backend.
+                metrics_mod.serve_router_requests_total.labels(
+                    replica=backend.name).inc()
+                self._settle(backend.name, failed=True, gate=False,
+                             timed_out=True)
+                return 504, json.dumps(
+                    {"error": f"backend {backend.name} timed out after "
+                              f"{self.request_timeout_s}s (request may "
+                              "still be executing; not retried)"}).encode()
+            except Exception:  # noqa: BLE001 — socket-level: failover
+                # Nothing was answered and likely nothing executed: a
+                # failed attempt is NOT a forward — counting it would
+                # multiply one client request across every backend tried
+                # during exactly the churn the router exists to smooth.
+                self._settle(backend.name, failed=True)
+                tried.add(backend.name)
+                continue
+            metrics_mod.serve_router_requests_total.labels(
+                replica=backend.name).inc()
+            self._settle(backend.name, failed=False)
+            return status, payload
+
+    # -------------------------------------------------------------- http
+
+    def _make_handler(router):  # noqa: N805 — closure over the router
+        from http.server import BaseHTTPRequestHandler
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: N802
+                pass
+
+            def _send(self, code: int, payload: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    ready = router.ready_count()
+                    self._send(200 if ready else 503, json.dumps({
+                        "ok": ready > 0,
+                        "service": router.service,
+                        "ready_replicas": ready,
+                        "backends": router.backends(),
+                    }).encode())
+                else:
+                    self._send(404, b'{"error": "not found"}')
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else None
+                code, payload = router.route("POST", self.path, body)
+                self._send(code, payload)
+
+        return Handler
+
+    # ------------------------------------------------------------- close
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:  # already closed: teardown is idempotent
+            pass
+
+
+def local_endpoint_resolver(runtime):
+    """(namespace, service, pod name, declared port) -> '127.0.0.1:p'
+    through the local runtime's port map — the same localhost-rewrite
+    contract LocalSession.replica_address uses. The operator hands this
+    to the serve controller; on K8s (no local port map) there is no
+    resolver and no in-process router."""
+
+    def resolve(namespace: str, service: str, pod_name: str,
+                port: int) -> str | None:
+        pm = runtime.port_map(service, namespace)
+        if pm is None:
+            return None
+        return pm.local_addr(f"{pod_name}.{namespace}.svc", port)
+
+    return resolve

@@ -1,45 +1,66 @@
 """The in-pod batch inference server: `python -m tf_operator_tpu.serve.server`.
 
-One serving replica of an InferenceService. Pipeline:
+One serving replica of an InferenceService. The round-18 fast-path
+pipeline:
 
-  HTTP handler threads --(queue)--> one BATCHER thread --(events)--> handlers
+  HTTP handler threads --(queue)--> ASSEMBLER --(slot)--> DISPATCH --(events)--> handlers
 
   * handlers parse `POST /predict {"instances": [[...], ...]}` rows,
     enqueue them, and block on a per-request event;
-  * the single batcher thread assembles micro-batches — it waits up to
+  * the ASSEMBLER thread owns micro-batch assembly — it waits up to
     `--batch-timeout-ms` after the FIRST queued row for peers to
-    coalesce, caps at `--batch-max-size` rows, PADS to the fixed batch
-    shape (one jit compilation, ever), runs ONE jitted forward, and
-    demuxes per-request results.
+    coalesce, caps at `--batch-max-size` rows, pads to the smallest
+    power-of-two BUCKET that fits (shape-bucketed compilation: the
+    small, fixed bucket-shape set is warmed before readiness, so a
+    single-row request no longer pays a full batchMaxSize forward), and
+    hands the padded batch to a depth-1 staging slot;
+  * the DISPATCH thread — the ONLY thread that dispatches XLA programs
+    (the PR-2 rule, repo-wide) — takes staged batches, runs the jitted
+    forward, and demuxes per-request results.
 
-  Thread discipline (the PR-2 rule, repo-wide): the batcher is the ONLY
-  thread that dispatches XLA programs. Handler threads never touch jax.
+  The depth-1 slot is the PR-2 staging-ring discipline at K=1
+  (double-buffering): batch N+1's dequeue/pad runs on the assembler
+  while batch N sits on the device, so dispatch never idles on HTTP
+  plumbing, and a full slot BLOCKS the assembler (bounded memory, never
+  an unbounded intermediate queue).
 
 Checkpoint contract: the newest VALIDATED step under --checkpoint-dir is
 resolved via models/checkpoint.latest_valid_checkpoint — the trainer's
 resume-walk census validation — and restored raw (host snapshot of
-fully-replicated leaves), then placed on device once. A torn newest save
+fully-replicated leaves), then placed on device. A torn newest save
 falls back to the previous valid step exactly like the trainer would.
+
+Checkpoint FOLLOWING (--follow): a background follower thread polls
+latest_valid_checkpoint every --follow-poll-s and, when a strictly newer
+step validates, restores it host-side, places it on device, and swaps
+the served (params, step) pair ATOMICALLY between batches — no restart,
+no recompile (shapes are unchanged), no dropped requests; in-flight
+batches keep the params ref they dispatched with. A failed restore
+(torn save, checkpoint GC racing the read) keeps the old params live and
+retries next poll. In follow mode an EMPTY checkpoint dir at startup is
+not fatal: the server waits (ticking its heartbeat) for the trainer's
+first valid save before readiness.
 
 Liveness + load surfaces:
   * heartbeat (TPUJOB_HEARTBEAT_FILE, utils/preemption.HeartbeatWriter):
-    ticked every batcher wake-up — step = dispatched batches — so the
+    ticked every dispatch wake-up — step = dispatched batches — so the
     controller's serving watchdog covers a wedged server like the hang
     watchdog covers a wedged trainer;
   * serve stats (TPUJOB_SERVE_STATS_FILE, atomic tmp+replace JSON):
-    {inflight, requests_total, served_total, p50/p99 ms, t} — the
-    collector reads it back per replica and the autoscaler sums inflight;
+    {inflight, requests_total, served_total, rows_useful, rows_padded,
+    pad_efficiency, p50/p99 ms, t} — the collector reads it back per
+    replica and the autoscaler sums inflight;
   * /metrics: tpujob_serve_{requests_total,inflight,batch_size,
-    latency_seconds} from the shared registry (status/metrics.py), one
-    child series per replica;
-  * metrics events (TPUJOB_METRICS_FILE): start/serve_ready/done lines,
-    same append-only record the trainer writes.
+    latency_seconds,pad_efficiency} from the shared registry
+    (status/metrics.py), one child series per replica; follow swaps
+    count into tpujob_serve_ckpt_follow_total{result};
+  * metrics events (TPUJOB_METRICS_FILE): start/serve_ready/ckpt_follow/
+    done lines, same append-only record the trainer writes.
 
-Graceful shutdown: SIGTERM latches a stop flag; the batcher drains the
-queued requests (each gets a response), writes a final stats snapshot and
-`done` event, and the process exits 0. Chaos `kill:step=N` (optionally
-`replica=server`) fires after N dispatched batches — deterministic
-serve-replica restart e2es ride the same grammar as trainer kills.
+Graceful shutdown: SIGTERM latches a stop flag; the assembler drains the
+queued requests into the slot, the dispatcher answers them all, a final
+stats snapshot and `done` event are written, and the process exits 0.
+Chaos `kill:step=N[,replica=server]` fires after N dispatched batches.
 """
 
 from __future__ import annotations
@@ -71,10 +92,37 @@ def _emit(event: dict) -> None:
         pass
 
 
+# ------------------------------------------------------------------ buckets
+
+
+def bucket_sizes(batch_max: int) -> tuple[int, ...]:
+    """The shape-bucket ladder for a batchMaxSize: every power of two
+    below it, then the max itself — a small, fixed set of compiled
+    shapes (log2(max)+1 of them), each warmed before readiness."""
+    if batch_max < 1:
+        raise ValueError(f"batch_max must be >= 1, got {batch_max}")
+    sizes = []
+    b = 1
+    while b < batch_max:
+        sizes.append(b)
+        b *= 2
+    sizes.append(batch_max)
+    return tuple(sizes)
+
+
+def select_bucket(n: int, buckets: tuple[int, ...]) -> int:
+    """The smallest bucket that fits n rows (buckets ascend and end at
+    batchMaxSize, so any legal batch fits)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(f"{n} rows exceed the largest bucket {buckets[-1]}")
+
+
 class _Pending:
     """One queued request: rows in, predictions out via the event."""
 
-    __slots__ = ("rows", "event", "result", "error", "t_in")
+    __slots__ = ("rows", "event", "result", "error", "t_in", "step")
 
     def __init__(self, rows):
         self.rows = rows
@@ -82,10 +130,73 @@ class _Pending:
         self.result = None
         self.error: str | None = None
         self.t_in = time.monotonic()
+        self.step: int | None = None  # checkpoint step that served it
+
+
+class _Staged:
+    """One assembled micro-batch parked in the staging slot: the padded
+    device-ready array plus the requests it demuxes back into."""
+
+    __slots__ = ("items", "padded", "n", "bucket")
+
+    def __init__(self, items, padded, n: int, bucket: int):
+        self.items = items
+        self.padded = padded
+        self.n = n
+        self.bucket = bucket
+
+
+class StagingSlot:
+    """Depth-1 staging between the assembler and dispatch stages (the
+    PR-2 staging-ring discipline at K=1). put() BLOCKS while the slot is
+    full — backpressure reaches the assembler instead of growing an
+    unbounded intermediate queue. Only the assembler closes the slot
+    (after draining the request queue), so the dispatcher's drain is
+    race-free."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._item: _Staged | None = None
+        self._closed = False
+
+    def put(self, staged: _Staged) -> bool:
+        with self._cond:
+            while self._item is not None and not self._closed:
+                self._cond.wait()
+            if self._closed:
+                return False
+            self._item = staged
+            self._cond.notify_all()
+            return True
+
+    def take(self, timeout_s: float = 0.05) -> _Staged | None:
+        """The next staged batch, or None on timeout (idle tick) or when
+        closed and drained — check is_closed() to tell the two apart."""
+        with self._cond:
+            deadline = time.monotonic() + timeout_s
+            while self._item is None and not self._closed:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(timeout=left)
+            staged, self._item = self._item, None
+            if staged is not None:
+                self._cond.notify_all()  # wake a blocked put()
+            return staged
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def is_closed(self) -> bool:
+        with self._lock:
+            return self._closed
 
 
 class BatchQueue:
-    """The handler->batcher queue plus the micro-batch assembly wait.
+    """The handler->assembler queue plus the micro-batch assembly wait.
 
     take_batch blocks until at least one request is queued, then waits up
     to `timeout_s` (from the FIRST row's arrival) for more, returning at
@@ -123,8 +234,8 @@ class BatchQueue:
         """The next micro-batch (None when closed AND drained). Without
         traffic, wakes every `poll_s` so the caller can tick liveness."""
         with self._cond:
-            # Arrival wait: bounded by poll_s so the idle batcher still
-            # ticks its heartbeat/stats.
+            # Arrival wait: bounded by poll_s so the idle assembler still
+            # reaches its stop-flag check.
             poll_deadline = time.monotonic() + poll_s
             while (not self._items and not self._closed
                    and poll_deadline - time.monotonic() > 0):
@@ -150,7 +261,8 @@ class BatchQueue:
 class InferenceServer:
     def __init__(self, model_name: str, ckpt_dir: str, port: int,
                  batch_max: int, batch_timeout_ms: float,
-                 replica: str = ""):
+                 replica: str = "", bucketing: bool = True,
+                 follow: bool = False, follow_poll_s: float = 2.0):
         self.model_name = model_name
         self.ckpt_dir = ckpt_dir
         self.port = port
@@ -158,10 +270,15 @@ class InferenceServer:
             os.environ.get("TPUJOB_REPLICA_TYPE", "server"),
             os.environ.get("TPUJOB_REPLICA_INDEX", "0"))
         self.queue = BatchQueue(batch_max, batch_timeout_ms / 1000.0)
+        self.slot = StagingSlot()
         self.batch_max = batch_max
+        self.bucketing = bucketing
+        self.buckets = (bucket_sizes(batch_max) if bucketing
+                        else (batch_max,))
+        self.follow = follow
+        self.follow_poll_s = follow_poll_s
         self.stop = threading.Event()
         self.ready = threading.Event()
-        self.loaded_step: int | None = None
         self._hb = HeartbeatWriter.from_env()
         self._stats_path = os.environ.get(ENV_STATS_FILE)
         self._stats_lock = threading.Lock()
@@ -170,6 +287,11 @@ class InferenceServer:
         self._served = 0
         self._batches = 0
         self._inflight = 0
+        # Pad accounting (cumulative): useful rows vs padded-slot rows
+        # actually dispatched. pad_efficiency = useful/padded is the
+        # bucketing win signal (pad-to-max single-row = 1/batchMaxSize).
+        self._rows_useful = 0
+        self._rows_padded = 0
         # Time-averaged inflight over the current stats window: an
         # instantaneous snapshot right after a batch drains reads ~0
         # under steady open-loop load (the queue empties every window),
@@ -184,23 +306,67 @@ class InferenceServer:
         self.m_inflight = metrics_mod.serve_inflight.labels(**labels)
         self.m_batch = metrics_mod.serve_batch_size.labels(**labels)
         self.m_latency = metrics_mod.serve_latency_seconds.labels(**labels)
+        self.m_pad_eff = metrics_mod.serve_pad_efficiency.labels(**labels)
         from tf_operator_tpu import chaos as chaos_lib
 
         self._chaos = chaos_lib.TrainerChaos.from_env()
+        # The served model: an ATOMICALLY-swapped (params, step) pair —
+        # the dispatch thread reads it ONCE per batch, the follower
+        # replaces the whole tuple, so a mid-swap batch serves entirely
+        # from the old params (never torn).
+        self._live: tuple[object, int | None] = (None, None)
         self._apply = None
         self._input_shape: tuple[int, ...] = ()
 
+    @property
+    def loaded_step(self) -> int | None:
+        return self._live[1]
+
     # ------------------------------------------------------------- model
+
+    def _restore_host(self, step: int):
+        """Host-side restore of `step`, walking back to older VALIDATED
+        steps when the restore itself raises (census-valid but
+        unreadable), like the trainer does. Returns (params, step) or
+        (None, None) when nothing restores."""
+        from tf_operator_tpu.models import checkpoint as ckpt
+
+        while step is not None:
+            try:
+                return ckpt.restore(self.ckpt_dir, step), step
+            except Exception as e:  # noqa: BLE001 — torn trees raise anything
+                _emit({"event": "serve_fallback", "skipped_step": step,
+                       "reason": f"restore_error: {type(e).__name__}: {e}"})
+                older = [s for s in ckpt.list_steps(self.ckpt_dir)
+                         if s < step]
+                step = None
+                for s in reversed(older):
+                    if ckpt.validate_step(self.ckpt_dir, s):
+                        step = s
+                        break
+        return None, None
 
     def load(self) -> None:
         """Resolve the newest VALIDATED checkpoint, restore it host-side,
-        place it on device, and jit the padded-batch forward."""
+        place it on device, and jit + warm the bucketed forwards."""
         import jax
         import jax.numpy as jnp
 
         from tf_operator_tpu.models import checkpoint as ckpt
 
         step = ckpt.latest_valid_checkpoint(self.ckpt_dir)
+        while step is None and self.follow and not self.stop.is_set():
+            # Follow mode tracks a LIVE trainer: its first periodic save
+            # may not exist yet. Wait for it, ticking liveness so the
+            # serving watchdog knows we are alive, not wedged.
+            self._hb.write(0, force=True)
+            self.stop.wait(timeout=min(0.5, self.follow_poll_s))
+            step = ckpt.latest_valid_checkpoint(self.ckpt_dir)
+        if step is None and self.follow and self.stop.is_set():
+            # Preempted while waiting for the trainer's first save: a
+            # graceful eviction, not a failure — run() sees the stop
+            # flag with no model loaded and drains to exit 0.
+            return
         if step is None:
             raise FileNotFoundError(
                 f"no valid checkpoint under {self.ckpt_dir} (torn/empty "
@@ -216,44 +382,106 @@ class InferenceServer:
                 f"serving model {self.model_name!r} not supported (mnist-"
                 f"mlp / mnist-conv today; the contract is the trainer's "
                 f"--model vocabulary)")
-        # Walk back past steps whose restore raises (census-valid but
-        # unreadable), like the trainer does.
-        params = None
-        while step is not None:
-            try:
-                params = ckpt.restore(self.ckpt_dir, step)
-                break
-            except Exception as e:  # noqa: BLE001 — torn trees raise anything
-                _emit({"event": "serve_fallback", "skipped_step": step,
-                       "reason": f"restore_error: {type(e).__name__}: {e}"})
-                older = [s for s in ckpt.list_steps(self.ckpt_dir)
-                         if s < step]
-                step = None
-                for s in reversed(older):
-                    if ckpt.validate_step(self.ckpt_dir, s):
-                        step = s
-                        break
+        params, step = self._restore_host(step)
         if params is None:
             raise FileNotFoundError(
                 f"every checkpoint under {self.ckpt_dir} failed to restore")
-        self.loaded_step = step
         params = jax.device_put(params)
 
         def forward(p, x):
             return jnp.argmax(model.apply({"params": p}, x), axis=-1)
 
         jitted = jax.jit(forward)
-        # Warm the compile cache at the FIXED padded shape so the first
-        # real request doesn't pay compilation.
+        # Warm the compile cache at EVERY bucket shape (a small, fixed
+        # set: log2(batchMaxSize)+1 shapes) so no real request ever pays
+        # compilation — the bucketed analogue of the single pad-to-max
+        # warmup.
         import numpy as np
 
-        pad = np.zeros((self.batch_max, *self._input_shape), np.float32)
-        jitted(params, pad).block_until_ready()
+        for b in self.buckets:
+            pad = np.zeros((b, *self._input_shape), np.float32)
+            jitted(params, pad).block_until_ready()
+            # Per-bucket liveness: warming log2(max)+1 shapes can take
+            # long enough that a silent warmup trips the serving
+            # watchdog (which measures from pod start).
+            self._hb.write(0, force=True)
 
-        def apply(x_np):
-            return np.asarray(jitted(params, jnp.asarray(x_np)))
+        def apply(p, x_np):
+            return np.asarray(jitted(p, jnp.asarray(x_np)))
 
         self._apply = apply
+        self._live = (params, step)
+
+    # ----------------------------------------------------------- follower
+
+    def _follow_loop(self) -> None:
+        """Checkpoint following: poll for a strictly newer VALIDATED
+        step; restore host-side + device_put OFF the dispatch thread
+        (transfer only — never an XLA program, the PR-2 rule), then swap
+        the (params, step) pair atomically. The dispatch thread picks the
+        new pair up at its next batch; the step served monotonically
+        advances and old params are never torn mid-batch."""
+        import jax
+
+        from tf_operator_tpu.models import checkpoint as ckpt
+
+        # Last step rejected for param-signature drift: a drifted
+        # checkpoint is PERMANENTLY incompatible (the mismatch is
+        # deterministic), so re-restoring it every poll would re-read
+        # the whole tree from disk ~every follow_poll_s forever. Each
+        # drifted step costs exactly one host restore; a NEWER step is
+        # still attempted (the trainer may have reverted its config).
+        # Transient failures (torn save, GC race) deliberately do NOT
+        # land here — those may heal and should retry.
+        drift_rejected: int | None = None
+        while not self.stop.is_set():
+            self.stop.wait(timeout=self.follow_poll_s)
+            if self.stop.is_set():
+                return
+            cur = self.loaded_step
+            try:
+                step = ckpt.latest_valid_checkpoint(self.ckpt_dir)
+            except OSError:  # checkpoint GC racing the walk: retry
+                continue
+            if (step is None or (cur is not None and step <= cur)
+                    or (drift_rejected is not None
+                        and step <= drift_rejected)):
+                continue
+            try:
+                host = ckpt.restore(self.ckpt_dir, step)
+                old_params = self._live[0]
+                if old_params is not None:
+                    # Reject model-config drift BEFORE paying the
+                    # host->device transfer: same tree, same per-leaf
+                    # shape AND dtype (a renamed layer, a changed width,
+                    # or a dtype flip would otherwise go live and break
+                    # every subsequent batch — or silently recompile on
+                    # the dispatch thread).
+                    def sig(tree):
+                        return jax.tree_util.tree_map(
+                            lambda a: (tuple(a.shape), str(a.dtype)),
+                            tree)
+
+                    old_sig, new_sig = sig(old_params), sig(host)
+                    if old_sig != new_sig:
+                        drift_rejected = step
+                        raise ValueError(
+                            f"checkpoint step {step} has a different "
+                            f"param signature: model config drift — "
+                            f"keeping step {cur}")
+                new_params = jax.device_put(host)
+            except Exception as e:  # noqa: BLE001 — keep serving old params
+                metrics_mod.serve_ckpt_follow_total.labels(
+                    result="error").inc()
+                _emit({"event": "ckpt_follow", "result": "error",
+                       "step": step,
+                       "reason": f"{type(e).__name__}: {e}"})
+                continue
+            self._live = (new_params, step)
+            metrics_mod.serve_ckpt_follow_total.labels(
+                result="swapped").inc()
+            _emit({"event": "ckpt_follow", "result": "swapped",
+                   "t": time.time(), "from_step": cur, "step": step})
 
     # ------------------------------------------------------------ batcher
 
@@ -272,6 +500,12 @@ class InferenceServer:
             self._infl_last_t = now
             self._inflight += delta
             return self._inflight
+
+    def pad_efficiency(self) -> float | None:
+        with self._stats_lock:
+            if not self._rows_padded:
+                return None
+            return self._rows_useful / self._rows_padded
 
     def _write_stats(self) -> None:
         if not self._stats_path:
@@ -296,6 +530,11 @@ class InferenceServer:
                 "requests_total": self._requests,
                 "served_total": self._served,
                 "batches_total": self._batches,
+                "rows_useful": self._rows_useful,
+                "rows_padded": self._rows_padded,
+                "pad_efficiency": (
+                    round(self._rows_useful / self._rows_padded, 4)
+                    if self._rows_padded else None),
                 "loaded_step": self.loaded_step,
                 "latency_p50_ms": lat[len(lat) // 2] if lat else None,
                 "latency_p99_ms": lat[int(len(lat) * 0.99)] if lat else None,
@@ -311,65 +550,103 @@ class InferenceServer:
             except OSError:
                 pass
 
-    def _batch_loop(self) -> None:
-        """The one XLA-dispatching thread: assemble, pad, apply, demux."""
+    def _assemble_loop(self) -> None:
+        """Stage 1 (host-only, never XLA): dequeue + validate + pad into
+        the bucket shape, then park in the depth-1 slot. Runs CONCURRENT
+        with stage 2's device time — double-buffering."""
         import numpy as np
 
-        last_stats = 0.0
         while True:
             batch = self.queue.take_batch()
             if batch is None:
-                break  # closed and drained
-            if batch:
-                try:
-                    # Assembly INSIDE the per-batch guard: a ragged or
-                    # wrong-shaped row raises in concatenate/reshape, and
-                    # an uncaught raise here would kill the one batcher
-                    # thread — a single malformed request must 500 its
-                    # own batch, never take the replica down.
-                    rows = np.concatenate(
-                        [np.asarray(i.rows, np.float32) for i in batch])
-                    n = rows.shape[0]
-                    padded = np.zeros((self.batch_max,
-                                       *self._input_shape), np.float32)
-                    padded[:n] = rows.reshape((n, *self._input_shape))
-                    preds = self._apply(padded)[:n]
-                except Exception as e:  # noqa: BLE001 — reported per request
-                    for item in batch:
-                        item.error = f"{type(e).__name__}: {e}"
-                        item.event.set()
-                    # Errored requests leave the inflight count (they are
-                    # answered) but never count as served.
-                    self._shift_inflight(-len(batch))
-                    continue
-                self._batches += 1
-                self.m_batch.observe(float(n))
-                off = 0
-                now = time.monotonic()
+                # Closed and drained: stage 2 drains the slot then exits.
+                self.slot.close()
+                return
+            if not batch:
+                if self.stop.is_set():
+                    self.queue.close()
+                continue
+            try:
+                # Assembly INSIDE the per-batch guard: a ragged or
+                # wrong-shaped row raises in concatenate/reshape, and a
+                # single malformed request must 500 its own batch, never
+                # take the pipeline down.
+                rows = np.concatenate(
+                    [np.asarray(i.rows, np.float32) for i in batch])
+                n = rows.shape[0]
+                bucket = select_bucket(n, self.buckets)
+                padded = np.zeros((bucket, *self._input_shape), np.float32)
+                padded[:n] = rows.reshape((n, *self._input_shape))
+            except Exception as e:  # noqa: BLE001 — reported per request
                 for item in batch:
-                    k = len(item.rows)
-                    item.result = [int(v) for v in preds[off:off + k]]
-                    off += k
-                    ms = (now - item.t_in) * 1000.0
-                    self.m_latency.observe(ms / 1000.0)
-                    self._note_latency(ms)
-                with self._stats_lock:
-                    self._served += len(batch)
-                inflight = self._shift_inflight(-len(batch))
-                self.m_inflight.set(float(max(0, inflight)))
-                for item in batch:
+                    item.error = f"{type(e).__name__}: {e}"
                     item.event.set()
-                if self._chaos is not None:
-                    # `kill:step=N[,replica=server]`: deterministic
-                    # serve-replica faults, N = dispatched batches.
-                    self._chaos.maybe_kill(self._batches, 0)
+                # Errored requests leave the inflight count (they are
+                # answered) but never count as served.
+                self._shift_inflight(-len(batch))
+                continue
+            self.slot.put(_Staged(batch, padded, n, bucket))
+
+    def _dispatch_loop(self) -> None:
+        """Stage 2 — the ONE XLA-dispatching thread: jitted forward at
+        the staged bucket shape, demux, liveness. The (params, step)
+        pair is read once per batch, so a follower swap lands cleanly
+        between batches."""
+        last_stats = 0.0
+        while True:
+            staged = self.slot.take()
+            if staged is None:
+                if self.slot.is_closed():
+                    break  # assembler closed after draining the queue
+                # Idle: tick liveness so the watchdog sees us.
+                self._hb.write(self._batches)
+                now = time.monotonic()
+                if now - last_stats > 0.25:
+                    self._write_stats()
+                    last_stats = now
+                continue
+            batch, n = staged.items, staged.n
+            params, step = self._live
+            try:
+                preds = self._apply(params, staged.padded)[:n]
+            except Exception as e:  # noqa: BLE001 — reported per request
+                for item in batch:
+                    item.error = f"{type(e).__name__}: {e}"
+                    item.event.set()
+                self._shift_inflight(-len(batch))
+                continue
+            self._batches += 1
+            self.m_batch.observe(float(n))
+            with self._stats_lock:
+                self._rows_useful += n
+                self._rows_padded += staged.bucket
+                pad_eff = self._rows_useful / self._rows_padded
+            self.m_pad_eff.set(round(pad_eff, 4))
+            off = 0
+            now = time.monotonic()
+            for item in batch:
+                k = len(item.rows)
+                item.result = [int(v) for v in preds[off:off + k]]
+                item.step = step
+                off += k
+                ms = (now - item.t_in) * 1000.0
+                self.m_latency.observe(ms / 1000.0)
+                self._note_latency(ms)
+            with self._stats_lock:
+                self._served += len(batch)
+            inflight = self._shift_inflight(-len(batch))
+            self.m_inflight.set(float(max(0, inflight)))
+            for item in batch:
+                item.event.set()
+            if self._chaos is not None:
+                # `kill:step=N[,replica=server]`: deterministic
+                # serve-replica faults, N = dispatched batches.
+                self._chaos.maybe_kill(self._batches, 0)
             self._hb.write(self._batches)
             now = time.monotonic()
-            if now - last_stats > 0.25 or batch:
+            if now - last_stats > 0.25:
                 self._write_stats()
                 last_stats = now
-            if self.stop.is_set():
-                self.queue.close()
 
     # --------------------------------------------------------------- http
 
@@ -399,6 +676,11 @@ class InferenceServer:
                         "model": server.model_name,
                         "checkpoint_step": server.loaded_step,
                         "inflight": server._inflight,
+                        "follow": server.follow,
+                        "buckets": list(server.buckets),
+                        "rows_useful": server._rows_useful,
+                        "rows_padded": server._rows_padded,
+                        "pad_efficiency": server.pad_efficiency(),
                     }, 200 if server.ready.is_set() else 503)
                 elif self.path == "/metrics":
                     self._send({}, raw=metrics_mod.DEFAULT.expose())
@@ -437,11 +719,29 @@ class InferenceServer:
                     return self._send({"error": item.error}, 500)
                 self._send({"predictions": item.result,
                             "model": server.model_name,
-                            "checkpoint_step": server.loaded_step})
+                            "checkpoint_step": item.step})
 
         return Handler
 
     # ---------------------------------------------------------------- run
+
+    def start_pipeline(self) -> list[threading.Thread]:
+        """Start the two pipeline stages (and the follower, in follow
+        mode). Split out of run() so tests can drive the real pipeline
+        with a stubbed _apply."""
+        threads = [
+            threading.Thread(target=self._assemble_loop,
+                             name="serve-assembler", daemon=True),
+            threading.Thread(target=self._dispatch_loop,
+                             name="serve-dispatch", daemon=True),
+        ]
+        if self.follow:
+            threads.append(
+                threading.Thread(target=self._follow_loop,
+                                 name="serve-follower", daemon=True))
+        for t in threads:
+            t.start()
+        return threads
 
     def run(self) -> int:
         from http.server import ThreadingHTTPServer
@@ -449,10 +749,26 @@ class InferenceServer:
         _emit({"event": "start", "t": time.time(), "role": "serve",
                "model": self.model_name})
         self._hb.write(0, force=True)
+
+        def _sigterm(*_a):
+            self.stop.set()
+            self.queue.close()
+
+        # Installed BEFORE load(): follow mode can wait in load() for the
+        # trainer's first checkpoint, and a preemption during that wait
+        # must still drain cleanly.
+        signal.signal(signal.SIGTERM, _sigterm)
+        signal.signal(signal.SIGINT, _sigterm)
         self.load()
-        batcher = threading.Thread(target=self._batch_loop,
-                                   name="serve-batcher", daemon=True)
-        batcher.start()
+        if self._apply is None and self.stop.is_set():
+            # Preempted during the follow-mode wait for the trainer's
+            # first checkpoint: nothing was served, nothing is queued —
+            # graceful exit, not a Failed pod.
+            self._write_stats()
+            _emit({"event": "done", "t": time.time(), "served": 0,
+                   "batches": 0, "reason": "stopped_before_first_load"})
+            return 0
+        threads = self.start_pipeline()
 
         # The runtime allocates this replica's localhost listen port from
         # its DNS identity (TPUJOB_SERVE_ENDPOINT); standalone runs bind
@@ -461,31 +777,29 @@ class InferenceServer:
         httpd = ThreadingHTTPServer(("127.0.0.1", port),
                                     self._make_handler())
         httpd.daemon_threads = True
-
-        def _sigterm(*_a):
-            self.stop.set()
-            self.queue.close()
-
-        signal.signal(signal.SIGTERM, _sigterm)
-        signal.signal(signal.SIGINT, _sigterm)
         threading.Thread(target=httpd.serve_forever, daemon=True,
                          name="serve-http").start()
         self.ready.set()
         self._hb.write(0, force=True)
         self._write_stats()
         _emit({"event": "serve_ready", "t": time.time(),
-               "checkpoint_step": self.loaded_step, "port": port})
+               "checkpoint_step": self.loaded_step, "port": port,
+               "buckets": list(self.buckets), "follow": self.follow})
         print(f"serving {self.model_name} step {self.loaded_step} on "
-              f"127.0.0.1:{port}", flush=True)
+              f"127.0.0.1:{port} (buckets={list(self.buckets)}"
+              f"{', following' if self.follow else ''})", flush=True)
         while not self.stop.is_set():
             self.stop.wait(timeout=0.5)
-        # Drain: the batcher answers everything queued, then exits.
+        # Drain: the assembler flushes the queue into the slot, the
+        # dispatcher answers everything, then both exit.
         self.queue.close()
-        batcher.join(timeout=10.0)
+        for t in threads:
+            t.join(timeout=10.0)
         httpd.shutdown()
         self._write_stats()
         _emit({"event": "done", "t": time.time(),
-               "served": self._served, "batches": self._batches})
+               "served": self._served, "batches": self._batches,
+               "pad_efficiency": self.pad_efficiency()})
         return 0
 
 
@@ -504,6 +818,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--batch-timeout-ms", type=float,
                     default=float(env.get("TPUJOB_SERVE_BATCH_TIMEOUT_MS",
                                           "5.0")))
+    ap.add_argument("--bucketing", type=int, choices=(0, 1),
+                    default=int(env.get("TPUJOB_SERVE_BUCKETING", "1")),
+                    help="1 = shape-bucketed padding (default), 0 = the "
+                         "pad-to-max baseline")
+    ap.add_argument("--follow", type=int, choices=(0, 1),
+                    default=int(env.get("TPUJOB_SERVE_FOLLOW", "0")),
+                    help="1 = poll the checkpoint dir and hot-swap "
+                         "params as newer valid steps appear")
+    ap.add_argument("--follow-poll-s", type=float,
+                    default=float(env.get("TPUJOB_SERVE_FOLLOW_POLL_S",
+                                          "2.0")))
     args = ap.parse_args(argv)
     if not args.checkpoint_dir:
         print("error: --checkpoint-dir (or TPUJOB_SERVE_CHECKPOINT_DIR) "
@@ -512,7 +837,9 @@ def main(argv: list[str] | None = None) -> int:
     server = InferenceServer(
         args.model, args.checkpoint_dir, args.port,
         args.batch_max_size, args.batch_timeout_ms,
-        replica=env.get("TPUJOB_POD_NAME", ""))
+        replica=env.get("TPUJOB_POD_NAME", ""),
+        bucketing=bool(args.bucketing), follow=bool(args.follow),
+        follow_poll_s=args.follow_poll_s)
     try:
         return server.run()
     except FileNotFoundError as e:

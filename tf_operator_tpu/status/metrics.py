@@ -420,6 +420,27 @@ serve_latency_seconds = DEFAULT.histogram(
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
              1.0, 2.5, 5.0),
 )
+serve_pad_efficiency = DEFAULT.gauge(
+    "tpujob_serve_pad_efficiency",
+    "Useful rows / padded rows dispatched by a serving replica "
+    "(cumulative; 1.0 = every padded slot carried a real row). The "
+    "shape-bucketing win signal: pad-to-max under light load reads "
+    "1/batchMaxSize, bucketed reads near 1.0",
+    labels_only=True,
+)
+serve_router_requests_total = DEFAULT.counter(
+    "tpujob_serve_router_requests_total",
+    "Requests the front-end router forwarded, per backend replica "
+    "(least-time-averaged-inflight choice over READY replicas)",
+    labels_only=True,
+)
+serve_ckpt_follow_total = DEFAULT.counter(
+    "tpujob_serve_ckpt_follow_total",
+    "Checkpoint-follow hot-swaps (result: swapped | error). A swap "
+    "replaces the served params between batches with no restart and no "
+    "recompile",
+    labels_only=True,
+)
 serve_ready_replicas = DEFAULT.gauge(
     "tpujob_serve_ready_replicas",
     "Running server replicas per InferenceService (operator-side; series "

@@ -3,21 +3,37 @@
 InferenceService through the operator (LocalSession + serve controller +
 real server subprocesses).
 
-For each ramp stage, an open-loop generator fires `POST /predict`
-requests at the offered rate (round-robin across the live replicas'
-endpoints), recording per-request latency; between samples it tracks the
-autoscaler's desired/ready trajectory. Output (one JSON object on
-stdout):
+Round 18: all traffic enters through the service's SHARED FRONT-END
+ROUTER (serve/router.py, status.routerEndpoint) — one endpoint,
+least-loaded + readiness-gated routing — instead of client-side
+round-robin over per-replica addresses. That kills the PR-13 documented
+error class (requests landing on Running-but-still-warming replicas
+during scale-out), so the 1→3 scale-out stage now asserts ZERO errors
+when --gate-scale-to is set.
 
-  stages[]:  offered_qps, achieved_qps, ok/err counts, p50/p99 ms
+Stages:
+
+  light_load (single-row, default QPS 10): the shape-bucketing win.
+  Two one-replica services serve the same checkpoint — one pad-to-max
+  (bucketing=false, the PR-13 baseline), one bucketed — at a large
+  batchMaxSize; single-row p50 and pad_efficiency are reported for
+  each plus speedup_p50. Bucketed pads 1 row to the 1-bucket instead
+  of batchMaxSize, so p50 drops by the wasted forward FLOPs.
+
+  ramp stages[]: offered_qps, achieved_qps, ok/err counts, p50/p99 ms,
+  pad_efficiency (useful/padded rows dispatched during the stage, from
+  the replicas' stats snapshots)
   scale_trajectory[]: (t, desired, ready) samples
   scaled_to: max desired reached;  scaled_back: True when the service
   returned to minReplicas after the ramp (within the drain window)
 
 Gates (exit 1 on violation): --gate-p99-ms on the FINAL stage's p99,
---gate-scale-to on the max desired reached. This is the "millions of
-users" story's measurable surface — the `serving` bench point runs it in
-a small configuration (bench.py), CI's serve-smoke stage gates it.
+--gate-scale-to on the max desired reached (also requires ZERO request
+errors across the ramp — the router's readiness gate makes scale-out
+clean), --gate-pad-efficiency on the bucketed light-load stage,
+--gate-light-speedup on p50_padmax/p50_bucketed. This is the "millions
+of users" story's measurable surface — the `serving` bench point runs
+it in a small configuration (bench.py), CI's serve-smoke stage gates it.
 
 By default the model is a checkpoint this tool writes itself (fast,
 deterministic); --train runs a real trainer first and serves ITS
@@ -87,7 +103,8 @@ def make_checkpoint(ckpt_dir: str, train: bool, steps: int = 12) -> int:
 
 def serve_manifest(name: str, ckpt_dir: str, max_replicas: int,
                    target: float, stabilization: float,
-                   batch_timeout_ms: float):
+                   batch_timeout_ms: float, min_replicas: int = 1,
+                   batch_max: int = 8, bucketing: bool = True):
     from tf_operator_tpu.api import compat
 
     return compat.infsvc_from_dict({
@@ -95,11 +112,12 @@ def serve_manifest(name: str, ckpt_dir: str, max_replicas: int,
         "metadata": {"name": name, "namespace": "default"},
         "spec": {
             "model": {"checkpointDir": ckpt_dir, "model": "mnist-mlp"},
-            "serving": {"batchMaxSize": 8,
+            "serving": {"batchMaxSize": batch_max,
                         "batchTimeoutMs": batch_timeout_ms,
-                        "port": 8500},
+                        "port": 8500,
+                        "bucketing": bucketing},
             "autoscale": {
-                "minReplicas": 1, "maxReplicas": max_replicas,
+                "minReplicas": min_replicas, "maxReplicas": max_replicas,
                 "targetInflightPerReplica": target,
                 "scaleDownStabilizationSeconds": stabilization,
             },
@@ -128,28 +146,68 @@ def wait_healthy(addr: str, timeout: float = 90.0) -> dict:
     raise RuntimeError(f"server at {addr} never became healthy: {last}")
 
 
-def run_stage(session, name: str, offered_qps: float, seconds: float,
-              rows, lat_out: list, scale_out: list) -> dict:
-    """One open-loop ramp stage: fire at `offered_qps` spread over the
-    live replica endpoints; sample the scale trajectory."""
+def wait_router(session, name: str, timeout: float = 90.0) -> str:
+    """The service's front-end router endpoint, once it exists AND has
+    at least one READY (probed) backend."""
+    deadline = time.monotonic() + timeout
+    addr = None
+    while time.monotonic() < deadline:
+        addr = session.service_address(name, "default")
+        if addr is not None:
+            try:
+                with urllib.request.urlopen(f"http://{addr}/healthz",
+                                            timeout=2) as r:
+                    if json.loads(r.read()).get("ok"):
+                        return addr
+            except Exception:  # noqa: BLE001 — router warming, retry
+                pass
+        time.sleep(0.2)
+    raise RuntimeError(f"router for {name} never became ready "
+                       f"(last endpoint: {addr})")
+
+
+def _pad_rows(session, name: str) -> dict[str, tuple[int, int]]:
+    """Per-pod cumulative (useful, padded) row counters from the
+    replicas' stats snapshots. Per-pod (not aggregate) so a stage delta
+    survives replica churn: a pod scaled away mid-stage just drops out
+    (its lost counters never net against survivors' new rows), and a
+    restarted pod whose counters reset is rebased instead of read as a
+    negative delta."""
+    if session.telemetry is None:
+        return {}
+    return {
+        pod: (int(snap.get("rows_useful") or 0),
+              int(snap.get("rows_padded") or 0))
+        for pod, snap in (session.telemetry.service_load("default", name)
+                          or {}).items()
+    }
+
+
+def _pad_delta(before: dict[str, tuple[int, int]],
+               after: dict[str, tuple[int, int]]) -> tuple[int, int]:
+    """Stage-window (useful, padded) totals from per-pod baselines."""
+    d_useful = d_padded = 0
+    for pod, (u1, p1) in after.items():
+        u0, p0 = before.get(pod, (0, 0))
+        if u1 < u0 or p1 < p0:
+            u0 = p0 = 0  # counter regressed: the replica restarted
+        d_useful += u1 - u0
+        d_padded += p1 - p0
+    return d_useful, d_padded
+
+
+def run_stage(session, name: str, addr: str, offered_qps: float,
+              seconds: float, rows, lat_out: list,
+              scale_out: list) -> dict:
+    """One open-loop ramp stage: fire at `offered_qps` through the
+    front-end router; sample the scale trajectory."""
     body = json.dumps({"instances": rows}).encode()
     lock = threading.Lock()
     ok = [0]
     err = [0]
     lats: list[float] = []
 
-    def addresses() -> list[str]:
-        # Round-robin across READY replicas only (a freshly-created pod
-        # that has not bound its port yet would just produce errors).
-        svc = session.get_service("default", name)
-        out = []
-        for i in range(max(1, svc.status.ready_replicas)):
-            a = session.server_address(name, "default", i, port=8500)
-            if a is not None:
-                out.append(a)
-        return out or ["127.0.0.1:1"]
-
-    def fire(addr: str) -> None:
+    def fire() -> None:
         t0 = time.monotonic()
         try:
             req = urllib.request.Request(
@@ -167,24 +225,19 @@ def run_stage(session, name: str, offered_qps: float, seconds: float,
             ok[0] += 1
             lats.append(ms)
 
+    pad0 = _pad_rows(session, name)
     interval = 1.0 / max(offered_qps, 0.001)
     t_start = time.monotonic()
     t_end = t_start + seconds
     next_fire = t_start
     next_sample = t_start
-    addrs = addresses()
-    addr_refresh = t_start
-    i = 0
     threads: list[threading.Thread] = []
     while time.monotonic() < t_end:
         now = time.monotonic()
         if now >= next_fire:
-            t = threading.Thread(target=fire,
-                                 args=(addrs[i % len(addrs)],),
-                                 daemon=True)
+            t = threading.Thread(target=fire, daemon=True)
             t.start()
             threads.append(t)
-            i += 1
             next_fire += interval
             if now - next_fire > 2.0:
                 next_fire = now  # generator fell behind: don't burst-spiral
@@ -196,13 +249,12 @@ def run_stage(session, name: str, offered_qps: float, seconds: float,
                 "ready": svc.status.ready_replicas,
             })
             next_sample = now + 0.25
-        if now - addr_refresh > 1.0:
-            addrs = addresses()
-            addr_refresh = now
         time.sleep(min(0.002, max(0.0, next_fire - time.monotonic())))
     for t in threads:
         t.join(timeout=20)
     wall = time.monotonic() - t_start
+    time.sleep(0.3)  # let the replicas' throttled stats writers flush
+    d_useful, d_padded = _pad_delta(pad0, _pad_rows(session, name))
     lats.sort()
     lat_out.extend(lats)
     return {
@@ -212,7 +264,80 @@ def run_stage(session, name: str, offered_qps: float, seconds: float,
         "latency_p50_ms": round(lats[len(lats) // 2], 3) if lats else None,
         "latency_p99_ms": (round(lats[int(len(lats) * 0.99)], 3)
                            if lats else None),
+        "pad_efficiency": (round(d_useful / d_padded, 4)
+                           if d_padded > 0 else None),
     }
+
+
+def light_load_point(session, ckpt_dir: str, seconds: float,
+                     qps: float = 10.0, batch_max: int = 1024) -> dict:
+    """The shape-bucketing win, measured: single-row requests at light
+    load against a pad-to-max service and a bucketed one (same host,
+    same checkpoint, large batchMaxSize so the wasted forward FLOPs
+    dominate). Closed-loop single client — the point is per-request
+    latency, not throughput."""
+    from tf_operator_tpu.api.types import JobConditionType
+
+    import numpy as np
+
+    row = np.random.default_rng(5).normal(
+        size=(1, 28, 28)).astype(np.float32).tolist()
+    body = json.dumps({"instances": row}).encode()
+    out: dict = {"qps": qps, "seconds": seconds, "batch_max": batch_max}
+    for variant, bucketing in (("padmax", False), ("bucketed", True)):
+        name = f"bench-light-{variant}"
+        session.submit_service(serve_manifest(
+            name, ckpt_dir, max_replicas=1, target=4.0, stabilization=60,
+            batch_timeout_ms=0.0, min_replicas=1, batch_max=batch_max,
+            bucketing=bucketing))
+        session.wait_for_service_condition(
+            "default", name, (JobConditionType.RUNNING,), timeout=120)
+        addr = wait_router(session, name)
+        lats: list[float] = []
+        errors = 0
+        interval = 1.0 / qps
+        t_end = time.monotonic() + seconds
+        while time.monotonic() < t_end:
+            t0 = time.monotonic()
+            try:
+                req = urllib.request.Request(
+                    f"http://{addr}/predict", data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=15) as r:
+                    r.read()
+                lats.append((time.monotonic() - t0) * 1000.0)
+            except Exception:  # noqa: BLE001 — counted, not raised
+                errors += 1
+            time.sleep(max(0.0, interval - (time.monotonic() - t0)))
+        h = {}
+        raddr = session.server_address(name, "default", 0, port=8500)
+        if raddr is not None:
+            try:
+                with urllib.request.urlopen(f"http://{raddr}/healthz",
+                                            timeout=2) as r:
+                    h = json.loads(r.read())
+            except Exception:  # noqa: BLE001 — stats are best-effort
+                pass
+        lats.sort()
+        out[variant] = {
+            "requests": len(lats), "errors": errors,
+            "latency_p50_ms": (round(lats[len(lats) // 2], 3)
+                               if lats else None),
+            "latency_p99_ms": (round(lats[int(len(lats) * 0.99)], 3)
+                               if lats else None),
+            "pad_efficiency": h.get("pad_efficiency"),
+            "buckets": h.get("buckets"),
+        }
+        log(f"exp_serve: light-load {variant}: "
+            f"p50={out[variant]['latency_p50_ms']}ms "
+            f"pad_efficiency={out[variant]['pad_efficiency']}")
+        session.delete_service("default", name)
+    p_pad = (out.get("padmax") or {}).get("latency_p50_ms")
+    p_bkt = (out.get("bucketed") or {}).get("latency_p50_ms")
+    out["speedup_p50"] = (round(p_pad / p_bkt, 2)
+                          if p_pad and p_bkt else None)
+    return out
 
 
 def run_serve_bench(qps_ramp: list[float], stage_seconds: float,
@@ -220,7 +345,9 @@ def run_serve_bench(qps_ramp: list[float], stage_seconds: float,
                     stabilization: float = 3.0,
                     batch_timeout_ms: float = 40.0,
                     ckpt_dir: str | None = None, train: bool = False,
-                    drain_seconds: float = 25.0) -> dict:
+                    drain_seconds: float = 25.0,
+                    light_seconds: float = 4.0,
+                    light_qps: float = 10.0) -> dict:
     from tf_operator_tpu.api.types import JobConditionType
     from tf_operator_tpu.runtime.session import LocalSession
 
@@ -238,17 +365,31 @@ def run_serve_bench(qps_ramp: list[float], stage_seconds: float,
             result["served_step"] = make_checkpoint(ckpt_dir, train)
         session = LocalSession(env_overrides=ONE_DEV,
                                log_dir=os.path.join(work, "logs"))
+
+        if light_seconds > 0:
+            log(f"exp_serve: light-load stage (single row at "
+                f"{light_qps:g} QPS, {light_seconds:g}s per variant)")
+            result["light_load"] = light_load_point(
+                session, ckpt_dir, light_seconds, qps=light_qps)
+
         name = "bench-serve"
         session.submit_service(serve_manifest(
             name, ckpt_dir, max_replicas, target, stabilization,
             batch_timeout_ms))
         session.wait_for_service_condition(
             "default", name, (JobConditionType.RUNNING,), timeout=120)
-        addr = session.server_address(name, "default", 0, port=8500)
-        h = wait_healthy(addr)
-        result.setdefault("served_step", h.get("checkpoint_step"))
-        log(f"exp_serve: replica 0 healthy at {addr} "
-            f"(step {h.get('checkpoint_step')})")
+        # All ramp traffic enters through the SHARED front-end router:
+        # readiness-gated least-loaded routing — a warming replica never
+        # sees a request (the PR-13 round-robin error class).
+        router = wait_router(session, name)
+        result["router_endpoint"] = router
+        h = wait_healthy(router)
+        raddr = session.server_address(name, "default", 0, port=8500)
+        if raddr is not None:
+            result.setdefault("served_step",
+                              wait_healthy(raddr).get("checkpoint_step"))
+        log(f"exp_serve: router ready at {router} "
+            f"({h.get('ready_replicas')} replica(s))")
 
         import numpy as np
 
@@ -260,16 +401,18 @@ def run_serve_bench(qps_ramp: list[float], stage_seconds: float,
         for qps in qps_ramp:
             log(f"exp_serve: stage offered_qps={qps} "
                 f"for {stage_seconds:g}s")
-            st = run_stage(session, name, qps, stage_seconds, rows,
-                           all_lats, scale_traj)
+            st = run_stage(session, name, router, qps, stage_seconds,
+                           rows, all_lats, scale_traj)
             stages.append(st)
             log(f"  achieved={st['achieved_qps']} "
                 f"p50={st['latency_p50_ms']}ms "
-                f"p99={st['latency_p99_ms']}ms errors={st['errors']}")
+                f"p99={st['latency_p99_ms']}ms errors={st['errors']} "
+                f"pad_efficiency={st['pad_efficiency']}")
         result["stages"] = stages
         result["scale_trajectory"] = scale_traj
         result["scaled_to"] = max(
             (s["desired"] or 1) for s in scale_traj) if scale_traj else 1
+        result["errors_total"] = sum(s["errors"] for s in stages)
 
         # Drain: the stabilization window must bring the service back to
         # its floor once the load stops.
@@ -313,6 +456,10 @@ def main(argv: list[str] | None = None) -> int:
                 help="server micro-batch window; also the latency "
                      "floor, so offered QPS x window ~ inflight "
                      "(the autoscale signal, Little's law)")
+    ap.add_argument("--light-seconds", type=float, default=4.0,
+                    help="seconds per light-load variant (single-row "
+                         "bucketing win stage); 0 disables")
+    ap.add_argument("--light-qps", type=float, default=10.0)
     ap.add_argument("--checkpoint-dir", default=None,
                     help="serve an existing checkpoint dir instead of "
                          "producing one")
@@ -323,14 +470,23 @@ def main(argv: list[str] | None = None) -> int:
                     help="fail unless the FINAL stage's p99 is under this")
     ap.add_argument("--gate-scale-to", type=int, default=None,
                     help="fail unless the autoscaler reached this many "
-                         "desired replicas")
+                         "desired replicas, scaled back, AND the ramp "
+                         "saw zero request errors (the router's "
+                         "readiness gate makes scale-out clean)")
+    ap.add_argument("--gate-pad-efficiency", type=float, default=None,
+                    help="fail unless the bucketed light-load stage's "
+                         "pad_efficiency reaches this")
+    ap.add_argument("--gate-light-speedup", type=float, default=None,
+                    help="fail unless light-load p50_padmax/p50_bucketed "
+                         "reaches this")
     args = ap.parse_args(argv)
     ramp = [float(x) for x in args.qps_ramp.split(",") if x.strip()]
     result = run_serve_bench(
         ramp, args.stage_seconds, max_replicas=args.max_replicas,
         target=args.target_inflight, stabilization=args.stabilization,
         batch_timeout_ms=args.batch_timeout_ms,
-        ckpt_dir=args.checkpoint_dir, train=args.train)
+        ckpt_dir=args.checkpoint_dir, train=args.train,
+        light_seconds=args.light_seconds, light_qps=args.light_qps)
     print(json.dumps(result, indent=2))
     if not result.get("ok"):
         return 1
@@ -348,6 +504,24 @@ def main(argv: list[str] | None = None) -> int:
             rc = 1
         elif not result.get("scaled_back"):
             log("GATE FAILED: service never scaled back to minReplicas")
+            rc = 1
+        elif result.get("errors_total", 0) > 0:
+            log(f"GATE FAILED: {result['errors_total']} request error(s) "
+                f"during the ramp — the router must keep scale-out "
+                f"error-free")
+            rc = 1
+    if args.gate_pad_efficiency is not None:
+        pe = ((result.get("light_load") or {}).get("bucketed")
+              or {}).get("pad_efficiency")
+        if pe is None or pe < args.gate_pad_efficiency:
+            log(f"GATE FAILED: bucketed light-load pad_efficiency {pe} "
+                f"< {args.gate_pad_efficiency}")
+            rc = 1
+    if args.gate_light_speedup is not None:
+        sp = (result.get("light_load") or {}).get("speedup_p50")
+        if sp is None or sp < args.gate_light_speedup:
+            log(f"GATE FAILED: light-load speedup_p50 {sp} < "
+                f"{args.gate_light_speedup}")
             rc = 1
     return rc
 
