@@ -51,6 +51,12 @@ if not os.environ.get("TPUJOB_TEST_TPU"):
 # test whose run recorded a cycle even when library code swallowed the
 # raised PotentialDeadlockError. CI enables it for the chaos-smoke and
 # fleet-smoke stages.
+#
+# The sibling TPUJOB_SCHEDCHECK knob (testing/schedcheck.py, the bounded
+# interleaving explorer) needs no install here — explorations are
+# per-test explicit — but an integer value >= 2 raises the default
+# preemption bound for every exploration that does not pin one, and the
+# teardown hook below polices leaked model threads under both detectors.
 try:
     from tf_operator_tpu.testing import lockcheck as _lockcheck
 
@@ -62,6 +68,10 @@ except ImportError:
 
 import pytest  # noqa: E402  (env setup above must run before anything heavy)
 
+# Leaked schedcheck threads already attributed to a test (see the
+# teardown hook): an unreapable thread must not re-fail every successor.
+_schedcheck_reported: set[int] = set()
+
 
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_teardown(item, nextitem):
@@ -72,17 +82,46 @@ def pytest_runtest_teardown(item, nextitem):
     # properly"; it also lets a test that deliberately seeds inversions
     # reset the graph in its own fixture finalizer before this reads it.
     yield
-    if _lockcheck is None or not _lockcheck.installed():
-        return
-    bad = _lockcheck.violations()
-    # Reset per test either way: edges are keyed by lock identity (id()),
-    # so a graph accumulated across tests could attach stale edges to a
-    # recycled id; per-test scoping keeps the graph meaningful and small.
-    _lockcheck.reset()
-    if bad:
-        raise AssertionError(
-            "lockcheck: lock-order violations recorded during "
-            f"{item.nodeid}:\n" + "\n".join(bad))
+    problems: list[str] = []
+    # Leaked-thread check, under BOTH detectors (round 19): a
+    # schedcheck-managed model thread that outlives its test would
+    # poison the NEXT test — its late lock ops land in lockcheck's
+    # freshly-reset graph, and its parked state corrupts the next
+    # exploration's handshake. Fail the test that LEAKED, then reap so
+    # its successors run clean. Checked whenever the schedcheck module
+    # is loaded (cheap: a registry read) — the TPUJOB_SCHEDCHECK env
+    # knob governs the exploration bound, not this accounting.
+    import sys as _sys
+
+    _schedcheck = _sys.modules.get("tf_operator_tpu.testing.schedcheck")
+    if _schedcheck is not None:
+        # An unreapable thread (stuck in an un-instrumented blocking
+        # call — join can't kill it) must be reported ONCE, against the
+        # test that leaked it: without the reported-set, it would fail
+        # every subsequent test's teardown under the wrong nodeid.
+        leaked = [t for t in _schedcheck.leaked_threads()
+                  if id(t) not in _schedcheck_reported]
+        if leaked:
+            _schedcheck_reported.update(id(t) for t in leaked)
+            names = [t.name for t in leaked]
+            _schedcheck.reap_leaked()
+            problems.append(
+                f"schedcheck: model threads leaked by {item.nodeid}: "
+                f"{names} (reaped where possible; an unreapable thread "
+                f"fails HERE, once, not in every later test)")
+    if _lockcheck is not None and _lockcheck.installed():
+        bad = _lockcheck.violations()
+        # Reset per test either way: edges are keyed by lock identity
+        # (id()), so a graph accumulated across tests could attach stale
+        # edges to a recycled id; per-test scoping keeps the graph
+        # meaningful and small.
+        _lockcheck.reset()
+        if bad:
+            problems.append(
+                "lockcheck: lock-order violations recorded during "
+                f"{item.nodeid}:\n" + "\n".join(bad))
+    if problems:
+        raise AssertionError("\n".join(problems))
 
 
 # Retry-once for @pytest.mark.flaky tests (a minimal in-repo

@@ -24,7 +24,14 @@ if str(REPO) not in sys.path:
 from tools.analysis import run_analysis  # noqa: E402
 from tools.analysis.allowlist import apply_allowlist, parse_allowlist  # noqa: E402
 from tools.analysis.core import Project  # noqa: E402
-from tools.analysis.passes import donation, hygiene, locks, schema, threads  # noqa: E402
+from tools.analysis.passes import (  # noqa: E402
+    donation,
+    envvars,
+    hygiene,
+    locks,
+    schema,
+    threads,
+)
 
 
 @pytest.fixture(scope="session")
@@ -197,6 +204,74 @@ class TestThreadDiscipline:
         names = {qual for _, qual in roots}
         assert "stage_to_device.worker" in names
         assert "prefetch_to_device.worker" in names
+
+    def test_round19_roots_cover_serve_router_and_dcn(self, repo_project):
+        # The round-19 expansion: the serve pipeline threads, the router
+        # probe, and the DCN engine are roots — the "one XLA-dispatching
+        # thread" claim PR 12/14 made in prose is machine-checked. Any
+        # rename that stops resolving silently un-gates the invariant.
+        roots = {(m.name, q) for m, q in threads._thread_roots(repo_project)}
+        for expected in (
+            ("tf_operator_tpu.serve.server",
+             "InferenceServer._assemble_loop"),
+            ("tf_operator_tpu.serve.server",
+             "InferenceServer._dispatch_loop"),
+            ("tf_operator_tpu.serve.server",
+             "InferenceServer._follow_loop"),
+            ("tf_operator_tpu.serve.router", "FrontEndRouter._probe_loop"),
+            ("tf_operator_tpu.parallel.multislice",
+             "DcnExchange._engine_main"),
+        ):
+            assert expected in roots, (expected, sorted(roots))
+
+    # Round 19: `Thread(target=self._method)` roots and `self._helper()`
+    # BFS edges resolve through the enclosing class — the serve/DCN
+    # thread shape. Bad twin: a self-method engine thread reaching a
+    # dispatching API through a self-call chain must flag with the full
+    # chain; good twin: the same shape staying on numpy is clean.
+    SELF_BAD = {
+        "tf_operator_tpu/__init__.py": "",
+        "tf_operator_tpu/serve/__init__.py": "",
+        "tf_operator_tpu/serve/server.py": """
+            import threading
+            import jax.numpy as jnp
+
+            class Server:
+                def _reduce(self, batch):
+                    return jnp.mean(batch)  # dispatch on the engine thread
+
+                def _loop(self):
+                    self._reduce([1.0])
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+        """,
+    }
+
+    def test_self_method_root_and_chain_flagged(self, tmp_path):
+        found = threads.run(make_project(tmp_path, self.SELF_BAD))
+        assert any(f.rule == "TPT201"
+                   and "Server._loop" in f.key
+                   and "Server._reduce" in f.key
+                   and "jax.numpy.mean" in f.key for f in found), found
+
+    def test_self_method_host_only_clean(self, tmp_path):
+        good = dict(self.SELF_BAD)
+        good["tf_operator_tpu/serve/server.py"] = """
+            import threading
+            import numpy as np
+
+            class Server:
+                def _reduce(self, batch):
+                    return np.mean(batch)  # host-only: fine
+
+                def _loop(self):
+                    self._reduce([1.0])
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+        """
+        assert threads.run(make_project(tmp_path, good)) == []
 
 
 # --------------------------------------------------------------------------
@@ -744,6 +819,164 @@ class TestHygieneUpgrades:
 
 
 # --------------------------------------------------------------------------
+class TestEnvContract:
+    """TPE701/702 (round 19): the operator<->pod env-var wire stays
+    two-sided. Fixture pair + the real-file drop-regression on the
+    serve bucketing flag (the knob whose two halves were hand-wired in
+    PR 14 — exactly the drift class the pass exists to catch)."""
+
+    BAD = {
+        "tf_operator_tpu/__init__.py": "",
+        "tf_operator_tpu/runtime/__init__.py": "",
+        "tf_operator_tpu/runtime/local.py": """
+            def build_env(env):
+                env["TPUJOB_INJECTED_NEVER_READ"] = "x"
+                env["TPUJOB_PAIRED"] = "y"
+                return env
+        """,
+        "tf_operator_tpu/worker.py": """
+            import os
+
+            def run():
+                os.environ.get("TPUJOB_PAIRED")
+                return os.environ.get("TPUJOB_READ_NEVER_INJECTED")
+        """,
+    }
+
+    def test_bad_fixture_flags_both_directions(self, tmp_path):
+        found = envvars.run(make_project(tmp_path, self.BAD))
+        keys = {f.key for f in found}
+        assert "env-injected-unread::TPUJOB_INJECTED_NEVER_READ" in keys, keys
+        assert "env-read-unwired::TPUJOB_READ_NEVER_INJECTED" in keys, keys
+        # the correctly-paired var is clean in both directions
+        assert not any("TPUJOB_PAIRED" in k for k in keys)
+
+    def test_documented_knob_is_clean(self, tmp_path):
+        good = dict(self.BAD)
+        good["tf_operator_tpu/runtime/local.py"] = """
+            def build_env(env):
+                env["TPUJOB_PAIRED"] = "y"
+                return env
+        """
+        good["docs/env.md"] = """
+            `TPUJOB_READ_NEVER_INJECTED` is an operator-set debug knob.
+        """
+        assert envvars.run(make_project(tmp_path, good)) == []
+
+    def test_constant_resolution_across_modules(self, tmp_path):
+        # tpu_env-style: injection through a dict keyed by ENV_* consts,
+        # consumption through the imported constant in another module.
+        tree = {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/cluster_spec/__init__.py": "",
+            "tf_operator_tpu/cluster_spec/tpu_env.py": """
+                ENV_WIDGET = "TPUJOB_WIDGET"
+
+                def gen(job):
+                    return {ENV_WIDGET: str(job)}
+            """,
+            "tf_operator_tpu/reader.py": """
+                import os
+
+                from tf_operator_tpu.cluster_spec.tpu_env import ENV_WIDGET
+
+                def run():
+                    return os.environ.get(ENV_WIDGET)
+            """,
+        }
+        assert envvars.run(make_project(tmp_path, tree)) == []
+        # drop the consumer: the injection side must flag
+        tree["tf_operator_tpu/reader.py"] = "def run():\n    return None\n"
+        found = envvars.run(make_project(tmp_path, tree))
+        assert {f.key for f in found} == {
+            "env-injected-unread::TPUJOB_WIDGET"}
+
+    def _serve_modules(self, server_src=None):
+        from tools.analysis.core import Module
+
+        out = {}
+        for name in ("tf_operator_tpu.serve.controller",
+                     "tf_operator_tpu.serve.server"):
+            path = REPO / name.replace(".", "/")
+            path = path.with_suffix(".py")
+            src = path.read_text()
+            if name.endswith(".server") and server_src is not None:
+                src = server_src
+            import ast as _ast
+
+            out[name] = Module(name, path, src, _ast.parse(src), root=REPO)
+        return out
+
+    def test_real_bucketing_flag_drop_regression(self):
+        # GOOD direction: on the real sources, the serve controller's
+        # TPUJOB_SERVE_BUCKETING injection has its server-side read.
+        docs = envvars._docs_text(REPO)
+        mods = self._serve_modules()
+        found = envvars.analyze_env(
+            mods, ("tf_operator_tpu.serve.controller",), [], docs)
+        assert not any("TPUJOB_SERVE_BUCKETING" in f.key for f in found), \
+            [f.render() for f in found]
+        # BAD direction: drop the read (the knob silently pins to its
+        # default) and the injection side must fail TPE701.
+        server = (REPO / "tf_operator_tpu/serve/server.py").read_text()
+        mutated = server.replace(
+            'default=int(env.get("TPUJOB_SERVE_BUCKETING", "1")),',
+            "default=1,")
+        assert mutated != server, "fixture went stale (read moved)"
+        found = envvars.analyze_env(
+            self._serve_modules(mutated),
+            ("tf_operator_tpu.serve.controller",), [], docs)
+        assert any(
+            f.rule == "TPE701"
+            and f.key == "env-injected-unread::TPUJOB_SERVE_BUCKETING"
+            for f in found), [f.render() for f in found]
+
+    def test_documented_prefix_does_not_mask_shorter_name(self, tmp_path):
+        # word-boundary docs match (review finding, round 19): docs
+        # naming TPUJOB_KNOB_POLL_S must not excuse an undocumented
+        # TPUJOB_KNOB read
+        tree = {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/worker.py": """
+                import os
+
+                def run():
+                    return os.environ.get("TPUJOB_KNOB")
+            """,
+            "docs/env.md": "`TPUJOB_KNOB_POLL_S` is a poll interval.\n",
+        }
+        found = envvars.run(make_project(tmp_path, tree))
+        assert {f.key for f in found} == {"env-read-unwired::TPUJOB_KNOB"}
+        # ...and the exact name documented IS enough
+        tree["docs/env.md"] += "`TPUJOB_KNOB` is the master switch.\n"
+        assert envvars.run(make_project(tmp_path, tree)) == []
+
+    def test_reflection_table_reads_count(self, tmp_path):
+        # the workload stub's `{k: os.environ[k] for k in KEYS}` shape:
+        # literals in the table count as consumed (only in modules that
+        # really do read os.environ dynamically)
+        tree = {
+            "tf_operator_tpu/__init__.py": "",
+            "tf_operator_tpu/runtime/__init__.py": "",
+            "tf_operator_tpu/runtime/local.py": """
+                def build_env(env):
+                    env["TPUJOB_TABLED"] = "x"
+                    return env
+            """,
+            "tf_operator_tpu/stub.py": """
+                import os
+
+                KEYS = ("TPUJOB_TABLED",)
+
+                def snapshot():
+                    return {k: os.environ[k] for k in KEYS
+                            if k in os.environ}
+            """,
+        }
+        assert envvars.run(make_project(tmp_path, tree)) == []
+
+
+# --------------------------------------------------------------------------
 class TestAllowlist:
     def test_suppression_and_staleness(self):
         from tools.analysis.core import Finding
@@ -810,7 +1043,8 @@ class TestAcceptance:
         # every pass actually ran
         assert set(stats["passes"]) == {
             "hygiene", "thread-discipline", "lock-discipline",
-            "schema-drift", "donation-safety", "metrics-doc"}
+            "schema-drift", "donation-safety", "metrics-doc",
+            "env-contract"}
 
     @pytest.mark.slow
     def test_cli_exit_codes(self, tmp_path):

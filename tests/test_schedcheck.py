@@ -1,0 +1,275 @@
+"""schedcheck core (tf_operator_tpu/testing/schedcheck.py): the
+deterministic bounded interleaving explorer.
+
+The detector contracts: a seeded lost wakeup and a seeded deadlock are
+FOUND within the default preemption bound, every failure carries a
+schedule token, and replaying that token reproduces the failure on the
+first run (the property the PR-13 rewind-race flake never had). Plus
+the bound semantics (a race needing one preemption is invisible at
+bound 0, found at bound 1), the virtual clock (timed waits fire
+deterministically as a last resort), thread-reaping (no model thread
+survives a schedule), and the TPUJOB_SCHEDCHECK knob parsing.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from tf_operator_tpu.testing import schedcheck
+
+
+class _S:
+    pass
+
+
+class _LostWakeupSlot:
+    """put() forgets to notify; take() waits untimed."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._item = None
+
+    def put(self, x):
+        with self._cond:
+            self._item = x  # BUG: no notify
+
+    def take(self):
+        with self._cond:
+            while self._item is None:
+                self._cond.wait()
+            x, self._item = self._item, None
+            return x
+
+
+class _FixedSlot(_LostWakeupSlot):
+    def put(self, x):
+        with self._cond:
+            self._item = x
+            self._cond.notify_all()
+
+
+def _slot_model(slot_cls) -> schedcheck.Model:
+    def setup():
+        s = _S()
+        s.slot = slot_cls()
+        s.got = []
+        return s
+
+    return schedcheck.Model(
+        name="slot",
+        setup=setup,
+        threads=[("taker", lambda s: s.got.append(s.slot.take())),
+                 ("putter", lambda s: s.slot.put(41))],
+        invariant=lambda s: None,
+    )
+
+
+class TestLostWakeup:
+    def test_found_and_token_replays_first_run(self):
+        model = _slot_model(_LostWakeupSlot)
+        report = schedcheck.explore(model)
+        assert not report.ok
+        failure = report.failures[0]
+        assert failure.kind == "lost-wakeup"
+        assert failure.token.startswith("p"), failure.token
+        # Replay: exactly one schedule, same failure, first run.
+        replayed = schedcheck.replay(model, failure.token)
+        assert replayed.schedules == 1
+        assert replayed.failures and replayed.failures[0].kind == \
+            "lost-wakeup"
+
+    def test_fixed_twin_explores_clean(self):
+        report = schedcheck.explore(_slot_model(_FixedSlot))
+        assert report.ok, report.summary()
+        assert report.schedules > 1  # actually explored, not one run
+
+    def test_check_raises_with_token_in_message(self):
+        with pytest.raises(schedcheck.ScheduleFailure) as ei:
+            schedcheck.check(_slot_model(_LostWakeupSlot))
+        assert "replay token: p" in str(ei.value)
+
+
+class TestDeadlock:
+    def _model(self):
+        def setup():
+            s = _S()
+            s.a = threading.Lock()
+            s.b = threading.Lock()
+            return s
+
+        def fwd(s):
+            with s.a:
+                schedcheck.sched_point()
+                with s.b:
+                    pass
+
+        def bwd(s):
+            with s.b:
+                schedcheck.sched_point()
+                with s.a:
+                    pass
+
+        return schedcheck.Model(name="abba", setup=setup,
+                                threads=[("fwd", fwd), ("bwd", bwd)])
+
+    def test_ab_ba_deadlock_found_and_replays(self):
+        report = schedcheck.explore(self._model())
+        dead = [f for f in report.failures if f.kind == "deadlock"]
+        assert dead, report.summary()
+        replayed = schedcheck.replay(self._model(), dead[0].token)
+        assert replayed.failures
+        assert replayed.failures[0].kind == "deadlock"
+
+
+class TestPreemptionBound:
+    """The CHESS accounting: a lost update needs ONE preemption inside
+    the read-modify-write window — invisible at bound 0 (threads only
+    switch at blocking points, and nothing blocks), found at bound 1."""
+
+    def _model(self):
+        def setup():
+            s = _S()
+            s.x = 0
+            return s
+
+        def incr(s):
+            tmp = s.x
+            schedcheck.sched_point("rmw-window")
+            s.x = tmp + 1
+
+        return schedcheck.Model(
+            name="lost-update", setup=setup,
+            threads=[("i1", incr), ("i2", incr)],
+            invariant=lambda s: (_ for _ in ()).throw(
+                AssertionError(f"lost update: x={s.x}")) if s.x != 2
+            else None)
+
+    def test_invisible_at_bound_zero(self):
+        report = schedcheck.explore(self._model(), preemptions=0)
+        assert report.ok, report.summary()
+
+    def test_found_at_bound_one(self):
+        report = schedcheck.explore(self._model(), preemptions=1)
+        assert not report.ok
+        assert report.failures[0].kind == "invariant"
+        # and the failing interleaving replays
+        replayed = schedcheck.replay(self._model(),
+                                     report.failures[0].token)
+        assert replayed.failures and replayed.failures[0].kind == \
+            "invariant"
+
+
+class TestTimedWaits:
+    def test_timeout_fires_as_last_resort(self):
+        """A lone consumer on an empty slot must terminate via its
+        timed wait (virtual clock jumps to the deadline) instead of
+        deadlocking — and the schedule count stays finite."""
+        from tf_operator_tpu.serve.server import StagingSlot
+
+        def setup():
+            s = _S()
+            s.slot = StagingSlot()
+            s.out = []
+            return s
+
+        def consumer(s):
+            s.out.append(s.slot.take(timeout_s=0.05))
+
+        report = schedcheck.explore(schedcheck.Model(
+            name="idle-take", setup=setup,
+            threads=[("consumer", consumer)],
+            invariant=lambda s: None if s.out == [None] else (
+                _ for _ in ()).throw(AssertionError(s.out))))
+        assert report.ok, report.summary()
+
+    def test_timed_lock_acquire_timeout_branch_explorable(self):
+        """lock.acquire(timeout=...) against a holder that never
+        releases must return False (the recovery branch runs) instead
+        of reading as a deadlock — review finding, round 19."""
+
+        def setup():
+            s = _S()
+            s.lock = threading.Lock()
+            s.outcomes = []
+            return s
+
+        def holder(s):
+            s.lock.acquire()
+            schedcheck.sched_point("holding-forever")
+            # never releases: only the contender's timeout can fire
+
+        def contender(s):
+            got = s.lock.acquire(timeout=0.05)
+            s.outcomes.append(got)
+            if got:
+                s.lock.release()
+
+        report = schedcheck.explore(schedcheck.Model(
+            name="timed-acquire", setup=setup,
+            threads=[("holder", holder), ("contender", contender)]))
+        assert not any(f.kind == "deadlock" for f in report.failures), \
+            report.summary()
+        assert report.ok, report.summary()
+
+    def test_untimed_wait_blocked_with_peers_live_is_deadlock(self):
+        def setup():
+            s = _S()
+            s.cond = threading.Condition()
+            s.lock = threading.Lock()
+            return s
+
+        def waiter(s):
+            with s.cond:
+                s.cond.wait()  # untimed, nobody notifies
+
+        def blocker(s):
+            s.lock.acquire()  # hold forever: never notifies either
+            with s.cond:
+                s.cond.wait()
+
+        report = schedcheck.explore(schedcheck.Model(
+            name="mixed-stuck", setup=setup,
+            threads=[("waiter", waiter), ("blocker", blocker)]))
+        assert not report.ok
+        # both stuck in waits -> classified lost-wakeup
+        assert report.failures[0].kind in ("lost-wakeup", "deadlock")
+
+
+class TestHygiene:
+    def test_no_threads_leak_after_exploration(self):
+        schedcheck.explore(_slot_model(_LostWakeupSlot))
+        assert schedcheck.leaked_threads() == []
+
+    def test_primitives_restored_after_exploration(self):
+        before = (threading.Lock, threading.Condition)
+        schedcheck.explore(_slot_model(_FixedSlot))
+        assert (threading.Lock, threading.Condition) == before
+        import time
+
+        # a real lock allocated now must be a genuine OS lock
+        lk = threading.Lock()
+        assert not isinstance(lk, object().__class__) or lk.acquire(False)
+        lk.release()
+        assert time.monotonic() > 0
+
+    def test_env_knob(self):
+        assert schedcheck.enabled_by_env({"TPUJOB_SCHEDCHECK": "1"})
+        assert not schedcheck.enabled_by_env({"TPUJOB_SCHEDCHECK": "0"})
+        assert not schedcheck.enabled_by_env({})
+        assert schedcheck.default_preemptions({}) == \
+            schedcheck.DEFAULT_PREEMPTIONS
+        assert schedcheck.default_preemptions(
+            {"TPUJOB_SCHEDCHECK": "1"}) == schedcheck.DEFAULT_PREEMPTIONS
+        assert schedcheck.default_preemptions(
+            {"TPUJOB_SCHEDCHECK": "4"}) == 4
+
+    def test_malformed_token_rejected(self):
+        with pytest.raises(ValueError):
+            schedcheck.replay(_slot_model(_FixedSlot), "not-a-token")
+
+    def test_determinism_same_model_same_count(self):
+        r1 = schedcheck.explore(_slot_model(_FixedSlot))
+        r2 = schedcheck.explore(_slot_model(_FixedSlot))
+        assert (r1.schedules, r1.ops) == (r2.schedules, r2.ops)

@@ -1,0 +1,218 @@
+"""schedcheck protocol models (testing/schedcheck_protocols.py): the
+real threaded protocols explored under the deterministic scheduler.
+
+THE acceptance tests for round 19: the re-seeded PR-13 multislice
+rewind race (stale `_Pending` snapshot swallowing a one-shot generation
+change) is FOUND by exploration within the default preemption bound,
+its printed schedule token replays the failure on the first run, and
+the current-tree protocols explore clean at the same bound. The two
+PR-14 review-found router races (cold-backend ewma floor, 504
+black-hole demotion) are pinned by exploration — their buggy twins
+(raw least-loaded pick) fail, the shipped router passes every
+interleaving. The registry runner used by the CI `schedcheck` stage is
+exercised in-process, including the explored-schedule floor (TPC803)
+and the seeded-race self-test (TPC802).
+"""
+
+from __future__ import annotations
+
+import types
+
+import pytest
+
+from tf_operator_tpu.testing import schedcheck
+from tf_operator_tpu.testing import schedcheck_protocols as protocols
+
+
+def _model(name: str) -> schedcheck.Model:
+    models = protocols.build_models()
+    assert name in models, sorted(models)
+    return models[name]
+
+
+class TestRewindRace:
+    """The tentpole acceptance: the PR-13 stale-pending-snapshot race,
+    re-seeded from the pre-fix `_check_peers` body, must be FOUND —
+    and the fixed (current-tree) class must survive every schedule."""
+
+    def test_reseeded_race_found_within_default_bound(self):
+        report = schedcheck.explore(_model("dcn-rewind-race-reseeded"))
+        assert not report.ok, (
+            "the re-seeded stale-snapshot race explored clean — "
+            "schedcheck no longer catches the class that produced the "
+            "round-17 tier-1 flake")
+        failure = report.failures[0]
+        assert failure.kind == "invariant"
+        assert "swallowed" in failure.detail
+
+    def test_token_replays_the_race_on_first_run(self):
+        report = schedcheck.explore(_model("dcn-rewind-race-reseeded"),
+                                    fail_fast=True)
+        token = report.failures[0].token
+        replayed = schedcheck.replay(
+            _model("dcn-rewind-race-reseeded"), token)
+        assert replayed.schedules == 1
+        assert replayed.failures, (
+            f"token {token} did not reproduce — determinism broken")
+        assert replayed.failures[0].kind == "invariant"
+
+    def test_fixed_exchange_explores_clean_same_bound(self):
+        report = schedcheck.explore(_model("dcn-rewind"))
+        assert report.ok, report.summary()
+        # same driver, same bound: the ONLY difference is the fix
+        assert report.preemption_bound == schedcheck.default_preemptions()
+
+
+class TestSeededLostWakeup:
+    def test_found_token_printed_and_replays(self):
+        report = schedcheck.explore(_model("seeded-lost-wakeup"),
+                                    fail_fast=True)
+        assert not report.ok
+        failure = report.failures[0]
+        assert failure.kind == "lost-wakeup"
+        assert failure.token in report.summary()  # printed with report
+        replayed = schedcheck.replay(_model("seeded-lost-wakeup"),
+                                     failure.token)
+        assert replayed.failures
+        assert replayed.failures[0].kind == "lost-wakeup"
+
+
+def _raw_least_loaded(router):
+    """The PRE-review `_pick`: raw ewma, no inflight floor, no
+    timeout-streak demotion — both PR-14 races re-seeded at once."""
+    import time
+
+    def _pick(self, exclude):
+        with self._lock:
+            now = time.monotonic()
+            best = None
+            best_key = None
+            for b in self._backends.values():
+                if not b.ready or b.name in exclude:
+                    continue
+                b.touch(now)
+                key = (b.ewma, b.inflight, b.requests)  # BUG: raw
+                if best is None or key < best_key:
+                    best, best_key = b, key
+            if best is not None:
+                best.inflight += 1
+                best.requests += 1
+            return best
+
+    router._pick = types.MethodType(_pick, router)
+    return router
+
+
+def _with_buggy_pick(model: schedcheck.Model) -> schedcheck.Model:
+    real_setup = model.setup
+
+    def setup():
+        s = real_setup()
+        _raw_least_loaded(s.r)
+        return s
+
+    return schedcheck.Model(
+        name=model.name + "-raw-pick", setup=setup,
+        threads=model.threads, invariant=model.invariant,
+        preemptions=model.preemptions)
+
+
+class TestRouterRacesPinnedByExploration:
+    """PR 14's two review-found races, previously pinned only by the
+    hand-written interleaving in test_serve_fastpath.py — now pinned by
+    exhaustive exploration: the shipped router survives EVERY schedule,
+    the raw least-loaded twin fails."""
+
+    def test_cold_backend_floor_clean_on_shipped_router(self):
+        report = schedcheck.explore(_model("router-cold-backend"))
+        assert report.ok, report.summary()
+
+    def test_cold_backend_race_reappears_without_the_floor(self):
+        report = schedcheck.explore(
+            _with_buggy_pick(_model("router-cold-backend")))
+        assert not report.ok, (
+            "raw-ewma pick explored clean: the cold-backend model no "
+            "longer exercises the race")
+        assert "cold" in report.failures[0].detail
+
+    def test_timeout_demotion_clean_on_shipped_router(self):
+        report = schedcheck.explore(_model("router-timeout-demotion"))
+        assert report.ok, report.summary()
+
+    def test_black_hole_reappears_without_demotion(self):
+        report = schedcheck.explore(
+            _with_buggy_pick(_model("router-timeout-demotion")))
+        assert not report.ok, (
+            "un-demoted pick explored clean: the black-hole model no "
+            "longer exercises the race")
+        assert "black hole" in report.failures[0].detail
+
+
+@pytest.mark.slow
+class TestFullRegistrySweep:
+    """Every registered model at its registry bound — the same sweep
+    the CI schedcheck stage runs via `python -m tools.analysis
+    schedcheck`; slow-marked here to keep it out of the tier-1
+    wall-clock budget (chaos-smoke-style: it still runs in CI)."""
+
+    def test_clean_models_explore_clean(self):
+        for name, model in protocols.build_models().items():
+            report = schedcheck.explore(model)
+            if model.expect == "clean":
+                assert report.ok, report.summary()
+            else:
+                assert not report.ok, (
+                    f"seeded-race model {name} explored clean")
+
+    def test_explored_schedule_volume(self):
+        total = sum(schedcheck.explore(m).schedules
+                    for m in protocols.build_models().values())
+        # the CI floor is 2000; leave headroom so a legitimately
+        # smaller refactor does not flap the gate
+        assert total >= 2000, total
+
+
+class TestRegistryRunner:
+    """tools/analysis schedcheck — the CI stage's entry point —
+    in-process."""
+
+    def test_clean_registry_no_findings_and_floor_counted(self):
+        from tools.analysis.schedcheck import run_registry
+
+        models = {n: m for n, m in protocols.build_models().items()
+                  if n in ("router-cold-backend", "seeded-lost-wakeup")}
+        findings, stats = run_registry(models, min_schedules=10)
+        assert findings == [], [f.render() for f in findings]
+        assert stats["models"] == 2
+        assert stats["found_races"] == 1
+        assert stats["schedules"] >= 10
+
+    def test_floor_violation_is_tpc803(self):
+        from tools.analysis.schedcheck import run_registry
+
+        models = {"seeded-lost-wakeup":
+                  protocols.build_models()["seeded-lost-wakeup"]}
+        findings, stats = run_registry(models, min_schedules=10**6)
+        assert [f.rule for f in findings] == ["TPC803"]
+
+    def test_neutered_detector_is_tpc802(self):
+        from tools.analysis.schedcheck import run_registry
+
+        # a "race" model that is actually clean = neutered detector
+        clean = _model("router-cold-backend")
+        neutered = schedcheck.Model(
+            name=clean.name, setup=clean.setup, threads=clean.threads,
+            invariant=clean.invariant, expect="race")
+        findings, _ = run_registry({"m": neutered})
+        assert [f.rule for f in findings] == ["TPC802"]
+
+    def test_clean_model_failure_is_tpc801_with_token(self):
+        from tools.analysis.schedcheck import run_registry
+
+        racy = _model("seeded-lost-wakeup")
+        misdeclared = schedcheck.Model(
+            name=racy.name, setup=racy.setup, threads=racy.threads,
+            invariant=racy.invariant, expect="clean")
+        findings, _ = run_registry({"m": misdeclared})
+        assert findings and findings[0].rule == "TPC801"
+        assert "--replay" in findings[0].message

@@ -192,7 +192,8 @@ class DcnExchange:
 
     def __init__(self, world: SliceWorld, resume_step: int,
                  microbatches: int = 1, buckets: int = 4,
-                 peer_timeout_s: float = 600.0):
+                 peer_timeout_s: float = 600.0,
+                 start_engine: bool = True):
         self.world = world
         self.microbatches = max(1, microbatches)
         self.num_buckets = max(1, buckets)
@@ -225,9 +226,14 @@ class DcnExchange:
         self.rewinds = 0
         os.makedirs(world.dcn_dir, exist_ok=True)
         self.announce(resume_step)
-        self._thread = threading.Thread(
-            target=self._engine_main, name="dcn-exchange", daemon=True)
-        self._thread.start()
+        # start_engine=False: schedcheck protocol models drive the
+        # engine body (snapshot + _check_peers) as an explicit model
+        # thread instead — the explorer does not intercept Thread.
+        self._thread = None
+        if start_engine:
+            self._thread = threading.Thread(
+                target=self._engine_main, name="dcn-exchange", daemon=True)
+            self._thread.start()
 
     # ------------------------------------------------------------ protocol
 
@@ -609,4 +615,5 @@ class DcnExchange:
         with self._cond:
             self._stop = True
             self._cond.notify_all()
-        self._thread.join(timeout=30.0)
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)

@@ -94,6 +94,11 @@ ENV_ENDPOINT = "TPUJOB_SERVE_ENDPOINT"
 ENV_BUCKETING = "TPUJOB_SERVE_BUCKETING"
 ENV_FOLLOW = "TPUJOB_SERVE_FOLLOW"
 ENV_FOLLOW_POLL = "TPUJOB_SERVE_FOLLOW_POLL_S"
+# The replica's own pod name: the server's metrics `replica` label —
+# server.py's __main__ read this from day one, but nothing injected it
+# (replicas fell back to the generic "server-N" label). Found by
+# tpulint's env-contract pass (TPE702, round 19).
+ENV_POD_NAME = "TPUJOB_POD_NAME"
 # fromTrainJob resolution cache (annotations, persisted with status): a
 # service that already resolved — and may already be SERVING — must not
 # wedge when the finished TrainJob is later deleted (routine cleanup).
@@ -921,6 +926,7 @@ class InferenceServiceController(ctrl.JobControllerBase):
                       f"{name}.{svc.namespace}.svc:{serving.port}")
             c.set_env("TPUJOB_REPLICA_TYPE", SERVER_REPLICA)
             c.set_env("TPUJOB_REPLICA_INDEX", str(index))
+            c.set_env(ENV_POD_NAME, name)
             if svc.spec.tpu is not None and svc.spec.tpu.topology:
                 chips = None
                 try:

@@ -99,13 +99,21 @@ class FrontEndRouter:
     across a network call."""
 
     def __init__(self, service: str, probe_interval_s: float = 0.25,
-                 request_timeout_s: float = 30.0):
+                 request_timeout_s: float = 30.0, serve_http: bool = True):
         self.service = service
         self.probe_interval_s = probe_interval_s
         self.request_timeout_s = request_timeout_s
         self._lock = threading.Lock()
         self._backends: dict[str, _Backend] = {}
         self._stop = threading.Event()
+        # serve_http=False: the pick/settle core without the front door
+        # or the probe thread — what schedcheck's protocol models drive
+        # (the explorer serializes MODEL threads; a live HTTP server
+        # per explored schedule would be thousands of real listeners).
+        self._httpd = None
+        self.port = 0
+        if not serve_http:
+            return
         from http.server import ThreadingHTTPServer
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", 0),
@@ -360,6 +368,8 @@ class FrontEndRouter:
 
     def close(self) -> None:
         self._stop.set()
+        if self._httpd is None:
+            return
         try:
             self._httpd.shutdown()
             self._httpd.server_close()

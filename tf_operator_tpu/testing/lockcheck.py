@@ -38,6 +38,7 @@ import _thread
 __all__ = [
     "PotentialDeadlockError", "install", "uninstall", "installed",
     "enabled_by_env", "violations", "reset", "checked_lock",
+    "allocation_from_package",
 ]
 
 ENV = "TPUJOB_LOCKCHECK"
@@ -162,26 +163,37 @@ def _alloc_site() -> str:
     return "<unknown>"
 
 
-def _ours() -> bool:
+def allocation_from_package(skip_frames: int = 3) -> bool:
     """True when the allocation came from tf_operator_tpu source (frame
-    walk, skipping this module, threading.py, and synthesized frames —
-    a dataclass `field(default_factory=threading.Lock)` calls the
-    factory from the generated __init__ whose co_filename is
+    walk, skipping the detector modules, threading.py, and synthesized
+    frames — a dataclass `field(default_factory=threading.Lock)` calls
+    the factory from the generated __init__ whose co_filename is
     '<string>', with dataclasses.py beneath it; treating those as the
-    caller would leave e.g. SliceAllocator._lock unwrapped)."""
-    f = sys._getframe(2)
+    caller would leave e.g. SliceAllocator._lock unwrapped).
+
+    Shared wrap-scope for both runtime detectors: lockcheck's lock-graph
+    wrappers and schedcheck's cooperative primitives (testing/
+    schedcheck.py) decide "is this lock OURS to instrument?" with the
+    exact same walk, so the two tools agree on scope by construction.
+    `skip_frames` is the caller's distance from the allocation site."""
+    f = sys._getframe(skip_frames)
     for _ in range(10):
         if f is None:
             return False
         fn = f.f_code.co_filename
         base = os.path.basename(fn)
         if (fn.endswith(os.path.join("testing", "lockcheck.py"))
+                or fn.endswith(os.path.join("testing", "schedcheck.py"))
                 or base in ("threading.py", "dataclasses.py")
                 or fn.startswith("<")):
             f = f.f_back
             continue
         return fn.startswith(_PKG_DIR)
     return False
+
+
+def _ours() -> bool:
+    return allocation_from_package(skip_frames=3)
 
 
 class _Checked:

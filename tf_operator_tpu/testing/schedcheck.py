@@ -1,0 +1,934 @@
+"""schedcheck — a deterministic bounded interleaving explorer for the
+repo's hand-built condition-variable protocols.
+
+lockcheck (the sibling module) catches lock-ORDER cycles on the first
+run that exhibits both orders; it is blind to the bug classes that
+actually bit this control plane — atomicity violations (the PR-13
+multislice rewind race: a stale `_Pending` snapshot swallowing a
+one-shot generation change, surfaced as a host-speed-dependent tier-1
+flake), lost wakeups, and stale-read-under-condition bugs. The
+reference operator leaned on Go's `-race` plus brute scheduling for
+these; the standard answer for a small fixed protocol is CHESS-style
+bounded schedule exploration, which is what this module implements:
+
+  * A **cooperative scheduler**: model threads are real OS threads, but
+    every one of them parks on its own semaphore and exactly ONE runs at
+    a time. Context switches happen only at *sched points* — lock
+    acquire/release, Condition wait/notify (threading.Event composes on
+    Condition and is covered transitively), `time.sleep`, and explicit
+    `sched_point()` yields — so an execution is fully determined by the
+    sequence of scheduling choices.
+  * **Systematic DFS** over those choices with a *preemption bound*
+    (default 3, CHESS-style): switches at blocking points are free and
+    fully explored; switching away from a thread that could have
+    continued costs one preemption credit. Small bounds find almost all
+    real concurrency bugs while keeping the schedule count tractable.
+  * **Deterministic detection at every terminal schedule**: deadlock
+    (all live threads blocked, no timeout can fire), lost wakeup (live
+    threads stuck in untimed waits nobody can ever notify), model
+    exceptions/assertions, and a user invariant checked after all
+    threads finish.
+  * A printable **schedule token** (`p3:0-0-1-0...`) for every failure.
+    `replay(model, token)` re-executes exactly that interleaving — the
+    first-run reproducibility the rewind-race flake never had.
+
+Scope discipline mirrors lockcheck: `install()` swaps
+`threading.Lock/RLock/Condition` and only wraps primitives allocated
+from `tf_operator_tpu` source (lockcheck.allocation_from_package — the
+shared frame walk), so driving the REAL protocol classes (StagingSlot,
+ShardedRateLimitingQueue, FleetScheduler, DcnExchange, FrontEndRouter)
+requires no changes to them: construct them inside the model's
+`setup()` and their internal locks become cooperative automatically.
+
+Time is virtualized during exploration: `time.monotonic` returns a
+deterministic virtual clock (advanced a tick per scheduling step;
+jumped to the deadline when a timed wait fires), and `time.sleep` from
+a model thread is a sched point that advances it. Timed waits fire
+only as a LAST RESORT (when no thread is otherwise runnable), which
+keeps polling protocols terminating without exploding the schedule
+space; an untimed wait that can never be notified is a lost wakeup.
+
+Deliberate limits (documented, not accidental): `threading.Thread` is
+NOT intercepted — a protocol whose internal thread matters is driven
+by running that thread's body as an explicit model thread (DcnExchange
+grows a `start_engine=False` hook for exactly this); primitives shared
+between model threads and foreign live threads are unsupported; model
+code must be deterministic given the virtual clock.
+
+Knob: `TPUJOB_SCHEDCHECK` (mirrors TPUJOB_LOCKCHECK). Truthy arms the
+conftest leaked-thread accounting in CI stages; an integer value >= 2
+also overrides the default preemption bound for every exploration that
+does not pin one explicitly.
+"""
+
+from __future__ import annotations
+
+import _thread
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from tf_operator_tpu.testing.lockcheck import allocation_from_package
+
+__all__ = [
+    "ENV", "Model", "Report", "Failure", "ScheduleFailure",
+    "explore", "replay", "check", "sched_point", "enabled_by_env",
+    "default_preemptions", "leaked_threads", "reap_leaked",
+]
+
+ENV = "TPUJOB_SCHEDCHECK"
+
+DEFAULT_PREEMPTIONS = 3
+DEFAULT_MAX_SCHEDULES = 20000
+DEFAULT_MAX_OPS = 4000          # per-schedule depth bound (runaway guard)
+GRANT_TIMEOUT_S = 20.0          # real-time stuck-thread watchdog
+
+_VT_BASE = 1_000_000.0          # virtual monotonic base: fixed => replayable
+_VT_TICK = 1e-6                 # per-scheduling-step advance
+
+_real_monotonic = time.monotonic
+_real_sleep = time.sleep
+
+
+def enabled_by_env(env: dict | None = None) -> bool:
+    e = os.environ if env is None else env
+    return e.get(ENV, "").strip() not in ("", "0", "off", "false")
+
+
+def default_preemptions(env: dict | None = None) -> int:
+    """The exploration bound: DEFAULT_PREEMPTIONS unless TPUJOB_SCHEDCHECK
+    carries an explicit integer >= 1 (TPUJOB_SCHEDCHECK=1 and other
+    truthy non-integers keep the default)."""
+    e = os.environ if env is None else env
+    raw = e.get(ENV, "").strip()
+    try:
+        n = int(raw)
+    except ValueError:
+        return DEFAULT_PREEMPTIONS
+    return n if n > 1 else DEFAULT_PREEMPTIONS
+
+
+# --------------------------------------------------------------------------
+# model / report surface
+
+
+@dataclass
+class Model:
+    """One protocol under exploration. `setup()` builds fresh state per
+    schedule (construct the real protocol objects HERE so their locks
+    are wrapped); `threads` maps name -> fn(state) bodies run
+    cooperatively; `invariant(state)`, if given, is asserted after every
+    schedule on which all threads finished."""
+
+    name: str
+    setup: Callable[[], object]
+    threads: list  # list[tuple[str, Callable[[object], None]]]
+    invariant: Callable[[object], None] | None = None
+    preemptions: int | None = None  # None: default_preemptions()
+    expect: str = "clean"  # "clean" | "race" (registry self-test contract)
+    describe: str = ""
+
+
+@dataclass(frozen=True)
+class Failure:
+    kind: str       # deadlock | lost-wakeup | exception | invariant | bound
+    token: str      # replayable schedule token
+    detail: str
+    schedule: int   # 0-based index of the failing schedule
+
+
+@dataclass
+class Report:
+    model: str
+    schedules: int = 0
+    preemption_bound: int = 0
+    failures: list = field(default_factory=list)
+    ops: int = 0  # total scheduling steps across all schedules
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+    def summary(self) -> str:
+        verdict = ("clean" if self.ok
+                   else f"{len(self.failures)} failing schedule(s)")
+        out = (f"schedcheck[{self.model}]: {self.schedules} schedules "
+               f"explored (bound={self.preemption_bound} preemptions, "
+               f"{self.ops} steps): {verdict}")
+        for f in self.failures:
+            out += f"\n  {f.kind}: {f.detail}\n    replay token: {f.token}"
+        return out
+
+
+class ScheduleFailure(AssertionError):
+    """Raised by check(): carries the failing schedule's replay token in
+    the message so the interleaving reproduces on the first run."""
+
+    def __init__(self, report: Report):
+        self.report = report
+        super().__init__(report.summary())
+
+
+class _Abandoned(BaseException):
+    """Injected into a parked model thread at schedule teardown so it
+    unwinds and exits instead of leaking into the next schedule/test."""
+
+
+# --------------------------------------------------------------------------
+# thread bookkeeping
+
+_STATE_NEW, _STATE_LIVE, _STATE_DONE = "new", "live", "done"
+
+# Every model thread ever spawned and possibly still alive: the conftest
+# leaked-thread check reads this so a thread that survives its test
+# fails THAT test, not its successor (whose lockcheck graph / schedule
+# state it would silently poison).
+_managed_threads: list[threading.Thread] = []
+_managed_mu = threading.Lock()
+
+
+def leaked_threads() -> list[threading.Thread]:
+    """Managed model threads still alive (normally none: the explorer
+    reaps every thread at schedule end)."""
+    with _managed_mu:
+        _managed_threads[:] = [t for t in _managed_threads if t.is_alive()]
+        return list(_managed_threads)
+
+
+def reap_leaked(timeout: float = 1.0) -> list[str]:
+    """Best-effort release of leaked model threads (abandon + join) so a
+    failing test does not wedge its successors. Returns the names of
+    threads that were still alive when called."""
+    leaked = leaked_threads()
+    names = [t.name for t in leaked]
+    for t in leaked:
+        mt = getattr(t, "_schedcheck_mt", None)
+        if mt is not None:
+            mt.abandoned = True
+            mt.sem.release()
+    for t in leaked:
+        t.join(timeout=timeout)
+    leaked_threads()  # prune the registry
+    return names
+
+
+class _BinSem:
+    """Strictly-alternating binary semaphore over a RAW _thread lock —
+    immune to the very patching this module performs (threading.Semaphore
+    would allocate a Condition through the patched factories). The
+    grant/park protocol holds exactly one token, so binary suffices."""
+
+    __slots__ = ("_lock",)
+
+    def __init__(self):
+        self._lock = _thread.allocate_lock()
+        self._lock.acquire()
+
+    def acquire(self, timeout: float | None = None) -> bool:
+        if timeout is None:
+            self._lock.acquire()
+            return True
+        return self._lock.acquire(True, timeout)
+
+    def release(self) -> None:
+        try:
+            self._lock.release()
+        except RuntimeError:
+            pass  # already released (idempotent reap)
+
+
+class _MThread:
+    __slots__ = ("index", "name", "fn", "state", "sem", "pending",
+                 "error", "thread", "abandoned")
+
+    def __init__(self, index: int, name: str, fn):
+        self.index = index
+        self.name = name
+        self.fn = fn
+        self.state = _STATE_NEW
+        self.sem = _BinSem()
+        self.pending: _Op | None = None
+        self.error: BaseException | None = None
+        self.abandoned = False
+        self.thread: threading.Thread | None = None
+
+    @property
+    def done(self) -> bool:
+        return self.state == _STATE_DONE
+
+
+class _Op:
+    """One announced sched point: what the parked thread wants to do
+    next. `enabled()` is evaluated by the scheduler (nothing else runs
+    concurrently); `fired` marks a timed wait woken by its timeout."""
+
+    __slots__ = ("kind", "what", "enabled", "timed", "deadline", "fired")
+
+    def __init__(self, kind: str, what: str, enabled, timed: bool = False,
+                 deadline: float = 0.0):
+        self.kind = kind
+        self.what = what
+        self.enabled = enabled
+        self.timed = timed
+        self.deadline = deadline
+        self.fired = False
+
+
+# --------------------------------------------------------------------------
+# cooperative primitives (installed over threading.* for package-allocated
+# primitives, lockcheck-style)
+
+_current: "_Explorer | None" = None
+
+
+def _me() -> _MThread | None:
+    ex = _current
+    if ex is None:
+        return None
+    return ex.by_ident.get(threading.get_ident())
+
+
+def sched_point(label: str = "yield") -> None:
+    """Explicit context-switch point for protocol code or model bodies.
+    A no-op outside exploration — safe to leave in production paths."""
+    mt = _me()
+    if mt is not None:
+        _current.op(mt, _Op("yield", label, lambda: True))
+
+
+class _CoopLock:
+    """Cooperative Lock/RLock. Model threads go through the scheduler;
+    non-model callers (setup/invariant on the scheduler thread, or any
+    use outside exploration) mutate the state directly — exclusive by
+    construction, since model threads only run when granted."""
+
+    _EXTERNAL = "<external>"
+
+    def __init__(self, reentrant: bool, name: str = ""):
+        self._reentrant = reentrant
+        self._name = name or ("rlock" if reentrant else "lock")
+        self._owner = None   # _MThread | _EXTERNAL | None
+        self._count = 0
+
+    # -- core protocol ---------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        mt = _me()
+        if mt is None:
+            if self._owner is None or (self._reentrant
+                                       and self._owner is self._EXTERNAL):
+                self._owner = self._EXTERNAL
+                self._count += 1
+                return True
+            if not blocking:
+                return False
+            raise RuntimeError(
+                f"schedcheck: non-model thread would block on {self._name} "
+                f"held by {self._owner!r} — foreign/model sharing is "
+                "unsupported")
+        if not blocking:
+            _current.op(mt, _Op("try-acquire", self._name, lambda: True))
+            if self._owner is None or (self._reentrant
+                                       and self._owner is mt):
+                self._owner = mt
+                self._count += 1
+                return True
+            return False
+        free = (lambda: self._owner is None
+                or (self._reentrant and self._owner is mt))
+        if timeout is not None and timeout >= 0:
+            # Timed acquire: modeled like a timed wait — the timeout
+            # fires as a last resort, and firing while the lock is
+            # still held returns False (the caller's recovery branch
+            # becomes explorable instead of a false deadlock).
+            op = _Op("acquire", self._name, free, timed=True,
+                     deadline=_current.vt + timeout)
+            _current.op(mt, op)
+            if op.fired and not free():
+                return False
+        else:
+            _current.op(mt, _Op("acquire", self._name, free))
+        self._owner = mt
+        self._count += 1
+        return True
+
+    def release(self) -> None:
+        mt = _me()
+        if self._owner is None:
+            raise RuntimeError(f"release of unheld {self._name}")
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+        if mt is not None:
+            _current.op(mt, _Op("release", self._name, lambda: True))
+
+    def locked(self) -> bool:
+        return self._owner is not None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<schedcheck {self._name} owner={self._owner!r}>"
+
+    # -- Condition integration ------------------------------------------
+    def _release_all(self) -> int:
+        n, self._count, self._owner = self._count, 0, None
+        return n
+
+    def _acquire_n(self, who, n: int) -> None:
+        self._owner, self._count = who, n
+
+    def _is_owned_by(self, who) -> bool:
+        return self._owner is who
+
+    # threading.Condition compatibility shims (it probes these on the
+    # lock it wraps; our Condition below never calls them, but foreign
+    # code holding a reference might).
+    def _is_owned(self) -> bool:
+        me = _me() or self._EXTERNAL
+        return self._owner is me
+
+    def _at_fork_reinit(self) -> None:
+        self._owner, self._count = None, 0
+
+
+class _Waiter:
+    __slots__ = ("mt", "notified")
+
+    def __init__(self, mt):
+        self.mt = mt
+        self.notified = False
+
+
+class _CoopCondition:
+    """Cooperative Condition over a _CoopLock. wait() is three sched
+    points — release, wake (notified or last-resort timeout), reacquire
+    — so other threads interleave exactly where the real primitive
+    allows them to."""
+
+    def __init__(self, lock=None, name: str = ""):
+        if lock is None:
+            lock = _CoopLock(reentrant=True, name=(name or "cond") + ".lock")
+        if not isinstance(lock, _CoopLock):
+            raise TypeError(
+                "schedcheck: Condition over a non-cooperative lock — "
+                "allocate the lock from package code (or inside the "
+                "model) so it is wrapped too")
+        self._lock = lock
+        self._name = name or f"cond({lock._name})"
+        self._waiters: list[_Waiter] = []
+        # lock API passthrough, threading.Condition-style
+        self.acquire = lock.acquire
+        self.release = lock.release
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._lock.release()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        mt = _me()
+        me = mt if mt is not None else _CoopLock._EXTERNAL
+        if not self._lock._is_owned_by(me):
+            raise RuntimeError("cannot wait on un-acquired lock")
+        if mt is None:
+            raise RuntimeError(
+                "schedcheck: non-model thread wait() on a cooperative "
+                "Condition is unsupported (drive it from a model thread)")
+        ex = _current
+        w = _Waiter(mt)
+        self._waiters.append(w)
+        n = self._lock._release_all()
+        # release point: peers may run from here on
+        ex.op(mt, _Op("wait-release", self._name, lambda: True))
+        timed = timeout is not None
+        deadline = (ex.vt + max(0.0, timeout)) if timed else 0.0
+        wake = _Op("wait", self._name, lambda: w.notified,
+                   timed=timed, deadline=deadline)
+        ex.op(mt, wake)
+        if w in self._waiters:
+            self._waiters.remove(w)
+        notified = w.notified and not wake.fired
+        ex.op(mt, _Op("reacquire", self._name,
+                      lambda: self._lock._owner is None))
+        self._lock._acquire_n(mt, n)
+        return notified
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        # mirrors threading.Condition.wait_for over the virtual clock
+        endtime = None
+        waittime = timeout
+        result = predicate()
+        while not result:
+            if waittime is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + waittime
+                else:
+                    waittime = endtime - time.monotonic()
+                    if waittime <= 0:
+                        break
+            self.wait(waittime)
+            result = predicate()
+        return result
+
+    def _notify(self, n: int) -> None:
+        mt = _me()
+        me = mt if mt is not None else _CoopLock._EXTERNAL
+        if not self._lock._is_owned_by(me):
+            raise RuntimeError("cannot notify on un-acquired lock")
+        if mt is not None:
+            _current.op(mt, _Op("notify", self._name, lambda: True))
+        woken = 0
+        for w in self._waiters:
+            if woken >= n:
+                break
+            if not w.notified:
+                w.notified = True
+                woken += 1
+
+    def notify(self, n: int = 1) -> None:
+        self._notify(n)
+
+    def notify_all(self) -> None:
+        self._notify(len(self._waiters) or 1)
+
+    notifyAll = notify_all  # noqa: N815 — threading alias
+
+    def __repr__(self) -> str:
+        return f"<schedcheck {self._name} waiters={len(self._waiters)}>"
+
+
+# -- factories swapped over threading.* (lockcheck-style install) ----------
+
+_RealLock = None  # bound at install (whatever was live: raw or lockcheck)
+_RealRLock = None
+_RealCondition = None
+
+
+def _wrap_here() -> bool:
+    """Wrap scope during exploration: package-allocated primitives
+    (lockcheck's frame walk), plus ANY allocation made by the scheduler
+    thread (setup/invariant) or a model thread — test-side fixtures are
+    part of the model under test. Foreign live threads (jax internals,
+    a lingering HTTP server) keep real primitives."""
+    ex = _current
+    if ex is None or ex.no_wrap:
+        return False
+    ident = threading.get_ident()
+    if ident == ex.sched_ident or ident in ex.by_ident:
+        return True
+    return allocation_from_package(skip_frames=3)
+
+
+def _make_lock():
+    if _wrap_here():
+        return _CoopLock(reentrant=False)
+    return _RealLock()
+
+
+def _make_rlock():
+    if _wrap_here():
+        return _CoopLock(reentrant=True)
+    return _RealRLock()
+
+
+def _make_condition(lock=None):
+    if _current is not None and (isinstance(lock, _CoopLock)
+                                 or (lock is None and _wrap_here())):
+        return _CoopCondition(lock)
+    return _RealCondition(lock) if lock is not None else _RealCondition()
+
+
+def _virtual_monotonic() -> float:
+    ex = _current
+    if ex is not None:
+        return ex.vt
+    return _real_monotonic()
+
+
+def _virtual_sleep(seconds: float) -> None:
+    mt = _me()
+    if mt is None:
+        _real_sleep(seconds)
+        return
+    ex = _current
+    ex.op(mt, _Op("sleep", f"sleep({seconds:g})", lambda: True))
+    ex.vt += max(0.0, seconds)
+
+
+# --------------------------------------------------------------------------
+# the explorer
+
+
+class _Step:
+    """One scheduling decision in the current schedule: which choice was
+    taken, how many there were, and what each alternative would have
+    cost in preemption credits (recorded so backtracking can skip
+    unaffordable branches without re-running)."""
+
+    __slots__ = ("chosen", "costs", "preemptions_before")
+
+    def __init__(self, chosen: int, costs: list, preemptions_before: int):
+        self.chosen = chosen
+        self.costs = costs
+        self.preemptions_before = preemptions_before
+
+
+class _DepthBound(Exception):
+    pass
+
+
+class _StuckThread(Exception):
+    """A granted thread did not reach another sched point within the
+    watchdog window: it is blocked in an UN-instrumented blocking call
+    (a real lock, real IO) the explorer cannot schedule around."""
+
+
+class _Explorer:
+    def __init__(self, model: Model, preemptions: int,
+                 max_schedules: int, max_ops: int):
+        self.model = model
+        self.bound = preemptions
+        self.max_schedules = max_schedules
+        self.max_ops = max_ops
+        self.by_ident: dict[int, _MThread] = {}
+        self.sched_ident = threading.get_ident()
+        self.no_wrap = False
+        self.sched_sem = _BinSem()
+        self.vt = _VT_BASE
+        self.threads: list[_MThread] = []
+        self.current: _MThread | None = None
+        self.preemptions = 0
+        self.ops_count = 0
+        self.trace: list[_Step] = []
+
+    # ---- model-thread side ----------------------------------------------
+
+    def op(self, mt: _MThread, op: _Op) -> None:
+        """Announce the next sched point and park until granted. Runs on
+        the model thread; the scheduler evaluates `op.enabled` and
+        decides who continues. An abandoned thread must NOT park again:
+        its unwind path (with-block __exit__ releases) crosses more
+        sched points, and each must fall straight through."""
+        if mt.abandoned:
+            raise _Abandoned()
+        mt.pending = op
+        self.sched_sem.release()
+        mt.sem.acquire()
+        mt.pending = None
+        if mt.abandoned:
+            raise _Abandoned()
+
+    def _thread_main(self, mt: _MThread, state) -> None:
+        self.by_ident[threading.get_ident()] = mt
+        try:
+            mt.sem.acquire()  # start grant
+            if not mt.abandoned:
+                mt.fn(state)
+        except _Abandoned:
+            pass
+        except BaseException as e:  # noqa: BLE001 — reported per schedule
+            mt.error = e
+        finally:
+            mt.state = _STATE_DONE
+            self.by_ident.pop(threading.get_ident(), None)
+            self.sched_sem.release()
+
+    # ---- scheduler side --------------------------------------------------
+
+    def _choices(self) -> tuple[list, list]:
+        """(choices, costs): the canonical ordered list of schedulable
+        (thread, fire_timeout) pairs and each one's preemption cost.
+        Current-thread-continues is always choice 0 when available (the
+        free default); timed waits fire only as a last resort."""
+        runnable: list[_MThread] = []
+        cur = self.current
+        if (cur is not None and not cur.done and cur.pending is not None
+                and cur.pending.enabled()):
+            runnable.append(cur)
+        for mt in self.threads:
+            if mt is cur or mt.done or mt.pending is None:
+                continue
+            if mt.pending.enabled():
+                runnable.append(mt)
+        if runnable:
+            # Switching away from a runnable current thread is a
+            # PREEMPTION (costs 1 credit); any choice at a blocking
+            # point (current blocked or finished) is free — the
+            # CHESS context-switch-bound accounting.
+            cur_runs = cur is not None and runnable[0] is cur
+            choices = [(mt, False) for mt in runnable]
+            if cur_runs:
+                costs = [0] + [1] * (len(runnable) - 1)
+            else:
+                costs = [0] * len(runnable)
+            return choices, costs
+        timed = [mt for mt in self.threads
+                 if not mt.done and mt.pending is not None
+                 and mt.pending.timed and not mt.pending.fired]
+        timed.sort(key=lambda mt: (mt.pending.deadline, mt.index))
+        return [(mt, True) for mt in timed], [0] * len(timed)
+
+    def _grant(self, mt: _MThread, fire: bool) -> None:
+        self.ops_count += 1
+        if self.ops_count > self.max_ops:
+            raise _DepthBound()
+        self.vt += _VT_TICK
+        if fire:
+            op = mt.pending
+            op.fired = True
+            self.vt = max(self.vt, op.deadline)
+            # a fired timed wait is enabled by definition
+            op.enabled = lambda: True
+        self.current = mt
+        mt.sem.release()
+        # Real-time watchdog (virtual time is paused from the model's
+        # point of view): a thread that never reaches another sched
+        # point is stuck in an un-instrumented blocking call — fail the
+        # schedule instead of hanging the whole run.
+        if not self.sched_sem.acquire(timeout=GRANT_TIMEOUT_S):
+            raise _StuckThread(mt.name)
+
+    def _classify_stuck(self) -> tuple[str, str]:
+        live = [mt for mt in self.threads if not mt.done]
+        waits = [mt for mt in live
+                 if mt.pending is not None and mt.pending.kind == "wait"]
+        blocked = ", ".join(
+            f"{mt.name} blocked at {mt.pending.kind}"
+            f"({mt.pending.what})" for mt in live if mt.pending is not None)
+        if waits and len(waits) == len(live):
+            return ("lost-wakeup",
+                    f"untimed wait(s) nobody can notify: {blocked}")
+        return ("deadlock", f"no runnable thread: {blocked}")
+
+    def _run_one(self, prefix: list[int]) -> tuple[list[_Step], Failure | None]:
+        # Fresh handshake token per schedule: an abandoned thread's
+        # unwind releases the OLD semaphore, which must not leak a
+        # token into this schedule's protocol.
+        self.sched_sem = _BinSem()
+        self.vt = _VT_BASE
+        self.preemptions = 0
+        self.ops_count = 0
+        self.trace = []
+        self.current = None
+        self.by_ident = {}
+        failure_kind = failure_detail = None
+        try:
+            state = self.model.setup()
+            self.threads = []
+            # Thread machinery (its _started Event) must not be wrapped:
+            # it is scheduler infrastructure, not model state.
+            self.no_wrap = True
+            try:
+                for i, (name, fn) in enumerate(self.model.threads):
+                    mt = _MThread(i, name, fn)
+                    mt.pending = _Op("start", name, lambda: True)
+                    t = threading.Thread(
+                        target=self._thread_main, args=(mt, state),
+                        name=f"schedcheck-{self.model.name}-{name}",
+                        daemon=True)
+                    t._schedcheck_mt = mt
+                    mt.thread = t
+                    self.threads.append(mt)
+                    with _managed_mu:
+                        _managed_threads.append(t)
+                    t.start()
+            finally:
+                self.no_wrap = False
+            while True:
+                if any(mt.error is not None for mt in self.threads):
+                    mt = next(m for m in self.threads if m.error is not None)
+                    failure_kind = "exception"
+                    failure_detail = (f"{mt.name} raised "
+                                      f"{type(mt.error).__name__}: {mt.error}")
+                    break
+                if all(mt.done for mt in self.threads):
+                    if self.model.invariant is not None:
+                        try:
+                            self.model.invariant(state)
+                        except AssertionError as e:
+                            failure_kind = "invariant"
+                            failure_detail = str(e) or "invariant failed"
+                        except Exception as e:  # noqa: BLE001
+                            failure_kind = "invariant"
+                            failure_detail = f"{type(e).__name__}: {e}"
+                    break
+                choices, costs = self._choices()
+                if not choices:
+                    failure_kind, failure_detail = self._classify_stuck()
+                    break
+                want = prefix[len(self.trace)] if len(self.trace) < len(
+                    prefix) else 0
+                idx = min(want, len(choices) - 1)
+                # an unaffordable prefix entry falls back to the default
+                if costs[idx] + self.preemptions > self.bound:
+                    idx = 0
+                self.trace.append(
+                    _Step(idx, costs, self.preemptions))
+                self.preemptions += costs[idx]
+                mt, fire = choices[idx]
+                self._grant(mt, fire)
+        except _DepthBound:
+            failure_kind = "bound"
+            failure_detail = (
+                f"schedule exceeded {self.max_ops} scheduling steps — "
+                "unbounded model (a thread loops on timed waits?)")
+        except _StuckThread as e:
+            failure_kind = "stuck"
+            failure_detail = (
+                f"thread {e} reached no sched point within "
+                f"{GRANT_TIMEOUT_S:g}s — blocked in an un-instrumented "
+                "blocking call (foreign lock/IO); the thread is leaked "
+                "and will be reported by the conftest leak check")
+        finally:
+            self._reap()
+        if failure_kind is None:
+            return self.trace, None
+        return self.trace, Failure(
+            kind=failure_kind, token=self._token(self.trace),
+            detail=failure_detail, schedule=-1)
+
+    def _reap(self) -> None:
+        """End of schedule: every model thread must exit. Threads parked
+        at a sched point are abandoned (the op wrapper re-raises), then
+        joined; anything still alive surfaces via leaked_threads()."""
+        for mt in self.threads:
+            mt.abandoned = True
+            mt.sem.release()
+        for mt in self.threads:
+            if mt.thread is not None:
+                mt.thread.join(timeout=2.0)
+        with _managed_mu:
+            _managed_threads[:] = [t for t in _managed_threads
+                                   if t.is_alive()]
+
+    def _token(self, trace: list[_Step]) -> str:
+        return f"p{self.bound}:" + "-".join(str(s.chosen) for s in trace)
+
+    # ---- DFS -------------------------------------------------------------
+
+    def _next_prefix(self, trace: list[_Step]) -> list[int] | None:
+        """The deepest untried, affordable branch — classic DFS
+        backtracking over the recorded decision points."""
+        for i in range(len(trace) - 1, -1, -1):
+            step = trace[i]
+            for j in range(step.chosen + 1, len(step.costs)):
+                if step.preemptions_before + step.costs[j] <= self.bound:
+                    return [s.chosen for s in trace[:i]] + [j]
+        return None
+
+    def explore(self, fail_fast: bool = False) -> Report:
+        report = Report(model=self.model.name, preemption_bound=self.bound)
+        prefix: list[int] | None = []
+        t_wall = _real_monotonic()
+        while prefix is not None and report.schedules < self.max_schedules:
+            trace, failure = self._run_one(prefix)
+            report.schedules += 1
+            report.ops += len(trace)
+            if failure is not None:
+                failure = Failure(failure.kind, failure.token,
+                                  failure.detail, report.schedules - 1)
+                report.failures.append(failure)
+                if fail_fast:
+                    break
+            prefix = self._next_prefix(trace)
+            if _real_monotonic() - t_wall > 120:
+                raise RuntimeError(
+                    f"schedcheck[{self.model.name}]: exploration exceeded "
+                    f"120 s wall clock after {report.schedules} schedules")
+        return report
+
+
+# --------------------------------------------------------------------------
+# install / top-level API
+
+_install_mu = threading.Lock()
+
+
+class _Session:
+    """Swap the primitives + clock in, restore on exit. Reentrancy is a
+    bug (one exploration at a time per process)."""
+
+    def __init__(self, ex: _Explorer):
+        self.ex = ex
+
+    def __enter__(self):
+        global _current, _RealLock, _RealRLock, _RealCondition
+        _install_mu.acquire()
+        if _current is not None:
+            _install_mu.release()
+            raise RuntimeError("schedcheck explorations cannot nest")
+        self.ex.sched_ident = threading.get_ident()
+        _RealLock = threading.Lock
+        _RealRLock = threading.RLock
+        _RealCondition = threading.Condition
+        threading.Lock = _make_lock            # type: ignore[assignment]
+        threading.RLock = _make_rlock          # type: ignore[assignment]
+        threading.Condition = _make_condition  # type: ignore[assignment]
+        time.monotonic = _virtual_monotonic
+        time.sleep = _virtual_sleep
+        _current = self.ex
+        return self.ex
+
+    def __exit__(self, *exc) -> None:
+        global _current
+        _current = None
+        threading.Lock = _RealLock             # type: ignore[assignment]
+        threading.RLock = _RealRLock           # type: ignore[assignment]
+        threading.Condition = _RealCondition   # type: ignore[assignment]
+        time.monotonic = _real_monotonic
+        time.sleep = _real_sleep
+        _install_mu.release()
+
+
+def explore(model: Model, preemptions: int | None = None,
+            max_schedules: int = DEFAULT_MAX_SCHEDULES,
+            max_ops: int = DEFAULT_MAX_OPS,
+            fail_fast: bool = False) -> Report:
+    """Systematically explore `model` within the preemption bound.
+    Returns the Report (failures carry replay tokens)."""
+    bound = (preemptions if preemptions is not None
+             else (model.preemptions if model.preemptions is not None
+                   else default_preemptions()))
+    ex = _Explorer(model, bound, max_schedules, max_ops)
+    with _Session(ex):
+        return ex.explore(fail_fast=fail_fast)
+
+
+def replay(model: Model, token: str) -> Report:
+    """Re-execute exactly one schedule from its token. Deterministic:
+    the same token reproduces the same interleaving (and failure) on
+    the first run."""
+    head, _, body = token.partition(":")
+    if not head.startswith("p"):
+        raise ValueError(f"malformed schedule token: {token!r}")
+    bound = int(head[1:])
+    prefix = [int(c) for c in body.split("-") if c != ""]
+    ex = _Explorer(model, bound, max_schedules=1, max_ops=DEFAULT_MAX_OPS)
+    with _Session(ex):
+        trace, failure = ex._run_one(prefix)
+        report = Report(model=model.name, schedules=1,
+                        preemption_bound=bound, ops=len(trace))
+        if failure is not None:
+            report.failures.append(Failure(
+                failure.kind, failure.token, failure.detail, 0))
+        return report
+
+
+def check(model: Model, preemptions: int | None = None,
+          max_schedules: int = DEFAULT_MAX_SCHEDULES) -> Report:
+    """explore() that raises ScheduleFailure (token in the message) on
+    the first failing schedule — the pytest-facing entry point."""
+    report = explore(model, preemptions=preemptions,
+                     max_schedules=max_schedules, fail_fast=True)
+    if not report.ok:
+        raise ScheduleFailure(report)
+    return report

@@ -1,0 +1,547 @@
+"""schedcheck protocol models: the repo's hand-built condition-variable
+protocols, each driven through its REAL class by a small fixed set of
+model threads and exhaustively explored within the preemption bound.
+
+This registry is shared by two consumers with one contract:
+
+  * `tests/test_schedcheck_protocols.py` explores every model in tier-1
+    (current-tree protocols must be CLEAN at the default bound; the
+    seeded-race models must be FOUND, and their tokens must replay).
+  * `python -m tools.analysis schedcheck` — the CI stage — runs the same
+    registry, emits failures in tpulint's finding format, and gates a
+    minimum explored-schedule count so a silently-shrunk bound fails CI.
+
+Models with `expect="race"` are deliberate seeded bugs (a lost-wakeup
+slot, and the PR-13 multislice rewind race re-seeded from the pre-fix
+`_check_peers` body): the explorer MUST find them, pinning that
+schedcheck catches the class — a registry where they explore clean
+means the detector has been neutered, and the CLI fails.
+
+Model-writing rules (see docs/static_analysis.md "schedcheck"):
+construct all protocol state in `setup()` (fresh per schedule, locks
+wrapped there); keep thread bodies bounded — no unbounded spins; a
+polling retry loop must wait on a TIMED condition so the scheduler can
+run peers (timed waits fire only as a last resort); never rely on real
+wall-clock (time.monotonic is virtualized during exploration).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+from tf_operator_tpu.testing import schedcheck
+
+__all__ = ["MODELS", "build_models", "REL_PATH"]
+
+# Where findings emitted for this registry point (tpulint Finding.path).
+REL_PATH = "tf_operator_tpu/testing/schedcheck_protocols.py"
+
+
+class _State:
+    """Per-schedule scratch state (plain attribute bag)."""
+
+
+# --------------------------------------------------------------------------
+# seeded fixtures (expect="race"): the classes schedcheck exists to catch
+
+
+class _LostWakeupSlot:
+    """Seeded lost wakeup: put() forgets to notify, take() waits untimed.
+    A wall-clock test passes whenever the putter happens to run first;
+    exploration finds the taker-first schedule deterministically."""
+
+    def __init__(self):
+        import threading
+
+        self._cond = threading.Condition()
+        self._item = None
+
+    def put(self, x) -> None:
+        with self._cond:
+            self._item = x  # BUG: no notify — the waiting taker sleeps on
+
+    def take(self):
+        with self._cond:
+            while self._item is None:
+                self._cond.wait()
+            x, self._item = self._item, None
+            return x
+
+
+def _lost_wakeup_model() -> schedcheck.Model:
+    def setup():
+        s = _State()
+        s.slot = _LostWakeupSlot()
+        s.got = []
+        return s
+
+    def inv(s):
+        assert s.got == [41], f"taker got {s.got}"
+
+    return schedcheck.Model(
+        name="seeded-lost-wakeup",
+        setup=setup,
+        threads=[("taker", lambda s: s.got.append(s.slot.take())),
+                 ("putter", lambda s: s.slot.put(41))],
+        invariant=inv,
+        expect="race",
+        describe="put() without notify: taker-first schedules hang",
+    )
+
+
+# --------------------------------------------------------------------------
+# multislice rewind: the PR-13 stale-pending-snapshot race, real class
+# vs the pre-fix twin
+
+
+def _buggy_exchange_class():
+    """The pre-fix `_check_peers`: the one-shot generation change is
+    judged against the engine's STALE `p` snapshot instead of the live
+    pending step — re-seeding the exact bug the round-17 flake exposed
+    (test_rewind_when_peer_resumes_at_pending_step)."""
+    from tf_operator_tpu.parallel.multislice import DcnExchange, SliceRewind
+
+    class StaleSnapshotExchange(DcnExchange):
+        def _check_peers(self, p) -> None:
+            for sid in range(self.world.num_slices):
+                if sid == self.world.slice_id:
+                    continue
+                st = self._read_status(sid)
+                if st is None or not st.get("gen"):
+                    continue
+                prev = self._peer_gen.get(sid)
+                self._peer_gen[sid] = st["gen"]
+                if prev is None or prev == st["gen"]:
+                    continue
+                resume = int(st.get("resume_step") or 0)
+                with self._cond:
+                    # BUG (pre-fix): stale snapshot — a begin_step that
+                    # landed after the snapshot makes `resume > p.step`
+                    # read as "peer restarted ahead of us" and the
+                    # one-shot change is swallowed for good.
+                    if resume <= p.step and self._rewind is None:
+                        self._rewind = SliceRewind(resume, sid)
+                        self._cond.notify_all()
+
+    return StaleSnapshotExchange
+
+
+_DCN_DIR: str | None = None
+
+
+def _dcn_dir() -> str:
+    """One scratch rendezvous dir per process, reused across schedules
+    (every schedule overwrites the same few tiny status files — content
+    is schedule-deterministic, so reuse keeps replay exact AND avoids
+    thousands of tempdirs)."""
+    global _DCN_DIR
+    if _DCN_DIR is None:
+        _DCN_DIR = tempfile.mkdtemp(prefix="schedcheck-dcn-")
+    return _DCN_DIR
+
+
+def _write_peer_status(dcn_dir: str, sid: int, gen: str, resume: int,
+                       step: int) -> None:
+    import json
+
+    path = os.path.join(dcn_dir, f"s{sid}.status.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps({"gen": gen, "resume_step": resume,
+                            "step": step, "t": 0.0}))
+    os.replace(tmp, path)
+
+
+def _rewind_model(name: str, exchange_cls_fn, expect: str) -> schedcheck.Model:
+    """Two threads around one real exchange object at step N:
+
+      step-loop: step_done(N); begin_step(N+1); THEN publish the peer's
+                 restart (new generation, resume_step = N+1) — so every
+                 observation of the generation change happens with the
+                 live pending step already at N+1, where the protocol
+                 REQUIRES a rewind (resume <= live step).
+      engine:    one real engine iteration with a possibly-stale
+                 snapshot (snapshot -> recv-work window -> _check_peers),
+                 then fresh re-scans (idle-poll timed waits) until the
+                 generation change has been consumed.
+
+    The race: the engine snapshots the completed step-N pending, the
+    step loop advances to N+1 and the restart lands, and the stale
+    snapshot makes the one-shot generation change read as "peer ahead
+    of us" — swallowed forever. The fixed class judges against the live
+    pending and latches the rewind in every schedule."""
+    N = 7
+
+    def setup():
+        from tf_operator_tpu.parallel.multislice import SliceWorld, _Pending
+
+        dcn = _dcn_dir()
+        world = SliceWorld(slice_id=0, num_slices=2, dcn_dir=dcn)
+        # Peer alive at gen g1 BEFORE the exchange exists, so the model
+        # records a baseline (first observation is never a restart).
+        _write_peer_status(dcn, 1, "g1", 0, N)
+        cls = exchange_cls_fn()
+        ex = cls(world, resume_step=N, buckets=1, start_engine=False)
+        ex._check_peers(_Pending(step=N))  # baseline: peer gen = g1
+        ex.begin_step(N)
+        s = _State()
+        s.ex = ex
+        s.dcn = dcn
+        return s
+
+    def step_loop(s):
+        s.ex.step_done(N)
+        s.ex.begin_step(N + 1)
+        # The peer's gang was rolled; its restart resumed from the
+        # shared checkpoint at our (now) pending step.
+        _write_peer_status(s.dcn, 1, "g2", N + 1, N + 1)
+
+    def engine(s):
+        ex = s.ex
+        # One real engine iteration: snapshot, then the _recv work
+        # window (where begin_step can land), then the peer scan.
+        with ex._cond:
+            p = ex._pending
+        schedcheck.sched_point("recv-window")
+        if p is not None:
+            ex._check_peers(p)
+        # Later iterations always re-snapshot; keep scanning until the
+        # generation change has been consumed (timed idle poll — fires
+        # only when the step loop cannot run).
+        while ex._peer_gen.get(1) != "g2" and ex._rewind is None:
+            with ex._cond:
+                ex._cond.wait(timeout=0.005)
+            with ex._cond:
+                p2 = ex._pending
+            if p2 is not None:
+                ex._check_peers(p2)
+
+    def inv(s):
+        rw = s.ex._rewind
+        assert rw is not None, (
+            "generation change swallowed: peer resumed at our pending "
+            "step but no SliceRewind was latched (the survivor would "
+            "hold until the peer timeout)")
+        assert rw.to_step == N + 1 and rw.peer == 1, rw
+
+    return schedcheck.Model(
+        name=name,
+        setup=setup,
+        threads=[("step-loop", step_loop), ("engine", engine)],
+        invariant=inv,
+        expect=expect,
+        describe="DcnExchange publish/collect vs restart detection",
+    )
+
+
+# --------------------------------------------------------------------------
+# serve pipeline: StagingSlot put/take/close (assembler -> dispatch)
+
+
+def _staging_slot_model() -> schedcheck.Model:
+    def setup():
+        from tf_operator_tpu.serve.server import StagingSlot, _Staged
+
+        s = _State()
+        s.slot = StagingSlot()
+        s.staged = _Staged
+        s.got = []
+        s.put_ok = []
+        return s
+
+    def assembler(s):
+        # Depth-1 backpressure: the second put must BLOCK until the
+        # dispatcher drains the slot; only the assembler closes.
+        for i in range(2):
+            s.put_ok.append(s.slot.put(s.staged([i], None, 1, 1)))
+        s.slot.close()
+
+    def dispatcher(s):
+        while True:
+            staged = s.slot.take(timeout_s=0.05)
+            if staged is not None:
+                s.got.append(staged.items[0])
+            elif s.slot.is_closed():
+                return
+
+    def inv(s):
+        assert s.put_ok == [True, True], f"put blocked/denied: {s.put_ok}"
+        assert s.got == [0, 1], (
+            f"dispatch saw {s.got}: item lost or reordered across the "
+            "depth-1 slot")
+
+    return schedcheck.Model(
+        name="staging-slot",
+        setup=setup,
+        threads=[("assembler", assembler), ("dispatcher", dispatcher)],
+        invariant=inv,
+        describe="serve assembler->dispatch depth-1 staging discipline",
+    )
+
+
+# --------------------------------------------------------------------------
+# sharded workqueue: add/drain with dedup + in-flight exclusivity
+
+
+def _sharded_queue_model() -> schedcheck.Model:
+    def setup():
+        from tf_operator_tpu.core.workqueue import ShardedRateLimitingQueue
+
+        s = _State()
+        s.q = ShardedRateLimitingQueue(2)
+        s.processed = []
+        s.concurrent = 0
+        s.max_concurrent_same_key = 0
+        return s
+
+    def adder(s):
+        # "a" re-added while possibly in flight: dedup/in-flight
+        # exclusivity must coalesce, never hand it to two workers.
+        s.q.add("a")
+        s.q.add("b")
+        s.q.add("a")
+        s.q.shut_down()
+
+    def worker(s, shard: int):
+        while True:
+            item = s.q.get(timeout=0.05, shard=shard)
+            if item is None:
+                return
+            if item == "a":
+                s.concurrent += 1
+                s.max_concurrent_same_key = max(
+                    s.max_concurrent_same_key, s.concurrent)
+                schedcheck.sched_point("processing-a")
+                s.concurrent -= 1
+            s.processed.append(item)
+            s.q.done(item)
+
+    def inv(s):
+        assert s.max_concurrent_same_key <= 1, (
+            "in-flight exclusivity violated: 'a' processed by two "
+            "workers at once")
+        assert set(s.processed) == {"a", "b"}, s.processed
+
+    return schedcheck.Model(
+        name="sharded-workqueue",
+        setup=setup,
+        threads=[("adder", adder),
+                 ("w0", lambda s: worker(s, 0)),
+                 ("w1", lambda s: worker(s, 1))],
+        invariant=inv,
+        preemptions=1,  # 3 threads: bound 1 keeps the space CI-sized
+        describe="ShardedRateLimitingQueue dedup + in-flight exclusivity",
+    )
+
+
+# --------------------------------------------------------------------------
+# fleet scheduler: admit / release / kick under contention
+
+
+def _fleet_job(name: str):
+    from tf_operator_tpu.api import defaults
+    from tf_operator_tpu.api.types import (
+        ContainerSpec, ObjectMeta, PodTemplateSpec, ReplicaSpec,
+        ReplicaType, TPUSpec, TrainJob, TrainJobSpec,
+    )
+
+    j = TrainJob(
+        metadata=ObjectMeta(name=name),
+        spec=TrainJobSpec(
+            replica_specs={ReplicaType.WORKER: ReplicaSpec(
+                replicas=1,
+                template=PodTemplateSpec(containers=[
+                    ContainerSpec(name="tensorflow", image="i")]),
+            )},
+            tpu=TPUSpec(topology="v5e-8"),
+        ))
+    defaults.set_defaults(j)
+    return j
+
+
+def _fleet_scheduler_model() -> schedcheck.Model:
+    def setup():
+        from tf_operator_tpu.gang.podgroup import SliceAllocator
+        from tf_operator_tpu.sched.scheduler import FleetScheduler
+
+        s = _State()
+        s.sched = FleetScheduler(SliceAllocator.of("v5e-8"))  # capacity 1
+        s.jobs = {n: _fleet_job(n) for n in ("j1", "j2")}
+        s.admitted = []
+        return s
+
+    def contender(s, name: str):
+        d = s.sched.decide(s.jobs[name])
+        s.sched.kick_targets()
+        if d.admit:
+            s.admitted.append(name)
+            schedcheck.sched_point("running")
+            s.sched.release(s.jobs[name].key())
+
+    def inv(s):
+        st = s.sched.stats
+        assert st["inversions"] == 0, st
+        assert st["quota_violations"] == 0, st
+        assert st["max_running"] <= 1, (
+            f"two gangs admitted onto one slice: {s.admitted}")
+        assert len(s.admitted) >= 1, "nobody admitted with a free slice"
+
+    return schedcheck.Model(
+        name="fleet-scheduler",
+        setup=setup,
+        threads=[("sync-j1", lambda s: contender(s, "j1")),
+                 ("sync-j2", lambda s: contender(s, "j2"))],
+        invariant=inv,
+        preemptions=2,  # decide() is sched-point dense: p2 keeps it CI-sized
+        describe="FleetScheduler admit/release/kick atomicity",
+    )
+
+
+# --------------------------------------------------------------------------
+# router: the two PR-14 review-found races, pinned by exploration
+
+
+def _headless_router(backends: dict[str, tuple[bool, float, int, int]]):
+    """A pick/settle core with no HTTP front door. backends: name ->
+    (ready, ewma, inflight, timeouts_consec)."""
+    from tf_operator_tpu.serve.router import FrontEndRouter
+
+    r = FrontEndRouter("default/svc", serve_http=False)
+    r.set_backends({name: f"127.0.0.1:{i + 1}"
+                    for i, name in enumerate(backends)})
+    with r._lock:
+        for name, (ready, ewma, infl, touts) in backends.items():
+            b = r._backends[name]
+            b.ready = ready
+            b.ewma = ewma
+            b.inflight = infl
+            b.timeouts_consec = touts
+    return r
+
+
+def _router_cold_backend_model() -> schedcheck.Model:
+    """PR-14 review race #1 (cold-backend ewma floor): a just-admitted
+    replica's EW average lags its rising queue by ~tau; comparing raw
+    ewma dumps every concurrent pick on the cold backend while warm
+    ones idle. The instantaneous-inflight floor must spread concurrent
+    picks in EVERY interleaving."""
+
+    def setup():
+        s = _State()
+        # warm carries history (ewma 0.5); cold was just admitted.
+        s.r = _headless_router({"warm": (True, 0.5, 0, 0),
+                                "cold": (True, 0.0, 0, 0)})
+        s.picks = []
+        return s
+
+    def client(s, tag: str):
+        b = s.r._pick(set())
+        # Overlap depth AT PICK TIME, from the router's own accounting:
+        # >1 means another request was in flight when this one routed.
+        with s.r._lock:
+            depth = sum(be.inflight for be in s.r._backends.values())
+        s.picks.append((tag, b.name, depth))
+        schedcheck.sched_point("request-in-flight")
+        s.r._settle(b.name, failed=False)
+
+    def inv(s):
+        assert len(s.picks) == 2
+        # Sequential picks (each saw an idle fleet) may both choose the
+        # cold backend — it IS least loaded then. The pinned property is
+        # the CONCURRENT case: a pick that overlapped another in-flight
+        # request must have spread, because the floor made the cold
+        # backend's queue visible where its lagging ewma was not.
+        if any(depth > 1 for _, _, depth in s.picks):
+            names = {n for _, n, _ in s.picks}
+            assert names == {"warm", "cold"}, (
+                f"overlapping picks {s.picks} piled onto one backend: "
+                "the cold backend's lagging ewma under-read its queue")
+
+    return schedcheck.Model(
+        name="router-cold-backend",
+        setup=setup,
+        threads=[("client-1", lambda s: client(s, "c1")),
+                 ("client-2", lambda s: client(s, "c2"))],
+        invariant=inv,
+        describe="least-loaded pick: inflight floors the lagging ewma",
+    )
+
+
+def _router_timeout_demotion_model() -> schedcheck.Model:
+    """PR-14 review race #2 (504 black hole): a backend on a
+    consecutive-read-timeout streak releases its inflight on every
+    timeout, so under raw least-loaded it keeps WINNING while answering
+    nothing. The demotion term must sort it behind every healthy
+    replica in every interleaving — yet it must still serve when it is
+    the last one standing."""
+
+    def setup():
+        s = _State()
+        # blackhole: timeout streak, zero load (every timeout released
+        # its inflight). healthy: real load — raw least-loaded would
+        # route everything to the blackhole.
+        s.r = _headless_router({"blackhole": (True, 0.0, 0, 2),
+                                "healthy": (True, 1.5, 2, 0)})
+        s.picks = []
+        return s
+
+    def pick_one(s, tag: str):
+        b = s.r._pick(set())
+        s.picks.append((tag, b.name))
+        schedcheck.sched_point("request-in-flight")
+        s.r._settle(b.name, failed=False)
+
+    def inv(s):
+        # Phase 1 (explored): while a healthy replica stands, NO
+        # interleaving of concurrent picks may route to the
+        # timeout-streak backend, however loaded the healthy one gets.
+        names = [n for _, n in s.picks]
+        assert names == ["healthy"] * 2, (
+            f"picks {s.picks}: the timeout-streak backend won "
+            "least-loaded — 504 black hole")
+        # Phase 2 (deterministic coda): demotion is last-resort, not
+        # amputation — with the healthy replica gone, the demoted one
+        # must still serve rather than 503 the service.
+        with s.r._lock:
+            s.r._backends["healthy"].ready = False
+        b = s.r._pick(set())
+        assert b is not None and b.name == "blackhole", (
+            "demotion must not amputate the last replica standing")
+
+    return schedcheck.Model(
+        name="router-timeout-demotion",
+        setup=setup,
+        threads=[("client-1", lambda s: pick_one(s, "c1")),
+                 ("client-2", lambda s: pick_one(s, "c2"))],
+        invariant=inv,
+        describe="timeout-streak demotion without losing last replica",
+    )
+
+
+# --------------------------------------------------------------------------
+# registry
+
+
+def build_models() -> dict[str, schedcheck.Model]:
+    """Fresh Model objects (model state is all in setup(); the objects
+    themselves are reusable, but a fresh dict keeps callers honest)."""
+    models = [
+        _staging_slot_model(),
+        _sharded_queue_model(),
+        _fleet_scheduler_model(),
+        _rewind_model("dcn-rewind",
+                      lambda: __import__(
+                          "tf_operator_tpu.parallel.multislice",
+                          fromlist=["DcnExchange"]).DcnExchange,
+                      expect="clean"),
+        _rewind_model("dcn-rewind-race-reseeded", _buggy_exchange_class,
+                      expect="race"),
+        _router_cold_backend_model(),
+        _router_timeout_demotion_model(),
+        _lost_wakeup_model(),
+    ]
+    return {m.name: m for m in models}
+
+
+MODELS = build_models()

@@ -74,6 +74,15 @@ def run_analysis(passes: list[str] | None = None,
 def main(argv: list[str] | None = None) -> int:
     import argparse
 
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    # Subcommand: `python -m tools.analysis schedcheck ...` runs the
+    # bounded interleaving explorer over the protocol-model registry —
+    # dynamic exploration beside the static passes, same finding format.
+    if argv and argv[0] == "schedcheck":
+        from tools.analysis import schedcheck as schedcheck_cli
+
+        return schedcheck_cli.main(argv[1:])
+
     from tools.analysis.passes import ALL_PASSES
 
     ap = argparse.ArgumentParser(

@@ -7,6 +7,7 @@ may emit), and `run(project) -> list[Finding]`. Register new passes here
 
 from tools.analysis.passes import (  # noqa: F401
     donation,
+    envvars,
     hygiene,
     locks,
     metrics_doc,
@@ -14,4 +15,5 @@ from tools.analysis.passes import (  # noqa: F401
     threads,
 )
 
-ALL_PASSES = (hygiene, threads, locks, schema, donation, metrics_doc)
+ALL_PASSES = (hygiene, threads, locks, schema, donation, metrics_doc,
+              envvars)

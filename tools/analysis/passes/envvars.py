@@ -1,0 +1,319 @@
+"""Pass: env-contract drift (TPE701/TPE702) — the operator⇄pod env-var
+wire stays two-sided.
+
+The operator's control plane talks to its pods through env vars: the
+runtime injects file paths (metrics/heartbeat/stats), cluster_spec
+emits the JAX world + slice coordinates, the serve controller hands the
+server its knobs. Every one of those contracts has TWO hand-wired
+halves — an injection site and an `os.environ` read — and recent PRs
+each grew both by hand (the serve follow/bucketing flags, the DCN
+epoch token). Nothing checked they stayed paired: an injection whose
+reader was renamed silently configures nobody (the knob "works" in the
+default), and a read whose injector was dropped silently runs on
+defaults forever.
+
+  TPE701  injected-never-read: a TPUJOB_*/JAX_* name written into pod
+          env by an injector module has no `os.environ` read anywhere
+          in the repo (package, tools, tests). Contract names kept for
+          EXTERNAL consumers (TPU_WORKER_ID-style legacy TF vars are
+          outside the TPUJOB_/JAX_ pattern; a JAX_* var read only by
+          the jax library itself) get an allowlist entry with the why.
+  TPE702  read-never-injected-or-documented: PACKAGE code reads a
+          TPUJOB_*/JAX_* name that no injector writes and no doc
+          mentions — an orphaned knob nobody can discover. Documenting
+          it (docs/*.md, README.md) is the fix for operator-set knobs
+          (TPUJOB_CHAOS, TPUJOB_LOCKCHECK, ...); wiring the injector is
+          the fix for pod-contract vars.
+
+Resolution: injection sites are `env["LIT"] = ...` subscript stores,
+dict-literal keys, `*.set_env(NAME, ...)` first args, and
+`EnvVar(name=...)` keywords; names resolve through module-level string
+constants (`ENV_X = "TPUJOB_X"`), including cross-module imports of
+them (how runtime/session reads cluster_spec/tpu_env's names). Dynamic
+names (f-strings, call results) are ignored — conservative by design.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from tools.analysis.core import Finding, Module, Project
+
+NAME = "env-contract"
+RULES = ("TPE701", "TPE702")
+
+PATTERN = re.compile(r"^(TPUJOB|JAX)_[A-Z0-9_]+$")
+
+# Modules that INJECT env into pods (the operator->pod direction).
+INJECTOR_MODULES = (
+    "tf_operator_tpu.runtime.local",
+    "tf_operator_tpu.cluster_spec.tpu_env",
+    "tf_operator_tpu.cluster_spec.tf_config",
+    "tf_operator_tpu.serve.controller",
+    "tf_operator_tpu.core.trainjob_controller",
+)
+
+# Non-package trees whose os.environ reads count as consumers (a knob
+# read by the bench/tools/tests sides is a live contract too).
+EXTRA_CONSUMER_GLOBS = ("tools/*.py", "tools/analysis/*.py",
+                        "tools/analysis/passes/*.py", "tests/*.py",
+                        "bench.py", "__graft_entry__.py")
+
+DOC_GLOBS = ("docs/*.md", "README.md")
+
+_ENV_READ_FUNCS = {
+    "os.environ.get", "os.environ.pop", "os.environ.setdefault",
+    "os.getenv",
+}
+
+
+def _const_table(module: Module) -> dict[str, str]:
+    """Module-level NAME -> string-literal assignments."""
+    out: dict[str, str] = {}
+    for node in module.tree.body:
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = node.value.value
+    return out
+
+
+class _Resolver:
+    """String resolution for name expressions: literals directly;
+    Name/Attribute through this module's constants, then through its
+    import table into other modules' constants."""
+
+    def __init__(self, project: Project | None, modules: dict[str, Module]):
+        self.project = project
+        self.modules = modules
+        self._consts = {m.name: _const_table(m) for m in modules.values()}
+
+    def resolve(self, module: Module, node: ast.AST) -> str | None:
+        if isinstance(node, ast.Constant):
+            return node.value if isinstance(node.value, str) else None
+        dotted = None
+        if isinstance(node, ast.Name):
+            dotted = node.id
+        elif isinstance(node, ast.Attribute):
+            parts = []
+            n = node
+            while isinstance(n, ast.Attribute):
+                parts.append(n.attr)
+                n = n.value
+            if isinstance(n, ast.Name):
+                parts.append(n.id)
+                dotted = ".".join(reversed(parts))
+        if dotted is None:
+            return None
+        head, _, tail = dotted.partition(".")
+        if not tail:
+            # local constant, or `from mod import ENV_X`
+            v = self._consts.get(module.name, {}).get(head)
+            if v is not None:
+                return v
+            target = module.imports.get(head)
+            if target is None:
+                return None
+            return self._global_const(target)
+        # `mod.ENV_X` through an imported module alias
+        target = module.imports.get(head)
+        if target is None:
+            return None
+        return self._global_const(f"{target}.{tail}")
+
+    def _global_const(self, dotted: str) -> str | None:
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mname = ".".join(parts[:i])
+            mod = self.modules.get(mname)
+            if mod is None:
+                continue
+            rest = ".".join(parts[i:])
+            return self._consts.get(mname, {}).get(rest)
+        return None
+
+
+def _dotted(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def collect_injected(resolver: _Resolver,
+                     modules: list[Module]) -> dict[str, tuple[str, int]]:
+    """name -> (rel path, line) of one injection site."""
+    out: dict[str, tuple[str, int]] = {}
+
+    def note(module, node, name):
+        if name is not None and PATTERN.match(name) and name not in out:
+            out[name] = (module.rel, node.lineno)
+
+    for module in modules:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        note(module, node,
+                             resolver.resolve(module, t.slice))
+            elif isinstance(node, ast.Dict):
+                for k in node.keys:
+                    if k is not None:
+                        note(module, node, resolver.resolve(module, k))
+            elif isinstance(node, ast.Call):
+                callee = _dotted(node.func)
+                if callee is not None and callee.split(".")[-1] == "set_env" \
+                        and node.args:
+                    note(module, node, resolver.resolve(module, node.args[0]))
+                if callee is not None and callee.split(".")[-1] == "EnvVar":
+                    for kw in node.keywords:
+                        if kw.arg == "name":
+                            note(module, node,
+                                 resolver.resolve(module, kw.value))
+                    if node.args:
+                        note(module, node,
+                             resolver.resolve(module, node.args[0]))
+    return out
+
+
+def collect_consumed(resolver: _Resolver,
+                     modules: list[Module]) -> dict[str, tuple[str, int]]:
+    """name -> (rel path, line) of one os.environ read."""
+    out: dict[str, tuple[str, int]] = {}
+
+    def note(module, node, name):
+        if name is not None and PATTERN.match(name) and name not in out:
+            out[name] = (module.rel, node.lineno)
+
+    for module in modules:
+        dynamic_read = False
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                callee = _dotted(node.func)
+                if callee in _ENV_READ_FUNCS and node.args:
+                    name = resolver.resolve(module, node.args[0])
+                    if name is None:
+                        dynamic_read = True
+                    note(module, node, name)
+                # `e.get(X)` where e is a locally-renamed environ is the
+                # chaos/tpu_env house style: `e = os.environ if env is
+                # None else env`. A bare .get with a matching env-var
+                # literal is overwhelmingly that pattern; names that do
+                # not match PATTERN are dropped anyway.
+                elif (callee is not None and callee.endswith(".get")
+                      and node.args):
+                    note(module, node, resolver.resolve(module, node.args[0]))
+            elif isinstance(node, ast.Subscript):
+                if _dotted(node.value) == "os.environ":
+                    name = resolver.resolve(module, node.slice)
+                    if name is None:
+                        dynamic_read = True
+                    note(module, node, name)
+            elif isinstance(node, ast.Compare):
+                if (len(node.ops) == 1
+                        and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                        and _dotted(node.comparators[0]) == "os.environ"):
+                    note(module, node, resolver.resolve(module, node.left))
+        if dynamic_read:
+            # Reflection-table reads: `{k: os.environ[k] for k in KEYS}`
+            # / `for var in ("TPUJOB_X", ...): os.environ.get(var)` (the
+            # workload stub's /runconfig surface). The key variable is
+            # unresolvable, so in a module with a dynamic environ read,
+            # matching literals inside tuple/list/set tables count as
+            # consumed — narrowly scoped to keep TPE701 honest without
+            # resolving full data flow.
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+                    for el in node.elts:
+                        if (isinstance(el, ast.Constant)
+                                and isinstance(el.value, str)):
+                            note(module, el, el.value)
+    return out
+
+
+def _extra_modules(root: Path) -> list[Module]:
+    out: list[Module] = []
+    for pattern in EXTRA_CONSUMER_GLOBS:
+        for path in sorted(root.glob(pattern)):
+            try:
+                src = path.read_text()
+                tree = ast.parse(src, filename=str(path))
+            except (OSError, SyntaxError):
+                continue
+            name = str(path.relative_to(root).with_suffix("")
+                       ).replace("/", ".")
+            out.append(Module(name, path, src, tree, root=root))
+    return out
+
+
+def _docs_text(root: Path) -> str:
+    chunks = []
+    for pattern in DOC_GLOBS:
+        for path in sorted(root.glob(pattern)):
+            try:
+                chunks.append(path.read_text())
+            except OSError:
+                continue
+    return "\n".join(chunks)
+
+
+def analyze_env(package_modules: dict[str, Module],
+                injector_names: tuple[str, ...],
+                extra_consumers: list[Module],
+                docs_text: str) -> list[Finding]:
+    """The testable core: findings over explicit module sets (the
+    fixture tests feed mutated real sources through this)."""
+    all_modules = dict(package_modules)
+    for m in extra_consumers:
+        all_modules.setdefault(m.name, m)
+    resolver = _Resolver(None, all_modules)
+    injectors = [package_modules[n] for n in injector_names
+                 if n in package_modules]
+    injected = collect_injected(resolver, injectors)
+    consumed_pkg = collect_consumed(resolver,
+                                    list(package_modules.values()))
+    consumed_all = dict(consumed_pkg)
+    consumed_all.update(collect_consumed(resolver, extra_consumers))
+
+    findings: list[Finding] = []
+    for name in sorted(injected):
+        if name not in consumed_all:
+            rel, line = injected[name]
+            findings.append(Finding(
+                "TPE701", rel, line,
+                f"env-injected-unread::{name}",
+                f"env var {name!r} is injected into pods but never read "
+                f"(no os.environ read in package/tools/tests) — dead "
+                f"contract half, or its reader was renamed"))
+    for name in sorted(consumed_pkg):
+        # Word-boundary match, not substring: docs mentioning
+        # TPUJOB_SERVE_FOLLOW_POLL_S must not excuse an undocumented
+        # TPUJOB_SERVE_FOLLOW (its prefix).
+        documented = re.search(
+            rf"(?<![A-Z0-9_]){re.escape(name)}(?![A-Z0-9_])", docs_text)
+        if name not in injected and not documented:
+            rel, line = consumed_pkg[name]
+            findings.append(Finding(
+                "TPE702", rel, line,
+                f"env-read-unwired::{name}",
+                f"env var {name!r} is read by package code but neither "
+                f"injected by an injector module nor documented in "
+                f"docs/*.md or README.md — an undiscoverable knob (or "
+                f"a dropped injection)"))
+    return findings
+
+
+def run(project: Project) -> list[Finding]:
+    return analyze_env(
+        project.modules,
+        INJECTOR_MODULES,
+        _extra_modules(project.root),
+        _docs_text(project.root))

@@ -1,0 +1,116 @@
+"""`python -m tools.analysis schedcheck` — run the protocol-model
+registry (tf_operator_tpu/testing/schedcheck_protocols.py) through the
+deterministic interleaving explorer and report in tpulint's finding
+format.
+
+The CI `schedcheck` stage's entry point. Three finding rules:
+
+  TPC801  a model that must explore CLEAN had a failing schedule
+          (the finding message carries the replay token);
+  TPC802  a seeded-race model explored clean — the detector has been
+          neutered (bound silently shrunk, models not actually driven);
+  TPC803  the total explored-schedule count fell below --min-schedules
+          — the same silently-shrunk-bound guard, from the other side.
+
+Exit 0 iff no finding. `--replay MODEL TOKEN` re-executes one schedule
+(the workflow printed with every failure); `--model NAME` scopes a run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.analysis.core import Finding
+
+RULES = ("TPC801", "TPC802", "TPC803")
+
+
+def run_registry(models: dict, min_schedules: int = 0,
+                 only: str | None = None) -> tuple[list[Finding], dict]:
+    from tf_operator_tpu.testing import schedcheck
+    from tf_operator_tpu.testing.schedcheck_protocols import REL_PATH
+
+    findings: list[Finding] = []
+    stats = {"models": 0, "schedules": 0, "steps": 0, "found_races": 0}
+    for name, model in models.items():
+        if only is not None and name != only:
+            continue
+        report = schedcheck.explore(model)
+        stats["models"] += 1
+        stats["schedules"] += report.schedules
+        stats["steps"] += report.ops
+        if model.expect == "race":
+            if report.ok:
+                findings.append(Finding(
+                    "TPC802", REL_PATH, 1,
+                    f"schedcheck-race-missed::{name}",
+                    f"seeded-race model {name!r} explored clean over "
+                    f"{report.schedules} schedules at bound "
+                    f"{report.preemption_bound} — the detector is "
+                    f"neutered"))
+            else:
+                stats["found_races"] += 1
+        elif not report.ok:
+            for f in report.failures[:3]:  # first few carry the signal
+                findings.append(Finding(
+                    "TPC801", REL_PATH, 1,
+                    f"schedcheck::{name}::{f.kind}",
+                    f"model {name!r} {f.kind} in schedule "
+                    f"{f.schedule}: {f.detail} — replay with `python -m "
+                    f"tools.analysis schedcheck --replay {name} "
+                    f"{f.token}`"))
+    if min_schedules and stats["schedules"] < min_schedules:
+        findings.append(Finding(
+            "TPC803", "tools/analysis/schedcheck.py", 1,
+            "schedcheck-floor",
+            f"only {stats['schedules']} schedules explored, floor is "
+            f"{min_schedules} — a silently-shrunk bound or model set"))
+    return findings, stats
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis schedcheck",
+        description="bounded interleaving exploration of the threaded "
+                    "protocol models")
+    ap.add_argument("--model", default=None,
+                    help="run only this registry model")
+    ap.add_argument("--min-schedules", type=int, default=0,
+                    help="fail (TPC803) when fewer total schedules were "
+                         "explored — the CI floor gate")
+    ap.add_argument("--replay", nargs=2, metavar=("MODEL", "TOKEN"),
+                    default=None,
+                    help="re-execute exactly one schedule from a "
+                         "failure's printed token")
+    ap.add_argument("--list-models", action="store_true")
+    args = ap.parse_args(argv)
+
+    from tf_operator_tpu.testing import schedcheck
+    from tf_operator_tpu.testing.schedcheck_protocols import build_models
+
+    models = build_models()
+    if args.list_models:
+        for name, m in models.items():
+            print(f"{name:28s} expect={m.expect:5s} {m.describe}")
+        return 0
+    if args.replay is not None:
+        name, token = args.replay
+        if name not in models:
+            print(f"unknown model {name!r} (see --list-models)",
+                  file=sys.stderr)
+            return 2
+        report = schedcheck.replay(models[name], token)
+        print(report.summary())
+        return 0 if report.ok else 1
+    findings, stats = run_registry(models, min_schedules=args.min_schedules,
+                                   only=args.model)
+    for f in sorted(findings, key=lambda f: (f.path, f.rule, f.key)):
+        print(f"{f.render()}  [{f.key}]")
+    print(
+        f"schedcheck: {stats['models']} models, {stats['schedules']} "
+        f"schedules explored ({stats['steps']} steps), "
+        f"{stats['found_races']} seeded races found, "
+        f"{len(findings)} findings",
+        file=sys.stderr)
+    return 1 if findings else 0
