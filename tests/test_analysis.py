@@ -206,16 +206,22 @@ class TestThreadDiscipline:
         assert "prefetch_to_device.worker" in names
 
     def test_round19_roots_cover_serve_router_and_dcn(self, repo_project):
-        # The round-19 expansion: the serve pipeline threads, the router
-        # probe, and the DCN engine are roots — the "one XLA-dispatching
-        # thread" claim PR 12/14 made in prose is machine-checked. Any
-        # rename that stops resolving silently un-gates the invariant.
+        # The round-19/20 expansion: the serve pipeline threads (BOTH
+        # arms of the generative-vs-classifier conditional targets), the
+        # router probe, and the DCN engine are roots — the "one
+        # XLA-dispatching thread" claim PR 12/14/16 made in prose is
+        # machine-checked. Any rename that stops resolving silently
+        # un-gates the invariant.
         roots = {(m.name, q) for m, q in threads._thread_roots(repo_project)}
         for expected in (
             ("tf_operator_tpu.serve.server",
              "InferenceServer._assemble_loop"),
             ("tf_operator_tpu.serve.server",
              "InferenceServer._dispatch_loop"),
+            ("tf_operator_tpu.serve.server",
+             "InferenceServer._assemble_decode_loop"),
+            ("tf_operator_tpu.serve.server",
+             "InferenceServer._dispatch_decode_loop"),
             ("tf_operator_tpu.serve.server",
              "InferenceServer._follow_loop"),
             ("tf_operator_tpu.serve.router", "FrontEndRouter._probe_loop"),
@@ -622,6 +628,63 @@ class TestSchemaDrift:
             no_crd = infsvc_crd.replace(f"                    {prop}",
                                         "                    renamedKnob:")
 
+            assert no_crd != infsvc_crd, f"fixture stale: {prop}"
+            found = self._infsvc(crd=no_crd)
+            assert any(f.rule == "TPS403" and key in f.key
+                       for f in found), [f.render() for f in found]
+
+    def test_decode_knobs_drift_guarded(self):
+        # Round-20 fixture set: model.maxSequenceLength +
+        # serving.maxNewTokens/maxConcurrentSequences (the decode
+        # scheduler's spec knobs) — each of the emit / parse / CRD
+        # directions must fail when its line is dropped.
+        _, compat, _, _ = self._real()
+        infsvc_crd = (REPO / "manifests/inferenceservice-crd.yaml").read_text()
+        # EMIT direction (maxConcurrentSequences emits across two lines;
+        # the whole pair goes, taking the wire-name string with it —
+        # the emit check is string-vocabulary based).
+        for needle, repl, key in (
+            ('"maxSequenceLength": spec.model.max_sequence_length,', "",
+             "ModelSpec.max_sequence_length"),
+            ('"maxNewTokens": spec.serving.max_new_tokens,', "",
+             "ServingSpec.max_new_tokens"),
+            ('"maxConcurrentSequences":\n'
+             '                    spec.serving.max_concurrent_sequences,',
+             "", "ServingSpec.max_concurrent_sequences"),
+        ):
+            no_emit = compat.replace(needle, repl)
+            assert no_emit != compat, f"fixture stale: {needle}"
+            found = self._infsvc(compat=no_emit)
+            assert any(f.rule == "TPS402"
+                       and f.key == f"schema-emit::{key}"
+                       for f in found), [f.render() for f in found]
+        # PARSE direction: each None-only-default expression collapses
+        # to its bare default constant.
+        for needle, repl, key in (
+            ('256 if model_d.get("maxSequenceLength") is None\n'
+             '                    else int(model_d["maxSequenceLength"])',
+             "256", "ModelSpec.max_sequence_length"),
+            ('64 if serving_d.get("maxNewTokens") is None\n'
+             '                    else int(serving_d["maxNewTokens"])',
+             "64", "ServingSpec.max_new_tokens"),
+            ('8 if serving_d.get("maxConcurrentSequences") is None\n'
+             '                    else int(serving_d["maxConcurrentSequences"])',
+             "8", "ServingSpec.max_concurrent_sequences"),
+        ):
+            no_parse = compat.replace(needle, repl)
+            assert no_parse != compat, f"fixture stale: {needle}"
+            found = self._infsvc(compat=no_parse)
+            assert any(f.rule == "TPS401" and key in f.key
+                       for f in found), [f.render() for f in found]
+        # CRD direction.
+        for prop, key in (
+            ("maxSequenceLength:", "ModelSpec.max_sequence_length"),
+            ("maxNewTokens:", "ServingSpec.max_new_tokens"),
+            ("maxConcurrentSequences:",
+             "ServingSpec.max_concurrent_sequences"),
+        ):
+            no_crd = infsvc_crd.replace(f"                    {prop}",
+                                        "                    renamedKnob:")
             assert no_crd != infsvc_crd, f"fixture stale: {prop}"
             found = self._infsvc(crd=no_crd)
             assert any(f.rule == "TPS403" and key in f.key

@@ -1,0 +1,614 @@
+"""Continuous-batching decode serving (round 20): the 2-D bucket grid,
+the KV-cache decode forward, and the persistent decode scheduler.
+
+Non-slow: seq-bucket ladder + grid-selection properties, decode-forward
+parity against the training-side `TransformerLM.apply` (prefill logits,
+then a multi-token greedy chain vs the naive full re-forward — the
+module-layout contract models/decode.py promises), stub-driven scheduler
+semantics (mid-decode admission under continuous=True, run-to-completion
+gating under continuous=False, hot-swap re-prefill coherence, prefill
+retirement of single-token requests, per-request error isolation), API
+roundtrip/validation/spec-hash for maxSequenceLength / maxNewTokens /
+maxConcurrentSequences, and the controller's env injection of all three.
+
+Slow (CI serve-smoke): the mid-decode hot-swap capstone — a REAL
+transformer-lm replica in follow mode serves concurrent decode requests
+while a strictly newer checkpoint lands; every request answers 200 with
+its full token budget and the server ends up on the new step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tf_operator_tpu.api import compat, validation
+from tf_operator_tpu.core.cluster import InMemoryCluster
+from tf_operator_tpu.serve.controller import (
+    ENV_MAX_CONCURRENT,
+    ENV_MAX_NEW_TOKENS,
+    ENV_MAX_SEQ_LEN,
+    InferenceServiceController,
+    serve_spec_hash,
+)
+from tf_operator_tpu.serve.server import (
+    SEQ_BUCKET_FLOOR,
+    InferenceServer,
+    _Pending,
+    bucket_sizes,
+    select_bucket,
+    select_grid_bucket,
+    seq_bucket_sizes,
+)
+
+from test_serve import make_service, run_all  # noqa: E402 — sibling module
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+PY = sys.executable
+ONE_DEV = {
+    "PYTHONPATH": REPO_ROOT,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+
+# --------------------------------------------------------- 2-D bucket grid
+
+
+class TestSeqBucketGrid:
+    @pytest.mark.parametrize("max_len", [16, 17, 31, 32, 100, 128, 256])
+    def test_ladder_floored_and_capped(self, max_len):
+        ladder = seq_bucket_sizes(max_len)
+        assert ladder[0] >= min(SEQ_BUCKET_FLOOR, max_len)
+        assert ladder[-1] == max_len
+        assert list(ladder) == sorted(set(ladder))
+        # Every length in range lands on the smallest fitting rung.
+        for n in range(1, max_len + 1):
+            b = select_bucket(n, ladder)
+            assert b >= n
+            assert all(x < n for x in ladder if x < b)
+
+    def test_short_context_window_collapses_the_floor(self):
+        # A max_len below the floor must still produce a usable ladder.
+        assert seq_bucket_sizes(8) == (8,)
+        assert seq_bucket_sizes(1) == (1,)
+
+    def test_grid_selection_is_per_dimension_smallest_fit(self):
+        rows = bucket_sizes(8)
+        toks = seq_bucket_sizes(64)
+        assert select_grid_bucket(3, 20, rows, toks) == (4, 32)
+        assert select_grid_bucket(8, 64, rows, toks) == (8, 64)
+        assert select_grid_bucket(1, 1, rows, toks) == (1, 16)
+
+    def test_generative_server_grid_capped_by_slots(self):
+        srv = InferenceServer("transformer-lm", "/nope", 0, batch_max=8,
+                              batch_timeout_ms=5.0, replica="g",
+                              max_seq_len=128, max_slots=4)
+        # Row buckets never exceed the KV slot count: a prefill chunk
+        # must fit in the free slots it lands in.
+        assert srv.buckets == (1, 2, 4)
+        assert srv.seq_buckets == (16, 32, 64, 128)
+        assert srv.generative
+
+    def test_bucketing_off_stays_pad_to_max(self):
+        srv = InferenceServer("transformer-lm", "/nope", 0, batch_max=8,
+                              batch_timeout_ms=5.0, replica="g0",
+                              bucketing=False, max_seq_len=128,
+                              max_slots=8)
+        assert srv.buckets == (8,)
+        assert srv.seq_buckets == (128,)
+
+
+# ------------------------------------------------- decode forward parity
+
+
+def _lm_cfg(**kw):
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import TransformerConfig
+
+    base = dict(vocab_size=61, num_layers=2, hidden=32, num_heads=2,
+                max_len=32, causal=True, dtype=jnp.float32)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _lm_params(cfg, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import TransformerLM
+
+    return TransformerLM(cfg).init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 4), jnp.int32))["params"]
+
+
+class TestDecodeParity:
+    """models/decode.py promises its hand-written forward cannot drift
+    from the flax modules; these tests are that pin (f32 so the
+    comparison is tight — production bf16 only loosens the tolerance,
+    not the code path)."""
+
+    def test_prefill_logits_match_full_forward(self):
+        import jax.numpy as jnp
+
+        from tf_operator_tpu.models import decode
+        from tf_operator_tpu.models.transformer import TransformerLM
+
+        cfg = _lm_cfg()
+        params = _lm_params(cfg)
+        rng = np.random.default_rng(3)
+        lengths = np.array([7, 1, 12, 4], np.int32)
+        t = 12
+        tokens = np.zeros((4, t), np.int32)
+        for i, n in enumerate(lengths):
+            tokens[i, :n] = rng.integers(0, cfg.vocab_size, n)
+        _k, _v, nxt, logits = decode.prefill(
+            params, jnp.asarray(tokens), jnp.asarray(lengths), cfg)
+        full = TransformerLM(cfg).apply({"params": params},
+                                        jnp.asarray(tokens))
+        want = np.asarray(full)[np.arange(4), lengths - 1]
+        np.testing.assert_allclose(np.asarray(logits), want,
+                                   atol=1e-4, rtol=1e-4)
+        assert np.array_equal(np.asarray(nxt), want.argmax(-1))
+
+    def test_greedy_chain_matches_naive_reforward(self):
+        """prefill_into_slots + decode_step over cache slots must produce
+        exactly the tokens a naive full re-forward greedy loop does —
+        variable-length rows sharing a cache, five generated tokens."""
+        import jax.numpy as jnp
+
+        from tf_operator_tpu.models import decode
+        from tf_operator_tpu.models.transformer import TransformerLM
+
+        cfg = _lm_cfg()
+        params = _lm_params(cfg, seed=1)
+        rng = np.random.default_rng(11)
+        prompts = [list(rng.integers(0, cfg.vocab_size, n))
+                   for n in (3, 8, 5)]
+        steps = 5
+        lm = TransformerLM(cfg)
+
+        def naive(prompt):
+            seq = list(prompt)
+            out = []
+            for _ in range(steps):
+                logits = lm.apply({"params": params},
+                                  jnp.asarray([seq], jnp.int32))
+                tok = int(np.asarray(logits)[0, len(seq) - 1].argmax())
+                out.append(tok)
+                seq.append(tok)
+            return out
+
+        want = [naive(p) for p in prompts]
+
+        slots = len(prompts)
+        k, v = decode.init_kv_cache(cfg, slots, cfg.max_len)
+        t = max(len(p) for p in prompts)
+        tokens = np.zeros((slots, t), np.int32)
+        lengths = np.zeros((slots,), np.int32)
+        for i, p in enumerate(prompts):
+            tokens[i, :len(p)] = p
+            lengths[i] = len(p)
+        k, v, first, _ = decode.prefill_into_slots(
+            params, k, v, jnp.asarray(tokens), jnp.asarray(lengths),
+            jnp.arange(slots, dtype=jnp.int32), cfg)
+        got = [[int(x)] for x in np.asarray(first)]
+        last = np.asarray(first, np.int32)
+        positions = lengths.copy()
+        for _ in range(steps - 1):
+            k, v, nxt, _ = decode.decode_step(
+                params, k, v, jnp.asarray(last), jnp.asarray(positions),
+                cfg)
+            last = np.asarray(nxt, np.int32)
+            positions += 1
+            for i in range(slots):
+                got[i].append(int(last[i]))
+        assert got == want
+
+    def test_config_from_params_roundtrip_and_rejection(self):
+        from tf_operator_tpu.models import decode
+
+        cfg = _lm_cfg(hidden=64, num_heads=1, mlp_ratio=2)
+        params = _lm_params(cfg)
+        # hidden 64 -> one conventional 64-wide head, no env needed.
+        derived = decode.config_from_params(params)
+        assert (derived.vocab_size, derived.num_layers, derived.hidden,
+                derived.num_heads, derived.mlp_ratio, derived.max_len
+                ) == (61, 2, 64, 1, 2, 32)
+        assert derived.causal
+        with pytest.raises(ValueError, match="num_heads 3 does not"):
+            decode.config_from_params(params, num_heads=3)
+        with pytest.raises(ValueError, match="not a TransformerLM"):
+            decode.config_from_params({"dense": {}})
+
+
+# ------------------------------------------------- scheduler (stub-driven)
+
+
+class _StubModel:
+    """A fake device model for driving the REAL scheduler host logic:
+    the first token after prefill encodes nothing clever (always 1),
+    every decode tick emits last+1, and every call is recorded so tests
+    can assert ORDER — which is what continuous batching is."""
+
+    def __init__(self):
+        self.events: list[tuple] = []
+        self.lock = threading.Lock()
+
+    def prefill(self, params, k, v, tok, lens, ids):
+        with self.lock:
+            self.events.append(("prefill", params,
+                                tuple(int(x) for x in ids)))
+        first = np.ones((tok.shape[0],), np.int32)
+        return k, v, first, None
+
+    def decode(self, params, k, v, last, positions):
+        with self.lock:
+            self.events.append(("decode", params))
+        return k, v, last + 1, None
+
+
+def _decode_server(*, max_slots=2, continuous=True, batch_max=4,
+                   params=("p1",)):
+    srv = InferenceServer("transformer-lm", "/nope", 0,
+                          batch_max=batch_max, batch_timeout_ms=5.0,
+                          replica="dec", max_seq_len=32,
+                          max_new_tokens=32, max_slots=max_slots,
+                          continuous=continuous)
+    stub = _StubModel()
+    srv._prefill_fn = stub.prefill
+    srv._decode_fn = stub.decode
+    srv._kv = (np.zeros(1), np.zeros(1))
+    srv._positions = np.zeros((max_slots + 1,), np.int32)
+    srv._last_tokens = np.zeros((max_slots + 1,), np.int32)
+    srv._live = (params, 1)
+    return srv, stub
+
+
+def _submit(srv, prompts, max_new):
+    it = _Pending([list(p) for p in prompts], max_new=max_new)
+    srv._shift_inflight(+1)
+    assert srv.queue.submit(it)
+    return it
+
+
+def _finish(srv, items, timeout=5.0):
+    srv.queue.close()
+    threads = srv.start_pipeline()
+    for it in items:
+        assert it.event.wait(timeout), "request never answered"
+    for t in threads:
+        t.join(timeout)
+
+
+class TestDecodeScheduler:
+    def test_continuous_admits_into_freed_slot_mid_decode(self):
+        """Three rows, two slots: the third row must be admitted as soon
+        as the short peer retires — while the long one is still
+        decoding. That refill-between-ticks IS continuous batching."""
+        srv, stub = _decode_server(max_slots=2, continuous=True)
+        a = _submit(srv, [[1, 2]], max_new=8)
+        b = _submit(srv, [[3, 4]], max_new=2)
+        c = _submit(srv, [[5, 6]], max_new=2)
+        _finish(srv, [a, b, c])
+        assert a.error is None and b.error is None and c.error is None
+        assert len(a.result[0]) == 8
+        assert len(b.result[0]) == 2 and len(c.result[0]) == 2
+        # Stub chain: first token 1, then 2, 3, ... per tick.
+        assert a.result[0] == list(range(1, 9))
+        kinds = [e[0] for e in stub.events]
+        first_p, second_p = [i for i, k in enumerate(kinds)
+                             if k == "prefill"][:2]
+        decodes_between = kinds[first_p:second_p].count("decode")
+        # Row c lands after ONE tick (b retires at tick 1), far before
+        # a's 7 remaining ticks drain.
+        assert decodes_between < 7, stub.events
+        assert srv._active_now == 0
+        assert srv._served == 3
+
+    def test_run_to_completion_gates_admission_on_drain(self):
+        """continuous=False is the static-batching baseline: the same
+        workload must NOT refill b's freed slot until a fully
+        retires."""
+        srv, stub = _decode_server(max_slots=2, continuous=False)
+        a = _submit(srv, [[1, 2]], max_new=8)
+        b = _submit(srv, [[3, 4]], max_new=2)
+        c = _submit(srv, [[5, 6]], max_new=2)
+        _finish(srv, [a, b, c])
+        assert a.error is None and b.error is None and c.error is None
+        assert len(c.result[0]) == 2
+        kinds = [e[0] for e in stub.events]
+        prefills = [i for i, k in enumerate(kinds) if k == "prefill"]
+        assert len(prefills) == 2
+        # All 7 of a's remaining ticks run before c's admission.
+        assert kinds[prefills[0]:prefills[1]].count("decode") == 7, (
+            stub.events)
+
+    def test_hot_swap_reprefills_before_decoding_with_new_params(self):
+        """The mid-decode coherence pin: when the follower swaps params,
+        every decode tick under the NEW params must be preceded by a
+        re-prefill of the active slots under those params — a sequence
+        never decodes over KV another params version wrote."""
+        srv, stub = _decode_server(max_slots=2, continuous=True,
+                                   params=("old",))
+        gate = threading.Event()
+        orig = stub.decode
+
+        def gated_decode(params, k, v, last, positions):
+            gate.set()  # at least one tick ran under the old params
+            time.sleep(0.005)  # a 40-token drain must OUTLIVE the swap
+            return orig(params, k, v, last, positions)
+
+        srv._decode_fn = gated_decode
+        a = _submit(srv, [[1, 2, 3]], max_new=40)
+        threads = srv.start_pipeline()
+        assert gate.wait(5.0)
+        new = ("new",)
+        srv._live = (new, 2)  # the follower's atomic pair swap
+        assert a.event.wait(10.0), "request never answered"
+        srv.queue.close()
+        for t in threads:
+            t.join(5.0)
+        assert a.error is None
+        assert len(a.result[0]) == 40
+        assert a.step == 2  # retired under the swapped step
+        assert srv._reprefills == 1
+        # Scan the recorded order: at every decode params-change there
+        # must be an intervening prefill under the incoming params.
+        last_params = None
+        for ev in stub.events:
+            if ev[0] == "prefill":
+                last_params = ev[1]
+            else:
+                assert ev[1] is last_params, (
+                    "decode tick ran over KV built by other params")
+
+    def test_single_token_requests_retire_at_prefill(self):
+        srv, stub = _decode_server(max_slots=2, continuous=True)
+        a = _submit(srv, [[9, 9], [7]], max_new=1)
+        _finish(srv, [a])
+        assert a.error is None
+        assert a.result == [[1], [1]]
+        assert [e[0] for e in stub.events].count("decode") == 0
+        assert srv._served == 1
+
+    def test_scheduler_error_answers_rows_and_keeps_serving(self):
+        """A prefill blow-up must 500 ITS rows exactly once (inflight
+        back to zero) and leave the loop alive for the next request."""
+        srv, stub = _decode_server(max_slots=2, continuous=True)
+        boom = [True]
+        orig = stub.prefill
+
+        def flaky_prefill(params, k, v, tok, lens, ids):
+            if boom[0]:
+                boom[0] = False
+                raise RuntimeError("device lost")
+            return orig(params, k, v, tok, lens, ids)
+
+        srv._prefill_fn = flaky_prefill
+        a = _submit(srv, [[1, 2], [3, 4]], max_new=3)
+        threads = srv.start_pipeline()
+        assert a.event.wait(5.0)
+        assert a.error is not None and "device lost" in a.error
+        b = _submit(srv, [[5, 6]], max_new=3)
+        srv.queue.close()
+        assert b.event.wait(5.0)
+        for t in threads:
+            t.join(5.0)
+        assert b.error is None
+        assert b.result[0] == [1, 2, 3]
+        assert srv._inflight == 0
+
+
+# ------------------------------------------------------------ api surface
+
+
+class TestDecodeApi:
+    def test_defaults_and_roundtrip(self):
+        svc = make_service()
+        assert svc.spec.model.max_sequence_length == 256
+        assert svc.spec.serving.max_new_tokens == 64
+        assert svc.spec.serving.max_concurrent_sequences == 8
+        svc.spec.model.max_sequence_length = 512
+        svc.spec.serving.max_new_tokens = 128
+        svc.spec.serving.max_concurrent_sequences = 16
+        d = compat.infsvc_to_dict(svc)
+        assert d["spec"]["model"]["maxSequenceLength"] == 512
+        assert d["spec"]["serving"]["maxNewTokens"] == 128
+        assert d["spec"]["serving"]["maxConcurrentSequences"] == 16
+        back = compat.infsvc_from_dict(d)
+        assert back.spec == svc.spec
+
+    @pytest.mark.parametrize("mutate, needle", [
+        (lambda s: setattr(s.spec.model, "max_sequence_length", 0),
+         "maxSequenceLength must be >= 1"),
+        (lambda s: setattr(s.spec.serving, "max_new_tokens", 0),
+         "maxNewTokens must be >= 1"),
+        (lambda s: setattr(s.spec.serving, "max_new_tokens", 256),
+         "must be < model.maxSequenceLength"),
+        (lambda s: setattr(s.spec.serving, "max_concurrent_sequences", 0),
+         "maxConcurrentSequences must be >= 1"),
+    ])
+    def test_validation(self, mutate, needle):
+        svc = make_service()
+        mutate(svc)
+        problems = validation.validate_inference_service(svc)
+        assert any(needle in p for p in problems), problems
+
+    def test_spec_hash_rolls_on_each_decode_knob(self):
+        svc = make_service()
+        base = serve_spec_hash(svc)
+        hashes = {base}
+        for mutate in (
+            lambda s: setattr(s.spec.model, "max_sequence_length", 512),
+            lambda s: setattr(s.spec.serving, "max_new_tokens", 32),
+            lambda s: setattr(s.spec.serving,
+                              "max_concurrent_sequences", 4),
+        ):
+            fresh = make_service()
+            mutate(fresh)
+            hashes.add(serve_spec_hash(fresh))
+        # Every knob participates in the rolling-replace trigger.
+        assert len(hashes) == 4
+
+
+class TestControllerEnv:
+    def test_decode_knobs_injected_into_server_pods(self):
+        cluster = InMemoryCluster()
+        c = InferenceServiceController(cluster)
+        svc = make_service(model="transformer-lm")
+        svc.spec.model.max_sequence_length = 512
+        svc.spec.serving.max_new_tokens = 96
+        svc.spec.serving.max_concurrent_sequences = 12
+        cluster.create_infsvc(svc)
+        assert c.run_until_idle(10)
+        run_all(cluster)
+        pod = cluster.list_pods("default")[0]
+        env = pod.spec.containers[0].env_dict()
+        assert env[ENV_MAX_SEQ_LEN] == "512"
+        assert env[ENV_MAX_NEW_TOKENS] == "96"
+        assert env[ENV_MAX_CONCURRENT] == "12"
+
+
+# ----------------------------------------------------------- slow capstone
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post_decode(port: int, rows, max_new: int, timeout=60.0) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps({"instances": rows,
+                         "maxNewTokens": max_new}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _healthz(port: int) -> dict:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                                timeout=2) as r:
+        return json.loads(r.read())
+
+
+@pytest.mark.slow
+class TestMidDecodeHotSwap:
+    """The acceptance pin for checkpoint-following during active decode:
+    a REAL transformer-lm server (follow mode) under concurrent decode
+    requests picks up a strictly newer checkpoint mid-flight; nothing
+    drops, every sequence gets its full token budget, and the replica
+    ends on the new step."""
+
+    def test_swap_during_active_decode_drops_nothing(self, tmp_path):
+        import jax
+
+        from tf_operator_tpu.models import checkpoint as ckpt
+        from tf_operator_tpu.models.transformer import (TransformerConfig,
+                                                        TransformerLM)
+
+        cfg = TransformerConfig(vocab_size=128, num_layers=2, hidden=64,
+                                num_heads=1, max_len=64, causal=True)
+
+        def save(step: int, seed: int) -> None:
+            import jax.numpy as jnp
+
+            params = TransformerLM(cfg).init(
+                jax.random.PRNGKey(seed),
+                jnp.zeros((1, 4), jnp.int32))["params"]
+            ckpt.save(str(tmp_path / "ck"), step, jax.device_get(params))
+
+        save(1, 0)
+        port = _free_port()
+        env = {
+            **os.environ, **ONE_DEV,
+            "TPUJOB_SERVE_MODEL": "transformer-lm",
+            "TPUJOB_SERVE_CHECKPOINT_DIR": str(tmp_path / "ck"),
+            "TPUJOB_SERVE_PORT": str(port),
+            "TPUJOB_SERVE_LISTEN_PORT": str(port),
+            "TPUJOB_SERVE_BATCH_MAX": "4",
+            "TPUJOB_SERVE_BATCH_TIMEOUT_MS": "2.0",
+            "TPUJOB_SERVE_MAX_SEQ_LEN": "64",
+            "TPUJOB_SERVE_MAX_NEW_TOKENS": "48",
+            "TPUJOB_SERVE_MAX_CONCURRENT_SEQS": "4",
+            "TPUJOB_SERVE_FOLLOW": "1",
+            "TPUJOB_SERVE_FOLLOW_POLL_S": "0.2",
+            "TPUJOB_POD_NAME": "swap-capstone",
+        }
+        proc = subprocess.Popen(
+            [PY, "-m", "tf_operator_tpu.serve.server"], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+        try:
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                try:
+                    if _healthz(port).get("ok"):
+                        break
+                except Exception:  # noqa: BLE001 — still warming
+                    pass
+                time.sleep(0.3)
+            else:
+                pytest.fail("server never became ready")
+
+            results: list[dict] = []
+            errors: list[str] = []
+
+            def client(seed: int, max_new: int) -> None:
+                rng = np.random.default_rng(seed)
+                for _ in range(3):
+                    prompt = [int(x) for x in rng.integers(0, 128, 6)]
+                    try:
+                        results.append(
+                            {"max_new": max_new,
+                             **_post_decode(port, [prompt], max_new)})
+                    except Exception as e:  # noqa: BLE001 — asserted below
+                        errors.append(repr(e))
+
+            clients = [threading.Thread(target=client, args=(i, m),
+                                        daemon=True)
+                       for i, m in enumerate((48, 48, 8, 8))]
+            for t in clients:
+                t.start()
+            time.sleep(0.5)  # let decode get properly mid-flight
+            save(2, 42)
+            for t in clients:
+                t.join(120)
+            assert not errors, errors
+            assert len(results) == 12
+            for r in results:
+                assert len(r["predictions"][0]) == r["max_new"], r
+            deadline = time.monotonic() + 20
+            h = _healthz(port)
+            while (h.get("checkpoint_step") != 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.3)
+                h = _healthz(port)
+            assert h.get("checkpoint_step") == 2
+            assert h.get("decode_steps", 0) > 0
+            # Post-swap traffic serves the NEW params (the in-flight
+            # cohort above may legitimately retire under step 1 if its
+            # drain beats the follow poll).
+            after = _post_decode(port, [[1, 2, 3]], 4)
+            assert after["checkpoint_step"] == 2
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except Exception:  # noqa: BLE001 — last resort
+                proc.kill()

@@ -264,6 +264,9 @@ def infsvc_from_dict(manifest: dict[str, Any],
                 follow_poll_seconds=(
                     2.0 if model_d.get("followPollSeconds") is None
                     else float(model_d["followPollSeconds"])),
+                max_sequence_length=(
+                    256 if model_d.get("maxSequenceLength") is None
+                    else int(model_d["maxSequenceLength"])),
             ),
             serving=ServingSpec(
                 # Explicit 0 must reach validation (>= 1 rule) — the
@@ -280,6 +283,12 @@ def infsvc_from_dict(manifest: dict[str, Any],
                 # Absent = bucketed (the fast path); explicit false is
                 # the pad-to-max baseline exp_serve measures against.
                 bucketing=bool(serving_d.get("bucketing", True)),
+                max_new_tokens=(
+                    64 if serving_d.get("maxNewTokens") is None
+                    else int(serving_d["maxNewTokens"])),
+                max_concurrent_sequences=(
+                    8 if serving_d.get("maxConcurrentSequences") is None
+                    else int(serving_d["maxConcurrentSequences"])),
             ),
             autoscale=AutoscaleSpec(
                 min_replicas=(1 if auto_d.get("minReplicas") is None
@@ -359,6 +368,7 @@ def infsvc_to_dict(svc) -> dict[str, Any]:
                 "model": spec.model.model,
                 "follow": spec.model.follow,
                 "followPollSeconds": spec.model.follow_poll_seconds,
+                "maxSequenceLength": spec.model.max_sequence_length,
             },
             "serving": {
                 "batchMaxSize": spec.serving.batch_max_size,
@@ -367,6 +377,9 @@ def infsvc_to_dict(svc) -> dict[str, Any]:
                 "heartbeatTimeoutSeconds":
                     spec.serving.heartbeat_timeout_seconds,
                 "bucketing": spec.serving.bucketing,
+                "maxNewTokens": spec.serving.max_new_tokens,
+                "maxConcurrentSequences":
+                    spec.serving.max_concurrent_sequences,
             },
             "autoscale": {
                 "minReplicas": spec.autoscale.min_replicas,

@@ -491,6 +491,12 @@ class ModelSpec:
     as soon as the job EXISTS (Running included) instead of waiting for
     Succeeded, and the server waits for the first valid checkpoint
     before readiness.
+
+    max_sequence_length: the model's context window in tokens — the cap
+    on prompt + generated tokens per sequence and the top of the serving
+    seq-len bucket ladder (generative models only; classifiers ignore
+    it). The server clamps it to the checkpoint's position-embedding
+    table, so an oversized value cannot outrun the trained positions.
     """
 
     checkpoint_dir: str = ""
@@ -498,6 +504,7 @@ class ModelSpec:
     model: str = ""
     follow: bool = False
     follow_poll_seconds: float = 2.0
+    max_sequence_length: int = 256
 
 
 @dataclass
@@ -517,7 +524,16 @@ class ServingSpec:
     smallest power-of-two bucket <= batch_max_size instead of always the
     max (the small, fixed bucket-shape set is warmed before readiness),
     so light-load latency and wasted FLOPs drop with occupancy. False =
-    the pad-to-max baseline (one compiled shape).
+    the pad-to-max baseline (one compiled shape per dimension). For
+    generative models the same ladder applies to the token dimension
+    (the 2-D rows x seq-len bucket grid).
+    max_new_tokens: per-request ceiling on generated tokens (generative
+    models); a request's own maxNewTokens is clamped to it. Bounded by
+    model.maxSequenceLength (a prompt needs at least one token of room).
+    max_concurrent_sequences: KV-cache slots per replica — the decode
+    scheduler's admission capacity and the replica-resident device-state
+    budget (cache bytes scale linearly with it). Also the unit of the
+    router's active-slot load signal.
     """
 
     batch_max_size: int = 8
@@ -525,6 +541,8 @@ class ServingSpec:
     port: int = 8500
     heartbeat_timeout_seconds: float | None = None
     bucketing: bool = True
+    max_new_tokens: int = 64
+    max_concurrent_sequences: int = 8
 
 
 @dataclass

@@ -270,6 +270,8 @@ def validate_inference_service(svc, fleet=None) -> list[str]:
                 f"name a valid TrainJob ('name' or 'namespace/name')")
     if model.follow_poll_seconds <= 0:
         problems.append("model.followPollSeconds must be > 0")
+    if model.max_sequence_length < 1:
+        problems.append("model.maxSequenceLength must be >= 1")
     if not spec.template.containers:
         problems.append("template has no containers")
     elif serving_container(spec.template) is None:
@@ -286,6 +288,18 @@ def validate_inference_service(svc, fleet=None) -> list[str]:
     if (serving.heartbeat_timeout_seconds is not None
             and serving.heartbeat_timeout_seconds <= 0):
         problems.append("serving.heartbeatTimeoutSeconds must be > 0")
+    if serving.max_new_tokens < 1:
+        problems.append("serving.maxNewTokens must be >= 1")
+    elif (model.max_sequence_length >= 1
+            and serving.max_new_tokens >= model.max_sequence_length):
+        # Cross-field: every sequence is prompt + generated inside one
+        # context window, and a prompt is at least one token.
+        problems.append(
+            f"serving.maxNewTokens ({serving.max_new_tokens}) must be < "
+            f"model.maxSequenceLength ({model.max_sequence_length}) — a "
+            f"prompt needs at least one token of the window")
+    if serving.max_concurrent_sequences < 1:
+        problems.append("serving.maxConcurrentSequences must be >= 1")
     auto = spec.autoscale
     if auto.min_replicas < 1:
         problems.append("autoscale.minReplicas must be >= 1")

@@ -1,0 +1,255 @@
+"""Decode-capable TransformerLM forward with an explicit KV cache.
+
+The serving decode loop (serve/server.py round 19) needs three things the
+training-side `TransformerLM.apply` cannot give it:
+
+  * PREFILL that returns the per-layer K/V it computed, so a new sequence's
+    attention state can be parked in a replica-resident cache slot;
+  * a single-token DECODE STEP that reads/extends that cache — O(T) work per
+    generated token instead of the O(T^2) full re-forward;
+  * slot-addressed cache updates, so the continuous-batching scheduler can
+    admit/retire individual sequences between ticks without touching the
+    others' state.
+
+flax's mutable-cache machinery keeps the cache inside module variables; the
+scheduler needs it as plain device arrays it can scatter into per slot. So
+this module is a hand-written functional forward over the *same param tree*
+`TransformerLM` produces — the param paths (trunk/{embed, pos_embed,
+layer_i/{attn/{query,key,value,attn_out}, ln1, ln2, mlp_in, mlp_out}, ln_f},
+lm_head) are the repo-wide module-name contract (sharding rules, checkpoint
+census), and `tests/test_serve_decode.py` pins prefill-logit equality against
+`TransformerLM.apply` so the two forwards cannot drift apart silently.
+
+Numerics mirror the flax modules: f32 params, `cfg.dtype` (bf16 by default)
+matmul compute, f32 layernorm statistics, f32 softmax, f32 final logits.
+
+Cache layout: one (k, v) pair of [num_layers, slots, heads, max_len,
+head_dim] arrays in `cfg.dtype`. The slot axis is the scheduler's unit of
+admission; position `p` of slot `s` holds the K/V of the token *fed* at
+absolute position p (prompt tokens from prefill, generated tokens from
+decode steps). Everything here is pure and jit-friendly; the server jits
+`prefill_into_slots`/`decode_step` once — with the cache buffers donated,
+so slot scatters update in place — and warms them over the
+(rows x seq-len) bucket grid before readiness.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models.transformer import TransformerConfig
+from tf_operator_tpu.ops.flash_attention import NEG_INF
+
+ENV_NUM_HEADS = "TPUJOB_SERVE_NUM_HEADS"
+
+# Conventional attention head width: every shape in a TransformerLM param
+# tree determines vocab/hidden/layers/max_len, but the head COUNT never
+# appears in any kernel shape, so serving a bare checkpoint needs a rule.
+# The trainer's transformer-lm defaults (hidden 512 / 8 heads) follow it;
+# non-conforming models override via TPUJOB_SERVE_NUM_HEADS.
+DEFAULT_HEAD_DIM = 64
+
+
+def config_from_params(params, num_heads: int | None = None
+                       ) -> TransformerConfig:
+    """Reconstruct the decode config from a TransformerLM param tree.
+
+    vocab/hidden come from the embedding table, num_layers from the
+    layer_i count, max_len from the position table, mlp_ratio from the
+    mlp_in kernel. num_heads is NOT derivable from shapes — pass it,
+    set TPUJOB_SERVE_NUM_HEADS, or inherit the head_dim=64 convention.
+    """
+    try:
+        trunk = params["trunk"]
+        vocab, hidden = trunk["embed"]["embedding"].shape
+        max_len = trunk["pos_embed"]["embedding"].shape[0]
+        layers = sum(1 for k in trunk if str(k).startswith("layer_"))
+        mlp_ratio = (trunk["layer_0"]["mlp_in"]["kernel"].shape[1]
+                     // hidden)
+    except (KeyError, TypeError) as e:
+        raise ValueError(
+            f"param tree is not a TransformerLM checkpoint (missing "
+            f"{e}): decode serving needs the trunk/lm_head layout") from None
+    if num_heads is None:
+        env = os.environ.get(ENV_NUM_HEADS)
+        if env:
+            num_heads = int(env)
+        elif hidden % DEFAULT_HEAD_DIM == 0:
+            num_heads = hidden // DEFAULT_HEAD_DIM
+        else:
+            raise ValueError(
+                f"cannot infer num_heads for hidden={hidden} (not a "
+                f"multiple of {DEFAULT_HEAD_DIM}); set {ENV_NUM_HEADS}")
+    if hidden % num_heads:
+        raise ValueError(f"num_heads {num_heads} does not divide "
+                         f"hidden {hidden}")
+    return TransformerConfig(
+        vocab_size=vocab, num_layers=layers, hidden=hidden,
+        num_heads=num_heads, mlp_ratio=mlp_ratio, max_len=max_len,
+        causal=True)
+
+
+def init_kv_cache(cfg: TransformerConfig, slots: int, max_len: int):
+    """Zeroed (k, v) cache: [layers, slots, heads, max_len, head_dim]."""
+    shape = (cfg.num_layers, slots, cfg.num_heads, max_len, cfg.head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def _dense(p, x, dtype):
+    y = x @ p["kernel"].astype(dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(dtype)
+    return y
+
+
+def _layernorm(p, x):
+    """flax LayerNorm numerics: f32 statistics, eps 1e-6, f32 affine,
+    result back in the compute dtype."""
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = jnp.square(xf - mu).mean(-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + 1e-6)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _split_heads(a, heads, head_dim):
+    b, t, _ = a.shape
+    return a.reshape(b, t, heads, head_dim).transpose(0, 2, 1, 3)
+
+
+def prefill(params, tokens, lengths, cfg: TransformerConfig):
+    """Full causal forward over prompt tokens, keeping per-layer K/V.
+
+    tokens: [rows, T] int32 (zero-padded past each row's length);
+    lengths: [rows] int32 — the true prompt length per row.
+
+    Returns (k [L, rows, H, T, D], v [...], next_tokens [rows] int32,
+    last_logits [rows, vocab] f32): the K/V ready to scatter into cache
+    slots, plus the greedy first generated token (the logits at each
+    row's LAST real position). Padding rows/positions produce garbage
+    K/V past `lengths` — harmless, since decode attention masks by
+    position and slot reuse overwrites from 0.
+    """
+    dtype = cfg.dtype
+    trunk = params["trunk"]
+    x = jnp.take(trunk["embed"]["embedding"], tokens, axis=0).astype(dtype)
+    t = tokens.shape[1]
+    pos = trunk["pos_embed"]["embedding"][:t].astype(dtype)
+    x = x + pos[None]
+    ks, vs = [], []
+    for i in range(cfg.num_layers):
+        lp = trunk[f"layer_{i}"]
+        h = _layernorm(lp["ln1"], x)
+        ap = lp["attn"]
+        q = _split_heads(_dense(ap["query"], h, dtype), cfg.num_heads,
+                         cfg.head_dim)
+        k = _split_heads(_dense(ap["key"], h, dtype), cfg.num_heads,
+                         cfg.head_dim)
+        v = _split_heads(_dense(ap["value"], h, dtype), cfg.num_heads,
+                         cfg.head_dim)
+        ks.append(k)
+        vs.append(v)
+        # Same reference numerics as training (ring_attention's single-
+        # device path): dtype QK^T, f32 softmax, dtype PV.
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+            jnp.float32(cfg.head_dim)).astype(dtype)
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask, s, NEG_INF)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        o = o.transpose(0, 2, 1, 3).reshape(x.shape)
+        x = x + _dense(ap["attn_out"], o, dtype)
+        h = _dense(lp["mlp_in"], _layernorm(lp["ln2"], x), dtype)
+        x = x + _dense(lp["mlp_out"], jax.nn.gelu(h), dtype)
+    x = _layernorm(trunk["ln_f"], x)
+    rows = tokens.shape[0]
+    last = x[jnp.arange(rows), jnp.maximum(lengths - 1, 0)]
+    logits = (last @ params["lm_head"]["kernel"].astype(dtype)
+              ).astype(jnp.float32)
+    return (jnp.stack(ks), jnp.stack(vs),
+            jnp.argmax(logits, axis=-1).astype(jnp.int32), logits)
+
+
+def write_slots(k_cache, v_cache, k_chunk, v_chunk, slot_ids):
+    """Scatter a prefill chunk's K/V ([L, rows, H, T, D]) into cache
+    slots `slot_ids` ([rows] int32) at token positions [0, T). Duplicate
+    slot ids are legal (last-write-wins) — the scheduler pads short
+    chunks by repeating its scratch slot."""
+    t = k_chunk.shape[3]
+    k_cache = k_cache.at[:, slot_ids, :, :t, :].set(k_chunk)
+    v_cache = v_cache.at[:, slot_ids, :, :t, :].set(v_chunk)
+    return k_cache, v_cache
+
+
+def prefill_into_slots(params, k_cache, v_cache, tokens, lengths,
+                       slot_ids, cfg: TransformerConfig):
+    """Fused prefill + slot scatter: ONE dispatch per admission chunk.
+
+    The scheduler admits between decode ticks, so admission cost is paid
+    on the serving critical path; fusing also lets the server jit this
+    with the cache buffers DONATED (in-place update — the cache is
+    several MB per replica and would otherwise be copied whole on every
+    admission).
+
+    Returns (k_cache, v_cache, next_tokens [rows] int32,
+    last_logits [rows, vocab] f32)."""
+    k_chunk, v_chunk, next_tokens, logits = prefill(params, tokens,
+                                                    lengths, cfg)
+    k_cache, v_cache = write_slots(k_cache, v_cache, k_chunk, v_chunk,
+                                   slot_ids)
+    return k_cache, v_cache, next_tokens, logits
+
+
+def decode_step(params, k_cache, v_cache, tokens, positions,
+                cfg: TransformerConfig):
+    """One decode tick over every cache slot.
+
+    tokens: [slots] int32 — the token FED to each slot this tick (its
+    K/V lands at `positions`); positions: [slots] int32 absolute
+    positions. Attention for slot s covers cached positions <=
+    positions[s], so inactive slots' stale state is never read once the
+    scheduler re-prefills on reuse.
+
+    Returns (k_cache, v_cache, next_tokens [slots] int32,
+    logits [slots, vocab] f32).
+    """
+    dtype = cfg.dtype
+    trunk = params["trunk"]
+    slots = tokens.shape[0]
+    max_len = k_cache.shape[3]
+    x = jnp.take(trunk["embed"]["embedding"], tokens, axis=0).astype(dtype)
+    x = x + jnp.take(trunk["pos_embed"]["embedding"], positions,
+                     axis=0).astype(dtype)  # [S, H*D]
+    s_i = jnp.arange(slots)
+    visible = (jnp.arange(max_len)[None] <= positions[:, None])  # [S, ML]
+    for i in range(cfg.num_layers):
+        lp = trunk[f"layer_{i}"]
+        h = _layernorm(lp["ln1"], x)
+        ap = lp["attn"]
+
+        def heads(a):  # [S, hidden] -> [S, H, D]
+            return a.reshape(slots, cfg.num_heads, cfg.head_dim)
+
+        q = heads(_dense(ap["query"], h, dtype))
+        k_tok = heads(_dense(ap["key"], h, dtype))
+        v_tok = heads(_dense(ap["value"], h, dtype))
+        # Scatter this tick's K/V at each slot's own position.
+        k_cache = k_cache.at[i, s_i, :, positions, :].set(k_tok)
+        v_cache = v_cache.at[i, s_i, :, positions, :].set(v_tok)
+        k_l, v_l = k_cache[i], v_cache[i]  # [S, H, ML, D]
+        s = jnp.einsum("shd,shmd->shm", q, k_l) / jnp.sqrt(
+            jnp.float32(cfg.head_dim)).astype(dtype)
+        s = jnp.where(visible[:, None, :], s, NEG_INF)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(dtype)
+        o = jnp.einsum("shm,shmd->shd", p, v_l).reshape(slots, cfg.hidden)
+        x = x + _dense(ap["attn_out"], o, dtype)
+        h = _dense(lp["mlp_in"], _layernorm(lp["ln2"], x), dtype)
+        x = x + _dense(lp["mlp_out"], jax.nn.gelu(h), dtype)
+    x = _layernorm(trunk["ln_f"], x)
+    logits = (x @ params["lm_head"]["kernel"].astype(dtype)
+              ).astype(jnp.float32)
+    return (k_cache, v_cache,
+            jnp.argmax(logits, axis=-1).astype(jnp.int32), logits)

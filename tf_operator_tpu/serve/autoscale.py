@@ -1,8 +1,13 @@
 """Autoscaler math: load signal -> desired replica count, with hysteresis.
 
 Pure functions over plain values so the policy is unit-testable without a
-controller: the reconcile tick feeds in the collector's total inflight and
-the persisted hysteresis latch, and applies whatever comes back.
+controller: the reconcile tick feeds in the service's total load and the
+persisted hysteresis latch, and applies whatever comes back. "Load" is
+measured in concurrent work units per replica: HTTP inflight for
+classifier replicas, and max(inflight, active decode slots) for
+generative ones — a continuous-batching replica decoding 8 sequences
+inside long-lived requests is 8 units, not 1 (see
+controller._service_load for why max, never sum).
 
 The policy (docs/serving.md "Autoscaling"):
 

@@ -94,6 +94,9 @@ ENV_ENDPOINT = "TPUJOB_SERVE_ENDPOINT"
 ENV_BUCKETING = "TPUJOB_SERVE_BUCKETING"
 ENV_FOLLOW = "TPUJOB_SERVE_FOLLOW"
 ENV_FOLLOW_POLL = "TPUJOB_SERVE_FOLLOW_POLL_S"
+ENV_MAX_SEQ_LEN = "TPUJOB_SERVE_MAX_SEQ_LEN"
+ENV_MAX_NEW_TOKENS = "TPUJOB_SERVE_MAX_NEW_TOKENS"
+ENV_MAX_CONCURRENT = "TPUJOB_SERVE_MAX_CONCURRENT_SEQS"
 # The replica's own pod name: the server's metrics `replica` label —
 # server.py's __main__ read this from day one, but nothing injected it
 # (replicas fell back to the generic "server-N" label). Found by
@@ -802,7 +805,13 @@ class InferenceServiceController(ctrl.JobControllerBase):
             per_pod = load_fn(svc.namespace, svc.name) or {}
             seen = [s for pod, s in per_pod.items() if pod in names]
             if seen:
-                total = float(sum(s.get("inflight") or 0 for s in seen))
+                # Per pod, HTTP inflight and active decode slots count
+                # the same requests from two vantage points (a decode
+                # request occupies a slot while it is inflight) — max,
+                # never sum, same rule as the router signal below.
+                total = float(sum(
+                    max(s.get("inflight") or 0, s.get("active_slots") or 0)
+                    for s in seen))
         router = self._routers.get(svc.key())
         if router is not None:
             per_backend = router.load()
@@ -916,6 +925,11 @@ class InferenceServiceController(ctrl.JobControllerBase):
             c.set_env(ENV_BATCH_MAX, str(serving.batch_max_size))
             c.set_env(ENV_BATCH_TIMEOUT_MS, str(serving.batch_timeout_ms))
             c.set_env(ENV_BUCKETING, "1" if serving.bucketing else "0")
+            c.set_env(ENV_MAX_SEQ_LEN,
+                      str(svc.spec.model.max_sequence_length))
+            c.set_env(ENV_MAX_NEW_TOKENS, str(serving.max_new_tokens))
+            c.set_env(ENV_MAX_CONCURRENT,
+                      str(serving.max_concurrent_sequences))
             if svc.spec.model.follow:
                 c.set_env(ENV_FOLLOW, "1")
                 c.set_env(ENV_FOLLOW_POLL,

@@ -63,7 +63,7 @@ class _ReadTimeout(Exception):
 
 class _Backend:
     __slots__ = ("name", "addr", "ready", "inflight", "ewma", "last_t",
-                 "requests", "failures", "timeouts_consec")
+                 "requests", "failures", "timeouts_consec", "slots")
 
     def __init__(self, name: str, addr: str):
         self.name = name
@@ -74,6 +74,12 @@ class _Backend:
         self.last_t = time.monotonic()
         self.requests = 0
         self.failures = 0
+        # Active decode slots reported by the replica's /healthz
+        # (generative models; 0 for classifiers). A long-running decode
+        # request is ONE HTTP inflight no matter how many sequences it
+        # carries, so slot occupancy is the honest least-loaded signal
+        # for continuous-batching replicas.
+        self.slots = 0
         # Consecutive read-timeouts: a timeout doesn't gate readiness
         # (alive-but-slow != dead, and the probe would re-admit a wedged
         # dispatch thread anyway — /healthz still answers), but _pick
@@ -155,6 +161,7 @@ class FrontEndRouter:
                     "addr": b.addr, "ready": b.ready,
                     "inflight": b.inflight,
                     "avg_inflight": round(b.ewma, 3),
+                    "active_slots": b.slots,
                     "requests": b.requests, "failures": b.failures,
                 }
             return out
@@ -175,8 +182,13 @@ class FrontEndRouter:
                 # The EW average lags a step arrival by ~tau; the
                 # instantaneous count floors it so a sudden burst is
                 # never under-read at the tick that matters (scale-up
-                # is latency).
-                out[b.name] = max(b.ewma, float(b.inflight))
+                # is latency). Active decode slots floor BOTH: a decode
+                # replica chewing through 8 sequences inside one HTTP
+                # request is 8 units of load, not 1 (max, not sum —
+                # those sequences ARE the inflight requests, counting
+                # them twice would double the autoscale signal).
+                out[b.name] = max(b.ewma, float(b.inflight),
+                                  float(b.slots))
             return out
 
     # ----------------------------------------------------------- probing
@@ -187,14 +199,16 @@ class FrontEndRouter:
                 targets = [(b.name, b.addr) for b in
                            self._backends.values()]
             for name, addr in targets:
-                ok = self._probe_one(addr)
+                ok, slots = self._probe_one(addr)
                 with self._lock:
                     b = self._backends.get(name)
                     if b is not None and b.addr == addr:
                         b.ready = ok
+                        b.slots = slots
             self._stop.wait(timeout=self.probe_interval_s)
 
-    def _probe_one(self, addr: str) -> bool:
+    def _probe_one(self, addr: str) -> tuple[bool, int]:
+        """(ready, active decode slots) from the replica's /healthz."""
         host, _, port = addr.rpartition(":")
         try:
             conn = http.client.HTTPConnection(host, int(port), timeout=1.0)
@@ -203,12 +217,14 @@ class FrontEndRouter:
                 r = conn.getresponse()
                 body = r.read()
                 if r.status != 200:
-                    return False
-                return bool(json.loads(body).get("ok"))
+                    return False, 0
+                hz = json.loads(body)
+                return (bool(hz.get("ok")),
+                        int(hz.get("active_slots") or 0))
             finally:
                 conn.close()
         except Exception:  # noqa: BLE001 — any probe failure = not ready
-            return False
+            return False, 0
 
     # ----------------------------------------------------------- routing
 
@@ -235,8 +251,8 @@ class FrontEndRouter:
                 # receives traffic when it is the last replica standing
                 # (and one answer un-demotes it).
                 key = (1 if b.timeouts_consec >= 2 else 0,
-                       max(b.ewma, float(b.inflight)), b.inflight,
-                       b.requests)
+                       max(b.ewma, float(b.inflight), float(b.slots)),
+                       b.inflight, b.requests)
                 if best is None or key < best_key:
                     best, best_key = b, key
             if best is not None:

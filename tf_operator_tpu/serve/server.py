@@ -57,10 +57,40 @@ Liveness + load surfaces:
   * metrics events (TPUJOB_METRICS_FILE): start/serve_ready/ckpt_follow/
     done lines, same append-only record the trainer writes.
 
+Generative serving (round 19, --model transformer-lm): the same pipeline
+runs a CONTINUOUS-BATCHING decode loop instead of run-to-completion.
+
+  * the bucket ladder becomes a 2-D (rows x seq-len) grid: prompts pad to
+    the smallest (row-bucket, seq-bucket) that fits, every grid point is
+    one compiled XLA shape warmed before readiness, and pad accounting
+    covers the token dimension (bucketing=false stays pad-to-max in both
+    dimensions);
+  * the KV cache is replica-resident device state —
+    serving.maxConcurrentSequences slots (+1 scratch) of
+    [layers, slots, heads, maxSeqLen, headDim] — owned by the dispatch
+    thread;
+  * the dispatch thread runs a persistent decode scheduler: PREFILL new
+    requests into free cache slots, one decode step over ALL active slots
+    per tick, retire finished rows immediately, and admit queued rows
+    between ticks (mid-decode admission). --continuous 0 is the
+    run-to-completion baseline exp_serve's decode stage measures against:
+    admitted rows must all retire before the next admission;
+  * checkpoint follows swap the (params, step) pair between ticks; the
+    scheduler then RE-PREFILLS every in-flight sequence's context
+    (prompt + tokens generated so far) under the new params before the
+    next tick, so a sequence's KV cache is always coherent with the
+    params attending over it — committed tokens stand, the attention
+    state restarts cleanly, never an old-KV/new-params mix;
+  * the assembler stage stays host-only: tokenize/validate/sort rows by
+    length and pack the token dimension; the depth-1 slot discipline
+    between the stages is unchanged.
+
 Graceful shutdown: SIGTERM latches a stop flag; the assembler drains the
-queued requests into the slot, the dispatcher answers them all, a final
-stats snapshot and `done` event are written, and the process exits 0.
-Chaos `kill:step=N[,replica=server]` fires after N dispatched batches.
+queued requests into the slot, the dispatcher answers them all (decode
+mode: finishes every in-flight sequence), a final stats snapshot and
+`done` event are written, and the process exits 0.
+Chaos `kill:step=N[,replica=server]` fires after N dispatched batches
+(decode mode: prefill calls + decode ticks).
 """
 
 from __future__ import annotations
@@ -119,18 +149,47 @@ def select_bucket(n: int, buckets: tuple[int, ...]) -> int:
     raise ValueError(f"{n} rows exceed the largest bucket {buckets[-1]}")
 
 
+# The seq-len ladder starts here, not at 1: token buckets below it would
+# multiply the compiled-shape grid for shapes whose whole forward costs
+# less than its dispatch overhead.
+SEQ_BUCKET_FLOOR = 16
+
+
+def seq_bucket_sizes(max_len: int) -> tuple[int, ...]:
+    """The token-dimension ladder of the 2-D bucket grid: the power-of-two
+    ladder floored at SEQ_BUCKET_FLOOR (tiny token shapes are not worth a
+    compile), capped by maxSequenceLength."""
+    floor = min(SEQ_BUCKET_FLOOR, max_len)
+    return tuple(b for b in bucket_sizes(max_len) if b >= floor)
+
+
+def select_grid_bucket(
+    rows: int, tokens: int,
+    row_buckets: tuple[int, ...], seq_buckets: tuple[int, ...],
+) -> tuple[int, int]:
+    """The smallest (rows, seq-len) grid point that fits — per-dimension
+    smallest fit, since the ladders are independent."""
+    return select_bucket(rows, row_buckets), select_bucket(tokens, seq_buckets)
+
+
 class _Pending:
-    """One queued request: rows in, predictions out via the event."""
+    """One queued request: rows in, predictions out via the event.
+    Generative requests additionally carry their (clamped) maxNewTokens
+    and an unfinished-row countdown — each row is one decode sequence and
+    the event fires when the LAST of them retires."""
 
-    __slots__ = ("rows", "event", "result", "error", "t_in", "step")
+    __slots__ = ("rows", "event", "result", "error", "t_in", "step",
+                 "max_new", "unfinished")
 
-    def __init__(self, rows):
+    def __init__(self, rows, max_new: int | None = None):
         self.rows = rows
         self.event = threading.Event()
         self.result = None
         self.error: str | None = None
         self.t_in = time.monotonic()
         self.step: int | None = None  # checkpoint step that served it
+        self.max_new = max_new
+        self.unfinished = 0
 
 
 class _Staged:
@@ -144,6 +203,38 @@ class _Staged:
         self.padded = padded
         self.n = n
         self.bucket = bucket
+
+
+class _StagedDecode:
+    """Assembled decode work parked in the staging slot: validated prompt
+    rows token-packed to a seq bucket, SORTED ascending by length (so the
+    dispatcher's admission chunks re-tighten their token bucket). The
+    dispatcher consumes it row-by-row as KV slots free up — `tokens` is a
+    host array precisely so partial admission can slice it."""
+
+    __slots__ = ("tokens", "lengths", "max_new", "row_refs", "n", "tb")
+
+    def __init__(self, tokens, lengths, max_new, row_refs, tb: int):
+        self.tokens = tokens      # np [n, tb] int32, zero-padded
+        self.lengths = lengths    # np [n] int32 — true prompt lengths
+        self.max_new = max_new    # np [n] int32 — per-row generation cap
+        self.row_refs = row_refs  # [(item, row_index)] aligned with rows
+        self.n = int(tokens.shape[0])
+        self.tb = tb
+
+
+class _Seq:
+    """One in-flight decode sequence bound to a KV-cache slot."""
+
+    __slots__ = ("item", "row", "prompt", "generated", "remaining")
+
+    def __init__(self, item, row: int, prompt: list[int], first: int,
+                 max_new: int):
+        self.item = item
+        self.row = row
+        self.prompt = prompt
+        self.generated = [first]
+        self.remaining = max_new - 1
 
 
 class StagingSlot:
@@ -258,11 +349,18 @@ class BatchQueue:
             return batch
 
 
+# Model names served by the decode scheduler (the trainer's --model
+# vocabulary, like the classifier list in load()).
+GENERATIVE_MODELS = ("transformer-lm",)
+
+
 class InferenceServer:
     def __init__(self, model_name: str, ckpt_dir: str, port: int,
                  batch_max: int, batch_timeout_ms: float,
                  replica: str = "", bucketing: bool = True,
-                 follow: bool = False, follow_poll_s: float = 2.0):
+                 follow: bool = False, follow_poll_s: float = 2.0,
+                 max_seq_len: int = 256, max_new_tokens: int = 64,
+                 max_slots: int = 8, continuous: bool = True):
         self.model_name = model_name
         self.ckpt_dir = ckpt_dir
         self.port = port
@@ -273,8 +371,26 @@ class InferenceServer:
         self.slot = StagingSlot()
         self.batch_max = batch_max
         self.bucketing = bucketing
-        self.buckets = (bucket_sizes(batch_max) if bucketing
-                        else (batch_max,))
+        self.generative = model_name in GENERATIVE_MODELS
+        self.max_seq_len = max_seq_len
+        self.max_new_tokens = max_new_tokens
+        self.max_slots = max_slots
+        # False = the run-to-completion baseline: an admitted batch must
+        # fully retire before the next admission (exp_serve's decode
+        # stage measures continuous batching against it).
+        self.continuous = continuous
+        if self.generative:
+            # Row buckets are capped by the KV slot count — a prefill
+            # chunk can never exceed the free slots it lands in.
+            row_max = min(batch_max, max_slots)
+            self.buckets = (bucket_sizes(row_max) if bucketing
+                            else (row_max,))
+            self.seq_buckets = (seq_bucket_sizes(max_seq_len)
+                                if bucketing else (max_seq_len,))
+        else:
+            self.buckets = (bucket_sizes(batch_max) if bucketing
+                            else (batch_max,))
+            self.seq_buckets = ()
         self.follow = follow
         self.follow_poll_s = follow_poll_s
         self.stop = threading.Event()
@@ -290,8 +406,18 @@ class InferenceServer:
         # Pad accounting (cumulative): useful rows vs padded-slot rows
         # actually dispatched. pad_efficiency = useful/padded is the
         # bucketing win signal (pad-to-max single-row = 1/batchMaxSize).
+        # The token pair is the 2-D grid's second dimension: prompt
+        # tokens vs padded prefill cells, plus active slots vs total
+        # slots per decode tick.
         self._rows_useful = 0
         self._rows_padded = 0
+        self._tokens_useful = 0
+        self._tokens_padded = 0
+        # Decode-loop counters (generative models only).
+        self._tokens_total = 0
+        self._decode_steps = 0
+        self._reprefills = 0
+        self._active_now = 0
         # Time-averaged inflight over the current stats window: an
         # instantaneous snapshot right after a batch drains reads ~0
         # under steady open-loop load (the queue empties every window),
@@ -307,6 +433,12 @@ class InferenceServer:
         self.m_batch = metrics_mod.serve_batch_size.labels(**labels)
         self.m_latency = metrics_mod.serve_latency_seconds.labels(**labels)
         self.m_pad_eff = metrics_mod.serve_pad_efficiency.labels(**labels)
+        self.m_tokens = metrics_mod.serve_tokens_total.labels(**labels)
+        self.m_decode_steps = metrics_mod.serve_decode_steps_total.labels(
+            **labels)
+        self.m_active = metrics_mod.serve_active_slots.labels(**labels)
+        self.m_tok_pad = metrics_mod.serve_token_pad_efficiency.labels(
+            **labels)
         from tf_operator_tpu import chaos as chaos_lib
 
         self._chaos = chaos_lib.TrainerChaos.from_env()
@@ -317,6 +449,16 @@ class InferenceServer:
         self._live: tuple[object, int | None] = (None, None)
         self._apply = None
         self._input_shape: tuple[int, ...] = ()
+        # Decode-loop state (generative models; owned by the dispatch
+        # thread after load()): jitted prefill/write/decode, the KV cache
+        # pair, and per-slot feed position / last-token host arrays.
+        self._decode_cfg = None
+        self._prefill_fn = None
+        self._decode_fn = None
+        self._kv = None
+        self._positions = None
+        self._last_tokens = None
+        self._vocab: int | None = None
 
     @property
     def loaded_step(self) -> int | None:
@@ -372,6 +514,8 @@ class InferenceServer:
                 f"no valid checkpoint under {self.ckpt_dir} (torn/empty "
                 f"dirs are skipped exactly as the trainer's resume walk "
                 f"would)")
+        if self.generative:
+            return self._load_decode(step)
         if self.model_name in ("mnist-mlp", "mnist-conv"):
             from tf_operator_tpu.models import mnist as M
 
@@ -380,8 +524,9 @@ class InferenceServer:
         else:
             raise ValueError(
                 f"serving model {self.model_name!r} not supported (mnist-"
-                f"mlp / mnist-conv today; the contract is the trainer's "
-                f"--model vocabulary)")
+                f"mlp / mnist-conv / {' / '.join(GENERATIVE_MODELS)} "
+                f"today; the contract is the trainer's --model "
+                f"vocabulary)")
         params, step = self._restore_host(step)
         if params is None:
             raise FileNotFoundError(
@@ -410,6 +555,77 @@ class InferenceServer:
             return np.asarray(jitted(p, jnp.asarray(x_np)))
 
         self._apply = apply
+        self._live = (params, step)
+
+    def _load_decode(self, step: int) -> None:
+        """Generative-model load: restore, derive the decode config from
+        the param tree, allocate the slot-addressed KV cache, and jit +
+        warm prefill over the whole (rows x seq-len) bucket grid plus
+        the one decode-tick shape."""
+        import functools
+
+        import jax
+        import numpy as np
+
+        from tf_operator_tpu.models import decode as decode_mod
+
+        host, step = self._restore_host(step)
+        if host is None:
+            raise FileNotFoundError(
+                f"every checkpoint under {self.ckpt_dir} failed to restore")
+        cfg = decode_mod.config_from_params(host)
+        self._decode_cfg = cfg
+        self._vocab = cfg.vocab_size
+        # The context window can never outrun the trained position table.
+        if cfg.max_len < self.max_seq_len:
+            self.max_seq_len = cfg.max_len
+            self.seq_buckets = (seq_bucket_sizes(cfg.max_len)
+                                if self.bucketing else (cfg.max_len,))
+        self.max_new_tokens = min(self.max_new_tokens,
+                                  self.max_seq_len - 1)
+        params = jax.device_put(host)
+        # Slot max_slots is SCRATCH: admission chunks pad their slot-id
+        # vector with it so every (row-bucket) write is one compiled
+        # scatter; nothing is ever scheduled there.
+        self._kv = decode_mod.init_kv_cache(cfg, self.max_slots + 1,
+                                            self.max_seq_len)
+        # Cache buffers are DONATED: admission and every decode tick
+        # rewrite the multi-MB cache, and donation makes those in-place
+        # instead of whole-cache copies on the serving critical path.
+        # The scheduler always rethreads self._kv from the outputs, so
+        # the consumed references are never reused.
+        self._prefill_fn = jax.jit(
+            functools.partial(decode_mod.prefill_into_slots, cfg=cfg),
+            donate_argnums=(1, 2))
+        self._decode_fn = jax.jit(
+            functools.partial(decode_mod.decode_step, cfg=cfg),
+            donate_argnums=(1, 2))
+        for rb in self.buckets:
+            for tb in self.seq_buckets:
+                tok = np.zeros((rb, tb), np.int32)
+                lens = np.ones((rb,), np.int32)
+                ids = np.full((rb,), self.max_slots, np.int32)
+                k, v, first, _ = self._prefill_fn(
+                    params, self._kv[0], self._kv[1], tok, lens, ids)
+                first.block_until_ready()
+                self._kv = (k, v)
+                # Per-grid-point liveness: the grid is rows x seq-len
+                # compiles — long enough on a cold cache to trip the
+                # serving watchdog without heartbeats.
+                self._hb.write(0, force=True)
+        s_total = self.max_slots + 1
+        k, v, nxt, _ = self._decode_fn(
+            params, self._kv[0], self._kv[1],
+            np.zeros((s_total,), np.int32), np.zeros((s_total,), np.int32))
+        nxt.block_until_ready()
+        self._kv = (k, v)
+        self._hb.write(0, force=True)
+        self._positions = np.zeros((s_total,), np.int32)
+        self._last_tokens = np.zeros((s_total,), np.int32)
+        # run()'s preempt-before-first-load check keys on _apply: mark
+        # the decode path loaded (never called — dispatch goes through
+        # _prefill_fn/_decode_fn).
+        self._apply = self._decode_fn
         self._live = (params, step)
 
     # ----------------------------------------------------------- follower
@@ -502,10 +718,25 @@ class InferenceServer:
             return self._inflight
 
     def pad_efficiency(self) -> float | None:
+        """Combined useful/dispatched cells over BOTH grid dimensions:
+        rows for classifiers (token counters stay zero, so this is the
+        round-18 row ratio unchanged), rows + tokens for the decode
+        path (prefill cells and decode-tick slot occupancy)."""
         with self._stats_lock:
-            if not self._rows_padded:
+            denom = self._rows_padded + self._tokens_padded
+            if not denom:
                 return None
-            return self._rows_useful / self._rows_padded
+            return (self._rows_useful + self._tokens_useful) / denom
+
+    def _pad_split(self) -> tuple[float | None, float | None]:
+        """(row-padding efficiency, token-padding efficiency) — the 2-D
+        ladder's two wins, separately visible (exp_serve reports both)."""
+        with self._stats_lock:
+            rows = (self._rows_useful / self._rows_padded
+                    if self._rows_padded else None)
+            toks = (self._tokens_useful / self._tokens_padded
+                    if self._tokens_padded else None)
+            return rows, toks
 
     def _write_stats(self) -> None:
         if not self._stats_path:
@@ -532,9 +763,23 @@ class InferenceServer:
                 "batches_total": self._batches,
                 "rows_useful": self._rows_useful,
                 "rows_padded": self._rows_padded,
+                "tokens_useful": self._tokens_useful,
+                "tokens_padded": self._tokens_padded,
                 "pad_efficiency": (
+                    round((self._rows_useful + self._tokens_useful)
+                          / (self._rows_padded + self._tokens_padded), 4)
+                    if self._rows_padded + self._tokens_padded else None),
+                "pad_efficiency_rows": (
                     round(self._rows_useful / self._rows_padded, 4)
                     if self._rows_padded else None),
+                "pad_efficiency_tokens": (
+                    round(self._tokens_useful / self._tokens_padded, 4)
+                    if self._tokens_padded else None),
+                "tokens_total": self._tokens_total,
+                "decode_steps": self._decode_steps,
+                "reprefills": self._reprefills,
+                "active_slots": self._active_now,
+                "max_slots": (self.max_slots if self.generative else 0),
                 "loaded_step": self.loaded_step,
                 "latency_p50_ms": lat[len(lat) // 2] if lat else None,
                 "latency_p99_ms": lat[int(len(lat) * 0.99)] if lat else None,
@@ -586,6 +831,285 @@ class InferenceServer:
                 self._shift_inflight(-len(batch))
                 continue
             self.slot.put(_Staged(batch, padded, n, bucket))
+
+    # -------------------------------------------------------- decode loop
+
+    def _assemble_decode_loop(self) -> None:
+        """Stage 1 for generative models (host-only): validate prompt
+        rows, SORT them ascending by length (admission chunks re-tighten
+        their token bucket, so short prompts never pay a long peer's
+        padding), and pack the token dimension to the smallest seq
+        bucket. The depth-1 slot discipline is unchanged."""
+        import numpy as np
+
+        while True:
+            batch = self.queue.take_batch()
+            if batch is None:
+                self.slot.close()
+                return
+            if not batch:
+                if self.stop.is_set():
+                    self.queue.close()
+                continue
+            try:
+                refs = []
+                for item in batch:
+                    item.result = [None] * len(item.rows)
+                    item.unfinished = len(item.rows)
+                    for r, row in enumerate(item.rows):
+                        refs.append((item, r, row))
+                refs.sort(key=lambda x: len(x[2]))
+                longest = max(len(row) for _, _, row in refs)
+                tb = select_bucket(longest, self.seq_buckets)
+                n = len(refs)
+                tokens = np.zeros((n, tb), np.int32)
+                lengths = np.zeros((n,), np.int32)
+                max_new = np.zeros((n,), np.int32)
+                for j, (item, _r, row) in enumerate(refs):
+                    arr = np.asarray(row, np.int32)
+                    if arr.ndim != 1 or arr.size == 0:
+                        raise ValueError(
+                            "each instance must be a non-empty token list")
+                    tokens[j, :arr.size] = arr
+                    lengths[j] = arr.size
+                    max_new[j] = item.max_new
+            except Exception as e:  # noqa: BLE001 — reported per request
+                for item in batch:
+                    item.error = f"{type(e).__name__}: {e}"
+                    item.event.set()
+                self._shift_inflight(-len(batch))
+                continue
+            self.slot.put(_StagedDecode(
+                tokens, lengths, max_new,
+                [(item, r) for item, r, _row in refs], tb))
+
+    def _retire_seq(self, slot_id: int, seq: _Seq, active: dict,
+                    free: list[int], step: int | None) -> None:
+        """Free the slot and fold the finished row into its request;
+        the LAST row of a request answers it (latency, inflight,
+        served)."""
+        item = seq.item
+        item.result[seq.row] = list(seq.generated)
+        item.unfinished -= 1
+        del active[slot_id]
+        free.append(slot_id)
+        if item.unfinished <= 0:
+            item.step = step
+            ms = (time.monotonic() - item.t_in) * 1000.0
+            self.m_latency.observe(ms / 1000.0)
+            self._note_latency(ms)
+            with self._stats_lock:
+                self._served += 1
+            inflight = self._shift_inflight(-1)
+            self.m_inflight.set(float(max(0, inflight)))
+            item.event.set()
+
+    def _admit(self, staged: _StagedDecode, cursor: int, free: list[int],
+               active: dict, params, step: int | None) -> int:
+        """Prefill staged rows into free KV slots, chunked at row-bucket
+        granularity (each chunk re-selects its token bucket — the
+        assembler sorted rows by length). Returns the new row cursor.
+        Single-token requests retire at prefill."""
+        import numpy as np
+
+        while cursor < staged.n and free:
+            chunk = min(len(free), staged.n - cursor, self.buckets[-1])
+            rb = select_bucket(chunk, self.buckets)
+            lens_chunk = staged.lengths[cursor:cursor + chunk]
+            tb = select_bucket(int(lens_chunk.max()), self.seq_buckets)
+            tok = np.zeros((rb, tb), np.int32)
+            tok[:chunk] = staged.tokens[cursor:cursor + chunk, :tb]
+            lens = np.ones((rb,), np.int32)
+            lens[:chunk] = lens_chunk
+            # Pad the slot-id vector with the scratch slot: one compiled
+            # scatter per row bucket, and duplicate scratch writes are
+            # harmless (nothing is ever scheduled there).
+            ids = np.full((rb,), self.max_slots, np.int32)
+            taken = free[:chunk]
+            ids[:chunk] = taken
+            k, v, first, _ = self._prefill_fn(params, self._kv[0],
+                                              self._kv[1], tok, lens, ids)
+            self._kv = (k, v)
+            first = np.asarray(first)
+            del free[:chunk]
+            self._batches += 1
+            self.m_batch.observe(float(chunk))
+            with self._stats_lock:
+                self._rows_useful += chunk
+                self._rows_padded += rb
+                self._tokens_useful += int(lens_chunk.sum())
+                self._tokens_padded += rb * tb
+                self._tokens_total += chunk
+            self.m_tokens.inc(float(chunk))
+            for j, s in enumerate(taken):
+                item, row = staged.row_refs[cursor + j]
+                prompt_len = int(lens_chunk[j])
+                prompt = staged.tokens[cursor + j, :prompt_len].tolist()
+                seq = _Seq(item, row, prompt, int(first[j]),
+                           int(staged.max_new[cursor + j]))
+                self._positions[s] = prompt_len
+                self._last_tokens[s] = int(first[j])
+                active[s] = seq
+                if seq.remaining <= 0:
+                    self._retire_seq(s, seq, active, free, step)
+            cursor += chunk
+            if not self.continuous:
+                break  # run-to-completion: one admission per drain
+        return cursor
+
+    def _reprefill_active(self, params, active: dict) -> None:
+        """Rebuild every in-flight sequence's KV state under freshly
+        swapped params: prefill (prompt + generated so far, minus the
+        still-unfed last token) back into the SAME slots. Committed
+        tokens stand; the attention state restarts cleanly — a sequence
+        never decodes over KV another params version wrote."""
+        import numpy as np
+
+        slots = sorted(active)
+        i = 0
+        while i < len(slots):
+            group = slots[i:i + self.buckets[-1]]
+            rb = select_bucket(len(group), self.buckets)
+            ctx_lens = [len(active[s].prompt) + len(active[s].generated) - 1
+                        for s in group]
+            tb = select_bucket(max(ctx_lens), self.seq_buckets)
+            tok = np.zeros((rb, tb), np.int32)
+            lens = np.ones((rb,), np.int32)
+            ids = np.full((rb,), self.max_slots, np.int32)
+            for j, s in enumerate(group):
+                seq = active[s]
+                ctx = seq.prompt + seq.generated[:-1]
+                tok[j, :len(ctx)] = ctx
+                lens[j] = len(ctx)
+                ids[j] = s
+            k, v, _first, _ = self._prefill_fn(params, self._kv[0],
+                                               self._kv[1], tok, lens, ids)
+            self._kv = (k, v)
+            self._batches += 1
+            with self._stats_lock:
+                self._tokens_useful += sum(ctx_lens)
+                self._tokens_padded += rb * tb
+            i += len(group)
+        with self._stats_lock:
+            self._reprefills += 1
+
+    def _decode_tick(self, params, step: int | None, active: dict,
+                     free: list[int]) -> None:
+        """One decode step over all slots: feed each slot's last token at
+        its position, append the greedy next token to every ACTIVE
+        sequence, retire the ones that hit their cap."""
+        import numpy as np
+
+        k, v, nxt, _ = self._decode_fn(params, self._kv[0], self._kv[1],
+                                       self._last_tokens, self._positions)
+        self._kv = (k, v)
+        nxt = np.asarray(nxt)
+        self._batches += 1
+        n_active = len(active)
+        with self._stats_lock:
+            self._decode_steps += 1
+            self._tokens_total += n_active
+            self._tokens_useful += n_active
+            self._tokens_padded += self.max_slots + 1
+        self.m_decode_steps.inc()
+        self.m_tokens.inc(float(n_active))
+        for s in sorted(active):
+            seq = active[s]
+            tok = int(nxt[s])
+            seq.generated.append(tok)
+            seq.remaining -= 1
+            self._positions[s] += 1
+            self._last_tokens[s] = tok
+            if seq.remaining <= 0:
+                self._retire_seq(s, seq, active, free, step)
+
+    def _fail_rows(self, rows: list[tuple], e: Exception) -> None:
+        """Report a scheduler error to every (item, ...) row ref exactly
+        once, answering each request when its last row fails."""
+        msg = f"{type(e).__name__}: {e}"
+        done = []
+        for ref in rows:
+            item = ref[0]
+            if item.error is None:
+                item.error = msg
+            item.unfinished -= 1
+            if item.unfinished <= 0 and not item.event.is_set():
+                done.append(item)
+        for item in done:
+            self._shift_inflight(-1)
+            item.event.set()
+
+    def _dispatch_decode_loop(self) -> None:
+        """Stage 2 for generative models — the persistent decode
+        scheduler on the ONE XLA-dispatching thread. Per iteration:
+        pick up staged work, land a pending params swap (re-prefilling
+        in-flight state first), admit rows into free slots, then one
+        decode tick over all active slots. Continuous batching is
+        exactly this loop shape: admission happens BETWEEN ticks, so a
+        retiring short request's slot is refilled while long peers keep
+        decoding. The (params, step) pair is read once per iteration —
+        a follower swap can never tear a tick."""
+        last_stats = 0.0
+        staged: _StagedDecode | None = None
+        cursor = 0
+        active: dict[int, _Seq] = {}
+        free = list(range(self.max_slots))
+        params, step = self._live
+        while True:
+            if staged is None:
+                got = self.slot.take(timeout_s=0.0 if active else 0.05)
+                if got is not None:
+                    staged, cursor = got, 0
+                elif self.slot.is_closed() and not active:
+                    break
+            new_params, new_step = self._live
+            if new_params is not params:
+                try:
+                    if active:
+                        self._reprefill_active(new_params, active)
+                except Exception as e:  # noqa: BLE001 — per-request report
+                    self._fail_rows([(seq.item,) for seq in
+                                     active.values()], e)
+                    for s in list(active):
+                        del active[s]
+                        free.append(s)
+                params, step = new_params, new_step
+            if (staged is not None and free
+                    and (self.continuous or not active)):
+                try:
+                    cursor = self._admit(staged, cursor, free, active,
+                                         params, step)
+                except Exception as e:  # noqa: BLE001 — per-request report
+                    self._fail_rows(staged.row_refs[cursor:], e)
+                    staged = None
+                else:
+                    if cursor >= staged.n:
+                        staged = None
+            if active:
+                try:
+                    self._decode_tick(params, step, active, free)
+                except Exception as e:  # noqa: BLE001 — per-request report
+                    self._fail_rows([(seq.item,) for seq in
+                                     active.values()], e)
+                    for s in list(active):
+                        del active[s]
+                        free.append(s)
+                if self._chaos is not None:
+                    self._chaos.maybe_kill(self._batches, 0)
+            with self._stats_lock:
+                self._active_now = len(active)
+            self.m_active.set(float(len(active)))
+            pad_eff = self.pad_efficiency()
+            if pad_eff is not None:
+                self.m_pad_eff.set(round(pad_eff, 4))
+            _rows_eff, tok_eff = self._pad_split()
+            if tok_eff is not None:
+                self.m_tok_pad.set(round(tok_eff, 4))
+            self._hb.write(self._batches)
+            now = time.monotonic()
+            if now - last_stats > 0.25:
+                self._write_stats()
+                last_stats = now
 
     def _dispatch_loop(self) -> None:
         """Stage 2 — the ONE XLA-dispatching thread: jitted forward at
@@ -671,6 +1195,7 @@ class InferenceServer:
 
             def do_GET(self):  # noqa: N802
                 if self.path == "/healthz":
+                    rows_eff, tok_eff = server._pad_split()
                     self._send({
                         "ok": server.ready.is_set(),
                         "model": server.model_name,
@@ -681,6 +1206,15 @@ class InferenceServer:
                         "rows_useful": server._rows_useful,
                         "rows_padded": server._rows_padded,
                         "pad_efficiency": server.pad_efficiency(),
+                        "pad_efficiency_rows": rows_eff,
+                        "pad_efficiency_tokens": tok_eff,
+                        "generative": server.generative,
+                        "seq_buckets": list(server.seq_buckets),
+                        "active_slots": server._active_now,
+                        "max_slots": (server.max_slots
+                                      if server.generative else 0),
+                        "tokens_total": server._tokens_total,
+                        "decode_steps": server._decode_steps,
                     }, 200 if server.ready.is_set() else 503)
                 elif self.path == "/metrics":
                     self._send({}, raw=metrics_mod.DEFAULT.expose())
@@ -701,7 +1235,35 @@ class InferenceServer:
                     return self._send(
                         {"error": "body must be "
                                   '{"instances": [[...], ...]}'}, 400)
-                item = _Pending(rows)
+                if server.generative:
+                    raw_new = req.get("maxNewTokens")
+                    try:
+                        max_new = (server.max_new_tokens if raw_new is None
+                                   else max(1, min(int(raw_new),
+                                                   server.max_new_tokens)))
+                    except (TypeError, ValueError):
+                        return self._send(
+                            {"error": "maxNewTokens must be an integer"},
+                            400)
+                    vocab = server._vocab or 1
+                    for row in rows:
+                        if (not isinstance(row, list) or not row
+                                or not all(isinstance(t, int)
+                                           and 0 <= t < vocab
+                                           for t in row)):
+                            return self._send(
+                                {"error": "each instance must be a "
+                                          "non-empty list of token ids in "
+                                          f"[0, {vocab})"}, 400)
+                        if len(row) + max_new > server.max_seq_len:
+                            return self._send(
+                                {"error": f"prompt of {len(row)} tokens + "
+                                          f"maxNewTokens {max_new} exceeds "
+                                          "maxSequenceLength "
+                                          f"{server.max_seq_len}"}, 400)
+                    item = _Pending(rows, max_new=max_new)
+                else:
+                    item = _Pending(rows)
                 with server._stats_lock:
                     server._requests += 1
                 inflight = server._shift_inflight(+1)
@@ -730,9 +1292,13 @@ class InferenceServer:
         mode). Split out of run() so tests can drive the real pipeline
         with a stubbed _apply."""
         threads = [
-            threading.Thread(target=self._assemble_loop,
+            threading.Thread(target=(self._assemble_decode_loop
+                                     if self.generative
+                                     else self._assemble_loop),
                              name="serve-assembler", daemon=True),
-            threading.Thread(target=self._dispatch_loop,
+            threading.Thread(target=(self._dispatch_decode_loop
+                                     if self.generative
+                                     else self._dispatch_loop),
                              name="serve-dispatch", daemon=True),
         ]
         if self.follow:
@@ -784,9 +1350,18 @@ class InferenceServer:
         self._write_stats()
         _emit({"event": "serve_ready", "t": time.time(),
                "checkpoint_step": self.loaded_step, "port": port,
-               "buckets": list(self.buckets), "follow": self.follow})
+               "buckets": list(self.buckets),
+               "seq_buckets": list(self.seq_buckets),
+               "max_slots": self.max_slots if self.generative else 0,
+               "continuous": self.continuous if self.generative else None,
+               "follow": self.follow})
+        decode_note = (f", seq_buckets={list(self.seq_buckets)}, "
+                       f"slots={self.max_slots}, "
+                       f"continuous={int(self.continuous)}"
+                       if self.generative else "")
         print(f"serving {self.model_name} step {self.loaded_step} on "
               f"127.0.0.1:{port} (buckets={list(self.buckets)}"
+              f"{decode_note}"
               f"{', following' if self.follow else ''})", flush=True)
         while not self.stop.is_set():
             self.stop.wait(timeout=0.5)
@@ -829,6 +1404,26 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--follow-poll-s", type=float,
                     default=float(env.get("TPUJOB_SERVE_FOLLOW_POLL_S",
                                           "2.0")))
+    ap.add_argument("--max-seq-len", type=int,
+                    default=int(env.get("TPUJOB_SERVE_MAX_SEQ_LEN", "256")),
+                    help="context window (prompt + generated) for "
+                         "generative models; clamped to the checkpoint's "
+                         "position table")
+    ap.add_argument("--max-new-tokens", type=int,
+                    default=int(env.get("TPUJOB_SERVE_MAX_NEW_TOKENS",
+                                        "64")),
+                    help="per-request generation ceiling (generative "
+                         "models)")
+    ap.add_argument("--max-concurrent-seqs", type=int,
+                    default=int(env.get("TPUJOB_SERVE_MAX_CONCURRENT_SEQS",
+                                        "8")),
+                    help="KV-cache slots per replica — the decode "
+                         "scheduler's admission capacity")
+    ap.add_argument("--continuous", type=int, choices=(0, 1),
+                    default=int(env.get("TPUJOB_SERVE_CONTINUOUS", "1")),
+                    help="1 = continuous batching (admit between decode "
+                         "ticks, default), 0 = the run-to-completion "
+                         "baseline")
     args = ap.parse_args(argv)
     if not args.checkpoint_dir:
         print("error: --checkpoint-dir (or TPUJOB_SERVE_CHECKPOINT_DIR) "
@@ -839,7 +1434,11 @@ def main(argv: list[str] | None = None) -> int:
         args.batch_max_size, args.batch_timeout_ms,
         replica=env.get("TPUJOB_POD_NAME", ""),
         bucketing=bool(args.bucketing), follow=bool(args.follow),
-        follow_poll_s=args.follow_poll_s)
+        follow_poll_s=args.follow_poll_s,
+        max_seq_len=args.max_seq_len,
+        max_new_tokens=args.max_new_tokens,
+        max_slots=args.max_concurrent_seqs,
+        continuous=bool(args.continuous))
     try:
         return server.run()
     except FileNotFoundError as e:

@@ -422,10 +422,37 @@ serve_latency_seconds = DEFAULT.histogram(
 )
 serve_pad_efficiency = DEFAULT.gauge(
     "tpujob_serve_pad_efficiency",
-    "Useful rows / padded rows dispatched by a serving replica "
-    "(cumulative; 1.0 = every padded slot carried a real row). The "
-    "shape-bucketing win signal: pad-to-max under light load reads "
-    "1/batchMaxSize, bucketed reads near 1.0",
+    "Useful units / padded units dispatched by a serving replica "
+    "(cumulative; 1.0 = every padded slot carried real work). "
+    "Classifiers count rows; generative models count rows + tokens, so "
+    "this is the combined 2-D bucketing win signal. Pad-to-max under "
+    "light load reads 1/batchMaxSize, bucketed reads near 1.0",
+    labels_only=True,
+)
+serve_token_pad_efficiency = DEFAULT.gauge(
+    "tpujob_serve_token_pad_efficiency",
+    "Token-dimension slice of pad efficiency on a generative replica: "
+    "useful tokens / padded token slots across prefill (seq-len "
+    "bucketing win) and decode ticks (slot occupancy)",
+    labels_only=True,
+)
+serve_tokens_total = DEFAULT.counter(
+    "tpujob_serve_tokens_total",
+    "Tokens generated by a serving replica (prefill first-tokens + one "
+    "per active slot per decode tick) — the numerator of tokens/sec",
+    labels_only=True,
+)
+serve_decode_steps_total = DEFAULT.counter(
+    "tpujob_serve_decode_steps_total",
+    "Decode ticks executed by the continuous-batching scheduler (each "
+    "tick advances every active KV slot by one token)",
+    labels_only=True,
+)
+serve_active_slots = DEFAULT.gauge(
+    "tpujob_serve_active_slots",
+    "KV-cache slots holding an in-flight sequence on a generative "
+    "replica (of serving.maxConcurrentSequences) — feeds the router's "
+    "least-loaded choice and the autoscaler load signal",
     labels_only=True,
 )
 serve_router_requests_total = DEFAULT.counter(

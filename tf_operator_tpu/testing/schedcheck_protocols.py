@@ -520,6 +520,91 @@ def _router_timeout_demotion_model() -> schedcheck.Model:
 
 
 # --------------------------------------------------------------------------
+# decode scheduler (round 20): checkpoint-swap coherence under
+# continuous batching — the follower replaces the (params, step) pair
+# while the dispatcher is mid-drain; every decode tick must read KV
+# written by the SAME params (the scheduler re-prefills in-flight
+# sequences before ticking with swapped weights).
+
+
+def _decode_scheduler_model() -> schedcheck.Model:
+    def setup():
+        import numpy as np
+
+        from tf_operator_tpu.serve.server import InferenceServer, _Pending
+
+        s = _State()
+        srv = InferenceServer("transformer-lm", "/nope", 0, batch_max=4,
+                              batch_timeout_ms=1.0, replica="schedcheck",
+                              max_seq_len=32, max_new_tokens=32,
+                              max_slots=2)
+        # Stub device fns drive the REAL host scheduler: every call is
+        # logged so the invariant can assert ORDER. Only the dispatcher
+        # thread calls them, so the plain list needs no lock.
+        s.events = []
+
+        def prefill(params, k, v, tok, lens, ids):
+            s.events.append(("prefill", params))
+            return k, v, np.ones((tok.shape[0],), np.int32), None
+
+        def decode(params, k, v, last, positions):
+            s.events.append(("decode", params))
+            return k, v, last + 1, None
+
+        srv._prefill_fn = prefill
+        srv._decode_fn = decode
+        srv._kv = (np.zeros(1), np.zeros(1))
+        srv._positions = np.zeros((srv.max_slots + 1,), np.int32)
+        srv._last_tokens = np.zeros((srv.max_slots + 1,), np.int32)
+        s.old = ("step1-params",)
+        s.new = ("step2-params",)
+        srv._live = (s.old, 1)
+        s.item = _Pending([[7, 8]], max_new=3)
+        srv._shift_inflight(+1)
+        assert srv.queue.submit(s.item)
+        srv.queue.close()
+        s.srv = srv
+        return s
+
+    def follower(s):
+        # One atomic pair replacement, placed at every explored point of
+        # the drain: before admission, between prefill and the first
+        # tick, between ticks, after retirement.
+        schedcheck.sched_point("checkpoint-ready")
+        s.srv._live = (s.new, 2)
+
+    def inv(s):
+        assert s.item.error is None, s.item.error
+        # Stub chain is 1, 2, 3 regardless of where the swap landed — a
+        # re-prefill reloads KV without touching generated tokens.
+        assert s.item.result[0] == [1, 2, 3], s.item.result
+        last_prefill = None
+        for ev in s.events:
+            if ev[0] == "prefill":
+                last_prefill = ev[1]
+            else:
+                assert ev[1] is last_prefill, (
+                    f"decode tick under {ev[1]} against KV prefilled by "
+                    f"{last_prefill}: params swap landed without "
+                    f"re-prefill (events: {s.events})")
+        assert s.srv._inflight == 0, "request retired but still in flight"
+
+    def dispatcher(s):
+        s.srv._dispatch_decode_loop()
+
+    return schedcheck.Model(
+        name="decode-scheduler-swap",
+        setup=setup,
+        threads=[("assembler", lambda s: s.srv._assemble_decode_loop()),
+                 ("dispatcher", dispatcher),
+                 ("follower", follower)],
+        invariant=inv,
+        preemptions=2,  # the drain loop is sched-point dense: p2 is CI-sized
+        describe="mid-decode checkpoint swap re-prefills before ticking",
+    )
+
+
+# --------------------------------------------------------------------------
 # registry
 
 
@@ -539,6 +624,7 @@ def build_models() -> dict[str, schedcheck.Model]:
                       expect="race"),
         _router_cold_backend_model(),
         _router_timeout_demotion_model(),
+        _decode_scheduler_model(),
         _lost_wakeup_model(),
     ]
     return {m.name: m for m in models}

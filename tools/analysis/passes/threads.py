@@ -105,9 +105,19 @@ def _self_method(module, scope: str, dotted: str) -> str | None:
     return qual if qual in module.functions else None
 
 
+def _target_exprs(value: ast.AST) -> list[ast.AST]:
+    """Flatten a `target=` expression into its candidate callables: a
+    conditional target (`self._a if flag else self._b` — how the serve
+    pipeline picks its decode vs classifier stages) roots BOTH arms."""
+    if isinstance(value, ast.IfExp):
+        return _target_exprs(value.body) + _target_exprs(value.orelse)
+    return [value]
+
+
 def _thread_roots(project: Project) -> list[tuple]:
     """(module, target_qualname) for every Thread(target=...) in the root
-    modules — plain-function targets and `self._method` targets both."""
+    modules — plain-function targets, `self._method` targets, and every
+    arm of a conditional target."""
     roots = []
     for mname in ROOT_MODULES:
         module = project.modules.get(mname)
@@ -125,17 +135,19 @@ def _thread_roots(project: Project) -> list[tuple]:
             for kw in node.keywords:
                 if kw.arg != "target":
                     continue
-                target = dotted_of(kw.value)
-                if target is None:
-                    continue
-                scope = _scope_of(module, node)
-                mqual = _self_method(module, scope, target)
-                if mqual is not None:
-                    roots.append((module, mqual))
-                    continue
-                tkind, tmod, tqual = project.resolve(module, scope, target)
-                if tkind == FUNC:
-                    roots.append((tmod, tqual))
+                for expr in _target_exprs(kw.value):
+                    target = dotted_of(expr)
+                    if target is None:
+                        continue
+                    scope = _scope_of(module, node)
+                    mqual = _self_method(module, scope, target)
+                    if mqual is not None:
+                        roots.append((module, mqual))
+                        continue
+                    tkind, tmod, tqual = project.resolve(module, scope,
+                                                         target)
+                    if tkind == FUNC:
+                        roots.append((tmod, tqual))
     return roots
 
 
