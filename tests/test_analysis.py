@@ -501,6 +501,39 @@ class TestSchemaDrift:
         assert any(f.rule == "TPS403" and "TPUSpec.slices" in f.key
                    for f in found), [f.render() for f in found]
 
+    def test_aging_drift_guarded(self):
+        # Round-17 fixture pair: schedulingPolicy.agingSeconds (priority
+        # aging) must stay in sync across types -> compat parse/emit ->
+        # CRD on the TrainJob root. BAD direction: drop the emit line /
+        # blank the parse / rename the CRD property and the pass must
+        # fail each one.
+        types, compat, validation, crd = self._real()
+        assert schema.analyze_schema(types, compat, validation, crd) == []
+        no_emit = "\n".join(
+            ln for ln in compat.splitlines()
+            if '"agingSeconds": rp.scheduling.aging_seconds' not in ln)
+        assert no_emit != compat, "fixture went stale (emit line moved)"
+        found = schema.analyze_schema(types, no_emit, validation, crd)
+        assert any(f.rule == "TPS402"
+                   and f.key == "schema-emit::SchedulingPolicy.aging_seconds"
+                   for f in found), [f.render() for f in found]
+        # the TrainJob parse line only (the infsvc parser reads the same
+        # wire string at a deeper indent and must stay untouched)
+        no_parse = compat.replace(
+            '            aging_seconds=sched_d.get("agingSeconds"),',
+            "            aging_seconds=None,")
+        assert no_parse != compat, "fixture went stale (parse line moved)"
+        found = schema.analyze_schema(types, no_parse, validation, crd)
+        assert any(f.rule == "TPS401"
+                   and "SchedulingPolicy.aging_seconds" in f.key
+                   for f in found), [f.render() for f in found]
+        no_crd = crd.replace("agingSeconds:", "renamedKnob:")
+        assert no_crd != crd, "fixture went stale (CRD property moved)"
+        found = schema.analyze_schema(types, compat, validation, no_crd)
+        assert any(f.rule == "TPS403"
+                   and "SchedulingPolicy.aging_seconds" in f.key
+                   for f in found), [f.render() for f in found]
+
     def test_new_types_field_without_wire_fails(self):
         # the forward direction: grow types.py, forget compat -> fail
         types, compat, validation, crd = self._real()
@@ -582,6 +615,36 @@ class TestSchemaDrift:
             f.rule == "TPS403"
             and "AutoscaleSpec.scale_down_stabilization_seconds" in f.key
             for f in found), [f.render() for f in found]
+
+    def test_infsvc_aging_drift_guarded(self):
+        # Round-17: agingSeconds rides the SHARED SchedulingPolicy, so
+        # the infsvc root needs its own emit/parse/CRD guard — serving
+        # replicas age in the same fleet queue train jobs do.
+        _, compat, _, _ = self._real()
+        no_emit = "\n".join(
+            ln for ln in compat.splitlines()
+            if '"agingSeconds": spec.scheduling.aging_seconds' not in ln)
+        assert no_emit != compat, "fixture went stale (emit line moved)"
+        found = self._infsvc(compat=no_emit)
+        assert any(f.rule == "TPS402"
+                   and f.key == "schema-emit::SchedulingPolicy.aging_seconds"
+                   for f in found), [f.render() for f in found]
+        # the infsvc parse line only (deeper indent than the TrainJob one)
+        no_parse = compat.replace(
+            '                aging_seconds=sched_d.get("agingSeconds"),',
+            "                aging_seconds=None,")
+        assert no_parse != compat, "fixture went stale (parse line moved)"
+        found = self._infsvc(compat=no_parse)
+        assert any(f.rule == "TPS401"
+                   and "SchedulingPolicy.aging_seconds" in f.key
+                   for f in found), [f.render() for f in found]
+        infsvc_crd = (REPO / "manifests/inferenceservice-crd.yaml").read_text()
+        no_crd = infsvc_crd.replace("agingSeconds:", "renamedKnob:")
+        assert no_crd != infsvc_crd, "fixture went stale (CRD moved)"
+        found = self._infsvc(crd=no_crd)
+        assert any(f.rule == "TPS403"
+                   and "SchedulingPolicy.aging_seconds" in f.key
+                   for f in found), [f.render() for f in found]
 
     def test_follow_and_bucketing_drift_guarded(self):
         # Round-18 fixture pair: model.follow/followPollSeconds +

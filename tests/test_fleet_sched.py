@@ -260,6 +260,87 @@ class TestFairShareQueue:
         assert order == ["a/heavy", "a/light"]
 
 
+# ------------------------------------------------------------ priority aging
+
+
+class TestPriorityAging:
+    """schedulingPolicy.agingSeconds (round 17): a waiting entry's
+    effective priority grows +1 per agingSeconds elapsed since submit, so
+    long-waiting low-priority work eventually outranks a steady stream of
+    fresh higher-class arrivals. Opt-in: with the knob unset, ranking is
+    bit-for-bit today's strict class order."""
+
+    @staticmethod
+    def entry(key, prio, t=0.0, aging=None):
+        return QueueEntry(key=key, namespace="default", queue="default",
+                          priority=prio, topology="v5e-8", submit_time=t,
+                          aging_seconds=aging)
+
+    def test_aged_entry_outranks_fresh_higher_class(self):
+        q = FairShareQueue()
+        q.submit(self.entry("a/aged-low", 100, t=0.0, aging=1.0))
+        q.submit(self.entry("a/fresh-high", 500, t=400.0))
+        # at t=300 the aged entry is still behind (100 + 300 < 500)...
+        order = [e.key for e in q.ranked({}, lambda _: 1.0, now=300.0)]
+        assert order == ["a/fresh-high", "a/aged-low"]
+        # ...at t=500 it has accrued past the fresh arrival's class value
+        order = [e.key for e in q.ranked({}, lambda _: 1.0, now=500.0)]
+        assert order == ["a/aged-low", "a/fresh-high"]
+
+    def test_unset_knob_never_reranks(self):
+        q = FairShareQueue()
+        q.submit(self.entry("a/old-low", 100, t=0.0))
+        q.submit(self.entry("a/new-high", 500, t=1e6))
+        order = [e.key for e in q.ranked({}, lambda _: 1.0, now=1e9)]
+        assert order == ["a/new-high", "a/old-low"]
+        assert self.entry("a/x", 100).effective_priority(1e9) == 100
+
+    def test_aging_bound_is_class_gap_times_knob(self):
+        # the wait before a low entry outranks class value V is bounded
+        # by (V - priority) * agingSeconds — the knob's contract
+        e = self.entry("a/x", 100, t=0.0, aging=2.0)
+        assert e.effective_priority(799.9) < 500
+        assert e.effective_priority(800.0) == 500
+
+    def test_scheduler_admits_aged_waiter_first(self):
+        t = [0.0]
+        s = FleetScheduler(SliceAllocator.of("v5e-8"),
+                           thrash_free_policy(), clock=lambda: t[0])
+        blocker = make_slice_job("blocker", "high")
+        assert s.decide(blocker).admit
+        aged = make_slice_job("aged", "low")
+        aged.spec.run_policy.scheduling.aging_seconds = 1.0
+        assert not s.decide(aged).admit  # queued at t=0
+        pol = s.policy
+        gap = (pol.resolve("normal").value - pol.resolve("low").value)
+        t[0] = gap + 1.0
+        fresh = make_slice_job("fresh", "normal")
+        assert not s.decide(fresh).admit
+        # the aged low job now outranks the fresh normal one: when the
+        # slice frees, IT is the kick target and the one admitted
+        s.release("default/blocker")
+        targets = s.kick_targets()
+        assert targets and targets[0] == "default/aged"
+        assert s.decide(aged).admit
+        assert not s.decide(fresh).admit
+
+    def test_views_surface_effective_priority(self):
+        t = [0.0]
+        s = FleetScheduler(SliceAllocator.of("v5e-8"),
+                           thrash_free_policy(), clock=lambda: t[0])
+        assert s.decide(make_slice_job("blocker", "high")).admit
+        aged = make_slice_job("aged", "low")
+        aged.spec.run_policy.scheduling.aging_seconds = 2.0
+        assert not s.decide(aged).admit
+        base = s.policy.resolve("low").value
+        t[0] = 10.0
+        view = s.job_view("default/aged")
+        assert view["effectivePriority"] == base + 5
+        waiting = s.snapshot()["waiting"]
+        mine = [w for w in waiting if w["key"] == "default/aged"]
+        assert mine and mine[0]["effectivePriority"] == base + 5
+
+
 # --------------------------------------------------------- scheduler engine
 
 

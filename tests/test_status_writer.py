@@ -1,0 +1,219 @@
+"""StatusWriter contract (round 17): dirty tracking, the opt-in
+coalescing window, urgency, and the lister-snapshot fence — plus the
+substrate-level no-op skip and resourceVersion fence on InMemoryCluster.
+
+The K8s-wire side of the same contract (one diffed merge-patch per dirty
+sync wave, zero requests on a no-op wave, 409 on a stale fenced flush)
+lives in test_k8s.py::TestCoalescedStatusWrites.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tf_operator_tpu.api import defaults
+from tf_operator_tpu.api.types import (
+    ContainerSpec,
+    InferenceService,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    ReplicaType,
+    TrainJob,
+    TrainJobSpec,
+)
+from tf_operator_tpu.core.cluster import ConflictError, InMemoryCluster
+from tf_operator_tpu.core.status_writer import _DEFER_SLACK_S, StatusWriter
+
+
+def _job(name: str = "j") -> TrainJob:
+    job = TrainJob(
+        metadata=ObjectMeta(name=name),
+        spec=TrainJobSpec(replica_specs={
+            ReplicaType.WORKER: ReplicaSpec(
+                replicas=1,
+                template=PodTemplateSpec(containers=[
+                    ContainerSpec(name="tensorflow", image="img:1")]),
+            )
+        }),
+    )
+    defaults.set_defaults(job)
+    return job
+
+
+class _Recorder:
+    """Stands in for cluster.update_job_status."""
+
+    def __init__(self):
+        self.calls: list[tuple] = []
+
+    def __call__(self, obj, *, expected_rv=None, base=None):
+        self.calls.append((obj, expected_rv, base))
+        return obj
+
+
+class _Clock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class TestStatusWriter:
+    def test_noop_flush_writes_nothing(self):
+        upd = _Recorder()
+        w = StatusWriter(upd, kind=TrainJob.KIND)
+        job = _job()
+        base = job.deep_copy()
+        assert w.flush(job, base) is job
+        assert upd.calls == []
+
+    def test_dirty_flush_writes_once_unfenced(self):
+        upd = _Recorder()
+        w = StatusWriter(upd, kind=TrainJob.KIND)
+        job = _job()
+        base = job.deep_copy()
+        job.status.start_time = 1.0
+        w.flush(job, base)
+        assert len(upd.calls) == 1
+        _, expected_rv, got_base = upd.calls[0]
+        assert expected_rv is None  # read-through substrate: no fence
+        assert got_base is base
+
+    def test_annotation_only_change_is_dirty(self):
+        upd = _Recorder()
+        w = StatusWriter(upd, kind=TrainJob.KIND)
+        job = _job()
+        base = job.deep_copy()
+        job.metadata.annotations["slice"] = "3"
+        w.flush(job, base)
+        assert len(upd.calls) == 1
+
+    def test_fence_carries_observed_rv(self):
+        upd = _Recorder()
+        w = StatusWriter(upd, kind=TrainJob.KIND, fence=True)
+        job = _job()
+        job.metadata.resource_version = 42
+        base = job.deep_copy()
+        job.status.start_time = 1.0
+        w.flush(job, base)
+        assert upd.calls[0][1] == 42
+
+    def test_window_defers_then_flushes_after_deadline(self):
+        upd = _Recorder()
+        clock = _Clock(100.0)
+        deferred: list[tuple[str, float]] = []
+        w = StatusWriter(upd, kind=TrainJob.KIND, window=30.0, clock=clock,
+                         defer=lambda k, d: deferred.append((k, d)))
+        job = _job()
+        base = job.deep_copy()
+        job.status.start_time = 1.0
+        # first dirty sync: deferred, nothing written, requeued for just
+        # past the window
+        assert w.flush(job, base) is job
+        assert upd.calls == []
+        assert deferred == [("default/j", 30.0 + _DEFER_SLACK_S)]
+        # window expired -> the recomputed dirt flushes
+        clock.t = 130.1
+        w.flush(job, base)
+        assert len(upd.calls) == 1
+
+    def test_window_deadline_is_first_dirty_not_last(self):
+        """A steadily-mutating job must not defer forever: the deadline is
+        first-dirty + window, so a sync landing after that writes even if
+        the previous dirty sync was recent."""
+        upd = _Recorder()
+        clock = _Clock(0.0)
+        w = StatusWriter(upd, kind=TrainJob.KIND, window=10.0, clock=clock,
+                         defer=lambda k, d: None)
+        job = _job()
+        base = job.deep_copy()
+        job.status.start_time = 1.0
+        w.flush(job, base)          # t=0: first dirty, deferred
+        clock.t = 9.9
+        w.flush(job, base)          # still inside the window
+        assert upd.calls == []
+        clock.t = 10.0
+        w.flush(job, base)          # deadline hit despite recent dirt
+        assert len(upd.calls) == 1
+
+    def test_urgent_bypasses_window(self):
+        upd = _Recorder()
+        w = StatusWriter(upd, kind=TrainJob.KIND, window=3600.0,
+                         clock=_Clock(), defer=lambda k, d: None)
+        job = _job()
+        base = job.deep_copy()
+        job.status.completion_time = 5.0
+        w.flush(job, base, urgent=True)
+        assert len(upd.calls) == 1
+
+    def test_forget_restarts_the_window(self):
+        upd = _Recorder()
+        clock = _Clock(0.0)
+        deferred: list[tuple[str, float]] = []
+        w = StatusWriter(upd, kind=TrainJob.KIND, window=10.0, clock=clock,
+                         defer=lambda k, d: deferred.append((k, d)))
+        job = _job()
+        base = job.deep_copy()
+        job.status.start_time = 1.0
+        w.flush(job, base)           # t=0: opens the window
+        w.forget("default/j")        # object deleted and recreated
+        clock.t = 50.0
+        w.flush(job, base)           # fresh window, deferred again
+        assert upd.calls == []
+        assert deferred[-1] == ("default/j", 10.0 + _DEFER_SLACK_S)
+
+
+class TestInMemorySubstrate:
+    def test_noop_job_status_update_skips_write(self):
+        cluster = InMemoryCluster()
+        created = cluster.create_job(_job("noop"))
+        rv = created.metadata.resource_version
+        events: list = []
+        cluster.on_update(TrainJob.KIND,
+                          lambda *a: events.append(a))
+        back = cluster.update_job_status(created.deep_copy())
+        assert back.metadata.resource_version == rv  # no rv bump
+        assert events == []                          # no handler fire
+
+    def test_noop_infsvc_status_update_skips_write(self):
+        cluster = InMemoryCluster()
+        svc = InferenceService(metadata=ObjectMeta(name="s"))
+        created = cluster.create_infsvc(svc)
+        rv = created.metadata.resource_version
+        back = cluster.update_infsvc_status(created.deep_copy())
+        assert back.metadata.resource_version == rv
+
+    def test_fenced_job_status_update_conflicts_when_stale(self):
+        cluster = InMemoryCluster()
+        created = cluster.create_job(_job("fence"))
+        stale_rv = created.metadata.resource_version
+        # a concurrent writer lands first
+        other = created.deep_copy()
+        other.status.start_time = 1.0
+        cluster.update_job_status(other)
+        # the stale observation's flush must 409, not blind-overwrite
+        mine = created.deep_copy()
+        mine.status.start_time = 99.0
+        with pytest.raises(ConflictError):
+            cluster.update_job_status(mine, expected_rv=stale_rv)
+        got = cluster.get_job("default", "fence")
+        assert got.status.start_time == 1.0
+        # re-observed at the current rv, the same write goes through
+        mine.metadata.resource_version = got.metadata.resource_version
+        cluster.update_job_status(
+            mine, expected_rv=got.metadata.resource_version)
+        assert cluster.get_job("default", "fence").status.start_time == 99.0
+
+    def test_snapshot_is_read_only_view_of_store(self):
+        cluster = InMemoryCluster()
+        cluster.create_job(_job("a"))
+        cluster.create_job(_job("b"))
+        snap = cluster.snapshot_jobs()
+        assert {j.name for j in snap} == {"a", "b"}
+        # the snapshot serves the store's own objects (no deep copy) —
+        # that is the point: resyncs at 10k jobs must not pay O(jobs)
+        # deep copies per wave. Callers only read.
+        assert {id(o) for o in cluster.snapshot_jobs()} == {
+            id(o) for o in snap}

@@ -147,6 +147,7 @@ def job_from_dict(manifest: dict[str, Any], apply_defaults: bool = True) -> Trai
             queue=sched_d.get("queue", ""),
             priority_class=sched_d.get("priorityClass", ""),
             min_available=sched_d.get("minAvailable"),
+            aging_seconds=sched_d.get("agingSeconds"),
         ),
         recovery=RecoveryPolicy(
             # `or ""`: an explicit null (legacy emitters) means unresolved,
@@ -326,6 +327,7 @@ def infsvc_from_dict(manifest: dict[str, Any],
                 queue=sched_d.get("queue", ""),
                 priority_class=sched_d.get("priorityClass", ""),
                 min_available=sched_d.get("minAvailable"),
+                aging_seconds=sched_d.get("agingSeconds"),
             ),
         ),
     )
@@ -394,6 +396,7 @@ def infsvc_to_dict(svc) -> dict[str, Any]:
                 "queue": spec.scheduling.queue,
                 "priorityClass": spec.scheduling.priority_class,
                 "minAvailable": spec.scheduling.min_available,
+                "agingSeconds": spec.scheduling.aging_seconds,
             },
             "template": {
                 "metadata": {
@@ -542,6 +545,7 @@ def job_to_dict(job: TrainJob) -> dict[str, Any]:
                     # round-tripped through the API lost its priority.
                     "priorityClass": rp.scheduling.priority_class,
                     "minAvailable": rp.scheduling.min_available,
+                    "agingSeconds": rp.scheduling.aging_seconds,
                 },
                 "recovery": {
                     # omitempty: an unresolved policy serializes as ABSENT

@@ -221,6 +221,14 @@ class SchedulingPolicy:
     queue: str = ""
     priority_class: str = ""
     min_available: int | None = None  # default: sum of all replicas
+    # Effective-priority aging (opt-in): while the job waits in the fleet
+    # queue, its effective priority grows by +1 per agingSeconds of wait,
+    # so a starved low-priority waiter eventually outranks a stream of
+    # fresh high-priority arrivals — a provable starvation bound at
+    # 10k-job queue depth. None (default) = no aging, today's strict
+    # priority order bit-for-bit. Ordering only: preemption victim
+    # selection and quota math still use the declared class value.
+    aging_seconds: float | None = None
 
 
 @dataclass

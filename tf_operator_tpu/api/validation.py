@@ -94,6 +94,10 @@ def validate_spec(spec: TrainJobSpec, fleet=None) -> list[str]:
                 f"runPolicy.schedulingPolicy.priorityClass "
                 f"{sched.priority_class!r} names no PriorityClass in the "
                 f"fleet policy (known: {known})")
+    if sched.aging_seconds is not None and sched.aging_seconds <= 0:
+        problems.append(
+            f"runPolicy.schedulingPolicy.agingSeconds must be > 0, got "
+            f"{sched.aging_seconds}")
     # successPolicy reached validation unchecked until round 13 (the field
     # wasn't even wire-parsed; see compat.py) — a typo'd policy silently
     # fell back to the default success rule.
@@ -345,4 +349,8 @@ def validate_inference_service(svc, fleet=None) -> list[str]:
                     f"namespace {svc.metadata.namespace!r} has a zero "
                     f"ResourceQuota for TPU slices: no serving replica "
                     "can ever be admitted")
+    if sched.aging_seconds is not None and sched.aging_seconds <= 0:
+        problems.append(
+            f"schedulingPolicy.agingSeconds must be > 0, got "
+            f"{sched.aging_seconds}")
     return problems

@@ -319,14 +319,36 @@ class InMemoryCluster:
     def update_job(self, job: TrainJob) -> TrainJob:
         return self._update(KIND_JOB, job)
 
-    def update_job_status(self, job: TrainJob) -> TrainJob:
+    def update_job_status(self, job: TrainJob, *, expected_rv=None,
+                          base=None) -> TrainJob:
         """Status-subresource write: only .status (+ bookkeeping annotations)
-        are persisted from `job` (ref UpdateStatus, k8sutil/client.go:85)."""
+        are persisted from `job` (ref UpdateStatus, k8sutil/client.go:85).
+
+        Round 17 extensions (status_writer.py is the caller):
+        `expected_rv` fences the write against the resourceVersion the
+        caller OBSERVED — a mismatch raises ConflictError instead of
+        blindly overwriting a newer status (the lister-snapshot staleness
+        guard). A write that would change nothing is skipped entirely
+        (no rv bump, no handler fire) so level-triggered no-op syncs are
+        invisible to watchers; `base` is accepted for signature parity
+        with the K8s substrate, which cannot read the stored object for
+        free — here the store itself is the diff baseline.
+        """
+        del base
         with self._lock:
             key = (job.metadata.namespace, job.metadata.name)
             old = self._stores[KIND_JOB].get(key)
             if old is None:
                 raise NotFoundError(f"TrainJob {key[0]}/{key[1]} not found")
+            if (expected_rv is not None
+                    and old.metadata.resource_version != expected_rv):
+                raise ConflictError(
+                    f"TrainJob {key[0]}/{key[1]}: resourceVersion "
+                    f"{expected_rv} != {old.metadata.resource_version}")
+            if (job.status == old.status
+                    and dict(job.metadata.annotations)
+                    == dict(old.metadata.annotations)):
+                return copy.deepcopy(old)
             new = copy.deepcopy(old)
             new.status = copy.deepcopy(job.status)
             new.metadata.annotations = dict(job.metadata.annotations)
@@ -340,6 +362,16 @@ class InMemoryCluster:
 
     def list_jobs(self, namespace: str | None = None) -> list[TrainJob]:
         return self._list(KIND_JOB, namespace, None)
+
+    def snapshot_jobs(self, namespace: str | None = None) -> list[TrainJob]:
+        """Read-only lister snapshot (round 17): the stored objects
+        themselves, NO deep copies — the same contract as K8sCluster's
+        informer-cache snapshot. For scans that only inspect (resync
+        enqueue, slice-waiter kicks), where list_jobs' full deep copy is
+        O(fleet) allocation per wave. Callers must not mutate."""
+        with self._lock:
+            return [o for (ns, _), o in self._stores[KIND_JOB].items()
+                    if namespace is None or ns == namespace]
 
     # ---- inference services (the second workload kind; same CRUD shape
     # ---- as jobs, including the status-subresource write semantics) ----
@@ -356,13 +388,27 @@ class InMemoryCluster:
     def update_infsvc(self, svc) -> Any:
         return self._update(KIND_INFSVC, svc)
 
-    def update_infsvc_status(self, svc) -> Any:
+    def update_infsvc_status(self, svc, *, expected_rv=None,
+                             base=None) -> Any:
+        """Same contract as update_job_status, including the round-17
+        rv fence and the no-op skip (both workload kinds optimize
+        together or neither — the PR-13 review note)."""
+        del base
         with self._lock:
             key = (svc.metadata.namespace, svc.metadata.name)
             old = self._stores[KIND_INFSVC].get(key)
             if old is None:
                 raise NotFoundError(
                     f"InferenceService {key[0]}/{key[1]} not found")
+            if (expected_rv is not None
+                    and old.metadata.resource_version != expected_rv):
+                raise ConflictError(
+                    f"InferenceService {key[0]}/{key[1]}: resourceVersion "
+                    f"{expected_rv} != {old.metadata.resource_version}")
+            if (svc.status == old.status
+                    and dict(svc.metadata.annotations)
+                    == dict(old.metadata.annotations)):
+                return copy.deepcopy(old)
             new = copy.deepcopy(old)
             new.status = copy.deepcopy(svc.status)
             new.metadata.annotations = dict(svc.metadata.annotations)
@@ -376,6 +422,12 @@ class InMemoryCluster:
 
     def list_infsvcs(self, namespace: str | None = None) -> list[Any]:
         return self._list(KIND_INFSVC, namespace, None)
+
+    def snapshot_infsvcs(self, namespace: str | None = None) -> list[Any]:
+        """Read-only lister snapshot (see snapshot_jobs)."""
+        with self._lock:
+            return [o for (ns, _), o in self._stores[KIND_INFSVC].items()
+                    if namespace is None or ns == namespace]
 
     # ---- pods ----
 

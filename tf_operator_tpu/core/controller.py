@@ -135,7 +135,10 @@ class JobControllerBase:
         return self.cluster.try_get_job(namespace, name)
 
     def _list_owners(self) -> list:
-        return self.cluster.list_jobs()
+        """Resync scan. A read-only lister snapshot, NOT a deep-copying
+        LIST: resync only reads keys (round 17 — at 10k jobs the old
+        full-LIST-the-world was the resync's dominant cost)."""
+        return self.cluster.snapshot_jobs()
 
     def _owner_replica_types(self, obj) -> list[str]:
         """Replica-type strings the owner's expectations are keyed by."""

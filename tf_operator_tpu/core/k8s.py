@@ -69,6 +69,7 @@ from tf_operator_tpu.core.cluster import (
     Service,
     ServicePort,
 )
+from tf_operator_tpu.status import metrics
 from tf_operator_tpu.utils.logging import FieldLogger
 
 PODGROUP_API = "scheduling.volcano.sh/v1beta1"
@@ -316,6 +317,21 @@ def _omit_nulls(v):
     return v
 
 
+_ABSENT = object()
+
+
+def _wire_diff(new_d: dict, base_d: dict) -> dict:
+    """Top-level merge-patch diff: the keys of `new_d` whose value differs
+    from `base_d`, plus explicit nulls for keys that disappeared (RFC 7386
+    null deletes). Byte-identical wire forms diff to {} — the no-op-skip
+    signal the coalescing status writer keys off."""
+    out = {k: v for k, v in new_d.items() if base_d.get(k, _ABSENT) != v}
+    for k in base_d:
+        if k not in new_d:
+            out[k] = None
+    return out
+
+
 def job_to_k8s(job: TrainJob) -> dict:
     out = compat.job_to_dict(job)
     out["metadata"] = _meta_to_dict(job.metadata)
@@ -555,6 +571,19 @@ class _TokenBucket:
             slept += wait
 
 
+def _path_resource(path: str) -> str:
+    """Resource plural from an apiserver path, for the per-kind request
+    metric: /apis/{group}/{ver}/namespaces/{ns}/{resource}/... (and the
+    /api/{ver}/... core-group and cluster-scope forms)."""
+    segs = [s for s in path.split("?", 1)[0].split("/") if s]
+    base = 3 if segs and segs[0] == "apis" else 2
+    if len(segs) <= base:
+        return segs[-1] if segs else "?"
+    if segs[base] == "namespaces":
+        return segs[base + 2] if len(segs) > base + 2 else "namespaces"
+    return segs[base]
+
+
 class K8sApi:
     """Minimal stdlib HTTP client for the API server.
 
@@ -683,8 +712,12 @@ class K8sApi:
         """Open AND read one unary request under the retry policy (the
         read is inside the loop: a connection dropped mid-body is the same
         transient as one dropped pre-status)."""
+        kind = _path_resource(path)
         attempt = 0
         while True:
+            # Per attempt, not per call: a retry IS another request the
+            # apiserver served — the load this family exists to budget.
+            metrics.apiserver_requests.labels(verb=method, kind=kind).inc()
             try:
                 with self._open(method, path, body, params, timeout=timeout,
                                 content_type=content_type) as r:
@@ -1115,20 +1148,59 @@ class K8sCluster:
         d = self.api.merge_patch(path, patch)
         return self.decode(kind, d)
 
+    def _diffed_status_patch(self, kind: str, obj, status_diff: dict,
+                             base, expected_rv):
+        """ONE merge-patch carrying only what this sync changed (round
+        17). Annotations unchanged -> the patch goes to /status (the
+        subresource lane, like the legacy path). Annotations changed ->
+        one combined patch to the main resource: both stanzas are
+        controller-owned, so the lane stays conflict-free against spec
+        editors, and one request replaces the legacy two. With
+        `expected_rv` the patch carries the observed resourceVersion —
+        the server 409s a stale observation instead of merging it.
+        Nothing changed -> NO request at all; the caller's working copy
+        is returned as-is."""
+        ann_diff = _wire_diff(dict(obj.metadata.annotations),
+                              dict(base.metadata.annotations))
+        if not status_diff and not ann_diff:
+            return obj
+        meta: dict = {}
+        if ann_diff:
+            meta["annotations"] = ann_diff
+        if expected_rv is not None:
+            # Wire form is a string (see _meta_to_dict); the server compares
+            # it verbatim against what it stored.
+            meta["resourceVersion"] = str(expected_rv)
+        patch: dict = {}
+        if meta:
+            patch["metadata"] = meta
+        if status_diff:
+            patch["status"] = status_diff
+        return self._patch(kind, obj.namespace, obj.name, patch,
+                           subresource="" if ann_diff else "status")
+
     def _delete(self, kind: str, namespace: str, name: str):
         d = self.api.request(
             "DELETE", f"{self._ns_path(kind, namespace)}/{name}"
         )
         return self.decode(kind, d) if d.get("kind") not in (None, "Status") else None
 
+    def _synced_informer(self, kind: str):
+        return next((i for i in self._informers
+                     if i.kind == kind and i.synced.is_set()), None)
+
     def _cache_list(self, kind: str, namespace: str | None,
                     selector: dict | None):
         """Lister-style read from the informer cache; None when the kind
-        has no synced informer (callers fall back to HTTP)."""
-        if kind == KIND_JOB:
-            return None  # jobs read-through: status latches need RYW
-        inf = next((i for i in self._informers
-                    if i.kind == kind and i.synced.is_set()), None)
+        has no synced informer (callers fall back to HTTP).
+
+        Round 17: jobs are no longer excluded. They used to stay
+        read-through because status latches (gang roll / preemption
+        drains) need read-your-writes — now every status flush from a
+        cache-served sync carries the observed resourceVersion as a
+        fence, so a stale read can only cost a 409 + requeue, never a
+        blind overwrite of a newer status (core/status_writer.py)."""
+        inf = self._synced_informer(kind)
         if inf is None:
             return None
         for _ in range(8):
@@ -1151,6 +1223,59 @@ class K8sCluster:
             # and must never write into the shared cache.
             out.append(copy.deepcopy(o))
         return out
+
+    def _cache_get(self, kind: str, namespace: str, name: str):
+        """Single-object lister read (round 17): the synced informer's
+        copy, deep-copied because reconcile mutates what it reads. None
+        falls back to read-through — including when the cache simply
+        does not hold the key, so a just-created object racing its watch
+        delivery costs one GET instead of a spurious not-found."""
+        if not self.lists_from_cache:
+            return None
+        inf = self._synced_informer(kind)
+        if inf is None:
+            return None
+        obj = inf._cache.get((namespace, name))
+        return copy.deepcopy(obj) if obj is not None else None
+
+    def snapshot_jobs(self, namespace: str | None = None) -> list[TrainJob]:
+        """Read-only lister snapshot of every job — NO deep copies and,
+        with a synced informer, NO apiserver round-trip. For scans that
+        only inspect (resync enqueue, slice-waiter kicks): at 10k jobs a
+        full HTTP LIST is megabytes of wire and decode per resync wave.
+        Callers must not mutate the returned objects."""
+        inf = self._synced_informer(KIND_JOB)
+        if inf is None:
+            return self._list(KIND_JOB, namespace, None)
+        for _ in range(8):
+            try:
+                objs = list(inf._cache.values())
+                break
+            except RuntimeError:  # cache resized mid-iteration: retry
+                continue
+        else:
+            return self._list(KIND_JOB, namespace, None)
+        if namespace is None:
+            return objs
+        return [o for o in objs if o.namespace == namespace]
+
+    def snapshot_infsvcs(self, namespace: str | None = None) -> list:
+        """Read-only lister snapshot of inference services (see
+        snapshot_jobs)."""
+        inf = self._synced_informer(KIND_INFSVC)
+        if inf is None:
+            return self._list(KIND_INFSVC, namespace, None)
+        for _ in range(8):
+            try:
+                objs = list(inf._cache.values())
+                break
+            except RuntimeError:
+                continue
+        else:
+            return self._list(KIND_INFSVC, namespace, None)
+        if namespace is None:
+            return objs
+        return [o for o in objs if o.namespace == namespace]
 
     def _list(self, kind: str, namespace: str | None, selector: dict | None):
         if self.lists_from_cache:
@@ -1178,12 +1303,16 @@ class K8sCluster:
         return self._get(KIND_JOB, namespace, name)
 
     def try_get_job(self, namespace: str, name: str) -> TrainJob | None:
+        cached = self._cache_get(KIND_JOB, namespace, name)
+        if cached is not None:
+            return cached
         return self._try_get(KIND_JOB, namespace, name)
 
     def update_job(self, job: TrainJob) -> TrainJob:
         return self._update(KIND_JOB, job)
 
-    def update_job_status(self, job: TrainJob) -> TrainJob:
+    def update_job_status(self, job: TrainJob, *, expected_rv=None,
+                          base=None) -> TrainJob:
         """Status + bookkeeping-annotation write via JSON merge-patch (ref
         UpdateStatus, k8sutil/client.go:85; PATCH per pod_control.go:104).
 
@@ -1192,20 +1321,37 @@ class K8sCluster:
         (kubectl, the dashboard) — a whole-object PUT here would fight them
         on resourceVersion (VERDICT r3 missing #2). The status dict always
         carries every key the engine owns; None values become explicit
-        merge-patch nulls, which delete — matching PUT's omitempty."""
-        if job.metadata.annotations:
-            try:
-                self._patch(
-                    KIND_JOB, job.namespace, job.name,
-                    {"metadata": {"annotations": dict(job.metadata.annotations)}},
-                )
-            except NotFoundError:
-                pass  # deleted underneath us: the status write will 404 too
-        return self._patch(
-            KIND_JOB, job.namespace, job.name,
-            {"status": job_status_to_dict(job.status)},
-            subresource="status",
-        )
+        merge-patch nulls, which delete — matching PUT's omitempty.
+
+        Round 17: with `base` (the object as the caller OBSERVED it), the
+        patch ships only the top-level status keys that actually changed
+        plus the changed annotations, as ONE request — byte-identical wire
+        forms issue ZERO requests. With `expected_rv` the patch carries
+        the observed resourceVersion as a precondition (409 on staleness;
+        the lister-snapshot fence). Without `base` the legacy full-form
+        two-patch write is preserved — that path stays rv-free so it can
+        never fight a concurrent spec editor (test_k8s pins this).
+        """
+        if base is None:
+            if job.metadata.annotations:
+                try:
+                    self._patch(
+                        KIND_JOB, job.namespace, job.name,
+                        {"metadata": {
+                            "annotations": dict(job.metadata.annotations)}},
+                    )
+                except NotFoundError:
+                    pass  # deleted underneath us: status write will 404 too
+            return self._patch(
+                KIND_JOB, job.namespace, job.name,
+                {"status": job_status_to_dict(job.status)},
+                subresource="status",
+            )
+        return self._diffed_status_patch(
+            KIND_JOB, job,
+            _wire_diff(job_status_to_dict(job.status),
+                       job_status_to_dict(base.status)),
+            base, expected_rv)
 
     def delete_job(self, namespace: str, name: str):
         return self._delete(KIND_JOB, namespace, name)
@@ -1222,29 +1368,39 @@ class K8sCluster:
         return self._get(KIND_INFSVC, namespace, name)
 
     def try_get_infsvc(self, namespace: str, name: str):
+        cached = self._cache_get(KIND_INFSVC, namespace, name)
+        if cached is not None:
+            return cached
         return self._try_get(KIND_INFSVC, namespace, name)
 
     def update_infsvc(self, svc):
         return self._update(KIND_INFSVC, svc)
 
-    def update_infsvc_status(self, svc):
-        """Same merge-patch discipline as update_job_status: the
-        controller owns status + its annotations; spec editors keep
+    def update_infsvc_status(self, svc, *, expected_rv=None, base=None):
+        """Same merge-patch discipline as update_job_status — including
+        the round-17 diffed single-patch / no-op-skip / rv-fence path:
+        the controller owns status + its annotations; spec editors keep
         their resourceVersion lane."""
-        if svc.metadata.annotations:
-            try:
-                self._patch(
-                    KIND_INFSVC, svc.namespace, svc.name,
-                    {"metadata": {"annotations":
-                                  dict(svc.metadata.annotations)}},
-                )
-            except NotFoundError:
-                pass
-        return self._patch(
-            KIND_INFSVC, svc.namespace, svc.name,
-            {"status": infsvc_status_to_dict(svc.status)},
-            subresource="status",
-        )
+        if base is None:
+            if svc.metadata.annotations:
+                try:
+                    self._patch(
+                        KIND_INFSVC, svc.namespace, svc.name,
+                        {"metadata": {"annotations":
+                                      dict(svc.metadata.annotations)}},
+                    )
+                except NotFoundError:
+                    pass
+            return self._patch(
+                KIND_INFSVC, svc.namespace, svc.name,
+                {"status": infsvc_status_to_dict(svc.status)},
+                subresource="status",
+            )
+        return self._diffed_status_patch(
+            KIND_INFSVC, svc,
+            _wire_diff(infsvc_status_to_dict(svc.status),
+                       infsvc_status_to_dict(base.status)),
+            base, expected_rv)
 
     def delete_infsvc(self, namespace: str, name: str):
         return self._delete(KIND_INFSVC, namespace, name)

@@ -48,6 +48,7 @@ from tf_operator_tpu.core.cluster import (
     Service,
     ServicePort,
 )
+from tf_operator_tpu.core import status_writer as status_writer_lib
 from tf_operator_tpu.gang import elastic as elastic_lib
 from tf_operator_tpu.gang import podgroup as gang
 from tf_operator_tpu.status import engine as status_engine
@@ -98,6 +99,7 @@ class TrainJobController(ctrl.JobControllerBase):
         queue_shards: int = 1,
         fleet_policy=None,
         enqueue_router=None,
+        status_coalesce_window: float = 0.0,
     ):
         super().__init__(cluster, queue_shards=queue_shards,
                          enqueue_router=enqueue_router)
@@ -164,6 +166,18 @@ class TrainJobController(ctrl.JobControllerBase):
         # status.pending_gang_roll_uids (persisted, not here): an operator
         # failover between the count and the drain must re-issue the
         # deletes WITHOUT re-counting the same incident.
+        # Round 17: every status/annotation persist goes through ONE
+        # coalescing writer — a no-op sync writes nothing, a dirty sync
+        # writes one diffed merge-patch, and (opt-in, window > 0) a
+        # fast job's queued/admitted/running transitions merge into its
+        # terminal write. Fenced with the observed resourceVersion when
+        # the substrate serves possibly-stale lister-snapshot reads.
+        self._status_writer = status_writer_lib.StatusWriter(
+            cluster.update_job_status, kind=TrainJob.KIND,
+            window=status_coalesce_window, clock=lambda: self._now(),
+            defer=lambda key, delay: self.queue.add_after(key, delay),
+            fence=bool(getattr(cluster, "lists_from_cache", True)),
+        )
         self.cluster.on_add("TrainJob", self._count_created)
         self.cluster.on_delete("TrainJob", self._count_deleted)
         self.cluster.on_delete("TrainJob", self._purge_job_state)
@@ -196,10 +210,15 @@ class TrainJobController(ctrl.JobControllerBase):
                     naming.gen_expectation_services_key(key, str(rtype))
                 )
             self._release_capacity(key)
+            self._status_writer.forget(key)
             return
 
         job = shared.deep_copy()
         api_defaults.set_defaults(job)
+        # The coalescing writer's dirty/diff baseline: the observed state
+        # this sync started from (post-defaults — defaults never touch
+        # status or annotations, so the wire form matches the store).
+        base = job.deep_copy()
 
         # Invalid spec: mark Failed, emit event, never crash (parity with the
         # unstructured-informer tolerance + invalid_tfjob_tests behavior).
@@ -221,13 +240,13 @@ class TrainJobController(ctrl.JobControllerBase):
                 changed = True
             if changed:
                 metrics.jobs_failed.labels(namespace=job.namespace).inc()
-                self.cluster.update_job_status(job)
+                self._status_writer.flush(job, base, urgent=True)
             return
 
         if not self._expectations_satisfied(key, job):
             return
 
-        self.reconcile(job)
+        self.reconcile(job, base)
 
     def _expectations_satisfied(self, key: str, job: TrainJob) -> bool:
         """satisfiedExpectations (controller.go:477-496)."""
@@ -244,10 +263,13 @@ class TrainJobController(ctrl.JobControllerBase):
 
     # ------------------------------------------------------------- reconcile
 
-    def reconcile(self, job: TrainJob) -> None:
-        """reconcileTFJobs (controller.go:332)."""
+    def reconcile(self, job: TrainJob, base: TrainJob | None = None) -> None:
+        """reconcileTFJobs (controller.go:332). `base` is the pristine
+        observed copy the status writer diffs flushes against; direct
+        callers (tests) may omit it."""
         key = job.key()
-        old_status = copy.deepcopy(job.status)
+        if base is None:
+            base = job.deep_copy()
 
         status_engine.set_condition(
             job.status, JobConditionType.CREATED, status_engine.REASON_CREATED,
@@ -279,8 +301,7 @@ class TrainJobController(ctrl.JobControllerBase):
                 status_engine.REASON_SUSPENDED,
                 f"TrainJob {key} is suspended.", self._now(),
             )
-            if job.status != old_status:
-                self.cluster.update_job_status(job)
+            self._status_writer.flush(job, base)
             return
 
         exceeded, exceed_reason, exceed_msg = self._past_limits(job, pods)
@@ -302,9 +323,9 @@ class TrainJobController(ctrl.JobControllerBase):
             if self.enable_gang:
                 gang.delete_podgroup(self.cluster, job)
             self._release_capacity(job.key())
-            # Status must be durable before TTL GC may delete the job.
-            if job.status != old_status:
-                self.cluster.update_job_status(job)
+            # Status must be durable before TTL GC may delete the job:
+            # urgent — terminal conditions never sit in the window.
+            self._status_writer.flush(job, base, urgent=True)
             self._cleanup_by_ttl(job)
             return
 
@@ -329,8 +350,7 @@ class TrainJobController(ctrl.JobControllerBase):
                 pre_synced = True
             retry_delay = self._admit_slice(job, key, pods)
             if retry_delay is not None:
-                if job.status != old_status:
-                    self.cluster.update_job_status(job)
+                self._status_writer.flush(job, base)
                 self.queue.add_after(key, retry_delay)
                 return
             # Elastic reshape: while status says the gang runs degraded,
@@ -367,9 +387,11 @@ class TrainJobController(ctrl.JobControllerBase):
         # next sync). Runs BEFORE gang recovery so an eviction in flight
         # can never be double-counted as a retryable failure.
         if self._preemption_tick(job, pods, key):
-            if job.status != old_status:
+            if job.status != base.status:
                 job.status.last_reconcile_time = self._now()
-                self.cluster.update_job_status(job)
+            # Urgent: the pending_preemption_uids drain latch must be
+            # durable before the NEXT sync's deletions depend on it.
+            self._status_writer.flush(job, base, urgent=True)
             return
 
         # Pods/services of replica types REMOVED from the spec would never be
@@ -405,9 +427,11 @@ class TrainJobController(ctrl.JobControllerBase):
         # gang through the normal creation path once the old generation is
         # fully drained (same two-phase discipline as the elastic roll).
         if self._gang_recovery_tick(job, pods, key):
-            if job.status != old_status:
+            if job.status != base.status:
                 job.status.last_reconcile_time = self._now()
-                self.cluster.update_job_status(job)
+            # Urgent: pending_gang_roll_uids is the don't-double-count
+            # latch an operator failover replays deletes from.
+            self._status_writer.flush(job, base, urgent=True)
             return
 
         for rtype, spec in sorted(
@@ -424,9 +448,19 @@ class TrainJobController(ctrl.JobControllerBase):
             if remaining > 0:
                 self.queue.add_after(key, remaining + 0.1)
 
-        if job.status != old_status:
+        if job.status != base.status:
             job.status.last_reconcile_time = self._now()
-            self.cluster.update_job_status(job)
+        # Urgent when this sync TRANSITIONED the job to terminal (the
+        # terminal branch above only handles already-terminal observations;
+        # letting the first Succeeded/Failed write sit in the window would
+        # stall teardown+TTL — and the whole fleet pipeline — one window
+        # per job) or recorded a reshape (a durability latch: the degraded
+        # size must survive an operator failover).
+        self._status_writer.flush(
+            job, base,
+            urgent=(is_terminal(job.status) and not is_terminal(base.status))
+            or job.status.reshaped_replicas != base.status.reshaped_replicas,
+        )
 
     @staticmethod
     def _elastic_enabled(job: TrainJob) -> bool:
@@ -1369,7 +1403,10 @@ class TrainJobController(ctrl.JobControllerBase):
                 self.route_enqueue(key)
             return
         try:
-            jobs = self.cluster.list_jobs()
+            # Read-only lister snapshot (round 17): this scheduler-less
+            # fallback fires per slice release — a full deep-copying
+            # LIST here was O(fleet) per freed slice.
+            jobs = self.cluster.snapshot_jobs()
         except Exception:
             return
         for j in jobs:

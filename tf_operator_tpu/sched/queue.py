@@ -5,11 +5,15 @@ quota exhausted) wait here instead of relying on the controller's old
 arbitrary `_kick_slice_waiters` wakeup order. Entries live in per-queue
 pools; the GLOBAL admission order interleaves queues by fair share:
 
-    rank = (-priority, -queue_share_deficit, submit_time, seq)
+    rank = (-effective_priority, -queue_share_deficit, submit_time, seq)
 
   * priority first — a higher PriorityClass value always outranks, across
     queues (priority is the fleet-wide urgency axis; fairness arbitrates
-    only among equals).
+    only among equals). With schedulingPolicy.agingSeconds set, the
+    effective priority is the class value plus +1 per agingSeconds of
+    wait, so a starved entry's rank climbs toward (and past) fresher
+    higher-class arrivals — a provable starvation bound. Without it
+    (default), effective == declared and the order is strict priority.
   * share deficit second — among equal priorities, the queue furthest
     BELOW its weighted target share of held capacity goes first, so a
     bursty queue cannot lock out a light one at the same priority tier.
@@ -52,7 +56,22 @@ class QueueEntry:
     # servable multi-slice waiter reserves NOTHING, so smaller jobs keep
     # backfilling behind it instead of deadlocking the class.
     slices: int = 1
+    # schedulingPolicy.agingSeconds: while waiting, effective priority
+    # grows +1 per aging_seconds elapsed since submit_time, so the wait a
+    # low-priority entry can accrue before outranking a fresh arrival of
+    # class value V is bounded by (V - priority) * aging_seconds. None =
+    # no aging (strict class priority, today's order bit-for-bit).
+    aging_seconds: float | None = None
     seq: int = 0
+
+    def effective_priority(self, now: float | None) -> int:
+        """Priority after aging credit at `now` (base priority when aging
+        is off or no clock was supplied). Ordering only — quota math and
+        preemption victim selection stay on the declared class value."""
+        if not self.aging_seconds or now is None:
+            return self.priority
+        waited = max(0.0, now - self.submit_time)
+        return self.priority + int(waited / self.aging_seconds)
 
 
 @dataclass
@@ -93,11 +112,12 @@ class FairShareQueue:
         return out
 
     def ranked(self, share_by_queue: dict[str, float],
-               weight_of) -> list[QueueEntry]:
+               weight_of, now: float | None = None) -> list[QueueEntry]:
         """Global admission order. `share_by_queue` is each queue's
         current fraction of HELD capacity (chips-weighted); `weight_of`
         maps a queue name to its configured weight. Deficit =
-        normalized-target-share − current-share."""
+        normalized-target-share − current-share. With `now`, entries
+        carrying aging_seconds rank by their aged effective priority."""
         if not self._entries:
             return []
         queues = {e.queue or DEFAULT_QUEUE for e in self._entries.values()}
@@ -109,14 +129,29 @@ class FairShareQueue:
 
         return sorted(
             self._entries.values(),
-            key=lambda e: (-e.priority, -deficit(e.queue or DEFAULT_QUEUE),
+            key=lambda e: (-e.effective_priority(now),
+                           -deficit(e.queue or DEFAULT_QUEUE),
                            e.submit_time, e.seq),
         )
 
+    def next_aging_tick(self, now: float) -> float | None:
+        """Earliest future instant any waiting entry's effective priority
+        increments (None when no entry ages) — when a cached ranking
+        computed at `now` can next become stale without a queue mutation."""
+        soonest: float | None = None
+        for e in self._entries.values():
+            if not e.aging_seconds:
+                continue
+            steps = int(max(0.0, now - e.submit_time) / e.aging_seconds)
+            t = e.submit_time + (steps + 1) * e.aging_seconds
+            if soonest is None or t < soonest:
+                soonest = t
+        return soonest
+
     def position(self, key: str, share_by_queue: dict[str, float],
-                 weight_of) -> int | None:
+                 weight_of, now: float | None = None) -> int | None:
         """1-based place in the global admission order; None if absent."""
-        for i, e in enumerate(self.ranked(share_by_queue, weight_of)):
+        for i, e in enumerate(self.ranked(share_by_queue, weight_of, now)):
             if e.key == key:
                 return i + 1
         return None

@@ -105,6 +105,12 @@ class FleetScheduler:
         self._ranked_cache: list[QueueEntry] | None = None
         self._rank_index: dict[str, int] = {}
         self._ranked_version = -1
+        # Aging makes the ranking time-dependent: when any waiting entry
+        # carries agingSeconds, the cached order is additionally stale
+        # once the clock crosses the next effective-priority increment
+        # (None = no aging entries, cache keyed by _version alone —
+        # the zero-aging fast path pays nothing).
+        self._aging_rerank_at: float | None = None
         self.stats = {
             "admitted": 0,
             "preemptions_requested": 0,
@@ -119,6 +125,7 @@ class FleetScheduler:
     def _entry_of(self, job: TrainJob, now: float) -> QueueEntry:
         sched = job.spec.run_policy.scheduling
         pc = self.policy.resolve(sched.priority_class)
+        aging = sched.aging_seconds
         return QueueEntry(
             key=job.key(),
             namespace=job.namespace,
@@ -129,6 +136,9 @@ class FleetScheduler:
             priority_class=sched.priority_class,
             slice_cls=slice_class(job.spec.tpu.topology),
             slices=max(1, job.spec.tpu.slices),
+            # Validation rejects aging <= 0; re-guard here so a job that
+            # raced validation can never divide by zero in the ranker.
+            aging_seconds=aging if aging and aging > 0 else None,
         )
 
     def _jobs_by_namespace(self) -> dict[str, tuple[int, int]]:
@@ -150,13 +160,22 @@ class FleetScheduler:
             out[r.queue] = out.get(r.queue, 0.0) + r.chips / total
         return out
 
-    def _ranked(self) -> list[QueueEntry]:
-        if self._ranked_cache is None or self._ranked_version != self._version:
+    def _ranked(self, now: float | None = None) -> list[QueueEntry]:
+        stale = (self._ranked_cache is None
+                 or self._ranked_version != self._version)
+        if not stale and self._aging_rerank_at is not None:
+            if now is None:
+                now = self._clock()
+            stale = now >= self._aging_rerank_at
+        if stale:
+            if now is None:
+                now = self._clock()
             self._ranked_cache = self._waiting.ranked(
-                self._share_by_queue(), self.policy.queue_weight)
+                self._share_by_queue(), self.policy.queue_weight, now)
             self._rank_index = {e.key: i + 1
                                 for i, e in enumerate(self._ranked_cache)}
             self._ranked_version = self._version
+            self._aging_rerank_at = self._waiting.next_aging_tick(now)
         return self._ranked_cache
 
     def _position_locked(self, key: str) -> int | None:
@@ -186,7 +205,7 @@ class FleetScheduler:
         return True
 
     def _free_after_reservations_locked(
-        self, min_priority: int | None = None
+        self, min_priority: int | None = None, now: float | None = None
     ) -> dict[tuple[str, int], int]:
         """Free capacity per class after mentally reserving one slice for
         every quota-eligible waiter at priority >= `min_priority` — what
@@ -199,8 +218,15 @@ class FleetScheduler:
         free = self.allocator.free_by_class()
         jobs_by_ns = self._jobs_by_namespace()
         reserved: dict[str, tuple[int, int]] = {}
-        for e in self._ranked():
-            if min_priority is not None and e.priority < min_priority:
+        if now is None:
+            now = self._clock()
+        for e in self._ranked(now):
+            # Effective (aged) priority, matching the ranked order: an
+            # aged-up waiter blocks an elastic upgrade exactly like a
+            # natively higher-priority one — the ordering axis is one
+            # axis, wherever it is compared.
+            if (min_priority is not None
+                    and e.effective_priority(now) < min_priority):
                 continue
             if not self._quota_headroom(e.namespace, jobs_by_ns, reserved,
                                         e.slices):
@@ -268,7 +294,7 @@ class FleetScheduler:
                 # `claim` (not `upgrade`): the old slice stays held —
                 # its pods are still running on it — until the
                 # controller's drain cleanup releases it.
-                free = self._free_after_reservations_locked(r.priority)
+                free = self._free_after_reservations_locked(r.priority, now)
                 if free.get(want_cls, 0) > 0:
                     sid = self.allocator.claim(key, topology)
                     if sid is not None:
@@ -283,8 +309,11 @@ class FleetScheduler:
             # probes rank and decide on a substituted copy below.
             entry = self._entry_of(job, now)
             cur = self._waiting.get(key)
-            if cur is None or (cur.queue, cur.priority, cur.topology) != (
-                    entry.queue, entry.priority, entry.topology):
+            if cur is None or (
+                    cur.queue, cur.priority, cur.topology,
+                    cur.aging_seconds) != (
+                    entry.queue, entry.priority, entry.topology,
+                    entry.aging_seconds):
                 entry = self._waiting.submit(entry)
                 self._version += 1
                 self._update_depth_gauge()
@@ -304,7 +333,7 @@ class FleetScheduler:
             # fleet bench gates on). Reserved-for waiters are served, not
             # inverted — they take their slice on their own next sync.
             unserved_ahead: list[QueueEntry] = []
-            ranked = self._ranked()
+            ranked = self._ranked(now)
 
             for pos, e in enumerate(ranked, start=1):
                 mine = e.key == key
@@ -391,7 +420,12 @@ class FleetScheduler:
         # free slices than it needs is NOT inverted by a smaller job
         # backfilling capacity it could never have used.
         for e, free_then in ahead:
-            if (e.slice_cls == cls and e.priority > entry.priority
+            # Effective (aged) priorities, same `now` the ranked scan
+            # ordered by: aging re-ordering the queue is the FEATURE, and
+            # must not read as an inversion of the declared class values.
+            if (e.slice_cls == cls
+                    and e.effective_priority(now)
+                    > entry.effective_priority(now)
                     and free_then >= e.slices):
                 self.stats["inversions"] += 1
         chips = parse_topology(entry.topology).num_chips * entry.slices
@@ -589,17 +623,22 @@ class FleetScheduler:
             e = self._waiting.get(key)
             if e is None:
                 return None
-            return {
+            view = {
                 "state": "Queued", "queue": e.queue,
                 "priority": e.priority,
                 "position": self._position_locked(key),
                 "submittedAt": e.submit_time,
             }
+            if e.aging_seconds:
+                view["effectivePriority"] = (
+                    e.effective_priority(self._clock()))
+            return view
 
     def snapshot(self) -> dict:
         """Whole-fleet view for GET /api/queues."""
         with self._lock:
-            ranked = self._ranked()
+            now = self._clock()
+            ranked = self._ranked(now)
             return {
                 "queues": {
                     q: {"depth": n, "weight": self.policy.queue_weight(q)}
@@ -607,6 +646,7 @@ class FleetScheduler:
                 },
                 "waiting": [
                     {"key": e.key, "queue": e.queue, "priority": e.priority,
+                     "effectivePriority": e.effective_priority(now),
                      "position": i + 1, "topology": e.topology,
                      "submittedAt": e.submit_time}
                     for i, e in enumerate(ranked)

@@ -50,6 +50,7 @@ from tf_operator_tpu.api.types import (
     is_succeeded,
 )
 from tf_operator_tpu.core import controller as ctrl
+from tf_operator_tpu.core import status_writer as status_writer_lib
 from tf_operator_tpu.core.cluster import (
     InMemoryCluster,
     Pod,
@@ -145,9 +146,20 @@ class InferenceServiceController(ctrl.JobControllerBase):
         queue_shards: int = 1,
         enqueue_router=None,
         endpoint_resolver=None,
+        status_coalesce_window: float = 0.0,
     ):
         super().__init__(cluster, queue_shards=queue_shards,
                          enqueue_router=enqueue_router)
+        # Round 17: same coalescing status writer as the TrainJob
+        # controller ("optimize both together or neither" — the PR-13
+        # review note): no-op syncs write nothing, dirty syncs flush one
+        # diffed merge-patch, fenced when reads may be lister-stale.
+        self._status_writer = status_writer_lib.StatusWriter(
+            cluster.update_infsvc_status, kind=InferenceService.KIND,
+            window=status_coalesce_window, clock=lambda: self._now(),
+            defer=lambda key, delay: self.queue.add_after(key, delay),
+            fence=bool(getattr(cluster, "lists_from_cache", True)),
+        )
         # (namespace, service, pod name, port) -> "host:port" for the
         # front-end router's backends (serve/router.py). The local
         # runtime provides one (router.local_endpoint_resolver); on K8s
@@ -184,7 +196,9 @@ class InferenceServiceController(ctrl.JobControllerBase):
         return self.cluster.try_get_infsvc(namespace, name)
 
     def _list_owners(self) -> list:
-        return self.cluster.list_infsvcs()
+        # Read-only lister snapshot — resync and waiter kicks only
+        # inspect keys/spec (round 17).
+        return self.cluster.snapshot_infsvcs()
 
     def _owner_replica_types(self, obj) -> list[str]:
         return [SERVER_REPLICA]
@@ -202,11 +216,15 @@ class InferenceServiceController(ctrl.JobControllerBase):
                 naming.gen_expectation_services_key(key, SERVER_REPLICA))
             self._release_all_claims(key)
             self._close_router(key)
+            self._status_writer.forget(key)
             metrics.serve_ready_replicas.remove(namespace=ns, service=name)
             return
 
         svc = shared.deep_copy()
         api_defaults.set_infsvc_defaults(svc)
+        # Coalescing-writer baseline: the observed state this sync
+        # started from (defaults never touch status or annotations).
+        base = svc.deep_copy()
 
         problems = api_validation.validate_inference_service(
             svc, fleet=self.fleet_policy)
@@ -222,7 +240,7 @@ class InferenceServiceController(ctrl.JobControllerBase):
                 self._now())
             changed = self._close_router(key, svc) or changed
             if changed:
-                self.cluster.update_infsvc_status(svc)
+                self._status_writer.flush(svc, base, urgent=True)
             return
 
         if not self.expectations.satisfied(
@@ -232,15 +250,15 @@ class InferenceServiceController(ctrl.JobControllerBase):
         ):
             return
 
-        self.reconcile(svc)
+        self.reconcile(svc, base)
 
     # ---------------------------------------------------------- reconcile
 
-    def reconcile(self, svc: InferenceService) -> None:
+    def reconcile(self, svc: InferenceService, base=None) -> None:
         key = svc.key()
         now = self._now()
-        old_status = copy.deepcopy(svc.status)
-        old_annotations = dict(svc.metadata.annotations)
+        if base is None:  # direct callers (tests) may omit the baseline
+            base = svc.deep_copy()
         status_engine.set_condition(
             svc.status, JobConditionType.CREATED, REASON_CREATED,
             f"InferenceService {key} is created.", now)
@@ -257,17 +275,20 @@ class InferenceServiceController(ctrl.JobControllerBase):
                 self._tracked_delete_service(svc, s)
             self._release_all_claims(key)
             self._close_router(key, svc)
-            if svc.status != old_status:
-                self.cluster.update_infsvc_status(svc)
+            # Urgent: Failed is terminal for a service — never windowed.
+            self._status_writer.flush(svc, base, urgent=True)
             return
 
         # Train->serve handoff: resolve the checkpoint source before any
         # pod exists (server pods bake it into their env).
         resolved = self._resolve_model(svc, key)
         if resolved is None:
-            if (svc.status != old_status
-                    or svc.metadata.annotations != old_annotations):
-                self.cluster.update_infsvc_status(svc)
+            # Urgent when resolution itself FAILED the service this sync:
+            # the teardown branch above only fires once Failed is
+            # OBSERVED, so windowing the transition would stall it.
+            self._status_writer.flush(
+                svc, base,
+                urgent=has_condition(svc.status, JobConditionType.FAILED))
             return
         ckpt_dir, model_name = resolved
 
@@ -298,10 +319,11 @@ class InferenceServiceController(ctrl.JobControllerBase):
         # tick: folding the now-stale pod list into status would set a
         # Running condition that displaces the fresh Preempted record.
         if self._eviction_tick(svc, key, pods):
-            if (svc.status != old_status
-                    or svc.metadata.annotations != old_annotations):
+            if status_writer_lib.StatusWriter.dirty(svc, base):
                 svc.status.last_reconcile_time = now
-                self.cluster.update_infsvc_status(svc)
+            # Urgent: the Preempted record is the one visible trace the
+            # disruption was planned — never windowed.
+            self._status_writer.flush(svc, base, urgent=True)
             return
 
         # Per-replica hang watchdog (serving.heartbeatTimeoutSeconds).
@@ -483,10 +505,11 @@ class InferenceServiceController(ctrl.JobControllerBase):
                 f"InferenceService {key} is serving "
                 f"({ready}/{desired} ready).", now)
 
-        if (svc.status != old_status
-                or svc.metadata.annotations != old_annotations):
+        if status_writer_lib.StatusWriter.dirty(svc, base):
             svc.status.last_reconcile_time = now
-            self.cluster.update_infsvc_status(svc)
+        self._status_writer.flush(
+            svc, base,
+            urgent=has_condition(svc.status, JobConditionType.FAILED))
 
     # ----------------------------------------------------- model handoff
 

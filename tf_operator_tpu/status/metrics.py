@@ -358,6 +358,26 @@ reconcile_latency = DEFAULT.histogram(
     "Per-reconcile sync latency (ref controller.go:289-291 logs this; "
     "here it is a scrapeable histogram)",
 )
+# Round 17 (control plane at 10k jobs): the write-path budget. requests
+# counts every unary apiserver call the operator issues, by verb and
+# resource kind — the denominator of "writes per job" the fleet bench
+# gates on. coalesced counts status flushes the StatusWriter did NOT
+# send: reason=noop (sync changed nothing -> zero requests) or
+# reason=deferred (dirty, merged into a later write inside the
+# coalescing window).
+apiserver_requests = DEFAULT.counter(
+    "tpujob_apiserver_requests_total",
+    "Unary apiserver requests issued by the operator, by verb and "
+    "resource kind (watch streams excluded)",
+    labels_only=True,
+)
+status_writes_coalesced = DEFAULT.counter(
+    "tpujob_status_writes_coalesced_total",
+    "Status flushes skipped by the coalescing StatusWriter: reason=noop "
+    "(nothing changed since observation) or reason=deferred (merged "
+    "into a later write inside the coalescing window)",
+    labels_only=True,
+)
 
 # --- Fleet scheduler (sched/): admission, fair-share queueing, preemption.
 sched_queue_depth = DEFAULT.gauge(

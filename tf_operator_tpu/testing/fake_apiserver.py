@@ -269,6 +269,27 @@ class FakeApiServer:
         self._faults: list[dict] = []
         self._faults_lock = threading.Lock()
 
+        # Per-(verb, resource) request/byte accounting — the wire-efficiency
+        # ledger tools/exp_fleet.py turns into status_writes_per_job and
+        # wire_bytes_per_job. Recorded at the single response chokepoint
+        # (_send_json), so every unary request counts exactly once; watch
+        # streams bypass it by design — they are the amortized read path
+        # whose whole point is NOT costing a request per object per wave.
+        self._req_stats: dict[tuple[str, str], dict[str, int]] = {}
+        self._req_stats_lock = threading.Lock()
+
+        def record_request(verb: str, path: str, n_in: int, n_out: int):
+            m = _PATH_RE.match(urllib.parse.urlparse(path).path)
+            res = (m["resource"] or "?") if m else "?"
+            with self._req_stats_lock:
+                s = self._req_stats.setdefault(
+                    (verb, res),
+                    {"requests": 0, "bytes_in": 0, "bytes_out": 0},
+                )
+                s["requests"] += 1
+                s["bytes_in"] += n_in
+                s["bytes_out"] += n_out
+
         def check_fault(method: str, path: str):
             """(code, message) to fail this request with, or None. The
             fault's latency is slept either way (code=0 = latency only)."""
@@ -351,6 +372,10 @@ class FakeApiServer:
 
             def _send_json(self, payload: dict, code: int = 200):
                 body = json.dumps(payload).encode()
+                record_request(
+                    self.command, self.path,
+                    int(self.headers.get("Content-Length") or 0), len(body),
+                )
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -866,6 +891,22 @@ class FakeApiServer:
         assertion)."""
         with self._faults_lock:
             return sum(f["count"] for f in self._faults)
+
+    def request_stats(self) -> dict[str, dict[str, dict[str, int]]]:
+        """{verb -> {resource -> {requests, bytes_in, bytes_out}}} for every
+        unary request served so far (watch streams excluded — see the
+        recording chokepoint). bytes_in is the request body, bytes_out the
+        response body; both are the JSON wire form, uncompressed."""
+        out: dict[str, dict[str, dict[str, int]]] = {}
+        with self._req_stats_lock:
+            for (verb, res), s in self._req_stats.items():
+                out.setdefault(verb, {})[res] = dict(s)
+        return out
+
+    def reset_request_stats(self) -> None:
+        """Zero the request/byte ledger (a bench's warmup cutoff)."""
+        with self._req_stats_lock:
+            self._req_stats.clear()
 
     def get_object(self, resource: str, namespace: str, name: str) -> dict | None:
         with self.store.lock:

@@ -28,11 +28,18 @@ Gated invariants (exit 1 on violation):
     in-process runs don't contaminate each other.
 
 Also reported: watch-fanout (informer event deliveries total / per job),
-jobs/sec, preemption and queue stats.
+jobs/sec, preemption and queue stats, and — on the kube substrate, where
+the FakeApiServer keeps a per-(verb, resource) request/byte ledger — the
+round-17 wire-efficiency metrics: `status_writes_per_job` (PATCH+PUT
+requests against the trainjobs resource per submitted job; the number the
+StatusWriter coalescing moves) and `wire_bytes_per_job` (request+response
+bytes across every unary verb). `--gate-writes-per-job` turns the former
+into an exit-1 gate, like `--gate-p99`.
 
 Usage:
   python tools/exp_fleet.py                          # 2000 jobs, kube
   python tools/exp_fleet.py --jobs 200 --gate-p99 2  # CI fleet-smoke
+  python tools/exp_fleet.py --jobs 10000 --timeout 1800   # depth run
 """
 
 from __future__ import annotations
@@ -209,7 +216,10 @@ def run_fleet(jobs: int = 2000, slices: int = 16, substrate: str = "kube",
               namespaces: int = 4, job_seconds: float = 0.05,
               workers: int = 4, shards: int = 4, seed: int = 0,
               quota_slices: int | None = None, cooldown: float = 0.5,
-              gate_p99: float | None = None, timeout: float = 600.0,
+              gate_p99: float | None = None,
+              gate_writes_per_job: float | None = None,
+              coalesce_window: float = 30.0,
+              timeout: float = 600.0,
               progress=None) -> dict:
     """Run the bench; returns the result dict (see module docstring)."""
     rng = random.Random(seed)
@@ -248,9 +258,13 @@ def run_fleet(jobs: int = 2000, slices: int = 16, substrate: str = "kube",
         from tf_operator_tpu.core.k8s import K8sApi, K8sCluster
         from tf_operator_tpu.testing.fake_apiserver import FakeApiServer
 
-        # A deep watch log so 2000-job churn doesn't 410 the informers
-        # into repeated full relists mid-bench.
-        fake = FakeApiServer(watch_log_retain=262144).start()
+        # A deep watch log so fleet churn doesn't 410 the informers into
+        # repeated full relists mid-bench; scaled with the job count so
+        # the 10k-depth run keeps the same headroom the 2000-job tuning
+        # had (~32 deltas/job of retained history).
+        fake = FakeApiServer(
+            watch_log_retain=max(262144, jobs * 32)
+        ).start()
         api = K8sApi(fake.url, qps=0.0)  # client throttle off: bench load
         # Lister-backed reads: at fleet scale the controller must not pay
         # two HTTP lists per sync (see K8sCluster.lists_from_cache).
@@ -276,6 +290,11 @@ def run_fleet(jobs: int = 2000, slices: int = 16, substrate: str = "kube",
 
     controller = TrainJobController(
         cluster, enable_gang=True, scheduler=scheduler, queue_shards=shards,
+        # Production posture: burst-coalesce non-urgent status flushes —
+        # a fast job's queued/admitted/running transitions merge into
+        # its one (urgent, immediate) terminal write. Terminal
+        # conditions and durability latches never wait.
+        status_coalesce_window=coalesce_window,
     )
     quota_monitor_stop = threading.Event()
     quota_violations = [0]
@@ -349,6 +368,31 @@ def run_fleet(jobs: int = 2000, slices: int = 16, substrate: str = "kube",
     p99 = percentile_from_buckets(hist.buckets, delta, 0.99)
 
     stats = dict(scheduler.stats)
+
+    # Wire-efficiency ledger (kube substrate only: the FakeApiServer is
+    # the meter). status_writes counts PATCH+PUT against the trainjobs
+    # resource — the per-job status/annotation write amplification the
+    # StatusWriter coalescing exists to hold at ~1/transition; wire_bytes
+    # is everything unary, both directions.
+    status_writes_per_job = wire_bytes_per_job = None
+    requests_by_verb: dict[str, int] | None = None
+    if fake is not None:
+        req_stats = fake.request_stats()
+        requests_by_verb = {
+            verb: sum(s["requests"] for s in by_res.values())
+            for verb, by_res in sorted(req_stats.items())
+        }
+        status_writes = sum(
+            req_stats.get(verb, {}).get("trainjobs", {}).get("requests", 0)
+            for verb in ("PATCH", "PUT")
+        )
+        wire_bytes = sum(
+            s["bytes_in"] + s["bytes_out"]
+            for by_res in req_stats.values() for s in by_res.values()
+        )
+        status_writes_per_job = round(status_writes / jobs, 3)
+        wire_bytes_per_job = round(wire_bytes / jobs, 1)
+
     result = {
         "jobs": jobs,
         "slices": slices,
@@ -365,6 +409,10 @@ def run_fleet(jobs: int = 2000, slices: int = 16, substrate: str = "kube",
         - errors_before,
         "watch_events": watch_events[0],
         "watch_events_per_job": round(watch_events[0] / jobs, 2),
+        "status_writes_per_job": status_writes_per_job,
+        "wire_bytes_per_job": wire_bytes_per_job,
+        "apiserver_requests_by_verb": requests_by_verb,
+        "coalesce_window_s": coalesce_window,
         "sched": stats,
         "max_running_by_namespace": max_by_ns,
         "invariants": {
@@ -375,6 +423,7 @@ def run_fleet(jobs: int = 2000, slices: int = 16, substrate: str = "kube",
             "priority_inversions": stats["inversions"],
         },
         "gate_p99_s": gate_p99,
+        "gate_writes_per_job": gate_writes_per_job,
     }
     failures = []
     if starved:
@@ -385,6 +434,15 @@ def run_fleet(jobs: int = 2000, slices: int = 16, substrate: str = "kube",
         failures.append(f"{stats['inversions']} priority inversion(s)")
     if gate_p99 is not None and p99 > gate_p99:
         failures.append(f"reconcile p99 {p99}s > gate {gate_p99}s")
+    if gate_writes_per_job is not None:
+        if status_writes_per_job is None:
+            failures.append(
+                "--gate-writes-per-job needs the kube substrate "
+                "(the FakeApiServer is the request meter)")
+        elif status_writes_per_job > gate_writes_per_job:
+            failures.append(
+                f"status_writes_per_job {status_writes_per_job} > gate "
+                f"{gate_writes_per_job}")
     result["ok"] = not failures
     result["failures"] = failures
     return result
@@ -405,6 +463,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--cooldown", type=float, default=0.5)
     ap.add_argument("--gate-p99", type=float, default=None,
                     help="fail (exit 1) when reconcile p99 exceeds this")
+    ap.add_argument("--gate-writes-per-job", type=float, default=None,
+                    help="fail (exit 1) when status_writes_per_job exceeds "
+                         "this (kube substrate only)")
+    ap.add_argument("--coalesce-window", type=float, default=30.0,
+                    help="StatusWriter burst-coalescing window in seconds "
+                         "(0 = flush every dirty sync)")
     ap.add_argument("--timeout", type=float, default=600.0)
     args = ap.parse_args(argv)
     result = run_fleet(
@@ -412,7 +476,9 @@ def main(argv: list[str] | None = None) -> int:
         namespaces=args.namespaces, job_seconds=args.job_seconds,
         workers=args.workers, shards=args.shards, seed=args.seed,
         quota_slices=args.quota_slices, cooldown=args.cooldown,
-        gate_p99=args.gate_p99, timeout=args.timeout,
+        gate_p99=args.gate_p99,
+        gate_writes_per_job=args.gate_writes_per_job,
+        coalesce_window=args.coalesce_window, timeout=args.timeout,
         progress=lambda msg: print(f"# {msg}", file=sys.stderr),
     )
     print(json.dumps(result, indent=2, sort_keys=True))
