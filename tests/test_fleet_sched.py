@@ -752,6 +752,103 @@ class TestChaosPreemptDirective:
         assert cluster.get_job("default", "prey").status.preemptions == 1
 
 
+class TestLatchDurabilityOrdering:
+    """Round-17 review: destructive latches (preemption drain, gang
+    roll) must be PERSISTED — and, when fenced, proven fresh — before
+    any pod dies for them. A flush conflict aborts the sync ahead of
+    side effects, and a latch observed through a possibly-stale lister
+    cache is re-verified with one read-through GET."""
+
+    def test_conflicting_latch_flush_aborts_eviction_deletes(self):
+        from tf_operator_tpu.core.cluster import ConflictError
+
+        cluster, controller, scheduler = sched_env(slices=1)
+        cluster.create_job(make_slice_job("low", pc="low"))
+        controller.sync_job("default/low")
+        assert len(cluster.list_pods("default", {"job-name": "low"})) == 2
+        # a higher-priority arrival marks low for eviction
+        cluster.create_job(make_slice_job("high", pc="high"))
+        controller.sync_job("default/high")
+        assert scheduler.eviction_requested("default/low") == "default/high"
+
+        # the latch flush conflicts once (what a fenced flush from a
+        # stale lister observation does on the wire substrate); the
+        # writer bound the substrate's update at construction, so the
+        # hook goes on the writer
+        orig = controller._status_writer._update
+        armed = {"on": True}
+
+        def conflicted(job, **kw):
+            if (armed["on"] and job.metadata.name == "low"
+                    and job.status.pending_preemption_uids):
+                armed["on"] = False
+                raise ConflictError("stale fenced observation")
+            return orig(job, **kw)
+
+        controller._status_writer._update = conflicted
+        with pytest.raises(ConflictError):
+            controller.sync_job("default/low")
+        # the abort landed BEFORE any destructive side effect: every pod
+        # alive, nothing persisted
+        assert len(cluster.list_pods("default", {"job-name": "low"})) == 2
+        stored = cluster.get_job("default", "low")
+        assert stored.status.pending_preemption_uids == []
+        assert stored.status.preemptions == 0
+        assert not has_condition(stored.status, JobConditionType.PREEMPTED)
+
+        # the requeue's retry re-observes fresh state and the eviction
+        # goes through: latch durable FIRST, then the deletes
+        controller.sync_job("default/low")
+        stored = cluster.get_job("default", "low")
+        assert stored.status.pending_preemption_uids != []
+        assert has_condition(stored.status, JobConditionType.PREEMPTED)
+        assert cluster.list_pods("default", {"job-name": "low"}) == []
+
+    def test_stale_cached_latch_reverified_via_read_through(self):
+        class _StaleLatchCluster(InMemoryCluster):
+            """Claims lister-cache reads and serves a phantom stale
+            observation until asked to read through."""
+
+            lists_from_cache = True
+
+            def __init__(self):
+                super().__init__()
+                self.stale_job = None
+                self.read_throughs = 0
+
+            def try_get_job(self, namespace, name, *, read_through=False):
+                if read_through:
+                    self.read_throughs += 1
+                elif (self.stale_job is not None
+                      and self.stale_job.metadata.name == name):
+                    return self.stale_job.deep_copy()
+                return super().try_get_job(
+                    namespace, name, read_through=read_through)
+
+        cluster = _StaleLatchCluster()
+        controller = TrainJobController(cluster, enable_gang=False)
+        cluster.create_job(make_slice_job("steady"))
+        controller.sync_job("default/steady")
+        pods = cluster.list_pods("default", {"job-name": "steady"})
+        assert len(pods) == 2
+
+        # the "cache" serves an observation whose drain latch names the
+        # CURRENT pods — e.g. a drain that already completed, whose
+        # latch-clearing write the informer has not delivered yet.
+        # Replaying deletes from it would kill a healthy gang.
+        stale = cluster.get_job("default", "steady")
+        stale.status.pending_preemption_uids = sorted(
+            p.metadata.uid for p in pods)
+        cluster.stale_job = stale
+
+        controller.sync_job("default/steady")
+        # the latch was re-verified read-through and found clear: no pod
+        # died for the phantom
+        assert cluster.read_throughs == 1
+        assert len(cluster.list_pods("default",
+                                     {"job-name": "steady"})) == 2
+
+
 class TestGuardReassert:
     def test_reassert_retakes_displaced_handlers(self):
         """jax.distributed.initialize installs XLA's TSL

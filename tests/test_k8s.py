@@ -1366,10 +1366,14 @@ class TestDeployManifests:
 
 
 class TestCoalescedStatusWrites:
-    """Round 17 control-plane economics over the real wire: a dirty sync
-    wave flushes exactly ONE merge-patch, a no-op wave issues ZERO write
-    requests, and a fenced flush carrying a stale observed
-    resourceVersion 409s instead of blind-overwriting newer state."""
+    """Round 17 control-plane economics over the real wire: a dirty
+    status-only sync flushes exactly ONE merge-patch (to /status — the
+    subresource lane is mandatory, a main-resource write's status stanza
+    is ignored by a real apiserver), a sync that also touched
+    annotations adds exactly one main-resource annotations patch, a
+    no-op wave issues ZERO write requests, and a fenced flush carrying a
+    stale observed resourceVersion 409s instead of blind-overwriting
+    newer state."""
 
     def _tj_writes(self, server) -> dict[str, int]:
         stats = server.request_stats()
@@ -1377,6 +1381,9 @@ class TestCoalescedStatusWrites:
             verb: stats.get(verb, {}).get("trainjobs", {}).get("requests", 0)
             for verb in ("PATCH", "PUT", "POST", "DELETE")
         }
+
+    _raw = TestMergePatch._raw
+    _job_path = TestMergePatch._job_path
 
     def test_dirty_wave_one_patch_noop_wave_zero_writes(self):
         with FakeApiServer() as server:
@@ -1392,11 +1399,24 @@ class TestCoalescedStatusWrites:
                 server.reset_request_stats()
                 controller.sync_job("default/wave")
                 writes = self._tj_writes(server)
-                # first reconcile sets conditions AND the slice
-                # bookkeeping annotation: the legacy path issued two
-                # patches here, the coalesced path exactly one
+                # first reconcile sets conditions (no annotations here —
+                # gang is off): exactly one diffed patch, to /status.
+                # The subresource lane is mandatory: a combined
+                # main-resource patch would have its status stanza
+                # DROPPED by a real apiserver (annotation-touching syncs
+                # add one main-resource patch, pinned by
+                # test_status_always_ships_via_subresource_lane).
                 assert writes["PATCH"] == 1, writes
                 assert writes["PUT"] == 0, writes
+                # and the status half actually landed on the server (the
+                # fake strips status from main-resource patches exactly
+                # like a real apiserver would, so a combined patch could
+                # not have passed this):
+                stored = api.request(
+                    "GET",
+                    f"/apis/{TrainJob.API_VERSION}/namespaces/default/"
+                    f"{TrainJob.PLURAL}/wave")
+                assert (stored.get("status") or {}).get("conditions")
 
                 # once the informer observes the write-back (job status +
                 # the pods the wave created), a re-sync is a no-op and
@@ -1463,6 +1483,57 @@ class TestCoalescedStatusWrites:
             # only the changed top-level status key is on the wire — not
             # the full ~15-key status document the legacy path shipped
             assert bodies[0] == {"status": {"startTime": 7.0}}
+
+    def test_status_always_ships_via_subresource_lane(self):
+        """A sync that dirtied status AND annotations must route status
+        through /status and annotations through the main resource — a
+        combined main-resource patch would silently lose its status half
+        on a real apiserver (status subresource enabled on both CRDs)."""
+        with FakeApiServer() as server:
+            api = K8sApi(server.url)
+            cluster = K8sCluster(api)
+            created = cluster.create_job(_mk_job("lanes", workers=1))
+            base = created.deep_copy()
+            created.status.start_time = 9.0
+            created.metadata.annotations["tpu.example.com/slice"] = "s0"
+            calls: list[tuple[str, dict]] = []
+            orig = api.merge_patch
+
+            def spy(path, body):
+                calls.append((path, body))
+                return orig(path, body)
+
+            api.merge_patch = spy
+            cluster.update_job_status(created, base=base)
+            assert [p.endswith("/status") for p, _ in calls] == [True, False]
+            assert "status" not in calls[1][1]
+            got = api.request("GET", self._job_path("lanes"))
+            assert got["status"]["startTime"] == 9.0
+            anns = got["metadata"]["annotations"]
+            assert anns["tpu.example.com/slice"] == "s0"
+
+    def test_fake_patch_strips_status_on_main_resource(self):
+        """The fake models the real apiserver's subresource semantics on
+        PATCH too (do_PUT already did): the status stanza of a
+        main-resource merge-patch is ignored, never merged."""
+        with FakeApiServer() as server:
+            api = K8sApi(server.url)
+            job = job_to_k8s(_mk_job("strip", workers=1))
+            with self._raw(server, "POST", self._job_path("")[: -1], job) as r:
+                assert r.status == 201
+            api.merge_patch(
+                self._job_path("strip"),
+                {"metadata": {"annotations": {"a": "b"}},
+                 "status": {"startTime": 5.0}},
+            )
+            got = api.request("GET", self._job_path("strip"))
+            assert "startTime" not in (got.get("status") or {})
+            assert got["metadata"]["annotations"]["a"] == "b"
+            # the /status lane still takes it
+            api.merge_patch(self._job_path("strip") + "/status",
+                            {"status": {"startTime": 5.0}})
+            got = api.request("GET", self._job_path("strip"))
+            assert got["status"]["startTime"] == 5.0
 
 
 def test_schema_covers_every_serialized_field():

@@ -359,14 +359,13 @@ class TestControllerMultiSlice:
                                         slice_allocator=alloc)
         return cluster, controller, alloc
 
-    def test_atomic_admission_annotation(self):
+    def test_atomic_admission_records_all_slices(self):
         cluster, controller, alloc = self._env(slices=2)
         job = make_ms_job("ms", workers=2, slices=2, gang=True)
         cluster.create_job(job)
         assert controller.run_until_idle(10.0)
         got = cluster.get_job("default", "ms")
-        ann = got.metadata.annotations.get("tpujob.dev/slice", "")
-        assert sorted(ann.split(",")) == ["slice-0", "slice-1"]
+        assert sorted(got.status.slice_ids) == ["slice-0", "slice-1"]
         pods = cluster.list_pods("default", {"job-name": "ms"})
         assert len(pods) == 2
         assert sorted(p.metadata.labels.get("slice-id") for p in pods) == \

@@ -370,7 +370,7 @@ class TestControllerReshape:
         cluster.create_job(make_elastic_job("j1"))
         job = drive(cluster, controller, "default/j1",
                     lambda j: len(cluster.list_pods("default")) == 2)
-        assert job.metadata.annotations["tpujob.dev/slice"] == "slice-1"
+        assert job.status.slice_ids == ["slice-1"]
         for p in cluster.list_pods("default"):
             assert pod_env(p, "TPUJOB_MESH") == '{"dp": 2}'
             assert pod_env(p, "TPUJOB_ALLOW_RESHAPE") == "1"
@@ -381,7 +381,7 @@ class TestControllerReshape:
                     lambda j: j.status.reshaped_replicas == 1
                     and len(cluster.list_pods("default")) == 1)
         assert job.status.reshaped_topology == "v5e-1"
-        assert job.metadata.annotations["tpujob.dev/slice"] == "slice-0"
+        assert job.status.slice_ids == ["slice-0"]
         (pod,) = cluster.list_pods("default")
         assert pod.name == "j1-worker-0"
         assert pod_env(pod, "TPUJOB_MESH") == '{"dp": 1}'
@@ -410,7 +410,7 @@ class TestControllerReshape:
                     lambda j: j.status.reshaped_replicas is None
                     and len(cluster.list_pods("default")) == 2)
         assert job.status.reshaped_topology == ""
-        assert job.metadata.annotations["tpujob.dev/slice"] == "slice-1"
+        assert job.status.slice_ids == ["slice-1"]
         for p in cluster.list_pods("default"):
             assert pod_env(p, "TPUJOB_MESH") == '{"dp": 2}'
         cond = [c for c in job.status.conditions
@@ -436,10 +436,10 @@ class TestControllerReshape:
         cluster.create_job(make_elastic_job("jm"))
         job = drive(cluster, controller, "default/jm",
                     lambda j: len(cluster.list_pods("default")) == 2)
-        assert job.metadata.annotations["tpujob.dev/slice"] == "slice-0"
+        assert job.status.slice_ids == ["slice-0"]
         alloc.slices[0].offline = True  # targeted loss of the held slice
         job = drive(cluster, controller, "default/jm", lambda j: True)
-        assert job.metadata.annotations["tpujob.dev/slice"] == "slice-0"
+        assert job.status.slice_ids == ["slice-0"]
         assert alloc.holding("default/jm") == "slice-0"
         assert alloc.free_by_class() == {("v5e", 2): 1}  # slice-1 untouched
 

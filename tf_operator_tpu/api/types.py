@@ -398,6 +398,13 @@ class JobStatus:
     # running at full spec size.
     reshaped_replicas: int | None = None
     reshaped_topology: str = ""
+    # TPU slice claim record: the slice id(s) the gang currently holds
+    # (one entry per slice for multi-slice jobs). Controller-owned
+    # observability/durability bookkeeping — the allocator/scheduler stays
+    # authoritative — kept in STATUS (not an annotation) so the claim
+    # rides the same /status subresource patch as the conditions instead
+    # of costing every job a second main-resource write.
+    slice_ids: list[str] = field(default_factory=list)
 
 
 @dataclass

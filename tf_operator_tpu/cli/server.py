@@ -67,6 +67,9 @@ def _job_payload(cluster: InMemoryCluster, job: TrainJob,
             # "slice 3 keeps failing" signal (job-level tallies above
             # stay authoritative for backoffLimit).
             "sliceRestarts": dict(job.status.slice_restarts),
+            # Which slice(s) the gang currently holds (the claim record
+            # that used to live in the tpujob.dev/slice annotation).
+            "sliceIds": list(job.status.slice_ids),
             "stuckPendingPods": list(job.status.stuck_pending_pods),
             # Preemption visibility (sched/): planned evictions are a
             # first-class lifecycle event, not failures.

@@ -313,7 +313,9 @@ class InMemoryCluster:
     def get_job(self, namespace: str, name: str) -> TrainJob:
         return self._get(KIND_JOB, namespace, name)
 
-    def try_get_job(self, namespace: str, name: str) -> TrainJob | None:
+    def try_get_job(self, namespace: str, name: str, *,
+                    read_through: bool = False) -> TrainJob | None:
+        del read_through  # every read here is read-through already
         return self._try_get(KIND_JOB, namespace, name)
 
     def update_job(self, job: TrainJob) -> TrainJob:

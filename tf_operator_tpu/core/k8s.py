@@ -195,6 +195,9 @@ def job_status_to_dict(status: JobStatus) -> dict:
         # reshaped gang back up onto capacity that is not there).
         "reshapedReplicas": status.reshaped_replicas,
         "reshapedTopology": status.reshaped_topology,
+        # Slice claim record (moved out of the tpujob.dev/slice annotation
+        # so the whole per-job lifecycle ships in ONE /status patch).
+        "sliceIds": list(status.slice_ids),
     }
 
 
@@ -216,6 +219,7 @@ def job_status_from_dict(d: dict) -> JobStatus:
         pending_preemption_uids=list(d.get("pendingPreemptionUids") or []),
         reshaped_replicas=d.get("reshapedReplicas"),
         reshaped_topology=d.get("reshapedTopology") or "",
+        slice_ids=list(d.get("sliceIds") or []),
     )
     for c in d.get("conditions") or []:
         status.conditions.append(
@@ -1150,34 +1154,46 @@ class K8sCluster:
 
     def _diffed_status_patch(self, kind: str, obj, status_diff: dict,
                              base, expected_rv):
-        """ONE merge-patch carrying only what this sync changed (round
-        17). Annotations unchanged -> the patch goes to /status (the
-        subresource lane, like the legacy path). Annotations changed ->
-        one combined patch to the main resource: both stanzas are
-        controller-owned, so the lane stays conflict-free against spec
-        editors, and one request replaces the legacy two. With
-        `expected_rv` the patch carries the observed resourceVersion —
-        the server 409s a stale observation instead of merging it.
-        Nothing changed -> NO request at all; the caller's working copy
-        is returned as-is."""
+        """Merge-patches carrying only what this sync changed (round 17,
+        amended by its review). Status ALWAYS ships via the /status
+        subresource: both CRDs enable the subresource, and a real
+        apiserver ignores the status stanza of a main-resource write —
+        a combined patch would silently drop the status half (terminal
+        conditions, drain latches) on a real cluster. Annotations
+        changed -> ONE extra main-resource patch carrying just the
+        annotations (both stanzas are controller-owned, so each lane
+        stays conflict-free against spec editors). The common
+        status-only sync is still exactly one request; nothing changed
+        -> NO request at all and the caller's working copy is returned
+        as-is. With `expected_rv` each patch carries the observed (or
+        just-written) resourceVersion — the server 409s a stale
+        observation instead of merging it."""
         ann_diff = _wire_diff(dict(obj.metadata.annotations),
                               dict(base.metadata.annotations))
         if not status_diff and not ann_diff:
             return obj
-        meta: dict = {}
-        if ann_diff:
-            meta["annotations"] = ann_diff
-        if expected_rv is not None:
-            # Wire form is a string (see _meta_to_dict); the server compares
-            # it verbatim against what it stored.
-            meta["resourceVersion"] = str(expected_rv)
-        patch: dict = {}
-        if meta:
-            patch["metadata"] = meta
+        out = obj
+        # Wire form is a string (see _meta_to_dict); the server compares
+        # it verbatim against what it stored.
+        rv = str(expected_rv) if expected_rv is not None else None
         if status_diff:
-            patch["status"] = status_diff
-        return self._patch(kind, obj.namespace, obj.name, patch,
-                           subresource="" if ann_diff else "status")
+            patch: dict = {"status": status_diff}
+            if rv is not None:
+                patch["metadata"] = {"resourceVersion": rv}
+            out = self._patch(kind, obj.namespace, obj.name, patch,
+                              subresource="status")
+            # The status write bumped the rv; fence the annotations
+            # patch against the version we just wrote, not the stale
+            # pre-write observation (which would always 409).
+            if rv is not None:
+                rv = str(out.metadata.resource_version)
+        if ann_diff:
+            meta: dict = {"annotations": ann_diff}
+            if rv is not None:
+                meta["resourceVersion"] = rv
+            out = self._patch(kind, obj.namespace, obj.name,
+                              {"metadata": meta})
+        return out
 
     def _delete(self, kind: str, namespace: str, name: str):
         d = self.api.request(
@@ -1196,10 +1212,15 @@ class K8sCluster:
 
         Round 17: jobs are no longer excluded. They used to stay
         read-through because status latches (gang roll / preemption
-        drains) need read-your-writes — now every status flush from a
-        cache-served sync carries the observed resourceVersion as a
+        drains) need read-your-writes — now (a) every status flush from
+        a cache-served sync carries the observed resourceVersion as a
         fence, so a stale read can only cost a 409 + requeue, never a
-        blind overwrite of a newer status (core/status_writer.py)."""
+        blind overwrite of a newer status (core/status_writer.py); and
+        (b) the fence alone cannot undo side effects taken BEFORE the
+        flush, so the controller re-verifies any observed destructive
+        latch with a read-through GET and flushes latch writes before
+        acting on them (trainjob_controller.sync_job / the tick
+        callers)."""
         inf = self._synced_informer(kind)
         if inf is None:
             return None
@@ -1302,10 +1323,18 @@ class K8sCluster:
     def get_job(self, namespace: str, name: str) -> TrainJob:
         return self._get(KIND_JOB, namespace, name)
 
-    def try_get_job(self, namespace: str, name: str) -> TrainJob | None:
-        cached = self._cache_get(KIND_JOB, namespace, name)
-        if cached is not None:
-            return cached
+    def try_get_job(self, namespace: str, name: str, *,
+                    read_through: bool = False) -> TrainJob | None:
+        """`read_through=True` bypasses the lister cache for this one
+        read (round-17 review): destructive status latches (preemption
+        drain, gang roll) drive pod deletes and scheduler requeues in
+        the SAME sync that observes them — those need read-your-writes,
+        which the cache cannot promise and the flush-time rv fence
+        cannot retroactively undo."""
+        if not read_through:
+            cached = self._cache_get(KIND_JOB, namespace, name)
+            if cached is not None:
+                return cached
         return self._try_get(KIND_JOB, namespace, name)
 
     def update_job(self, job: TrainJob) -> TrainJob:

@@ -23,11 +23,19 @@ through instead:
     nothing now. The next sync recomputes the same diff against the
     then-current observation (deferred dirt is recomputed, never
     stored), so the queued/admitted/running transitions of a fast job
-    merge into its one terminal write. `window=0` (default) flushes
-    every dirty sync — bit-for-bit today's write timing, which tests
-    observe. Urgent flushes (terminal conditions, durability latches
-    that must be persisted before pod deletions, reshape records)
-    always write immediately and also sweep up any deferred dirt.
+    merge into its one terminal write. THE CONTRACT this places on
+    callers: every non-urgent status/annotation mutation must be a
+    pure function of state the deferred sync can RE-OBSERVE (the
+    object itself, its pods/services, scheduler state). A value
+    derived from transient observed state — say a counter sampled
+    from a pod condition that may vanish before the deferred sync
+    fires — would be silently LOST, not coalesced; such writes must
+    flush `urgent=True` (which is exactly why the durability latches
+    do). `window=0` (default) flushes every dirty sync — bit-for-bit
+    today's write timing, which tests observe. Urgent flushes
+    (terminal conditions, durability latches that must be persisted
+    before pod deletions, reshape records) always write immediately
+    and also sweep up any deferred dirt.
 
   * **Generation fencing** — when the controller read the object from a
     lister snapshot (`lists_from_cache`), flush carries the observed
@@ -96,6 +104,13 @@ class StatusWriter:
         """Write obj's status+annotations if they differ from `base`
         (the pristine observed copy this sync started from). Returns the
         post-write object (or `obj` unchanged when nothing was written).
+
+        A deferred non-urgent flush (window > 0) writes NOTHING and
+        retains no diff — the deferred sync recomputes dirt from its own
+        fresh observation. Non-urgent mutations must therefore be pure
+        functions of re-observable state; anything derived from
+        transient state must pass urgent=True or it can be lost (see
+        the module docstring's coalescing contract).
 
         Raises the substrate's ConflictError when the fence detects the
         observation was stale — callers let it propagate so the

@@ -70,6 +70,12 @@ ENV_TTL_DEBUG = "ttlSecondsAfterFinishedDebug"
 DEFAULT_TTL_CLEAN_S = 15 * 60
 DEFAULT_TTL_DEBUG_S = 7 * 24 * 3600
 
+# Legacy slice-claim annotation key. The controller no longer writes it —
+# the claim record lives in status.slice_ids (see _record_slices) so it
+# rides the one /status patch per sync instead of costing every job a
+# second main-resource write. Jobs persisted by older operators may still
+# carry the annotation; it is simply left alone (the allocator re-derives
+# claims on sync, so nothing reads it back).
 ANNOTATION_SLICE = "tpujob.dev/slice"
 
 SLICE_RETRY_DELAY_S = 15.0
@@ -172,11 +178,21 @@ class TrainJobController(ctrl.JobControllerBase):
         # fast job's queued/admitted/running transitions merge into its
         # terminal write. Fenced with the observed resourceVersion when
         # the substrate serves possibly-stale lister-snapshot reads.
+        # Coalescing contract (status_writer.py): a deferred flush
+        # writes nothing and keeps no diff — every non-urgent status
+        # mutation this controller makes must be recomputable from a
+        # fresh observation (all of sync_job's are: conditions, replica
+        # tallies, and bookkeeping derive from the job+pods it reads
+        # each pass); anything transient-derived must flush urgent.
         self._status_writer = status_writer_lib.StatusWriter(
             cluster.update_job_status, kind=TrainJob.KIND,
             window=status_coalesce_window, clock=lambda: self._now(),
             defer=lambda key, delay: self.queue.add_after(key, delay),
-            fence=bool(getattr(cluster, "lists_from_cache", True)),
+            # Default False: only substrates that declare they may serve
+            # stale lister reads get fenced — read-through substrates
+            # (InMemoryCluster) skip it so the merge-patch lane stays
+            # conflict-free against concurrent spec editors.
+            fence=bool(getattr(cluster, "lists_from_cache", False)),
         )
         self.cluster.on_add("TrainJob", self._count_created)
         self.cluster.on_delete("TrainJob", self._count_deleted)
@@ -200,6 +216,18 @@ class TrainJobController(ctrl.JobControllerBase):
         metrics.reconcile_total.inc()
         ns, name = naming.split_job_key(key)
         shared = self.cluster.try_get_job(ns, name)
+        if (shared is not None
+                and getattr(self.cluster, "lists_from_cache", False)
+                and (shared.status.pending_preemption_uids
+                     or shared.status.pending_gang_roll_uids)):
+            # A destructive drain latch replays pod deletes and
+            # scheduler requeues in THIS sync — that needs
+            # read-your-writes, which a lister-cache observation cannot
+            # promise: the flush-time rv fence converts a stale WRITE
+            # into a requeue but cannot undo deletes already issued
+            # from a stale latch. One read-through GET re-verifies the
+            # latch before anything acts on it (round-17 review).
+            shared = self.cluster.try_get_job(ns, name, read_through=True)
         if shared is None:
             # Deleted between enqueue and sync: drop bookkeeping.
             for rtype in ReplicaType:
@@ -386,12 +414,17 @@ class TrainJobController(ctrl.JobControllerBase):
         # per-type loop, exactly like a gang roll (deletions drive the
         # next sync). Runs BEFORE gang recovery so an eviction in flight
         # can never be double-counted as a retryable failure.
-        if self._preemption_tick(job, pods, key):
+        doomed = self._preemption_tick(job, pods, key)
+        if doomed is not None:
             if job.status != base.status:
                 job.status.last_reconcile_time = self._now()
-            # Urgent: the pending_preemption_uids drain latch must be
-            # durable before the NEXT sync's deletions depend on it.
+            # Urgent, and flushed BEFORE the deletes it authorizes: the
+            # pending_preemption_uids drain latch must be durable — and,
+            # when fenced, proven fresh (a stale lister observation 409s
+            # here into a requeue) — ahead of any destructive side
+            # effect this sync takes from it.
             self._status_writer.flush(job, base, urgent=True)
+            self._delete_gang_pods(job, key, doomed)
             return
 
         # Pods/services of replica types REMOVED from the spec would never be
@@ -426,12 +459,17 @@ class TrainJobController(ctrl.JobControllerBase):
         # the deletions' events drive the next sync, which recreates the
         # gang through the normal creation path once the old generation is
         # fully drained (same two-phase discipline as the elastic roll).
-        if self._gang_recovery_tick(job, pods, key):
+        doomed = self._gang_recovery_tick(job, pods, key)
+        if doomed is not None:
             if job.status != base.status:
                 job.status.last_reconcile_time = self._now()
-            # Urgent: pending_gang_roll_uids is the don't-double-count
-            # latch an operator failover replays deletes from.
+            # Urgent, and flushed BEFORE the deletes it authorizes:
+            # pending_gang_roll_uids is the don't-double-count latch an
+            # operator failover replays deletes from — it must be
+            # durable (and, when fenced, proven fresh) before any pod
+            # dies for it.
             self._status_writer.flush(job, base, urgent=True)
+            self._delete_gang_pods(job, key, doomed)
             return
 
         for rtype, spec in sorted(
@@ -520,6 +558,17 @@ class TrainJobController(ctrl.JobControllerBase):
             status_engine.REASON_GANG_RESHAPED, msg, now,
         )
 
+    @staticmethod
+    def _record_slices(job: TrainJob, slice_ids: list[str]) -> None:
+        """Record the slice claim in status.slice_ids (idempotent). The
+        allocator/scheduler stays authoritative; this is the durable
+        observability record, kept in STATUS so it ships inside the same
+        /status patch as the conditions — an annotation here would cost
+        every admitted job a second main-resource write per sync wave."""
+        ids = [s for s in slice_ids if s]
+        if job.status.slice_ids != ids:
+            job.status.slice_ids = ids
+
     def _record_full_size(self, job: TrainJob, key: str) -> bool:
         """Full-size (re)admission: clear any reshape state, lower the
         GangReshaped condition, count the grow transition. True when a
@@ -607,7 +656,7 @@ class TrainJobController(ctrl.JobControllerBase):
             self.cluster.record_event(
                 TrainJob.KIND, job.namespace, job.name, "Warning",
                 "SliceLost",
-                f"slice {job.metadata.annotations.get(ANNOTATION_SLICE)} "
+                f"slice {','.join(job.status.slice_ids) or None} "
                 f"went offline while held; releasing the claim for "
                 f"re-admission",
             )
@@ -666,9 +715,8 @@ class TrainJobController(ctrl.JobControllerBase):
                 # holds both until the old generation drains; the
                 # cleanup block above releases it and kicks the waiters.
                 self._record_full_size(job, key)
-            if (decision.slice_id and job.metadata.annotations.get(
-                    ANNOTATION_SLICE) != decision.slice_id):
-                job.metadata.annotations[ANNOTATION_SLICE] = decision.slice_id
+            if decision.slice_id:
+                self._record_slices(job, [decision.slice_id])
             return None
         sched = job.spec.run_policy.scheduling
         if decision.reason == "quota":
@@ -723,9 +771,7 @@ class TrainJobController(ctrl.JobControllerBase):
             # per holder; elastic reshape is excluded by validation.
             sids = self.slice_allocator.admit_many(key, full_topology, n)
             if sids is not None:
-                joined = ",".join(sids)
-                if job.metadata.annotations.get(ANNOTATION_SLICE) != joined:
-                    job.metadata.annotations[ANNOTATION_SLICE] = joined
+                self._record_slices(job, sids)
                 return None
             free = self.slice_allocator.free_of_class(full_topology)
             self.cluster.record_event(
@@ -747,8 +793,7 @@ class TrainJobController(ctrl.JobControllerBase):
                 and job.status.reshaped_replicas is None):
             held = (self.slice_allocator.holding_class(key, full_topology)
                     or self.slice_allocator.holding(key))
-            if job.metadata.annotations.get(ANNOTATION_SLICE) != held:
-                job.metadata.annotations[ANNOTATION_SLICE] = held
+            self._record_slices(job, [held])
             return None
         # Full size first — `claim` is both the fresh admission and the
         # scale-back-up: a reshaped gang with live pods keeps its
@@ -757,15 +802,13 @@ class TrainJobController(ctrl.JobControllerBase):
         slice_id = self.slice_allocator.claim(key, full_topology)
         if slice_id is not None:
             self._record_full_size(job, key)
-            if job.metadata.annotations.get(ANNOTATION_SLICE) != slice_id:
-                job.metadata.annotations[ANNOTATION_SLICE] = slice_id
+            self._record_slices(job, [slice_id])
             return None
         # Full size unavailable. A reshaped gang's degraded claim stands
         # (admit is idempotent by holder).
         held = self.slice_allocator.admit(key, full_topology)
         if held is not None:
-            if job.metadata.annotations.get(ANNOTATION_SLICE) != held:
-                job.metadata.annotations[ANNOTATION_SLICE] = held
+            self._record_slices(job, [held])
             return None
         if elastic:
             for cand, scaled in self._degraded_candidates(job):
@@ -773,8 +816,7 @@ class TrainJobController(ctrl.JobControllerBase):
                 if sid is None:
                     continue  # raced: try the next class
                 self._record_reshape(job, key, scaled, cand)
-                if job.metadata.annotations.get(ANNOTATION_SLICE) != sid:
-                    job.metadata.annotations[ANNOTATION_SLICE] = sid
+                self._record_slices(job, [sid])
                 return None
         self.cluster.record_event(
             TrainJob.KIND, job.namespace, job.name, "Warning",
@@ -964,18 +1006,21 @@ class TrainJobController(ctrl.JobControllerBase):
             return d, False
         return None, False
 
-    def _preemption_tick(self, job: TrainJob, pods: list[Pod], key: str) -> bool:
+    def _preemption_tick(self, job: TrainJob, pods: list[Pod],
+                         key: str) -> list[Pod] | None:
         """Graceful eviction: triggered by the fleet scheduler (a pending
         higher-priority job claimed this gang's slice) or by a chaos
-        `preempt:` directive (deterministic e2es). Deletes every
+        `preempt:` directive (deterministic e2es). Dooms every
         non-succeeded pod — the runtime SIGTERMs them, trainers finish the
         in-flight step and emergency-checkpoint (PR 4), the drain
         discipline SIGKILLs stragglers (PR 5) — then requeues the job with
         a Preempted condition. The restart tally is NEVER touched: a
         planned eviction is not a failure, and counting it against
         backoffLimit would fail exactly the long-running low-priority jobs
-        preemption targets. Returns True when this sync acted (the caller
-        skips the per-type loop; deletions drive the next sync)."""
+        preemption targets. Returns None when this sync did not act, else
+        the pods to delete (possibly none): the caller skips the per-type
+        loop and issues the deletes only AFTER the latch flush succeeds,
+        so a stale fenced observation 409s before anything dies."""
         # Drain phase first: a counted preemption re-issues its deletes
         # across syncs (and operator failovers — the latch is in status)
         # without ever re-counting the incident.
@@ -983,11 +1028,10 @@ class TrainJobController(ctrl.JobControllerBase):
             pending = set(job.status.pending_preemption_uids)
             left = [p for p in pods if p.metadata.uid in pending]
             if left:
-                self._delete_gang_pods(job, key, left)
-                return True
+                return left
             job.status.pending_preemption_uids = []
             self._finish_preemption_drain(job, key)
-            return True
+            return []
 
         detail = None
         if self.scheduler is not None:
@@ -1020,12 +1064,12 @@ class TrainJobController(ctrl.JobControllerBase):
                 detail = (f"chaos preempt directive fired at step >= "
                           f"{d.params['step']}")
         if detail is None:
-            return False
+            return None
         if is_terminal(job.status):
             # Raced completion: nothing to evict; drop the request.
             if self.scheduler is not None:
                 self.scheduler.clear_eviction(key)
-            return False
+            return None
 
         now = self._now()
         # The eviction marker is deliberately NOT cleared here: it stands
@@ -1053,10 +1097,9 @@ class TrainJobController(ctrl.JobControllerBase):
             job.status.pending_preemption_uids = sorted(
                 p.metadata.uid for p in doomed
             )
-            self._delete_gang_pods(job, key, doomed)
-        else:
-            self._finish_preemption_drain(job, key)
-        return True
+            return doomed
+        self._finish_preemption_drain(job, key)
+        return []
 
     def _finish_preemption_drain(self, job: TrainJob, key: str) -> None:
         """Every evicted pod is gone: hand the slice back (the preemptor
@@ -1073,17 +1116,21 @@ class TrainJobController(ctrl.JobControllerBase):
         # their Queued position refreshed).
         self.queue.add_after(key, 0.2)
 
-    def _gang_recovery_tick(self, job: TrainJob, pods: list[Pod], key: str) -> bool:
+    def _gang_recovery_tick(self, job: TrainJob, pods: list[Pod],
+                            key: str) -> list[Pod] | None:
         """One gang-recovery pass: consecutive-tally reset on heartbeat
         progress, then the two triggers — (a) a gang member failed with a
         retryable exit code under EXIT_CODE policy, (b) the hang watchdog
         (Running job whose freshest heartbeat is older than
-        recovery.heartbeatTimeoutSeconds). Returns True when this sync
-        initiated a gang restart or backoff-failed the job (the caller
-        then skips the per-type loop; deletions drive the next sync)."""
+        recovery.heartbeatTimeoutSeconds). Returns None when this sync
+        did not initiate a gang restart or backoff-fail the job;
+        otherwise the pods to delete (possibly none) — the caller skips
+        the per-type loop and issues the deletes only AFTER the latch
+        flush succeeds, so a stale fenced observation 409s before
+        anything dies."""
         rec = job.spec.run_policy.recovery
         if rec.policy != "gang":
-            return False  # per-pod replacement: today's path, bit-for-bit
+            return None  # per-pod replacement: today's path, bit-for-bit
         now = self._now()
         # Heartbeat aggregation hits per-pod files on disk: read at most
         # once per tick, and ONLY on the branches that consume it — a
@@ -1175,8 +1222,7 @@ class TrainJobController(ctrl.JobControllerBase):
         if pending:
             left = [p for p in pods if p.metadata.uid in pending]
             if left:
-                self._delete_gang_pods(job, key, left)
-                return True
+                return left
             job.status.pending_gang_roll_uids = []  # roll fully drained
 
         members = self._gang_members(pods)
@@ -1207,7 +1253,7 @@ class TrainJobController(ctrl.JobControllerBase):
                 continue
             code = pod.main_exit_code()
             if code is None or not is_retryable_exit_code(code):
-                return False  # permanent failure: normal path fails the job
+                return None  # permanent failure: normal path fails the job
             failed_retryable.append(pod)
             if trigger is None:
                 # Same cause taxonomy as the per-pod path: 128+signum is
@@ -1304,7 +1350,7 @@ class TrainJobController(ctrl.JobControllerBase):
                     )
 
         if trigger is None:
-            return False
+            return None
 
         reason, detail = trigger
         limit = job.spec.run_policy.backoff_limit
@@ -1324,7 +1370,7 @@ class TrainJobController(ctrl.JobControllerBase):
                 metrics.jobs_failed.labels(namespace=job.namespace).inc()
             if job.status.completion_time is None:
                 job.status.completion_time = now
-            return True
+            return []
 
         # The restart: ONE tally increment and ONE restarts_total sample
         # however many pods roll, heartbeat high-water recorded as the
@@ -1368,8 +1414,7 @@ class TrainJobController(ctrl.JobControllerBase):
         job.status.pending_gang_roll_uids = sorted(
             p.metadata.uid for p in doomed
         )
-        self._delete_gang_pods(job, key, doomed)
-        return True
+        return doomed
 
     def _delete_gang_pods(self, job: TrainJob, key: str,
                           doomed: list[Pod]) -> None:

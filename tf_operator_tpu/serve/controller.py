@@ -154,11 +154,18 @@ class InferenceServiceController(ctrl.JobControllerBase):
         # controller ("optimize both together or neither" — the PR-13
         # review note): no-op syncs write nothing, dirty syncs flush one
         # diffed merge-patch, fenced when reads may be lister-stale.
+        # Coalescing contract (status_writer.py): deferred flushes keep
+        # no diff, so every non-urgent status mutation here must be
+        # recomputable from a fresh observation (replica states, route
+        # tables, and autoscale targets all re-derive from the service
+        # + its pods each sync); transient-derived writes flush urgent.
         self._status_writer = status_writer_lib.StatusWriter(
             cluster.update_infsvc_status, kind=InferenceService.KIND,
             window=status_coalesce_window, clock=lambda: self._now(),
             defer=lambda key, delay: self.queue.add_after(key, delay),
-            fence=bool(getattr(cluster, "lists_from_cache", True)),
+            # Default False: read-through substrates (InMemoryCluster)
+            # skip the fence — see the TrainJob controller's note.
+            fence=bool(getattr(cluster, "lists_from_cache", False)),
         )
         # (namespace, service, pod name, port) -> "host:port" for the
         # front-end router's backends (serve/router.py). The local

@@ -748,6 +748,24 @@ class FakeApiServer:
                     return self._error(404, "NotFound", self.path)
                 res, ns, name, sub = m["resource"], m["ns"], m["name"], m["sub"]
                 patch = self._body()
+                if sub == "status":
+                    # the /status subresource only takes status changes —
+                    # but the resourceVersion precondition (checked below)
+                    # still applies
+                    kept = {"status": patch.get("status", {})}
+                    rv_pre = (patch.get("metadata") or {}).get(
+                        "resourceVersion")
+                    if rv_pre:
+                        kept["metadata"] = {"resourceVersion": rv_pre}
+                    patch = kept
+                elif "status" in patch:
+                    # A real apiserver IGNORES the status stanza of a
+                    # main-resource write when the status subresource is
+                    # enabled (both CRDs enable it) — same modeling as
+                    # do_PUT above. Without this, a combined
+                    # status+metadata patch "works" here while silently
+                    # dropping its status half on a real cluster.
+                    patch = {k: v for k, v in patch.items() if k != "status"}
                 if sub is None and res in webhooks:
                     # Admission sees the merged object (what would be
                     # stored). Preview-merge OUTSIDE the store lock — an
@@ -779,9 +797,6 @@ class FakeApiServer:
                             f"{res} {ns}/{name}: resourceVersion {patch_rv} "
                             f"!= {cur['metadata'].get('resourceVersion')}",
                         )
-                    if sub == "status":
-                        # the /status subresource only takes status changes
-                        patch = {"status": patch.get("status", {})}
                     # deep-copy first: _merge_patch shallow-shares unpatched
                     # subtrees with the stored object, so the rv write below
                     # (or _validate_and_prune's in-place pruning on a patch
