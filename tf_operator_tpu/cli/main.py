@@ -14,6 +14,8 @@ re-targeted at the local substrate:
                                threads (--threadiness)
   tpujob get [NS [NAME]]       query a running operator's REST API
   tpujob submit JOB.yaml       submit to a running operator via REST
+  tpujob timeline NAME         causal phase view of one job's lifecycle
+                               from the operator's flight recorder
   tpujob version               version info (pkg/version parity)
 
 Exit codes: run returns 0 on Succeeded, 1 on Failed.
@@ -137,6 +139,22 @@ def cmd_operator(args) -> int:
     from tf_operator_tpu.utils.leader import LeaderElector
 
     log = FieldLogger({"component": "operator"})
+    # Flight recorder sizing: the journal is ON by default (bounded ring
+    # per job, O(1) appends — docs/monitoring.md "Flight recorder").
+    from tf_operator_tpu.telemetry import journal as journal_lib
+
+    journal_lib.configure(
+        enabled=not args.no_journal,
+        per_job_capacity=args.journal_events,
+        max_jobs=args.journal_jobs,
+    )
+    # Operator-side tracing is opt-in (--trace PATH): spans around every
+    # reconcile pass, scheduler decide, and status flush land in a
+    # Perfetto/chrome://tracing-loadable Chrome trace on shutdown.
+    if args.trace:
+        from tf_operator_tpu.telemetry import tracer as tracer_lib
+
+        tracer_lib.configure(enabled=True)
     # Fleet scheduling policy (sched/): priority classes, per-namespace
     # quotas, weighted queues, preemption cooldown. With --tpu-slices the
     # scheduler arbitrates the fleet; without slices the policy still
@@ -277,7 +295,8 @@ def cmd_operator(args) -> int:
         api = ApiServer(cluster, port=args.monitoring_port, log_dir=args.log_dir,
                         runtime=runtime, bind=args.bind,
                         telemetry=heartbeat_source, scheduler=scheduler,
-                        fleet=fleet_policy)
+                        fleet=fleet_policy,
+                        controllers=[controller, serve_controller])
         api.start()
         log.info("REST/metrics API on %s:%d", args.bind, api.port)
         controller.run(workers=args.threadiness)
@@ -291,6 +310,12 @@ def cmd_operator(args) -> int:
         if on_k8s:
             cluster.stop()
         api.stop()
+        if args.trace:
+            from tf_operator_tpu.telemetry import tracer as tracer_lib
+
+            n = tracer_lib.get_tracer().export(args.trace)
+            log.info("chrome trace: %d event(s) written to %s",
+                     n, args.trace)
 
     # Standby health stub (in-cluster only — pods have their own netns, so
     # no port collision; on a shared host two operators DO collide, which is
@@ -399,6 +424,72 @@ def cmd_get(args) -> int:
             path += f"/{args.name}"
     data = _api_get(args.server, path)
     print(json.dumps(data, indent=2, default=str))
+    return 0
+
+
+def render_timeline(data: dict, *, show_events: bool = True) -> str:
+    """Human rendering of one job's flight-recorder timeline (the
+    /api/trainjobs/{ns}/{name}/timeline payload): the causal phase
+    breakdown first, then the raw event log, then whatever the trainer
+    telemetry collector knows about the same job."""
+    lines = []
+    wall = data.get("wall_clock_s", 0.0)
+    suffix = " (deleted; post-mortem)" if data.get("deleted") else ""
+    lines.append(f"TrainJob {data['job']} — timeline, "
+                 f"{wall:.3f}s journaled wall clock{suffix}")
+    # All times render as offsets from the submit anchor — absolute wall
+    # clocks belong in --json, not a terminal table.
+    t0 = data.get("submitted_at", 0.0)
+    phases = data.get("phases") or []
+    if phases:
+        lines.append("")
+        lines.append(f"  {'PHASE':<10} {'START':>10} {'END':>10} "
+                     f"{'SECONDS':>10}  ")
+        for p in phases:
+            frac = (p["seconds"] / wall) if wall > 0 else 0.0
+            bar = "#" * max(1, int(round(frac * 30)))
+            lines.append(f"  {p['phase']:<10} {p['start'] - t0:>9.3f}s "
+                         f"{p['end'] - t0:>9.3f}s {p['seconds']:>9.3f}s"
+                         f"  {bar}")
+    if show_events:
+        events = data.get("events") or []
+        dropped = data.get("dropped", 0)
+        lines.append("")
+        lines.append(f"events: {len(events)}"
+                     + (f" (+{dropped} dropped — oldest fell off the ring)"
+                        if dropped else ""))
+        for ev in events:
+            attrs = ev.get("attrs") or {}
+            extra = " ".join(f"{k}={v}" for k, v in attrs.items())
+            rid = ev.get("reconcile_id")
+            tag = f" [rid={rid}]" if rid else ""
+            lines.append(f"  +{ev['offset_s']:>9.3f}s  {ev['event']:<16}"
+                         f" {extra}{tag}".rstrip())
+    trainer = data.get("trainer")
+    if trainer and trainer.get("replicas"):
+        lines.append("")
+        lines.append("trainer telemetry:")
+        for pod, s in sorted(trainer["replicas"].items()):
+            bits = []
+            for k in ("startup_s", "step", "loss", "steady_steps_per_sec"):
+                if s.get(k) is not None:
+                    bits.append(f"{k}={s[k]}")
+            lines.append(f"  {pod}: " + " ".join(bits))
+    return "\n".join(lines)
+
+
+def cmd_timeline(args) -> int:
+    path = f"/api/trainjobs/{args.namespace}/{args.name}/timeline"
+    try:
+        data = _api_get(args.server, path)
+    except urllib.error.HTTPError as e:
+        print(f"timeline: {e.code} {e.read().decode(errors='replace')}",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(data, indent=2, default=str))
+        return 0
+    print(render_timeline(data, show_events=not args.no_events))
     return 0
 
 
@@ -569,6 +660,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="TLS cert for the webhook (real clusters require "
                         "HTTPS webhooks); plain HTTP without it")
     p.add_argument("--webhook-key", default=None)
+    # Flight recorder + tracing (docs/monitoring.md "Flight recorder").
+    p.add_argument("--no-journal", action="store_true",
+                   help="disable the per-job lifecycle journal (on by "
+                        "default; bounded memory, O(1) per event)")
+    p.add_argument("--journal-events", type=int, default=256,
+                   help="ring capacity per job — oldest events drop "
+                        "(counted in the timeline's `dropped`) beyond it")
+    p.add_argument("--journal-jobs", type=int, default=4096,
+                   help="max jobs journaled; least-recently-touched "
+                        "jobs evict beyond it")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="record operator-side spans (reconcile passes, "
+                        "scheduler decides, status flushes) and write a "
+                        "Perfetto/chrome://tracing-loadable trace to "
+                        "PATH on shutdown")
     p.set_defaults(fn=cmd_operator)
 
     p = sub.add_parser("kubelet")
@@ -596,6 +702,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("manifest")
     p.add_argument("--server", default="127.0.0.1:8443")
     p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("timeline",
+                       help="causal phase view of one job from the "
+                            "operator's flight recorder")
+    p.add_argument("name")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--server", default="127.0.0.1:8443")
+    p.add_argument("--json", action="store_true",
+                   help="raw timeline payload instead of the rendering")
+    p.add_argument("--no-events", action="store_true",
+                   help="phase breakdown only; skip the event log")
+    p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("scale")
     p.add_argument("name")

@@ -140,13 +140,17 @@ class ApiServer:
     def __init__(self, cluster: InMemoryCluster, port: int = 8443,
                  log_dir: str | None = None, runtime=None,
                  bind: str = "127.0.0.1", telemetry=None, scheduler=None,
-                 fleet=None):
+                 fleet=None, controllers=()):
         self.cluster = cluster
         self.log_dir = log_dir
         self.runtime = runtime  # LocalProcessRuntime, for the endpoints view
         # Fleet scheduler (sched.FleetScheduler): serves per-job queue
         # position on single-job GETs and the whole-fleet /api/queues view.
         self.scheduler = scheduler
+        # Workload controllers, for /debug/state introspection: their
+        # StatusWriters' pending coalescing windows and (serve) router
+        # backends. Optional — the endpoint degrades to what's wired.
+        self.controllers = list(controllers)
         # Fleet policy for submit-time validation. Passed separately so a
         # --fleet-config-only deployment (no slices -> no scheduler) still
         # 400s a typo'd priorityClass at the API edge.
@@ -300,6 +304,15 @@ class ApiServer:
                                 ]
                             }
                         )
+                    elif (parts[:2] == ["api", "trainjobs"]
+                          and len(parts) == 5 and parts[4] == "timeline"):
+                        tl = outer.timeline(parts[2], parts[3])
+                        if tl is None:
+                            self._send({"error": "no journal for job"}, 404)
+                        else:
+                            self._send(tl)
+                    elif parts == ["debug", "state"]:
+                        self._send(outer.debug_state())
                     elif parts[:2] == ["api", "trainjobs"] and len(parts) == 4:
                         self._get_job_maybe_wait(parts[2], parts[3])
                     elif (parts[:2] == ["api", "inferenceservices"]
@@ -540,6 +553,51 @@ class ApiServer:
         self._server = ThreadingHTTPServer((bind, port), Handler)
         self.port = self._server.server_address[1]
         self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------- flight recorder views
+
+    def timeline(self, ns: str, name: str) -> dict | None:
+        """The job's flight-recorder timeline: journaled events (wall-
+        clock anchored), the contiguous phase breakdown, and — when a
+        collector is wired — the trainer-side telemetry merged in. None
+        when the job was never journaled (or its ring expired)."""
+        from tf_operator_tpu.telemetry import journal as journal_lib
+
+        return journal_lib.timeline_payload(
+            ns, name, telemetry=self.telemetry)
+
+    def debug_state(self) -> dict:
+        """One JSON snapshot of the control plane's live internals:
+        scheduler queues, allocator claims, pending StatusWriter
+        coalescing windows, serve-router backends, journal accounting."""
+        from tf_operator_tpu.telemetry import journal as journal_lib
+
+        state: dict = {"journal": journal_lib.get_journal().snapshot()}
+        if self.scheduler is not None:
+            state["scheduler"] = self.scheduler.snapshot()
+            alloc = getattr(self.scheduler, "allocator", None)
+        else:
+            alloc = None
+        if alloc is None:
+            for c in self.controllers:
+                alloc = getattr(c, "slice_allocator", None)
+                if alloc is not None:
+                    break
+        if alloc is not None:
+            state["allocator"] = alloc.snapshot()
+        writers = {}
+        routers = {}
+        for c in self.controllers:
+            sw = getattr(c, "_status_writer", None)
+            if sw is not None:
+                writers[sw.kind] = {"pending": sw.pending(),
+                                    "window_s": sw.window}
+            snap = getattr(c, "router_snapshot", None)
+            if callable(snap):
+                routers.update(snap())
+        state["status_writers"] = writers
+        state["routers"] = routers
+        return state
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)

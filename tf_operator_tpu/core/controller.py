@@ -17,6 +17,7 @@ Responsibilities at this layer (ref jobcontroller.go:81-301, pod.go, service.go)
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Callable
@@ -32,6 +33,8 @@ from tf_operator_tpu.core.cluster import (
 from tf_operator_tpu.core.control import PodControl, ServiceControl
 from tf_operator_tpu.core.expectations import make_expectations
 from tf_operator_tpu.core.workqueue import make_queue
+from tf_operator_tpu.telemetry import journal as _journal
+from tf_operator_tpu.telemetry import tracer as _tracer
 from tf_operator_tpu.utils import naming
 from tf_operator_tpu.utils.logging import logger_for_key
 
@@ -123,6 +126,11 @@ class JobControllerBase:
         self._workers: list[threading.Thread] = []
         self._in_flight = 0
         self._idle_cond = threading.Condition()
+        # Sync-wave ids for the flight recorder: one id per _process_item
+        # pass; every journal event recorded on the sync's thread — by this
+        # controller, the scheduler it consults, or the StatusWriter it
+        # flushes through — carries it, so a timeline groups by wave.
+        self._reconcile_ids = itertools.count(1)
         self._register_handlers()
 
     # ---- plug-points (ControllerInterface, jobcontroller.go:33-63) ----
@@ -307,6 +315,9 @@ class JobControllerBase:
         self.expectations.raise_expectations(exp_key, 0, 1)
         if not self.pod_control.delete_pod(pod.namespace, pod.name, owner):
             self.expectations.deletion_observed(exp_key)
+        else:
+            _journal.get_journal().record(owner.key(), "pod.delete",
+                                          pod=pod.name)
 
     def _tracked_delete_service(self, owner, svc: Service) -> None:
         rt = svc.metadata.labels.get(LABEL_REPLICA_TYPE, "")
@@ -324,6 +335,8 @@ class JobControllerBase:
             # stuck until the 5-minute expectation timeout.
             self.expectations.creation_observed(exp_key)
             return False
+        _journal.get_journal().record(owner.key(), "pod.create",
+                                      pod=pod.name, replica_type=rtype)
         return True
 
     def _tracked_create_service(self, owner, svc: Service,
@@ -444,9 +457,18 @@ class JobControllerBase:
         """Sync one key; on failure, requeue with backoff (controller.go:267)."""
         from tf_operator_tpu.status import metrics
 
+        # One sync wave = one reconcile_id: stamp the thread so every
+        # journal event this pass emits (controller, scheduler, status
+        # writer) is causally groupable, and open an operator trace span
+        # (no-op unless the operator ran with --trace).
+        rid = next(self._reconcile_ids)
+        jrnl = _journal.get_journal()
+        jrnl.set_wave(rid)
         t0 = time.monotonic()
         try:
-            self.sync_job(item)
+            with _tracer.span("reconcile", job=str(item), kind=self.OWNER_KIND,
+                              reconcile_id=rid):
+                self.sync_job(item)
             self.queue.forget(item)
         except Exception as e:
             metrics.reconcile_errors.inc()
@@ -457,6 +479,7 @@ class JobControllerBase:
             # controller.go:289-291; we expose it on /metrics).
             metrics.reconcile_latency.observe(time.monotonic() - t0)
             self.queue.done(item)
+            jrnl.set_wave(0)
 
     def _worker(self, index: int = 0) -> None:
         sharded = getattr(self.queue, "sharded", False)

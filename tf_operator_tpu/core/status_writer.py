@@ -55,6 +55,8 @@ import time
 from typing import Any, Callable
 
 from tf_operator_tpu.status import metrics
+from tf_operator_tpu.telemetry import journal as _journal
+from tf_operator_tpu.telemetry import tracer as _tracer
 
 # Padding added to the deferral requeue so the follow-up sync lands just
 # AFTER the window expires (landing just before would defer once more and
@@ -100,7 +102,8 @@ class StatusWriter:
                 or dict(obj.metadata.annotations)
                 != dict(base.metadata.annotations))
 
-    def flush(self, obj: Any, base: Any, *, urgent: bool = False) -> Any:
+    def flush(self, obj: Any, base: Any, *, urgent: bool = False,
+              reconcile_id: int = 0) -> Any:
         """Write obj's status+annotations if they differ from `base`
         (the pristine observed copy this sync started from). Returns the
         post-write object (or `obj` unchanged when nothing was written).
@@ -117,11 +120,13 @@ class StatusWriter:
         workqueue's error path requeues the key.
         """
         key = f"{obj.metadata.namespace}/{obj.metadata.name}"
+        jrnl = _journal.get_journal()
         if not self.dirty(obj, base):
             with self._lock:
                 self._first_dirty.pop(key, None)
             metrics.status_writes_coalesced.labels(
                 kind=self.kind, reason="noop").inc()
+            jrnl.record(key, "status.flush", reconcile_id, outcome="noop")
             return obj
         if not urgent and self.window > 0:
             now = self._clock()
@@ -133,14 +138,37 @@ class StatusWriter:
                     self._defer(key, remaining + _DEFER_SLACK_S)
                 metrics.status_writes_coalesced.labels(
                     kind=self.kind, reason="deferred").inc()
+                jrnl.record(key, "status.flush", reconcile_id,
+                            outcome="deferred")
                 return obj
         with self._lock:
             self._first_dirty.pop(key, None)
         expected_rv = (base.metadata.resource_version
                        if self.fence else None)
-        return self._update(obj, expected_rv=expected_rv, base=base)
+        try:
+            with _tracer.span("status.flush", job=key, kind=self.kind,
+                              urgent=urgent):
+                out = self._update(obj, expected_rv=expected_rv, base=base)
+        except Exception:
+            # The fence tripped (stale lister snapshot 409'd) or the
+            # apiserver rejected the write — journal it so a timeline
+            # shows the retry loop, then let the workqueue's error path
+            # requeue as before.
+            jrnl.record(key, "status.flush", reconcile_id,
+                        outcome="fenced" if expected_rv is not None
+                        else "error", urgent=urgent)
+            raise
+        jrnl.record(key, "status.flush", reconcile_id, outcome="sent",
+                    urgent=urgent)
+        return out
 
     def forget(self, key: str) -> None:
         """Drop per-key deferral state (the object was deleted)."""
         with self._lock:
             self._first_dirty.pop(key, None)
+
+    def pending(self) -> dict[str, float]:
+        """Keys with un-flushed deferred dirt -> when each FIRST went
+        dirty (/debug/state visibility into the coalescing window)."""
+        with self._lock:
+            return dict(self._first_dirty)

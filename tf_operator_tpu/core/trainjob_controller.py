@@ -53,6 +53,7 @@ from tf_operator_tpu.gang import elastic as elastic_lib
 from tf_operator_tpu.gang import podgroup as gang
 from tf_operator_tpu.status import engine as status_engine
 from tf_operator_tpu.status import metrics
+from tf_operator_tpu.telemetry import journal as journal_lib
 from tf_operator_tpu.utils import naming
 from tf_operator_tpu.utils.env import getenv_int
 from tf_operator_tpu.utils.exit_codes import (
@@ -204,6 +205,13 @@ class TrainJobController(ctrl.JobControllerBase):
         # difference between "a job failed somewhere" and "team X's
         # namespace is failing" on one dashboard.
         metrics.jobs_created.labels(namespace=job.namespace).inc()
+        # Flight recorder: the submit event anchors every later phase
+        # duration (time-to-admission, -running, -first-step). Nameless
+        # stubs (metrics tests exercise the counter alone) skip it.
+        name = getattr(job, "name", None)
+        if name:
+            journal_lib.get_journal().record(
+                f"{job.namespace}/{name}", "submit")
 
     @staticmethod
     def _count_deleted(job: TrainJob) -> None:
@@ -266,9 +274,12 @@ class TrainJobController(ctrl.JobControllerBase):
             if job.status.completion_time is None:
                 job.status.completion_time = self._now()
                 changed = True
+            journal_lib.get_journal().record(
+                key, "validate", ok=False, problems=len(problems),
+                msg=msg[:200])
             if changed:
                 metrics.jobs_failed.labels(namespace=job.namespace).inc()
-                self._status_writer.flush(job, base, urgent=True)
+                self._flush(job, base, urgent=True)
             return
 
         if not self._expectations_satisfied(key, job):
@@ -288,6 +299,66 @@ class TrainJobController(ctrl.JobControllerBase):
             ):
                 return False
         return True
+
+    # ----------------------------------------------------- status persisting
+
+    def _flush(self, job: TrainJob, base: TrainJob, *,
+               urgent: bool = False):
+        """StatusWriter front-end all persist paths go through: journals
+        this sync's condition TRANSITIONS (and derives the scheduling/
+        recovery phase histograms from them) before handing the write to
+        the coalescing writer — one chokepoint, so no flush site can
+        change a condition without the flight recorder seeing it."""
+        self._journal_conditions(job, base)
+        return self._status_writer.flush(job, base, urgent=urgent)
+
+    def _journal_conditions(self, job: TrainJob, base: TrainJob) -> None:
+        """Record each condition whose (status, reason) changed this sync.
+        Running newly-true additionally samples the phase histograms —
+        BEFORE the new events land, so last_ts still sees the previous
+        Running/roll marks."""
+        if job.status.conditions == base.status.conditions:
+            return
+        jrnl = journal_lib.get_journal()
+        if not jrnl.enabled:
+            return
+        key = job.key()
+        prev = {str(c.type): (bool(c.status), c.reason)
+                for c in base.status.conditions}
+        for c in job.status.conditions:
+            cur = (bool(c.status), c.reason)
+            ctype = str(c.type)
+            if prev.get(ctype) == cur:
+                continue
+            if ctype == str(JobConditionType.RUNNING) and cur[0]:
+                self._observe_running_phases(jrnl, key)
+            jrnl.record(key, "condition", type=ctype, status=cur[0],
+                        reason=c.reason)
+
+    @staticmethod
+    def _observe_running_phases(jrnl, key: str) -> None:
+        """Running just asserted: one phase sample. After a gang roll or
+        preemption latch newer than the previous Running mark this is the
+        RECOVERY duration (restart-to-recovery MTTR); on the FIRST
+        Running it is the SCHEDULING duration (slice admitted -> gang
+        actually running, i.e. pod startup under the operator's control
+        — trainer-side startup is the collector's `startup` phase)."""
+        now_ns = time.perf_counter_ns()
+        t_prev_run = jrnl.last_ts(key, "condition",
+                                  type=str(JobConditionType.RUNNING),
+                                  status=True)
+        rolls = [t for t in (jrnl.last_ts(key, "gang.roll"),
+                             jrnl.last_ts(key, "preempt.latch"))
+                 if t is not None]
+        t_roll = max(rolls) if rolls else None
+        if t_roll is not None and (t_prev_run is None or t_roll > t_prev_run):
+            metrics.job_phase_seconds.labels(phase="recovery").observe(
+                max(0.0, (now_ns - t_roll) / 1e9))
+        elif t_prev_run is None:
+            t0 = jrnl.last_ts(key, "slice.admit") or jrnl.first_ts(key)
+            if t0 is not None:
+                metrics.job_phase_seconds.labels(phase="scheduling").observe(
+                    max(0.0, (now_ns - t0) / 1e9))
 
     # ------------------------------------------------------------- reconcile
 
@@ -329,7 +400,7 @@ class TrainJobController(ctrl.JobControllerBase):
                 status_engine.REASON_SUSPENDED,
                 f"TrainJob {key} is suspended.", self._now(),
             )
-            self._status_writer.flush(job, base)
+            self._flush(job, base)
             return
 
         exceeded, exceed_reason, exceed_msg = self._past_limits(job, pods)
@@ -353,7 +424,7 @@ class TrainJobController(ctrl.JobControllerBase):
             self._release_capacity(job.key())
             # Status must be durable before TTL GC may delete the job:
             # urgent — terminal conditions never sit in the window.
-            self._status_writer.flush(job, base, urgent=True)
+            self._flush(job, base, urgent=True)
             self._cleanup_by_ttl(job)
             return
 
@@ -378,7 +449,7 @@ class TrainJobController(ctrl.JobControllerBase):
                 pre_synced = True
             retry_delay = self._admit_slice(job, key, pods)
             if retry_delay is not None:
-                self._status_writer.flush(job, base)
+                self._flush(job, base)
                 self.queue.add_after(key, retry_delay)
                 return
             # Elastic reshape: while status says the gang runs degraded,
@@ -423,7 +494,7 @@ class TrainJobController(ctrl.JobControllerBase):
             # when fenced, proven fresh (a stale lister observation 409s
             # here into a requeue) — ahead of any destructive side
             # effect this sync takes from it.
-            self._status_writer.flush(job, base, urgent=True)
+            self._flush(job, base, urgent=True)
             self._delete_gang_pods(job, key, doomed)
             return
 
@@ -468,7 +539,7 @@ class TrainJobController(ctrl.JobControllerBase):
             # operator failover replays deletes from — it must be
             # durable (and, when fenced, proven fresh) before any pod
             # dies for it.
-            self._status_writer.flush(job, base, urgent=True)
+            self._flush(job, base, urgent=True)
             self._delete_gang_pods(job, key, doomed)
             return
 
@@ -494,7 +565,7 @@ class TrainJobController(ctrl.JobControllerBase):
         # stall teardown+TTL — and the whole fleet pipeline — one window
         # per job) or recorded a reshape (a durability latch: the degraded
         # size must survive an operator failover).
-        self._status_writer.flush(
+        self._flush(
             job, base,
             urgent=(is_terminal(job.status) and not is_terminal(base.status))
             or job.status.reshaped_replicas != base.status.reshaped_replicas,
@@ -557,17 +628,32 @@ class TrainJobController(ctrl.JobControllerBase):
             job.status, JobConditionType.GANG_RESHAPED,
             status_engine.REASON_GANG_RESHAPED, msg, now,
         )
+        journal_lib.get_journal().record(
+            key, "reshape", direction=direction, scaled=scaled,
+            topology=topology)
 
-    @staticmethod
-    def _record_slices(job: TrainJob, slice_ids: list[str]) -> None:
+    def _record_slices(self, job: TrainJob, slice_ids: list[str]) -> None:
         """Record the slice claim in status.slice_ids (idempotent). The
         allocator/scheduler stays authoritative; this is the durable
         observability record, kept in STATUS so it ships inside the same
         /status patch as the conditions — an annotation here would cost
         every admitted job a second main-resource write per sync wave."""
         ids = [s for s in slice_ids if s]
-        if job.status.slice_ids != ids:
-            job.status.slice_ids = ids
+        if job.status.slice_ids == ids:
+            return
+        was_empty = not job.status.slice_ids
+        job.status.slice_ids = ids
+        if self.scheduler is None and ids and was_empty:
+            # Scheduler-less deployments: the allocator grant IS the
+            # admission (with a FleetScheduler, _admit_locked records
+            # slice.admit and the admission phase itself).
+            jrnl = journal_lib.get_journal()
+            key = job.key()
+            jrnl.record(key, "slice.admit", slice=",".join(ids))
+            t0 = jrnl.first_ts(key)
+            if t0 is not None:
+                metrics.job_phase_seconds.labels(phase="admission").observe(
+                    max(0.0, (time.perf_counter_ns() - t0) / 1e9))
 
     def _record_full_size(self, job: TrainJob, key: str) -> bool:
         """Full-size (re)admission: clear any reshape state, lower the
@@ -593,6 +679,8 @@ class TrainJobController(ctrl.JobControllerBase):
             status_engine.REASON_GANG_RESTORED,
             f"TrainJob {key} is back at its spec size.", now,
         )
+        journal_lib.get_journal().record(
+            key, "reshape", direction="restore", prev=prev)
         return True
 
     def _apply_reshape(self, job: TrainJob) -> None:
@@ -882,6 +970,9 @@ class TrainJobController(ctrl.JobControllerBase):
             if not e.startswith(key + ":")
         }
         metrics.gang_size.remove(namespace=job.namespace, job=job.name)
+        # The ring survives for retention_s so a post-mortem timeline
+        # still reconstructs the deleted job.
+        journal_lib.get_journal().mark_deleted(key)
 
     def _check_stuck_pending(self, job: TrainJob, pods: list[Pod], key: str) -> None:
         """recovery.pendingTimeoutSeconds: surface pods wedged in Pending
@@ -1097,6 +1188,12 @@ class TrainJobController(ctrl.JobControllerBase):
             job.status.pending_preemption_uids = sorted(
                 p.metadata.uid for p in doomed
             )
+            # The latch event lands BEFORE any pod.delete can: the
+            # caller flushes the latch first, then deletes — so a
+            # timeline showing latch -> pod.delete is the PR-17 write->
+            # delete ordering made observable.
+            journal_lib.get_journal().record(
+                key, "preempt.latch", pods=len(doomed), detail=detail)
             return doomed
         self._finish_preemption_drain(job, key)
         return []
@@ -1106,11 +1203,12 @@ class TrainJobController(ctrl.JobControllerBase):
         is among the kick targets) and requeue this job — it resumes from
         its emergency checkpoint when capacity frees again."""
         if self.scheduler is not None:
-            self.scheduler.requeue_preempted(job)
+            self.scheduler.requeue_preempted(job)  # journals preempt.requeue
             self._kick_slice_waiters()
         elif self.slice_allocator is not None:
             if self.slice_allocator.release(key):
                 self._kick_slice_waiters()
+            journal_lib.get_journal().record(key, "preempt.requeue")
         # Our own readmission attempt (chaos preemptions with idle
         # capacity readmit on this wake-up; scheduler-queued jobs get
         # their Queued position refreshed).
@@ -1414,6 +1512,9 @@ class TrainJobController(ctrl.JobControllerBase):
         job.status.pending_gang_roll_uids = sorted(
             p.metadata.uid for p in doomed
         )
+        journal_lib.get_journal().record(
+            key, "gang.roll", reason=reason, detail=detail,
+            pods=len(doomed), restarts=job.status.gang_restarts)
         return doomed
 
     def _delete_gang_pods(self, job: TrainJob, key: str,

@@ -315,6 +315,27 @@ class SliceAllocator:
                     seen.setdefault(s.topology.num_chips, s.topology.name)
         return [seen[c] for c in sorted(seen, reverse=True)]
 
+    def snapshot(self) -> dict:
+        """Inventory view for /debug/state: every slice's class, holder,
+        and availability, plus the aggregate free count."""
+        with self._lock:
+            slices = [
+                {
+                    "slice_id": s.slice_id,
+                    "topology": s.topology.name,
+                    "held_by": s.held_by,
+                    "offline": s.offline,
+                }
+                for s in self.slices
+            ]
+        return {
+            "slices": slices,
+            "total": len(slices),
+            "free": sum(1 for s in slices
+                        if s["held_by"] is None and not s["offline"]),
+        }
+
+
 def slice_class(topology: str) -> tuple[str, int]:
     """Capacity class of a topology request: (accelerator, chip count) —
     exactly the fields SliceAllocator.admit matches a free slice on."""

@@ -179,6 +179,15 @@ class LocalSession:
             return None
         return svc.status.router_endpoint
 
+    def timeline(self, namespace: str, name: str) -> dict | None:
+        """The flight-recorder timeline for one job — the same payload
+        the operator serves at /api/trainjobs/{ns}/{name}/timeline
+        (journaled events + phase breakdown + trainer telemetry)."""
+        from tf_operator_tpu.telemetry import journal as journal_lib
+
+        return journal_lib.timeline_payload(
+            namespace, name, telemetry=self.telemetry)
+
     def wait_for_delete(self, namespace: str, name: str, timeout: float = 60.0) -> None:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:

@@ -49,6 +49,8 @@ from tf_operator_tpu.sched.policy import (
 )
 from tf_operator_tpu.sched.queue import FairShareQueue, QueueEntry
 from tf_operator_tpu.status import metrics
+from tf_operator_tpu.telemetry import journal as _journal
+from tf_operator_tpu.telemetry import tracer as _tracer
 
 
 @dataclass
@@ -111,6 +113,12 @@ class FleetScheduler:
         # (None = no aging entries, cache keyed by _version alone —
         # the zero-aging fast path pays nothing).
         self._aging_rerank_at: float | None = None
+        # Flight recorder: last journaled blocking reason per waiting key.
+        # The journal records queue.enter/exit plus the blocking reason
+        # ONLY when it changes — a 10k-fleet retry storm re-deciding the
+        # same "capacity" answer thousands of times must not wrap every
+        # ring with identical events. Entries clear on admit/release.
+        self._blocked_reason: dict[str, str] = {}
         self.stats = {
             "admitted": 0,
             "preemptions_requested": 0,
@@ -237,6 +245,21 @@ class FleetScheduler:
                 reserved[e.namespace] = (rj + 1, rs + e.slices)
         return free
 
+    def _journal_blocked_locked(self, key: str, reason: str, position: int,
+                                victims: tuple[str, ...] = ()) -> None:
+        """Journal WHY a waiter is blocked — only when the reason changes
+        (quota -> capacity -> preempting transitions), never per retry."""
+        if self._blocked_reason.get(key) == reason:
+            return
+        self._blocked_reason[key] = reason
+        if victims:
+            _journal.get_journal().record(
+                key, "queue.blocked", reason=reason, position=position,
+                victims=",".join(victims))
+        else:
+            _journal.get_journal().record(
+                key, "queue.blocked", reason=reason, position=position)
+
     def _update_depth_gauge(self) -> None:
         depths = self._waiting.depths()
         for q in self._gauge_queues - set(depths):
@@ -265,7 +288,7 @@ class FleetScheduler:
         probe = topology is not None and topology != requested
         topology = topology or requested
         now = self._clock()
-        with self._lock:
+        with _tracer.span("sched.decide", job=key, probe=probe), self._lock:
             if key in self._running:
                 r = self._running[key]
                 want_cls = slice_class(topology)
@@ -302,6 +325,9 @@ class FleetScheduler:
                         r.chips = parse_topology(topology).num_chips
                         r.slice_id = sid
                         self._version += 1
+                        _journal.get_journal().record(
+                            key, "slice.upgrade", slice=sid,
+                            topology=topology)
                         return Decision(admit=True, slice_id=sid)
                 return Decision(admit=True, slice_id=r.slice_id)
 
@@ -317,6 +343,10 @@ class FleetScheduler:
                 entry = self._waiting.submit(entry)
                 self._version += 1
                 self._update_depth_gauge()
+                if cur is None:
+                    _journal.get_journal().record(
+                        key, "queue.enter", queue=entry.queue,
+                        priority=entry.priority, topology=entry.topology)
             else:
                 entry = cur  # unchanged: keep the cached ranking valid
             if probe:
@@ -347,6 +377,7 @@ class FleetScheduler:
                         self.stats["quota_blocked"] += 1
                         metrics.sched_quota_blocked_total.labels(
                             namespace=e.namespace).inc()
+                        self._journal_blocked_locked(key, "quota", pos)
                         return Decision(
                             admit=False, reason="quota", position=pos)
                     continue  # quota-blocked waiters reserve nothing
@@ -374,6 +405,9 @@ class FleetScheduler:
                         gap = entry.slices - free.get(cls, 0)
                         victims = self._maybe_preempt_locked(
                             entry, cls, now, gap)
+                    self._journal_blocked_locked(
+                        key, "preempting" if victims else "capacity", pos,
+                        victims)
                     return Decision(
                         admit=False,
                         reason="preempting" if victims else "capacity",
@@ -454,8 +488,17 @@ class FleetScheduler:
                     or (q.max_slices is not None and ns_sl > q.max_slices)):
                 self.stats["quota_violations"] += 1
         metrics.sched_admitted_total.labels(queue=entry.queue).inc()
-        metrics.sched_queue_wait_seconds.observe(
-            max(0.0, now - entry.submit_time))
+        wait = max(0.0, now - entry.submit_time)
+        metrics.sched_queue_wait_seconds.observe(wait)
+        # Phase histogram: submit -> slice admitted ("why was admission
+        # slow" is the fleet bench's p99 gate, tools/exp_fleet.py).
+        metrics.job_phase_seconds.labels(phase="admission").observe(wait)
+        self._blocked_reason.pop(key, None)
+        jrnl = _journal.get_journal()
+        jrnl.record(key, "queue.exit", queue=entry.queue,
+                    wait_s=round(wait, 6))
+        jrnl.record(key, "slice.admit", slice=sid, topology=entry.topology,
+                    slices=entry.slices)
         return Decision(admit=True, slice_id=sid)
 
     def _maybe_preempt_locked(self, entry: QueueEntry, cls: tuple[str, int],
@@ -526,12 +569,16 @@ class FleetScheduler:
             self._running.pop(key, None)
             self._waiting.remove(key)
             self._evictions.pop(key, None)
+            self._blocked_reason.pop(key, None)
             for victim, preemptor in list(self._evictions.items()):
                 if preemptor == key:  # preemptor gone: spare the victim
                     del self._evictions[victim]
             self._version += 1
             self._update_depth_gauge()
-        return self.allocator.release(key)
+        freed = self.allocator.release(key)
+        if freed:
+            _journal.get_journal().record(key, "slice.release")
+        return freed
 
     def requeue_preempted(self, job: TrainJob) -> None:
         """Victim drained: back into the wait queue, keeping its ORIGINAL
@@ -542,6 +589,7 @@ class FleetScheduler:
         with self._lock:
             info = self._running.pop(key, None)
             self._evictions.pop(key, None)
+            self._blocked_reason.pop(key, None)
             entry = self._entry_of(job, now)
             if info is not None:
                 entry = dc_replace(entry, submit_time=info.first_submit)
@@ -549,6 +597,9 @@ class FleetScheduler:
             self._version += 1
             self._update_depth_gauge()
         self.allocator.release(key)
+        _journal.get_journal().record(
+            key, "preempt.requeue", queue=entry.queue,
+            original_submit=round(entry.submit_time, 6))
 
     def running_class(self, key: str) -> tuple[str, int] | None:
         """The slice class a running job currently holds (None when not

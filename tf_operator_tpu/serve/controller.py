@@ -60,6 +60,7 @@ from tf_operator_tpu.core.cluster import (
 )
 from tf_operator_tpu.status import engine as status_engine
 from tf_operator_tpu.status import metrics
+from tf_operator_tpu.telemetry import journal as journal_lib
 from tf_operator_tpu.utils import naming
 from tf_operator_tpu.utils.exit_codes import (
     EXIT_USER_RETRYABLE,
@@ -210,7 +211,41 @@ class InferenceServiceController(ctrl.JobControllerBase):
     def _owner_replica_types(self, obj) -> list[str]:
         return [SERVER_REPLICA]
 
+    def router_snapshot(self) -> dict:
+        """Per-service front-end router state (endpoint + live backend
+        accounting) for /debug/state."""
+        out = {}
+        for key, router in list(self._routers.items()):
+            try:
+                out[key] = {
+                    "endpoint": router.endpoint,
+                    "backends": router.backends(),
+                }
+            except Exception as e:  # router torn down mid-snapshot
+                from tf_operator_tpu.utils.logging import logger_for_key
+
+                logger_for_key(key).debug("router snapshot skipped: %s", e)
+        return out
+
     # --------------------------------------------------------------- sync
+
+    def _flush(self, svc, base, *, urgent: bool = False):
+        """StatusWriter front-end: journal this sync's condition
+        transitions (flight recorder, telemetry/journal.py) before the
+        coalescing write — same chokepoint discipline as the TrainJob
+        controller's _flush."""
+        if svc.status.conditions != base.status.conditions:
+            jrnl = journal_lib.get_journal()
+            if jrnl.enabled:
+                key = svc.key()
+                prev = {str(c.type): (bool(c.status), c.reason)
+                        for c in base.status.conditions}
+                for c in svc.status.conditions:
+                    cur = (bool(c.status), c.reason)
+                    if prev.get(str(c.type)) != cur:
+                        jrnl.record(key, "condition", type=str(c.type),
+                                    status=cur[0], reason=c.reason)
+        return self._status_writer.flush(svc, base, urgent=urgent)
 
     def sync_job(self, key: str) -> None:
         metrics.reconcile_total.inc()
@@ -247,7 +282,7 @@ class InferenceServiceController(ctrl.JobControllerBase):
                 self._now())
             changed = self._close_router(key, svc) or changed
             if changed:
-                self._status_writer.flush(svc, base, urgent=True)
+                self._flush(svc, base, urgent=True)
             return
 
         if not self.expectations.satisfied(
@@ -283,7 +318,7 @@ class InferenceServiceController(ctrl.JobControllerBase):
             self._release_all_claims(key)
             self._close_router(key, svc)
             # Urgent: Failed is terminal for a service — never windowed.
-            self._status_writer.flush(svc, base, urgent=True)
+            self._flush(svc, base, urgent=True)
             return
 
         # Train->serve handoff: resolve the checkpoint source before any
@@ -293,7 +328,7 @@ class InferenceServiceController(ctrl.JobControllerBase):
             # Urgent when resolution itself FAILED the service this sync:
             # the teardown branch above only fires once Failed is
             # OBSERVED, so windowing the transition would stall it.
-            self._status_writer.flush(
+            self._flush(
                 svc, base,
                 urgent=has_condition(svc.status, JobConditionType.FAILED))
             return
@@ -330,7 +365,7 @@ class InferenceServiceController(ctrl.JobControllerBase):
                 svc.status.last_reconcile_time = now
             # Urgent: the Preempted record is the one visible trace the
             # disruption was planned — never windowed.
-            self._status_writer.flush(svc, base, urgent=True)
+            self._flush(svc, base, urgent=True)
             return
 
         # Per-replica hang watchdog (serving.heartbeatTimeoutSeconds).
@@ -514,7 +549,7 @@ class InferenceServiceController(ctrl.JobControllerBase):
 
         if status_writer_lib.StatusWriter.dirty(svc, base):
             svc.status.last_reconcile_time = now
-        self._status_writer.flush(
+        self._flush(
             svc, base,
             urgent=has_condition(svc.status, JobConditionType.FAILED))
 

@@ -378,6 +378,22 @@ status_writes_coalesced = DEFAULT.counter(
     "into a later write inside the coalescing window)",
     labels_only=True,
 )
+# Round 18 (flight recorder): phase durations derived from the lifecycle
+# journal (telemetry/journal.py), observed once per transition at the
+# controller — never per reconcile. phase=admission (submit -> slice
+# admitted), scheduling (admitted -> Running condition), startup
+# (Running -> first trainer step seen by the heartbeat source), recovery
+# (gang roll / preemption -> back to Running; the restart MTTR). Fleet
+# benches (tools/exp_fleet.py) gate admission p99 from this family
+# instead of inferring it from wall clock.
+job_phase_seconds = DEFAULT.histogram(
+    "tpujob_job_phase_seconds",
+    "Job lifecycle phase durations from the flight-recorder journal "
+    "(phase: admission | scheduling | startup | recovery)",
+    labels_only=True,
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0, 300.0,
+             1800.0),
+)
 
 # --- Fleet scheduler (sched/): admission, fair-share queueing, preemption.
 sched_queue_depth = DEFAULT.gauge(

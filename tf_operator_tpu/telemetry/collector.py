@@ -28,6 +28,7 @@ import re
 import time
 
 from tf_operator_tpu.status import metrics as metrics_mod
+from tf_operator_tpu.telemetry import journal as journal_mod
 from tf_operator_tpu.utils.preemption import read_heartbeat
 
 __all__ = ["TRAINER_GAUGES", "TelemetryCollector", "summarize_events"]
@@ -312,3 +313,24 @@ class TelemetryCollector:
             ):
                 if value is not None:
                     self._gauges[gauge_name].labels(**labels).set(float(value))
+            self._observe_first_step(job, primary)
+
+    def _observe_first_step(self, job, primary: dict) -> None:
+        """Once per job: the trainer reported its startup time (imports,
+        compile, checkpoint restore) — record the `first_step` journal
+        event (timeline's startup->training boundary) and sample the
+        startup phase histogram. The journal ring itself is the
+        once-guard, so the sample survives collector restarts no worse
+        than the ring does."""
+        startup = primary.get("startup_s")
+        if startup is None:
+            return
+        jrnl = journal_mod.get_journal()
+        if not jrnl.enabled:
+            return
+        key = f"{job.namespace}/{job.name}"
+        if jrnl.last_ts(key, "first_step") is not None:
+            return
+        jrnl.record(key, "first_step", startup_s=round(float(startup), 3))
+        metrics_mod.job_phase_seconds.labels(phase="startup").observe(
+            float(startup))

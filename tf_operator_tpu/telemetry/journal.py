@@ -1,0 +1,376 @@
+"""Per-job lifecycle journal: the control plane's flight recorder.
+
+The Tracer (tracer.py) answers "where did the wall clock go inside one
+process"; the journal answers "WHY did this job take 90 s to admit" — a
+bounded, thread-safe ring of structured lifecycle events per job that
+the trainjob controller, serve controller, FleetScheduler,
+SliceAllocator, and StatusWriter all record into: submit, validate,
+queue enter/exit (with the blocking reason — quota vs capacity vs aging
+rank), slice admit/release/upgrade, pod create/delete, condition
+transitions, gang-roll and reshape decisions, the preemption latch
+write→delete ordering, and status-flush outcomes
+(sent/noop/deferred/fenced). Each event is stamped with the sync wave's
+`reconcile_id`, so causality across subsystems reconstructs from one
+stream.
+
+Design constraints (the Tracer's, re-applied at fleet depth):
+
+  1. **O(1) per event, no allocation beyond the tuple.** `record()` on
+     the hot reconcile path is one lock, one deque append, one LRU
+     move-to-end — no per-event dict, no string formatting, no clock
+     math. The fleet bench (tools/exp_fleet.py) runs with the journal ON
+     by default and its p99/writes-per-job gates pin the overhead.
+  2. **Bounded memory at 10k jobs.** Per-job rings are
+     collections.deque(maxlen=per_job_capacity); the job table itself is
+     an LRU (OrderedDict) capped at max_jobs — churning 10k jobs through
+     a 1k-entry journal evicts the coldest rings whole. `dropped(key)`
+     is exact per ring (append + counter move under one lock, the
+     Tracer's locked-append lesson), and `evicted_jobs` counts whole
+     rings lost to LRU.
+  3. **Post-mortem readable.** A deleted job's ring SURVIVES for
+     `retention_s` (default 10 min) so `tpujob timeline` works on a job
+     that already finished and was GC'd — `mark_deleted` stamps the ring
+     instead of dropping it; expiry happens lazily on later writes.
+  4. **Monotonic clocks, wall-clock anchored.** Events carry
+     time.perf_counter_ns(); the journal records ONE (epoch_wall,
+     epoch_ns) anchor at construction so exports can place events on the
+     wall clock (to merge with trainer telemetry) without per-event
+     time.time() calls or NTP-step artifacts inside a timeline.
+
+Event-name vocabulary (docs/monitoring.md "Flight recorder" documents
+the schema): ``submit`` ``validate`` ``queue.enter`` ``queue.blocked``
+``queue.exit``
+``slice.admit`` ``slice.release`` ``slice.upgrade`` ``pod.create``
+``pod.delete`` ``condition`` ``gang.roll`` ``reshape``
+``preempt.latch`` ``preempt.requeue`` ``status.flush`` ``deleted``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+__all__ = [
+    "Journal", "JobRing", "get_journal", "configure", "phase_breakdown",
+    "timeline_payload",
+]
+
+
+class JobRing:
+    """One job's event ring + exact drop accounting. Internal mutable
+    state is only touched under the owning Journal's lock."""
+
+    __slots__ = ("events", "appended", "first_ns", "deleted_at_ns")
+
+    def __init__(self, capacity: int):
+        # (event, t_ns, reconcile_id, attrs) tuples; attrs is the kwargs
+        # dict or None — the only per-event allocations are the tuple
+        # and the caller's kwargs.
+        self.events: collections.deque = collections.deque(maxlen=capacity)
+        self.appended = 0
+        self.first_ns = 0  # t_ns of the FIRST event ever (survives ring wrap)
+        self.deleted_at_ns = 0  # 0 = live; else when mark_deleted stamped it
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self.appended - len(self.events))
+
+
+class Journal:
+    def __init__(
+        self,
+        per_job_capacity: int = 256,
+        max_jobs: int = 4096,
+        retention_s: float = 600.0,
+        enabled: bool = True,
+    ):
+        if per_job_capacity < 1 or max_jobs < 1:
+            raise ValueError("per_job_capacity and max_jobs must be >= 1")
+        self.enabled = enabled
+        self.per_job_capacity = per_job_capacity
+        self.max_jobs = max_jobs
+        self.retention_s = retention_s
+        self._rings: collections.OrderedDict[str, JobRing] = (
+            collections.OrderedDict())
+        self._lock = threading.Lock()
+        # Per-thread current sync wave: the controller mints one
+        # reconcile_id per sync (core/controller.py _process_item) and
+        # every event recorded on that thread during the wave — by the
+        # controller, the scheduler it consults, or the StatusWriter it
+        # flushes through — is stamped with it without threading an id
+        # through every call signature.
+        self._wave = threading.local()
+        self.evicted_jobs = 0  # whole rings lost to the LRU cap
+        # Wall-clock anchor: t_wall = epoch_wall + (t_ns - epoch_ns)/1e9.
+        self._epoch_ns = time.perf_counter_ns()
+        self._epoch_wall = time.time()
+
+    # ------------------------------------------------------------ recording
+
+    def set_wave(self, reconcile_id: int) -> None:
+        """Stamp this thread's subsequent records with `reconcile_id`
+        (one sync wave = one id; 0 clears)."""
+        self._wave.rid = reconcile_id
+
+    def record(self, key: str, event: str, /, reconcile_id: int = 0,
+               **attrs) -> None:
+        """Append one event to `key`'s ring. O(1): lock, LRU touch,
+        deque append. The disabled path is one attribute read."""
+        if not self.enabled:
+            return
+        if not reconcile_id:
+            reconcile_id = getattr(self._wave, "rid", 0)
+        t_ns = time.perf_counter_ns()
+        with self._lock:
+            ring = self._rings.get(key)
+            if ring is None:
+                ring = JobRing(self.per_job_capacity)
+                ring.first_ns = t_ns
+                self._rings[key] = ring
+                if len(self._rings) > self.max_jobs:
+                    self._rings.popitem(last=False)
+                    self.evicted_jobs += 1
+            else:
+                self._rings.move_to_end(key)
+            ring.events.append((event, t_ns, reconcile_id, attrs or None))
+            ring.appended += 1
+
+    def mark_deleted(self, key: str) -> None:
+        """The job object is gone; keep its ring for retention_s so a
+        post-mortem `tpujob timeline` still reconstructs it. Lazily
+        expires OTHER overdue rings on the way (no GC thread)."""
+        if not self.enabled:
+            return
+        t_ns = time.perf_counter_ns()
+        with self._lock:
+            ring = self._rings.get(key)
+            if ring is not None:
+                ring.events.append(("deleted", t_ns, 0, None))
+                ring.appended += 1
+                ring.deleted_at_ns = t_ns
+            if self.retention_s <= 0:
+                self._rings.pop(key, None)
+                return
+            horizon = t_ns - int(self.retention_s * 1e9)
+            expired = [k for k, r in self._rings.items()
+                       if r.deleted_at_ns and r.deleted_at_ns < horizon]
+            for k in expired:
+                del self._rings[k]
+
+    def forget(self, key: str) -> None:
+        """Drop a ring immediately (tests / explicit purge)."""
+        with self._lock:
+            self._rings.pop(key, None)
+
+    # ------------------------------------------------------------ inspection
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rings)
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._rings
+
+    def dropped(self, key: str) -> int:
+        with self._lock:
+            ring = self._rings.get(key)
+            return ring.dropped if ring is not None else 0
+
+    def wall_time(self, t_ns: int) -> float:
+        """Place a journal timestamp on the wall clock (one anchor, no
+        per-event time.time() — NTP steps cannot reorder a timeline)."""
+        return self._epoch_wall + (t_ns - self._epoch_ns) / 1e9
+
+    def elapsed_s(self, t0_ns: int, t1_ns: int) -> float:
+        return (t1_ns - t0_ns) / 1e9
+
+    def events(self, key: str) -> list[tuple]:
+        """Snapshot of `key`'s events, oldest first, as raw
+        (event, t_ns, reconcile_id, attrs) tuples."""
+        with self._lock:
+            ring = self._rings.get(key)
+            return list(ring.events) if ring is not None else []
+
+    def last_ts(self, key: str, event: str, **match) -> int | None:
+        """t_ns of the most recent `event` in the ring (None if absent),
+        optionally also matching attr values (e.g. type="Running").
+        O(ring); called only on rare transitions, never per record."""
+        with self._lock:
+            ring = self._rings.get(key)
+            if ring is None:
+                return None
+            for name, t_ns, _rid, attrs in reversed(ring.events):
+                if name != event:
+                    continue
+                if match and not (attrs and all(
+                        attrs.get(k) == v for k, v in match.items())):
+                    continue
+                return t_ns
+        return None
+
+    def first_ts(self, key: str) -> int | None:
+        """t_ns of the very first event recorded for the job — survives
+        ring wrap (the submit anchor for time-to-X math)."""
+        with self._lock:
+            ring = self._rings.get(key)
+            return ring.first_ns if ring is not None else None
+
+    def export(self, key: str) -> dict | None:
+        """The ring as a JSON-ready dict: wall-clock-anchored events plus
+        drop/retention accounting. None when the job was never journaled
+        (or already expired)."""
+        with self._lock:
+            ring = self._rings.get(key)
+            if ring is None:
+                return None
+            events = list(ring.events)
+            dropped = ring.dropped
+            first_ns = ring.first_ns
+            deleted_ns = ring.deleted_at_ns
+        out_events = []
+        for name, t_ns, rid, attrs in events:
+            ev = {
+                "event": name,
+                "t": round(self.wall_time(t_ns), 6),
+                "offset_s": round((t_ns - first_ns) / 1e9, 6),
+            }
+            if rid:
+                ev["reconcile_id"] = rid
+            if attrs:
+                ev["attrs"] = attrs
+            out_events.append(ev)
+        return {
+            "job": key,
+            "events": out_events,
+            "dropped": dropped,
+            "submitted_at": round(self.wall_time(first_ns), 6),
+            "deleted": bool(deleted_ns),
+        }
+
+    def snapshot(self) -> dict:
+        """Journal-wide accounting for /debug/state."""
+        with self._lock:
+            return {
+                "jobs": len(self._rings),
+                "max_jobs": self.max_jobs,
+                "per_job_capacity": self.per_job_capacity,
+                "retention_s": self.retention_s,
+                "evicted_jobs": self.evicted_jobs,
+                "events": sum(len(r.events) for r in self._rings.values()),
+                "dropped": sum(r.dropped for r in self._rings.values()),
+            }
+
+
+def phase_breakdown(events: list[dict]) -> list[dict]:
+    """Partition an exported event stream (Journal.export's `events`)
+    into contiguous lifecycle phases. The segments tile the interval
+    from the first event to the terminal event exactly — no gaps, no
+    overlap — so their durations sum to the job's journaled wall clock
+    (the `tpujob timeline` telescoping property its e2e test pins).
+
+    Phases: ``queued`` (submit -> slice admitted, and again after a
+    preemption requeue), ``startup`` (slice admitted -> Running/first
+    trainer step), ``running``, ``recovery`` (gang roll or preemption
+    latch -> Running re-asserted), ``terminal`` (a closed zero-width
+    marker once Succeeded/Failed lands or the job is deleted)."""
+    if not events:
+        return []
+    segs: list[dict] = []
+    phase = "queued"
+    start = events[0]["t"]
+
+    def close(t: float, nxt: str) -> None:
+        nonlocal phase, start
+        if t > start:
+            segs.append({"phase": phase, "start": round(start, 6),
+                         "end": round(t, 6),
+                         "seconds": round(t - start, 6)})
+        phase, start = nxt, t
+
+    for ev in events:
+        name = ev["event"]
+        t = ev["t"]
+        attrs = ev.get("attrs") or {}
+        if phase == "terminal":
+            break
+        if name == "slice.admit" and phase == "queued":
+            close(t, "startup")
+        elif name == "first_step" and phase == "startup":
+            close(t, "running")
+        elif (name == "condition" and attrs.get("type") == "Running"
+              and attrs.get("status")
+              and phase in ("queued", "startup", "recovery")):
+            # `queued` included: a scheduler-less deployment journals no
+            # slice.admit, so Running asserting IS the admission edge.
+            close(t, "running")
+        elif (name in ("gang.roll", "preempt.latch")
+              and phase in ("running", "startup")):
+            close(t, "recovery")
+        elif name == "preempt.requeue" and phase == "recovery":
+            close(t, "queued")
+        elif (name == "condition" and attrs.get("status")
+              and attrs.get("type") in ("Succeeded", "Failed")):
+            close(t, "terminal")
+        elif name == "deleted":
+            close(t, "terminal")
+    if phase != "terminal":
+        close(events[-1]["t"], "terminal")
+    return segs
+
+
+def timeline_payload(namespace: str, name: str, *, telemetry=None,
+                     journal: "Journal | None" = None) -> dict | None:
+    """The full `tpujob timeline` payload for one job: the exported
+    journal plus its phase breakdown, with the trainer-side telemetry
+    (collector summaries) merged in when a collector is wired. The one
+    assembly both the operator's /timeline route and LocalSession share.
+    None when the job was never journaled (or its ring expired)."""
+    jrnl = journal if journal is not None else get_journal()
+    data = jrnl.export(f"{namespace}/{name}")
+    if data is None:
+        return None
+    phases = phase_breakdown(data["events"])
+    data["phases"] = phases
+    data["wall_clock_s"] = round(sum(p["seconds"] for p in phases), 6)
+    if telemetry is not None:
+        data["trainer"] = telemetry.job_telemetry(namespace, name)
+    return data
+
+
+# Module-level default journal, mirroring tracer.get_tracer(): the
+# zero-wiring path — controllers/scheduler/StatusWriter record into the
+# process default unless a Journal is injected explicitly (tests inject).
+_DEFAULT = Journal()
+
+
+def get_journal() -> Journal:
+    return _DEFAULT
+
+
+def configure(enabled: bool | None = None, per_job_capacity: int | None = None,
+              max_jobs: int | None = None,
+              retention_s: float | None = None) -> Journal:
+    """Configure the default journal (operator flags land here). Sizing
+    changes re-allocate the table, dropping recorded rings — configure
+    before the controllers start."""
+    global _DEFAULT
+    resize = (
+        (per_job_capacity is not None
+         and per_job_capacity != _DEFAULT.per_job_capacity)
+        or (max_jobs is not None and max_jobs != _DEFAULT.max_jobs)
+    )
+    if resize:
+        _DEFAULT = Journal(
+            per_job_capacity=per_job_capacity or _DEFAULT.per_job_capacity,
+            max_jobs=max_jobs or _DEFAULT.max_jobs,
+            retention_s=(retention_s if retention_s is not None
+                         else _DEFAULT.retention_s),
+            enabled=_DEFAULT.enabled,
+        )
+    if retention_s is not None:
+        _DEFAULT.retention_s = retention_s
+    if enabled is not None:
+        _DEFAULT.enabled = enabled
+    return _DEFAULT

@@ -36,6 +36,13 @@ StatusWriter coalescing moves) and `wire_bytes_per_job` (request+response
 bytes across every unary verb). `--gate-writes-per-job` turns the former
 into an exit-1 gate, like `--gate-p99`.
 
+Round 18: the flight-recorder journal (telemetry/journal.py) runs ON by
+default — the p99/writes-per-job gates therefore pin its hot-path
+overhead — and the bench reports the journal-fed admission-phase
+latency (submit -> slice admitted, from tpujob_job_phase_seconds) as
+`admission_p99_s`, gateable via `--gate-admission-p99`. `--no-journal`
+gives the A/B baseline.
+
 Usage:
   python tools/exp_fleet.py                          # 2000 jobs, kube
   python tools/exp_fleet.py --jobs 200 --gate-p99 2  # CI fleet-smoke
@@ -218,10 +225,20 @@ def run_fleet(jobs: int = 2000, slices: int = 16, substrate: str = "kube",
               quota_slices: int | None = None, cooldown: float = 0.5,
               gate_p99: float | None = None,
               gate_writes_per_job: float | None = None,
+              gate_admission_p99: float | None = None,
               coalesce_window: float = 30.0,
+              journal: bool = True,
               timeout: float = 600.0,
               progress=None) -> dict:
     """Run the bench; returns the result dict (see module docstring)."""
+    from tf_operator_tpu.telemetry import journal as journal_lib
+
+    # The flight recorder runs in its production posture (ON) unless
+    # --no-journal: the p99/writes-per-job gates below therefore PIN the
+    # journal's hot-path overhead at fleet depth, and the admission-phase
+    # histogram it feeds becomes a gateable latency surface of its own.
+    journal_prev = journal_lib.get_journal().enabled
+    journal_lib.configure(enabled=journal)
     rng = random.Random(seed)
     ns_names = [f"team-{i}" for i in range(namespaces)]
     if quota_slices is None:
@@ -235,6 +252,11 @@ def run_fleet(jobs: int = 2000, slices: int = 16, substrate: str = "kube",
     hist = status_metrics.reconcile_latency
     counts_before = hist.bucket_counts()
     errors_before = status_metrics.reconcile_errors.value()
+    # Admission-phase latency (submit -> slice admitted) from the journal-
+    # fed tpujob_job_phase_seconds histogram — same delta discipline as
+    # reconcile latency so repeated in-process runs stay independent.
+    adm_hist = status_metrics.job_phase_seconds.labels(phase="admission")
+    adm_before = adm_hist.bucket_counts()
 
     fake = None
     watch_events = [0]
@@ -366,6 +388,12 @@ def run_fleet(jobs: int = 2000, slices: int = 16, substrate: str = "kube",
     delta = [a - b for a, b in zip(counts_after, counts_before)]
     p50 = percentile_from_buckets(hist.buckets, delta, 0.50)
     p99 = percentile_from_buckets(hist.buckets, delta, 0.99)
+    adm_delta = [a - b for a, b in zip(adm_hist.bucket_counts(), adm_before)]
+    adm_p50 = percentile_from_buckets(adm_hist.buckets, adm_delta, 0.50)
+    adm_p99 = percentile_from_buckets(adm_hist.buckets, adm_delta, 0.99)
+    journal_snapshot = journal_lib.get_journal().snapshot() if journal \
+        else None
+    journal_lib.configure(enabled=journal_prev)
 
     stats = dict(scheduler.stats)
 
@@ -413,6 +441,11 @@ def run_fleet(jobs: int = 2000, slices: int = 16, substrate: str = "kube",
         "wire_bytes_per_job": wire_bytes_per_job,
         "apiserver_requests_by_verb": requests_by_verb,
         "coalesce_window_s": coalesce_window,
+        "journal_enabled": journal,
+        "journal": journal_snapshot,
+        "admission_p50_s": adm_p50,
+        "admission_p99_s": adm_p99,
+        "admission_samples": sum(adm_delta),
         "sched": stats,
         "max_running_by_namespace": max_by_ns,
         "invariants": {
@@ -424,6 +457,7 @@ def run_fleet(jobs: int = 2000, slices: int = 16, substrate: str = "kube",
         },
         "gate_p99_s": gate_p99,
         "gate_writes_per_job": gate_writes_per_job,
+        "gate_admission_p99_s": gate_admission_p99,
     }
     failures = []
     if starved:
@@ -434,6 +468,9 @@ def run_fleet(jobs: int = 2000, slices: int = 16, substrate: str = "kube",
         failures.append(f"{stats['inversions']} priority inversion(s)")
     if gate_p99 is not None and p99 > gate_p99:
         failures.append(f"reconcile p99 {p99}s > gate {gate_p99}s")
+    if gate_admission_p99 is not None and adm_p99 > gate_admission_p99:
+        failures.append(
+            f"admission p99 {adm_p99}s > gate {gate_admission_p99}s")
     if gate_writes_per_job is not None:
         if status_writes_per_job is None:
             failures.append(
@@ -466,6 +503,14 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--gate-writes-per-job", type=float, default=None,
                     help="fail (exit 1) when status_writes_per_job exceeds "
                          "this (kube substrate only)")
+    ap.add_argument("--gate-admission-p99", type=float, default=None,
+                    help="fail (exit 1) when the journal-fed admission-"
+                         "phase (submit -> slice admitted) p99 exceeds "
+                         "this")
+    ap.add_argument("--no-journal", action="store_true",
+                    help="disable the flight-recorder journal for this "
+                         "run (it is ON by default — the production "
+                         "posture the gates pin)")
     ap.add_argument("--coalesce-window", type=float, default=30.0,
                     help="StatusWriter burst-coalescing window in seconds "
                          "(0 = flush every dirty sync)")
@@ -478,7 +523,9 @@ def main(argv: list[str] | None = None) -> int:
         quota_slices=args.quota_slices, cooldown=args.cooldown,
         gate_p99=args.gate_p99,
         gate_writes_per_job=args.gate_writes_per_job,
-        coalesce_window=args.coalesce_window, timeout=args.timeout,
+        gate_admission_p99=args.gate_admission_p99,
+        coalesce_window=args.coalesce_window,
+        journal=not args.no_journal, timeout=args.timeout,
         progress=lambda msg: print(f"# {msg}", file=sys.stderr),
     )
     print(json.dumps(result, indent=2, sort_keys=True))
