@@ -224,7 +224,11 @@ class TestThreadDiscipline:
              "InferenceServer._dispatch_decode_loop"),
             ("tf_operator_tpu.serve.server",
              "InferenceServer._follow_loop"),
-            ("tf_operator_tpu.serve.router", "FrontEndRouter._probe_loop"),
+            # Round 19 tier: the probe thread runs on the SHARED state
+            # (one probe per tier, not per listener) and hedged attempts
+            # are their own thread roots.
+            ("tf_operator_tpu.serve.router", "_TierState._probe_loop"),
+            ("tf_operator_tpu.serve.router", "FrontEndRouter._attempt"),
             ("tf_operator_tpu.parallel.multislice",
              "DcnExchange._engine_main"),
         ):
@@ -746,6 +750,54 @@ class TestSchemaDrift:
             ("maxConcurrentSequences:",
              "ServingSpec.max_concurrent_sequences"),
         ):
+            no_crd = infsvc_crd.replace(f"                    {prop}",
+                                        "                    renamedKnob:")
+            assert no_crd != infsvc_crd, f"fixture stale: {prop}"
+            found = self._infsvc(crd=no_crd)
+            assert any(f.rule == "TPS403" and key in f.key
+                       for f in found), [f.render() for f in found]
+
+    def test_router_tier_drift_guarded(self):
+        # ISSUE-19 fixture pair: serving.routers/hedgeAfterMs (the
+        # router tier's spec knobs) — each of the emit / parse / CRD
+        # directions must fail when its line is dropped, so tier sizing
+        # and the hedge budget can't silently fall off the wire.
+        _, compat, _, _ = self._real()
+        infsvc_crd = (REPO / "manifests/inferenceservice-crd.yaml").read_text()
+        # EMIT direction.
+        for needle, key in (
+            ('"routers": spec.serving.routers,', "ServingSpec.routers"),
+            ('"hedgeAfterMs": spec.serving.hedge_after_ms,',
+             "ServingSpec.hedge_after_ms"),
+        ):
+            no_emit = "\n".join(ln for ln in compat.splitlines()
+                                if needle not in ln)
+            assert no_emit != compat, f"fixture stale: {needle}"
+            found = self._infsvc(compat=no_emit)
+            assert any(f.rule == "TPS402"
+                       and f.key == f"schema-emit::{key}"
+                       for f in found), [f.render() for f in found]
+        # PARSE direction: collapse each expression to its bare default.
+        no_parse = compat.replace(
+            '1 if serving_d.get("routers") is None\n'
+            '                         else int(serving_d["routers"])',
+            "1")
+        assert no_parse != compat, "fixture stale (routers parse moved)"
+        found = self._infsvc(compat=no_parse)
+        assert any(f.rule == "TPS401" and "ServingSpec.routers" in f.key
+                   for f in found), [f.render() for f in found]
+        no_parse = compat.replace(
+            'hedge_after_ms=serving_d.get("hedgeAfterMs"),',
+            "hedge_after_ms=None,")
+        assert no_parse != compat, "fixture stale (hedge parse moved)"
+        found = self._infsvc(compat=no_parse)
+        assert any(f.rule == "TPS401"
+                   and "ServingSpec.hedge_after_ms" in f.key
+                   for f in found), [f.render() for f in found]
+        # CRD direction (the fake apiserver prunes unknown fields, so a
+        # missing property silently eats the knob on the wire).
+        for prop, key in (("routers:", "ServingSpec.routers"),
+                          ("hedgeAfterMs:", "ServingSpec.hedge_after_ms")):
             no_crd = infsvc_crd.replace(f"                    {prop}",
                                         "                    renamedKnob:")
             assert no_crd != infsvc_crd, f"fixture stale: {prop}"

@@ -614,6 +614,343 @@ class TestRouter:
             fast.close()
 
 
+# -------------------------------------------------------------- router tier
+
+
+class TestRouterTier:
+    def test_two_routers_share_state_and_survive_kill(self):
+        """TWO listeners over ONE shared backend table: both serve with
+        the same readiness knowledge; killing one leaves the sibling
+        fully current (no per-router convergence), and the next
+        ensure() replaces the dead slot, reporting router.failover."""
+        from tf_operator_tpu.serve.router import RouterTier
+
+        a = _StubReplica("a-0")
+        tier = RouterTier("default/svc", replicas=2,
+                          probe_interval_s=0.05)
+        try:
+            assert len(tier.endpoints()) == 2
+            assert tier.endpoint == tier.endpoints()[0]
+            tier.set_backends({"a-0": a.addr})
+            _wait_ready(tier, 1)
+            for ep in tier.endpoints():
+                code, resp = _post(ep)
+                assert code == 200 and resp["replica"] == "a-0"
+            dead = tier.kill(0)
+            assert dead == tier.endpoints()[0]
+            assert tier.alive_count() == 1
+            # The survivor keeps serving off the SHARED table…
+            code, resp = _post(tier.endpoints()[1])
+            assert code == 200 and resp["replica"] == "a-0"
+            # …while the dead port refuses (a crashed router process).
+            with pytest.raises(urllib.error.URLError):
+                _post(dead, timeout=1.0)
+            events = tier.ensure(2)
+            assert [e for e, _ in events] == ["router.failover"]
+            new_ep = tier.endpoints()[0]
+            assert new_ep != dead
+            code, resp = _post(new_ep)
+            assert code == 200 and resp["replica"] == "a-0"
+        finally:
+            tier.close()
+            a.close()
+
+    def test_ensure_grows_shrinks_and_snapshots(self):
+        from tf_operator_tpu.serve.router import RouterTier
+
+        tier = RouterTier("default/svc", replicas=1, probe_interval_s=30)
+        try:
+            assert len(tier.endpoints()) == 1
+            events = tier.ensure(3)
+            assert [e for e, _ in events] == ["router.open"] * 2
+            assert [r.name for r in tier.routers()] == ["r0", "r1", "r2"]
+            events = tier.ensure(1)
+            assert [e for e, _ in events] == ["router.close"] * 2
+            assert len(tier.endpoints()) == 1
+            assert tier.ensure(1) == [], "steady state must be silent"
+            snap = tier.snapshot()
+            assert snap["endpoint"] == snap["endpoints"][0]
+            assert snap["routers"][0]["alive"] is True
+            assert "session_ring" in snap and "hedge" in snap
+        finally:
+            tier.close()
+
+    def test_service_address_fails_over_past_dead_router(self):
+        """The client seam (LocalSession.service_address): round-robin
+        over status.routerEndpoints with a connect-phase probe — a
+        router killed between reconciles costs the sibling's address,
+        never 111s against a cached dead port."""
+        import socket as socket_mod
+        from types import SimpleNamespace
+
+        from tf_operator_tpu.runtime.session import LocalSession
+
+        live = socket_mod.socket()
+        live.bind(("127.0.0.1", 0))
+        live.listen(8)
+        live_ep = f"127.0.0.1:{live.getsockname()[1]}"
+        dead = socket_mod.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_ep = f"127.0.0.1:{dead.getsockname()[1]}"
+        dead.close()  # the port now refuses: a crashed router
+
+        svc = SimpleNamespace(status=SimpleNamespace(
+            router_endpoints=[dead_ep, live_ep],
+            router_endpoint=dead_ep))
+
+        class _Cluster:
+            def try_get_infsvc(self, ns, name):
+                return svc
+
+        # Seam only: the method under test needs the cluster view and
+        # the round-robin cursor, not a running runtime.
+        session = LocalSession.__new__(LocalSession)
+        session.cluster = _Cluster()
+        session._service_rr = {}
+        try:
+            for _ in range(4):
+                assert session.service_address("svc") == live_ep, (
+                    "every resolution must skip the dead router")
+            # Legacy singular fallback (pre-tier statuses).
+            svc.status.router_endpoints = []
+            svc.status.router_endpoint = live_ep
+            assert session.service_addresses("svc") == [live_ep]
+            assert session.service_address("svc") == live_ep
+            # Everyone dead (all routers mid-replacement): hand back
+            # the round-robin choice — the caller's retry loop covers
+            # the gap; None would read as "service never came up".
+            svc.status.router_endpoints = [dead_ep]
+            assert session.service_address("svc") == dead_ep
+        finally:
+            live.close()
+
+
+# --------------------------------------------------------- session affinity
+
+
+class TestSessionAffinity:
+    def test_ring_consistency_and_minimal_movement(self):
+        from tf_operator_tpu.serve.router import _HashRing
+
+        ring = _HashRing()
+        assert ring.lookup("s") is None, "empty ring: no home"
+        assert ring.sync(frozenset({"a", "b", "c"}))
+        assert not ring.sync(frozenset({"a", "b", "c"})), (
+            "unchanged membership must not rebuild")
+        keys = [f"sess-{i}" for i in range(200)]
+        home0 = {k: ring.lookup(k) for k in keys}
+        assert set(home0.values()) == {"a", "b", "c"}
+        ring.sync(frozenset({"a", "b"}))
+        home1 = {k: ring.lookup(k) for k in keys}
+        moved = [k for k in keys if home0[k] != home1[k]]
+        assert moved and all(home0[k] == "c" for k in moved), (
+            "losing one replica may move ONLY the keys it homed")
+        ring.sync(frozenset({"a", "b", "c"}))
+        assert {k: ring.lookup(k) for k in keys} == home0, (
+            "re-admission must restore every original home (stable "
+            "hashing, not the salted builtin)")
+
+    def test_session_key_extraction(self):
+        from tf_operator_tpu.serve.router import _session_key
+
+        assert _session_key({"X-Session-Id": "s1"}, b"{}") == "s1"
+        body = json.dumps({"sessionId": "s2"}).encode()
+        assert _session_key({}, body) == "s2"
+        assert _session_key({"X-Session-Id": "h"}, body) == "h", (
+            "the header wins: no body parse on the fast path")
+        assert _session_key({}, b'{"x": 1}') is None
+        assert _session_key({}, b'garbage "sessionId" oops') is None
+        assert _session_key({}, None) is None
+
+    def test_affinity_beats_load_and_falls_back(self):
+        """A session's home replica receives its requests even when it
+        is the MORE loaded one (its KV cache is there; recomputing it
+        elsewhere costs more than queueing). Keyless requests still
+        flee the load, and a not-ready home falls back instead of
+        failing."""
+        a = _StubReplica("a-0")
+        b = _StubReplica("b-0")
+        router = FrontEndRouter("default/svc", probe_interval_s=30)
+        try:
+            router.set_backends({"a-0": a.addr, "b-0": b.addr})
+            with router._lock:
+                for be in router._backends.values():
+                    be.ready = True
+            payload = {"instances": [[1.0]], "sessionId": "sess-7"}
+            code, resp = _post(router.endpoint, payload)
+            assert code == 200
+            home = resp["replica"]
+            other = "b-0" if home == "a-0" else "a-0"
+            with router._lock:  # pile load on the home
+                router._backends[home].ewma = 50.0
+            for _ in range(5):
+                code, resp = _post(router.endpoint, payload)
+                assert code == 200 and resp["replica"] == home, (
+                    "affinity must not flee the home's load")
+            code, resp = _post(router.endpoint)
+            assert code == 200 and resp["replica"] == other, (
+                "keyless requests still route least-loaded")
+            with router._lock:
+                router._backends[home].ready = False
+            code, resp = _post(router.endpoint, payload)
+            assert code == 200 and resp["replica"] == other, (
+                "a gone home falls back to least-loaded, not to 503")
+        finally:
+            router.close()
+            a.close()
+            b.close()
+
+
+# ------------------------------------------------------------ hedged sends
+
+
+class TestHedging:
+    def _tier(self, hedge_ms, backends, events=None, **kw):
+        from tf_operator_tpu.serve.router import RouterTier
+
+        on_event = None
+        if events is not None:
+            def on_event(ev, _evs=events, **attrs):
+                _evs.append((ev, attrs))
+        tier = RouterTier("default/svc", replicas=1, probe_interval_s=30,
+                          hedge_after_ms=hedge_ms, on_event=on_event,
+                          **kw)
+        tier.set_backends(backends)
+        with tier._lock:
+            for be in tier._backends.values():
+                be.ready = True
+        return tier
+
+    def test_hedge_rescues_slow_primary(self):
+        """A primary quiet past the budget earns ONE duplicate on the
+        next replica; the duplicate's answer wins well before the
+        straggler finishes, and the win is counted + journaled."""
+        from tf_operator_tpu.status import metrics as metrics_mod
+
+        slow = _StubReplica("slow-0", delay_s=1.0)
+        fast = _StubReplica("fast-0")
+        events: list = []
+        tier = self._tier(50.0, {"slow-0": slow.addr,
+                                 "fast-0": fast.addr}, events)
+        try:
+            with tier._lock:  # make the straggler win the pick
+                tier._backends["fast-0"].ewma = 5.0
+            won0 = metrics_mod.serve_router_hedges_total.labels(
+                result="won").value()
+            t0 = time.monotonic()
+            code, resp = _post(tier.endpoint)
+            took_s = time.monotonic() - t0
+            assert code == 200 and resp["replica"] == "fast-0"
+            assert took_s < 0.9, (
+                "the hedge must answer before the straggler")
+            assert metrics_mod.serve_router_hedges_total.labels(
+                result="won").value() == won0 + 1
+            hedges = [(ev, at) for ev, at in events
+                      if ev == "router.hedge"]
+            assert len(hedges) == 1
+            assert hedges[0][1]["result"] == "won"
+            assert hedges[0][1]["primary"] == "slow-0"
+            assert hedges[0][1]["hedge"] == "fast-0"
+        finally:
+            tier.close()
+            slow.close()
+            fast.close()
+
+    def test_at_most_one_hedge_per_request(self):
+        """Three equally slow replicas, one request: exactly primary +
+        ONE duplicate — a hedge that itself runs slow must not cascade
+        into a third attempt."""
+        stubs = [_StubReplica(f"s-{i}", delay_s=0.5) for i in range(3)]
+        tier = self._tier(40.0, {s.name: s.addr for s in stubs})
+        try:
+            code, _ = _post(tier.endpoint)
+            assert code == 200
+            assert sum(s.hits for s in stubs) == 2, (
+                f"expected primary + one hedge, saw "
+                f"{[(s.name, s.hits) for s in stubs]}")
+        finally:
+            tier.close()
+            for s in stubs:
+                s.close()
+
+    def test_read_timeout_never_hedges(self):
+        """THE round-19 pin: a budget at/over the request timeout turns
+        hedging OFF entirely, so the hedge decision can never race the
+        read-timeout — a timed-out request is likely still executing,
+        and duplicating it is retry amplification wearing a different
+        hat. The timeout answers 504 with the survivor untouched."""
+        from tf_operator_tpu.status import metrics as metrics_mod
+
+        slow = _StubReplica("slow-0", delay_s=1.0)
+        fast = _StubReplica("fast-0")
+        tier = self._tier(400.0, {"slow-0": slow.addr,
+                                  "fast-0": fast.addr},
+                          request_timeout_s=0.3)
+        try:
+            with tier._lock:
+                tier._backends["fast-0"].ewma = 5.0
+            before = {
+                r: metrics_mod.serve_router_hedges_total.labels(
+                    result=r).value()
+                for r in ("won", "lost", "suppressed")}
+            code, resp = _post(tier.endpoint)
+            assert code == 504 and "timed out" in resp["error"]
+            assert fast.hits == 0, (
+                "a read timeout must never spawn work on the survivor")
+            after = {
+                r: metrics_mod.serve_router_hedges_total.labels(
+                    result=r).value()
+                for r in ("won", "lost", "suppressed")}
+            assert after == before, "no hedge activity of any kind"
+        finally:
+            tier.close()
+            slow.close()
+            fast.close()
+
+    def test_saturation_suppresses_the_hedge(self):
+        """With instantaneous inflight at/above ready x target, the
+        budget expiring is a no-op (counted as suppressed): every
+        replica already has a queue, so a duplicate is pure
+        amplification — hedging is a tail tool, not a load tool."""
+        from tf_operator_tpu.status import metrics as metrics_mod
+
+        slow = _StubReplica("slow-0", delay_s=0.4)
+        fast = _StubReplica("fast-0")
+        tier = self._tier(50.0, {"slow-0": slow.addr,
+                                 "fast-0": fast.addr},
+                          saturation_target=1.0)
+        try:
+            with tier._lock:
+                tier._backends["fast-0"].ewma = 5.0
+                tier._backends["slow-0"].inflight = 2
+                tier._backends["fast-0"].inflight = 2
+            sup0 = metrics_mod.serve_router_hedges_total.labels(
+                result="suppressed").value()
+            code, resp = _post(tier.endpoint)
+            assert code == 200 and resp["replica"] == "slow-0", (
+                "suppressed hedging waits the primary out")
+            assert fast.hits == 0
+            assert metrics_mod.serve_router_hedges_total.labels(
+                result="suppressed").value() == sup0 + 1
+        finally:
+            tier.close()
+            slow.close()
+            fast.close()
+
+    def test_hedge_budget_math(self):
+        from tf_operator_tpu.serve.router import _TierState
+
+        st = _TierState("default/svc")
+        assert st.hedge_budget_ms(30.0) is None, "hedging off by default"
+        st.hedge_after_ms = 25.0
+        assert st.hedge_budget_ms(30.0) == 25.0, "the operator floor"
+        st.lat_p95_ms = 90.0
+        assert st.hedge_budget_ms(30.0) == 90.0, "the EW p95 dominates"
+        assert st.hedge_budget_ms(0.05) is None, (
+            "budget at/over the request timeout disables hedging — the "
+            "structural no-hedge-after-timeout guarantee")
+
+
 class TestPadDelta:
     def test_stage_delta_survives_replica_churn(self):
         """exp_serve's per-stage pad accounting diffs PER-POD baselines:
@@ -720,6 +1057,66 @@ class TestControllerRouter:
             cur = cluster.get_infsvc("default", "svc")
             assert cur.status.desired_replicas == 3, (
                 "ceil(5/2)=3: router inflight must drive scale-up")
+        finally:
+            c.stop()
+
+    def test_tier_sized_from_spec_and_killed_member_replaced(self):
+        """The controller's tier lifecycle: serving.routers sizes the
+        member set, status publishes every endpoint (legacy singular =
+        endpoint 0), a killed member is replaced on the next tick with
+        router.failover journaled, and /debug/state exposes the full
+        tier."""
+        from tf_operator_tpu.telemetry import journal as journal_lib
+
+        cluster, c = serve_env_with_router(
+            lambda ns, svc, pod, port: "127.0.0.1:1")
+        try:
+            svc = make_service("tier")
+            svc.spec.serving.routers = 2
+            cluster.create_infsvc(svc)
+            assert c.run_until_idle(10)
+            cur = cluster.get_infsvc("default", "tier")
+            assert len(cur.status.router_endpoints) == 2
+            assert (cur.status.router_endpoint
+                    == cur.status.router_endpoints[0])
+            tier = c._routers["default/tier"]
+            assert tier.alive_count() == 2
+            opened = [e for e in journal_lib.get_journal().events(
+                "default/tier") if e[0] == "router.open"]
+            assert len(opened) == 2, (
+                "one router.open per member, never double-journaled")
+
+            dead = tier.kill(0)
+            assert dead is not None
+            c.enqueue("default/tier")
+            assert c.run_until_idle(10)
+            assert tier.alive_count() == 2, "dead member must be replaced"
+            cur = cluster.get_infsvc("default", "tier")
+            assert dead not in cur.status.router_endpoints, (
+                "status must stop advertising the dead port")
+            assert len(cur.status.router_endpoints) == 2
+            failovers = [e for e in journal_lib.get_journal().events(
+                "default/tier") if e[0] == "router.failover"]
+            assert len(failovers) == 1
+            assert failovers[0][3]["dead"] == dead
+
+            snap = c.router_snapshot()["default/tier"]
+            assert len(snap["routers"]) == 2
+            assert all(r["alive"] for r in snap["routers"])
+            assert snap["endpoints"] == cur.status.router_endpoints
+            assert "session_ring" in snap and "hedge" in snap
+
+            # Shrinking the tier is a status-only change (the spec hash
+            # pins that it never rolls replicas) and journals the close.
+            edited = cluster.get_infsvc("default", "tier").deep_copy()
+            edited.spec.serving.routers = 1
+            cluster.update_infsvc(edited)
+            assert c.run_until_idle(10)
+            cur = cluster.get_infsvc("default", "tier")
+            assert len(cur.status.router_endpoints) == 1
+            closed = [e for e in journal_lib.get_journal().events(
+                "default/tier") if e[0] == "router.close"]
+            assert len(closed) >= 1
         finally:
             c.stop()
 
@@ -978,10 +1375,55 @@ class TestFastPathApi:
 
         svc = make_service()
         svc.status.router_endpoint = "127.0.0.1:41234"
+        svc.status.router_endpoints = ["127.0.0.1:41234",
+                                       "127.0.0.1:41235"]
         d = k8s_mod.infsvc_status_to_dict(svc.status)
         assert d["routerEndpoint"] == "127.0.0.1:41234"
+        assert d["routerEndpoints"] == ["127.0.0.1:41234",
+                                        "127.0.0.1:41235"]
         back = k8s_mod.infsvc_status_from_dict(d)
         assert back.router_endpoint == "127.0.0.1:41234"
+        assert back.router_endpoints == ["127.0.0.1:41234",
+                                         "127.0.0.1:41235"]
+        # Pre-tier payloads (no routerEndpoints key) parse to an empty
+        # list, never None.
+        d.pop("routerEndpoints")
+        assert k8s_mod.infsvc_status_from_dict(d).router_endpoints == []
+
+    def test_router_tier_knobs_are_control_plane_only(self):
+        """routers/hedgeAfterMs are CONTROL-TIER knobs: editing either
+        must NOT change the spec hash — resizing the front door or
+        re-arming hedging never rolls the serving replicas (contrast
+        test_new_knobs_roll_replicas for serving-path knobs)."""
+        base = serve_spec_hash(make_service())
+        svc = make_service()
+        svc.spec.serving.routers = 3
+        assert serve_spec_hash(svc) == base
+        svc.spec.serving.hedge_after_ms = 25.0
+        assert serve_spec_hash(svc) == base
+
+    def test_router_tier_api_roundtrip_and_validation(self):
+        svc = make_service()
+        assert svc.spec.serving.routers == 1, (
+            "the default tier is the pre-tier single router")
+        assert svc.spec.serving.hedge_after_ms is None, (
+            "hedging is opt-in")
+        svc.spec.serving.routers = 2
+        svc.spec.serving.hedge_after_ms = 40.0
+        d = compat.infsvc_to_dict(svc)
+        assert d["spec"]["serving"]["routers"] == 2
+        assert d["spec"]["serving"]["hedgeAfterMs"] == 40.0
+        back = compat.infsvc_from_dict(d)
+        assert back.spec.serving.routers == 2
+        assert back.spec.serving.hedge_after_ms == 40.0
+        bad = make_service()
+        bad.spec.serving.routers = 0
+        assert any("serving.routers" in p
+                   for p in validation.validate_inference_service(bad))
+        bad = make_service()
+        bad.spec.serving.hedge_after_ms = 0.0
+        assert any("serving.hedgeAfterMs" in p
+                   for p in validation.validate_inference_service(bad))
 
 
 # ---------------------------------------------------------- slow capstone

@@ -290,6 +290,9 @@ def infsvc_from_dict(manifest: dict[str, Any],
                 max_concurrent_sequences=(
                     8 if serving_d.get("maxConcurrentSequences") is None
                     else int(serving_d["maxConcurrentSequences"])),
+                routers=(1 if serving_d.get("routers") is None
+                         else int(serving_d["routers"])),
+                hedge_after_ms=serving_d.get("hedgeAfterMs"),
             ),
             autoscale=AutoscaleSpec(
                 min_replicas=(1 if auto_d.get("minReplicas") is None
@@ -382,6 +385,8 @@ def infsvc_to_dict(svc) -> dict[str, Any]:
                 "maxNewTokens": spec.serving.max_new_tokens,
                 "maxConcurrentSequences":
                     spec.serving.max_concurrent_sequences,
+                "routers": spec.serving.routers,
+                "hedgeAfterMs": spec.serving.hedge_after_ms,
             },
             "autoscale": {
                 "minReplicas": spec.autoscale.min_replicas,

@@ -549,6 +549,16 @@ class ServingSpec:
     scheduler's admission capacity and the replica-resident device-state
     budget (cache bytes scale linearly with it). Also the unit of the
     router's active-slot load signal.
+    routers: front-end router replicas in the service's tier (wire:
+    routers). All share one backend/readiness table, so any router
+    serves any request the moment a sibling dies; 1 (default) is the
+    pre-tier single router. A CONTROL-TIER knob like autoscale —
+    changing it never rolls the serving replicas.
+    hedge_after_ms: floor (ms) for the hedged-send budget — a request
+    quiet past max(hedgeAfterMs, EW p95 latency) earns ONE duplicate on
+    the next-least-loaded ready replica, first answer wins. None
+    (default) disables hedging. Suppressed under saturation; never
+    fired in response to a read-timeout. Control-tier, like routers.
     """
 
     batch_max_size: int = 8
@@ -558,6 +568,8 @@ class ServingSpec:
     bucketing: bool = True
     max_new_tokens: int = 64
     max_concurrent_sequences: int = 8
+    routers: int = 1
+    hedge_after_ms: float | None = None
 
 
 @dataclass
@@ -614,8 +626,13 @@ class InferenceServiceStatus:
     # operator runs one (local runtime): the single endpoint clients hit;
     # it routes each request to the READY replica with least
     # time-averaged inflight. None on substrates where the front-end is
-    # an external Service/LB (K8s).
+    # an external Service/LB (K8s). Since the router TIER (round 19)
+    # this is always routerEndpoints[0] — kept for pre-tier clients.
     router_endpoint: str | None = None
+    # Every router in the tier, slot-ordered (spec.serving.routers
+    # addresses; clients round-robin with connect-phase failover across
+    # them). Empty on substrates without an in-process router.
+    router_endpoints: list[str] = field(default_factory=list)
     start_time: float | None = None
     last_reconcile_time: float | None = None
 

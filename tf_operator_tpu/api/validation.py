@@ -304,6 +304,10 @@ def validate_inference_service(svc, fleet=None) -> list[str]:
             f"prompt needs at least one token of the window")
     if serving.max_concurrent_sequences < 1:
         problems.append("serving.maxConcurrentSequences must be >= 1")
+    if serving.routers < 1:
+        problems.append("serving.routers must be >= 1")
+    if serving.hedge_after_ms is not None and serving.hedge_after_ms <= 0:
+        problems.append("serving.hedgeAfterMs must be > 0")
     auto = spec.autoscale
     if auto.min_replicas < 1:
         problems.append("autoscale.minReplicas must be >= 1")

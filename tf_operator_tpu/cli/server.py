@@ -115,9 +115,11 @@ def _infsvc_payload(cluster, svc, telemetry=None) -> dict:
             "desiredReplicas": svc.status.desired_replicas,
             "lastScaleTime": svc.status.last_scale_time,
             "restarts": svc.status.restarts,
-            # The shared front-end: the ONE address clients should hit
-            # (least-loaded, readiness-gated routing — serve/router.py).
+            # The shared front-end tier (serve/router.py): every router
+            # address, slot-ordered; clients round-robin with connect-
+            # phase failover. The legacy singular is endpoint 0.
             "routerEndpoint": svc.status.router_endpoint,
+            "routerEndpoints": list(svc.status.router_endpoints),
             "startTime": svc.status.start_time,
         },
         "events": [

@@ -265,6 +265,7 @@ def infsvc_status_to_dict(status) -> dict:
         "lowLoadSince": status.low_load_since,
         "restarts": status.restarts,
         "routerEndpoint": status.router_endpoint,
+        "routerEndpoints": list(status.router_endpoints),
         "startTime": status.start_time,
     }
 
@@ -280,6 +281,7 @@ def infsvc_status_from_dict(d: dict):
         low_load_since=d.get("lowLoadSince"),
         restarts=int(d.get("restarts") or 0),
         router_endpoint=d.get("routerEndpoint"),
+        router_endpoints=list(d.get("routerEndpoints") or []),
         start_time=d.get("startTime"),
     )
     for c in d.get("conditions") or []:

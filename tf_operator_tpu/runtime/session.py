@@ -15,6 +15,7 @@ reproduce the reference's eight behavior suites on one machine.
 from __future__ import annotations
 
 import json
+import socket
 import time
 import urllib.request
 
@@ -92,6 +93,9 @@ class LocalSession:
             endpoint_resolver=local_endpoint_resolver(self.runtime),
         )
         serve_ref.append(self.serve_controller)
+        # Round-robin cursor per service for service_address (the
+        # client side of the router tier).
+        self._service_rr: dict[tuple[str, str], int] = {}
         self.controller.run(workers=workers)
         self.serve_controller.run(workers=1)
 
@@ -168,16 +172,71 @@ class LocalSession:
         return self.replica_address(service, namespace, "server", index,
                                     port=port)
 
-    def service_address(self, service: str,
-                        namespace: str = "default") -> str | None:
-        """The service's SHARED front-end endpoint (serve/router.py):
-        one address, least-loaded + readiness-gated routing over the
-        replicas — what clients should hit instead of per-replica
-        round-robin. None until the first reconcile publishes it."""
+    def service_addresses(self, service: str,
+                          namespace: str = "default") -> list[str]:
+        """Every router in the service's front-end tier, slot-ordered
+        (status.routerEndpoints; falls back to the legacy singular for
+        pre-tier statuses). Empty until the first reconcile publishes
+        them."""
         svc = self.cluster.try_get_infsvc(namespace, service)
         if svc is None:
+            return []
+        eps = list(svc.status.router_endpoints)
+        if not eps and svc.status.router_endpoint:
+            eps = [svc.status.router_endpoint]
+        return eps
+
+    def service_address(self, service: str,
+                        namespace: str = "default") -> str | None:
+        """ONE address of the service's front-end router tier
+        (serve/router.py): least-loaded + readiness-gated routing over
+        the replicas — what clients should hit instead of per-replica
+        round-robin. Round-robins across the tier's endpoints and fails
+        over past a dead one: each candidate gets a cheap connect probe,
+        so a router killed between reconciles costs the NEXT sibling's
+        address, not 111s against a cached dead port until the
+        controller replaces it. None until the first reconcile
+        publishes an endpoint."""
+        eps = self.service_addresses(service, namespace)
+        if not eps:
             return None
-        return svc.status.router_endpoint
+        start = self._service_rr.get((namespace, service), 0)
+        self._service_rr[(namespace, service)] = start + 1
+        for i in range(len(eps)):
+            addr = eps[(start + i) % len(eps)]
+            host, _, port = addr.rpartition(":")
+            try:
+                # Connect-phase only: a live listener accepts instantly.
+                # A refused/timed-out connect means a dead router —
+                # skip to the next sibling (client-seam failover).
+                socket.create_connection((host, int(port)),
+                                         timeout=0.25).close()
+            except OSError:
+                continue
+            return addr
+        # Nobody accepted (all routers mid-replacement): hand back the
+        # round-robin choice — the caller's own retry loop covers the
+        # gap, and hiding the address entirely would read as "service
+        # never came up".
+        return eps[start % len(eps)]
+
+    def kill_router(self, service: str, namespace: str = "default",
+                    index: int = 0) -> str | None:
+        """Fault injection: close ONE router of the service's front-end
+        tier (its port goes dead like a crashed router process; the
+        shared backend table and the siblings keep serving). The serve
+        controller replaces it on its next tick — this is what the
+        mid-ramp router-kill gate drives. Returns the dead endpoint, or
+        None when there is no such router."""
+        tier = self.serve_controller._routers.get(f"{namespace}/{service}")
+        if tier is None:
+            return None
+        dead = tier.kill(index)
+        if dead is not None:
+            # The controller replaces the dead listener on its next
+            # reconcile — kick one rather than waiting for the resync.
+            self.serve_controller.enqueue(f"{namespace}/{service}")
+        return dead
 
     def timeline(self, namespace: str, name: str) -> dict | None:
         """The flight-recorder timeline for one job — the same payload

@@ -116,10 +116,15 @@ def serve_spec_hash(svc: InferenceService) -> str:
     (model source, serving knobs, template, tpu class) — the serving
     analogue of cluster_spec.tf_config.topology_hash. Autoscale and
     scheduling knobs are deliberately EXCLUDED: a changed replica range
-    or queue must not roll healthy replicas."""
+    or queue must not roll healthy replicas. The router-tier knobs
+    (serving.routers / serving.hedgeAfterMs) are control-tier for the
+    same reason: resizing the front door or re-arming hedging is an
+    operator-side change, invisible to the server pods."""
     d = api_compat.infsvc_to_dict(svc)["spec"]
     d.pop("autoscale", None)
     d.pop("schedulingPolicy", None)
+    d.get("serving", {}).pop("routers", None)
+    d.get("serving", {}).pop("hedgeAfterMs", None)
     blob = json.dumps(d, sort_keys=True, default=str).encode()
     return hashlib.sha1(blob).hexdigest()[:10]
 
@@ -212,16 +217,15 @@ class InferenceServiceController(ctrl.JobControllerBase):
         return [SERVER_REPLICA]
 
     def router_snapshot(self) -> dict:
-        """Per-service front-end router state (endpoint + live backend
-        accounting) for /debug/state."""
+        """Per-service front-end TIER state for /debug/state: every
+        router's liveness + endpoint, the shared backend accounting,
+        the session ring's membership, and the hedge budget — `tpujob
+        timeline` + this view is how router churn reads post-mortem."""
         out = {}
-        for key, router in list(self._routers.items()):
+        for key, tier in list(self._routers.items()):
             try:
-                out[key] = {
-                    "endpoint": router.endpoint,
-                    "backends": router.backends(),
-                }
-            except Exception as e:  # router torn down mid-snapshot
+                out[key] = tier.snapshot()
+            except Exception as e:  # tier torn down mid-snapshot
                 from tf_operator_tpu.utils.logging import logger_for_key
 
                 logger_for_key(key).debug("router snapshot skipped: %s", e)
@@ -260,6 +264,7 @@ class InferenceServiceController(ctrl.JobControllerBase):
             self._close_router(key)
             self._status_writer.forget(key)
             metrics.serve_ready_replicas.remove(namespace=ns, service=name)
+            metrics.serve_router_ready.remove(namespace=ns, service=name)
             return
 
         svc = shared.deep_copy()
@@ -808,24 +813,59 @@ class InferenceServiceController(ctrl.JobControllerBase):
 
     def _router_tick(self, svc: InferenceService, key: str,
                      live: list[Pod]) -> None:
-        """Create/sync this service's front-end router (serve/router.py)
-        when the operator has an endpoint resolver: backends = live
-        RUNNING pods' resolved addresses (the router's own probe gates
-        readiness on the server actually answering — pod Running !=
-        warmed), endpoint published in status.routerEndpoint."""
+        """Create/size this service's front-end router TIER
+        (serve/router.py) when the operator has an endpoint resolver:
+        spec.serving.routers listeners over one shared backend table,
+        backends = live RUNNING pods' resolved addresses (the tier's
+        own probe gates readiness on the server actually answering —
+        pod Running != warmed). A listener that died since the last
+        tick is REPLACED here (router.failover) — clients fail over
+        across status.routerEndpoints meanwhile; the legacy singular
+        routerEndpoint stays endpoint 0."""
         if self.endpoint_resolver is None:
             return
-        router = self._routers.get(key)
-        if router is None:
-            from tf_operator_tpu.serve.router import FrontEndRouter
+        tier = self._routers.get(key)
+        serving = svc.spec.serving
+        if tier is None:
+            from tf_operator_tpu.serve.router import RouterTier
 
-            router = FrontEndRouter(service=key)
-            self._routers[key] = router
+            tier = RouterTier(
+                service=key, replicas=serving.routers,
+                hedge_after_ms=serving.hedge_after_ms,
+                saturation_target=(
+                    svc.spec.autoscale.target_inflight_per_replica),
+                # The tier emits its own lifecycle (router.open/close/
+                # failover, from ensure()) and hedge resolutions (from
+                # handler threads, no reconcile wave to stamp) — one
+                # journal path for both, so nothing is double-recorded.
+                on_event=lambda event, _key=key, **attrs:
+                    journal_lib.get_journal().record(_key, event, **attrs))
+            self._routers[key] = tier
             self.cluster.record_event(
                 InferenceService.KIND, svc.namespace, svc.name,
                 "Normal", "RouterReady",
-                f"front-end router on {router.endpoint} (least-loaded, "
-                f"readiness-gated)")
+                f"front-end router tier on {tier.endpoints()} "
+                f"(least-loaded, readiness-gated, "
+                f"{serving.routers} router(s))")
+        else:
+            # Control-tier knobs apply live: resize the tier, re-arm
+            # hedging — never a replica roll (see serve_spec_hash).
+            tier.configure(
+                hedge_after_ms=serving.hedge_after_ms,
+                saturation_target=(
+                    svc.spec.autoscale.target_inflight_per_replica))
+            # ensure() journals its own events through the tier's
+            # on_event hook; the returned list only feeds the
+            # cluster-event surface.
+            events = tier.ensure(serving.routers)
+            for event, attrs in events:
+                if event == "router.failover":
+                    self.cluster.record_event(
+                        InferenceService.KIND, svc.namespace, svc.name,
+                        "Warning", "RouterFailover",
+                        f"router {attrs['router']} died at "
+                        f"{attrs['dead']}; replaced on "
+                        f"{attrs['endpoint']}")
         backends: dict[str, str] = {}
         for p in live:
             if p.status.phase != PodPhase.RUNNING:
@@ -834,22 +874,35 @@ class InferenceServiceController(ctrl.JobControllerBase):
                 svc.namespace, svc.name, p.name, svc.spec.serving.port)
             if addr:
                 backends[p.name] = addr
-        router.set_backends(backends)
-        svc.status.router_endpoint = router.endpoint
+        tier.set_backends(backends)
+        svc.status.router_endpoints = tier.endpoints()
+        svc.status.router_endpoint = svc.status.router_endpoints[0]
+        metrics.serve_router_ready.labels(
+            namespace=svc.namespace, service=svc.name).set(
+                tier.alive_count())
 
     def _close_router(self, key: str, svc=None) -> bool:
-        """Close the service's router AND clear the advertised endpoint
-        in one place — every early-return path that closes the front
-        door must stop advertising the dead port, and hand-pairing the
-        two at each site is how that invariant gets lost. Returns True
-        when `svc`'s status changed."""
-        router = self._routers.pop(key, None)
-        if router is not None:
-            router.close()
+        """Close the service's router tier AND clear the advertised
+        endpoints in one place — every early-return path that closes
+        the front door must stop advertising the dead ports, and
+        hand-pairing the two at each site is how that invariant gets
+        lost. Returns True when `svc`'s status changed."""
+        tier = self._routers.pop(key, None)
+        if tier is not None:
+            jrnl = journal_lib.get_journal()
+            if jrnl.enabled:
+                for r in tier.routers():
+                    jrnl.record(key, "router.close", router=r.name,
+                                endpoint=r.endpoint)
+            tier.close()
+        changed = False
         if svc is not None and svc.status.router_endpoint is not None:
             svc.status.router_endpoint = None
-            return True
-        return False
+            changed = True
+        if svc is not None and svc.status.router_endpoints:
+            svc.status.router_endpoints = []
+            changed = True
+        return changed
 
     # ---------------------------------------------------------- autoscale
 

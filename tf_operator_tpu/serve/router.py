@@ -1,4 +1,4 @@
-"""Shared front-end router: ONE operator-managed endpoint per
+"""Shared front-end router tier: operator-managed endpoints per
 InferenceService.
 
 Before round 18 every client round-robined the per-replica endpoints
@@ -21,25 +21,60 @@ error burst (PR-13's known-error). The router kills that class:
     preempted mid-request costs a retry, not a client error. /predict
     is pure inference — idempotent — so retry-after-send is safe.
 
-The serve controller owns one router per service (created lazily when
+Round 19 scales the front door itself (ROADMAP item 2: "survive a
+router"): a RouterTier runs `spec.serving.routers` listeners per
+service, every one backed by the SAME _TierState — one backend table,
+one probe thread, one lock — so the instant a sibling dies, any other
+router serves any request with fully current readiness/load knowledge
+(the collector-fed-snapshot shape: shared state, not per-router gossip
+convergence). The controller replaces a dead listener on its next tick
+and clients fail over across `status.routerEndpoints` meanwhile.
+
+Two tier behaviors ride on the shared state:
+
+  * SESSION AFFINITY — a request carrying a session id (X-Session-Id
+    header or a "sessionId" body field) routes through a consistent-
+    hash ring over READY replicas, so PR-16 decode sequences keep
+    landing on the replica holding their KV cache even when the request
+    enters through a different router after a failover. The ring
+    rebuilds ONLY on ready-membership change (virtual nodes keep the
+    reshuffle ~1/N); no session key = least-loaded, exactly as before.
+  * HEDGED SENDS — when `serving.hedgeAfterMs` is set and the primary
+    has not answered within max(hedgeAfterMs, EW p95 of observed
+    latency), ONE duplicate goes to the next-least-loaded ready
+    replica; first answer wins, the loser is ignored. Bounded to <= 1
+    hedge per request, suppressed while the tier is saturated
+    (instantaneous inflight >= ready x target), and NEVER launched in
+    response to a read-timeout — the PR-14 round-3 lesson: a timed-out
+    request is likely still executing, and replaying it on an equally
+    loaded survivor amplifies exactly the overload that caused the
+    slowness.
+
+The serve controller owns one tier per service (created lazily when
 the operator runs with an endpoint resolver — the local runtime's port
 map; on K8s the front-end is a readiness-probed Service/LB instead) and
-syncs its backend set every reconcile from the live pods. The router's
-address is published in status.routerEndpoint, and its per-backend
-time-averaged inflight doubles as an autoscale load signal
-(`router.load()`), so scaling reacts to traffic the moment it enters
-the front door — no stats-file round trip.
+syncs its backend set every reconcile from the live pods. The tier's
+addresses are published in status.routerEndpoints (legacy singular
+routerEndpoint = endpoint 0), and its per-backend time-averaged
+inflight doubles as an autoscale load signal (`tier.load()`), so
+scaling reacts to traffic the moment it enters the front door — no
+stats-file round trip.
 
-Metrics: tpujob_serve_router_requests_total{replica} counts forwards
-per backend (the router runs inside the operator process, so the
-series lands on the operator's /metrics like the scheduler's).
+Metrics (the routers run inside the operator process, so the series
+land on the operator's /metrics like the scheduler's):
+  tpujob_serve_router_requests_total{replica}   forwards per backend
+  tpujob_serve_router_hedges_total{result}      won | lost | suppressed
+  tpujob_serve_router_affinity_total{result}    hit | miss
 """
 
 from __future__ import annotations
 
+import bisect
+import hashlib
 import http.client
 import json
 import math
+import queue as queue_mod
 import socket
 import threading
 import time
@@ -50,6 +85,15 @@ from tf_operator_tpu.status import metrics as metrics_mod
 # enough to smooth between-batch zeros, short enough that a drained
 # replica looks drained within a couple of batch windows.
 LOAD_TAU_S = 1.0
+
+# Virtual nodes per backend on the session ring: enough that losing one
+# replica moves ~1/N of the key space, few enough that a rebuild on
+# membership change stays trivially cheap at serving replica counts.
+RING_POINTS = 64
+
+# Saturation guard when the service declares no autoscale target:
+# matches AutoscaleSpec.target_inflight_per_replica's default.
+DEFAULT_SATURATION_TARGET = 4.0
 
 
 class _ReadTimeout(Exception):
@@ -90,7 +134,7 @@ class _Backend:
 
     def touch(self, now: float) -> None:
         """Advance the EW time-average to `now` (caller holds the
-        router lock)."""
+        tier lock)."""
         dt = max(0.0, now - self.last_t)
         if dt > 0:
             alpha = 1.0 - math.exp(-dt / LOAD_TAU_S)
@@ -98,20 +142,202 @@ class _Backend:
             self.last_t = now
 
 
-class FrontEndRouter:
-    """One service's front door. Thread shape: N handler threads
-    (ThreadingHTTPServer) pick/forward/account, one probe thread flips
-    readiness. All shared state behind one lock; no lock is ever held
-    across a network call."""
+class _HashRing:
+    """Consistent-hash session ring over READY replica names. Stable
+    hashing (md5, not the salted builtin) so a session's home replica
+    is the same from every router in the tier and across operator
+    restarts; rebuilt ONLY when the ready set changes."""
+
+    def __init__(self):
+        self._points: list[tuple[int, str]] = []
+        self._members: frozenset[str] = frozenset()
+
+    @staticmethod
+    def _h(s: str) -> int:
+        return int.from_bytes(hashlib.md5(s.encode()).digest()[:8], "big")
+
+    def sync(self, members: frozenset[str]) -> bool:
+        """Rebuild iff membership changed. Caller holds the tier lock."""
+        if members == self._members:
+            return False
+        self._members = members
+        pts = []
+        for name in members:
+            for i in range(RING_POINTS):
+                pts.append((self._h(f"{name}#{i}"), name))
+        pts.sort()
+        self._points = pts
+        return True
+
+    def lookup(self, key: str) -> str | None:
+        if not self._points:
+            return None
+        i = bisect.bisect_left(self._points, (self._h(key), ""))
+        if i >= len(self._points):
+            i = 0
+        return self._points[i][1]
+
+    def members(self) -> list[str]:
+        return sorted(self._members)
+
+
+class _TierState:
+    """Everything the routers of one service SHARE: the backend table,
+    its lock, the probe thread, the session ring, and the hedging
+    budget. A standalone FrontEndRouter owns a private instance (the
+    pre-tier shape, bit-for-bit); a RouterTier threads one instance
+    through all its members so any router routes with the same
+    knowledge the moment a sibling dies."""
 
     def __init__(self, service: str, probe_interval_s: float = 0.25,
-                 request_timeout_s: float = 30.0, serve_http: bool = True):
+                 hedge_after_ms: float | None = None,
+                 saturation_target: float | None = None):
         self.service = service
         self.probe_interval_s = probe_interval_s
+        self.lock = threading.Lock()
+        self.backends: dict[str, _Backend] = {}
+        self.stop = threading.Event()
+        self.ring = _HashRing()
+        # Hedging knobs (serving.hedgeAfterMs; None = hedging off, the
+        # default — and the bit-for-bit PR-14 path).
+        self.hedge_after_ms = hedge_after_ms
+        self.saturation_target = saturation_target
+        # EW p95 of observed request latency (ms): Robbins-Monro
+        # asymmetric quantile steps — 5% of samples push up, 95% push
+        # down 5/95 as far, equilibrium at the 95th percentile, O(1)
+        # per observation and naturally exponentially aged.
+        self.lat_p95_ms = 0.0
+        self.lat_mean_ms = 0.0
+        self.lat_samples = 0
+        # Journal hook: callable(event, **attrs) wired by the serve
+        # controller (router.hedge into the flight recorder).
+        self.on_event = None
+        self._probe_started = False
+
+    # ----------------------------------------------------------- probing
+
+    def start_probe(self) -> None:
+        if self._probe_started:
+            return
+        self._probe_started = True
+        threading.Thread(target=self._probe_loop, daemon=True,
+                         name=f"serve-router-probe-{self.service}").start()
+
+    def _probe_loop(self) -> None:
+        while not self.stop.is_set():
+            with self.lock:
+                targets = [(b.name, b.addr) for b in self.backends.values()]
+            for name, addr in targets:
+                ok, slots = self._probe_one(addr)
+                with self.lock:
+                    b = self.backends.get(name)
+                    if b is not None and b.addr == addr:
+                        b.ready = ok
+                        b.slots = slots
+            self.stop.wait(timeout=self.probe_interval_s)
+
+    def _probe_one(self, addr: str) -> tuple[bool, int]:
+        """(ready, active decode slots) from the replica's /healthz."""
+        host, _, port = addr.rpartition(":")
+        try:
+            conn = http.client.HTTPConnection(host, int(port), timeout=1.0)
+            try:
+                conn.request("GET", "/healthz")
+                r = conn.getresponse()
+                body = r.read()
+                if r.status != 200:
+                    return False, 0
+                hz = json.loads(body)
+                return (bool(hz.get("ok")),
+                        int(hz.get("active_slots") or 0))
+            finally:
+                conn.close()
+        except Exception:  # noqa: BLE001 — any probe failure = not ready
+            return False, 0
+
+    # ----------------------------------------------------------- hedging
+
+    def observe_latency(self, ms: float) -> None:
+        with self.lock:
+            self.lat_samples += 1
+            self.lat_mean_ms += (ms - self.lat_mean_ms) * 0.05
+            step = max(0.05 * max(self.lat_mean_ms, 1.0), 0.01)
+            if ms > self.lat_p95_ms:
+                self.lat_p95_ms += step
+            else:
+                self.lat_p95_ms = max(0.0,
+                                      self.lat_p95_ms - step * (5.0 / 95.0))
+
+    def hedge_budget_ms(self, request_timeout_s: float) -> float | None:
+        """How long to wait on the primary before duplicating, or None
+        when hedging is off. The EW p95 floors at the operator's knob,
+        and a budget at/over the request timeout is meaningless — worse,
+        it would let the hedge decision race the read-timeout, and a
+        read-timeout must never spawn work."""
+        if self.hedge_after_ms is None:
+            return None
+        with self.lock:
+            budget = max(float(self.hedge_after_ms), self.lat_p95_ms)
+        if budget >= request_timeout_s * 1000.0:
+            return None
+        return budget
+
+    def saturated(self) -> bool:
+        """Instantaneous inflight at/above the per-replica target across
+        the ready set: every replica already has a queue, so a duplicate
+        is pure amplification — hedging is a TAIL tool, not a load tool."""
+        target = self.saturation_target
+        if target is None or target <= 0:
+            target = DEFAULT_SATURATION_TARGET
+        with self.lock:
+            ready = [b for b in self.backends.values() if b.ready]
+            if not ready:
+                return True
+            return sum(b.inflight for b in ready) >= target * len(ready)
+
+    def emit(self, event: str, **attrs) -> None:
+        cb = self.on_event
+        if cb is None:
+            return
+        try:
+            cb(event, **attrs)
+        except Exception as e:  # noqa: BLE001 — telemetry never fails routing
+            from tf_operator_tpu.utils.logging import logger_for_key
+
+            logger_for_key(self.service).debug(
+                "router event %s dropped: %s", event, e)
+
+
+class FrontEndRouter:
+    """One front-door listener. Thread shape: N handler threads
+    (ThreadingHTTPServer) pick/forward/account, one probe thread flips
+    readiness. All shared state behind one lock; no lock is ever held
+    across a network call.
+
+    Standalone (state=None, the pre-tier constructor): owns a private
+    _TierState and its probe thread — today's single-router behavior.
+    As a tier member (state=..., probe=False): a thin listener over the
+    tier's shared table; closing it kills ONE front door and nothing
+    else, which is exactly what the mid-ramp router-kill gate exercises."""
+
+    def __init__(self, service: str, probe_interval_s: float = 0.25,
+                 request_timeout_s: float = 30.0, serve_http: bool = True,
+                 state: _TierState | None = None, probe: bool = True,
+                 name: str = "r0"):
+        self.service = service
+        self.name = name
+        self.probe_interval_s = probe_interval_s
         self.request_timeout_s = request_timeout_s
-        self._lock = threading.Lock()
-        self._backends: dict[str, _Backend] = {}
-        self._stop = threading.Event()
+        self._owns_state = state is None
+        self._state = state if state is not None else _TierState(
+            service, probe_interval_s=probe_interval_s)
+        # Aliases tests and the schedcheck protocol models reach into;
+        # both reference the SHARED objects, so a tier member mutating
+        # through them is visible to every sibling.
+        self._lock = self._state.lock
+        self._backends = self._state.backends
+        self._stop = self._state.stop
+        self._closed = False
         # serve_http=False: the pick/settle core without the front door
         # or the probe thread — what schedcheck's protocol models drive
         # (the explorer serializes MODEL threads; a live HTTP server
@@ -127,13 +353,17 @@ class FrontEndRouter:
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
         threading.Thread(target=self._httpd.serve_forever, daemon=True,
-                         name=f"serve-router-{service}").start()
-        threading.Thread(target=self._probe_loop, daemon=True,
-                         name=f"serve-router-probe-{service}").start()
+                         name=f"serve-router-{service}-{name}").start()
+        if probe and self._owns_state:
+            self._state.start_probe()
 
     @property
     def endpoint(self) -> str:
         return f"127.0.0.1:{self.port}"
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
 
     # ---------------------------------------------------------- backends
 
@@ -192,50 +422,47 @@ class FrontEndRouter:
             return out
 
     # ----------------------------------------------------------- probing
+    # (kept as methods for the standalone shape; the tier probes once,
+    # centrally, through its shared _TierState)
 
     def _probe_loop(self) -> None:
-        while not self._stop.is_set():
-            with self._lock:
-                targets = [(b.name, b.addr) for b in
-                           self._backends.values()]
-            for name, addr in targets:
-                ok, slots = self._probe_one(addr)
-                with self._lock:
-                    b = self._backends.get(name)
-                    if b is not None and b.addr == addr:
-                        b.ready = ok
-                        b.slots = slots
-            self._stop.wait(timeout=self.probe_interval_s)
+        self._state._probe_loop()
 
     def _probe_one(self, addr: str) -> tuple[bool, int]:
-        """(ready, active decode slots) from the replica's /healthz."""
-        host, _, port = addr.rpartition(":")
-        try:
-            conn = http.client.HTTPConnection(host, int(port), timeout=1.0)
-            try:
-                conn.request("GET", "/healthz")
-                r = conn.getresponse()
-                body = r.read()
-                if r.status != 200:
-                    return False, 0
-                hz = json.loads(body)
-                return (bool(hz.get("ok")),
-                        int(hz.get("active_slots") or 0))
-            finally:
-                conn.close()
-        except Exception:  # noqa: BLE001 — any probe failure = not ready
-            return False, 0
+        return self._state._probe_one(addr)
 
     # ----------------------------------------------------------- routing
 
-    def _pick(self, exclude: set[str]) -> _Backend | None:
+    def _pick(self, exclude: set[str],
+              session_key: str | None = None) -> _Backend | None:
         """The READY backend with least time-averaged inflight
         (instantaneous inflight, then lifetime requests, break ties —
         the latter spreads the very first burst before any average
         exists). Returns with inflight already incremented so a
-        concurrent pick sees the load."""
+        concurrent pick sees the load.
+
+        With a session_key, the consistent-hash ring picks first: the
+        session's home replica wins REGARDLESS of load (its KV cache is
+        there; recomputing it elsewhere costs more than queueing), and
+        only an excluded/gone home falls back to least-loaded."""
         with self._lock:
             now = time.monotonic()
+            if session_key is not None:
+                st = self._state
+                st.ring.sync(frozenset(
+                    n for n, b in self._backends.items() if b.ready))
+                home = st.ring.lookup(session_key)
+                if home is not None and home not in exclude:
+                    b = self._backends.get(home)
+                    if b is not None and b.ready:
+                        b.touch(now)
+                        b.inflight += 1
+                        b.requests += 1
+                        metrics_mod.serve_router_affinity_total.labels(
+                            result="hit").inc()
+                        return b
+                metrics_mod.serve_router_affinity_total.labels(
+                    result="miss").inc()
             best: _Backend | None = None
             best_key = None
             for b in self._backends.values():
@@ -300,49 +527,169 @@ class FrontEndRouter:
         finally:
             conn.close()
 
-    def route(self, method: str, path: str,
-              body: bytes | None) -> tuple[int, bytes]:
-        """Forward to the least-loaded ready replica, failing over to
-        the next one when the chosen replica dies mid-request (socket
-        errors only — an HTTP status from the server, even a 5xx, IS
-        the answer and is relayed verbatim). A backend that accepted the
-        request but exceeded request_timeout_s answers 504 WITHOUT
-        failover or readiness gating: the work is likely still running
-        there, and replaying it on an equally loaded survivor amplifies
-        exactly the overload that caused the slowness."""
+    def _attempt(self, backend: _Backend, method: str, path: str,
+                 body: bytes | None, out: queue_mod.SimpleQueue) -> None:
+        """One forward with full accounting, reporting its outcome to
+        `out` as (kind, backend name, status, payload) where kind is
+        'answer' | 'timeout' | 'fail'. Runs on its own thread under
+        hedging so the router can act on whichever attempt finishes
+        first; the loser settles here, whenever it lands."""
+        t0 = time.monotonic()
+        try:
+            status, payload = self._forward(backend, method, path, body)
+        except _ReadTimeout:
+            # The request WAS handed over (and may still execute
+            # there): it counts as a forward to this backend.
+            metrics_mod.serve_router_requests_total.labels(
+                replica=backend.name).inc()
+            self._settle(backend.name, failed=True, gate=False,
+                         timed_out=True)
+            out.put(("timeout", backend.name, None, None))
+        except Exception:  # noqa: BLE001 — socket-level: failover
+            # Nothing was answered and likely nothing executed: a
+            # failed attempt is NOT a forward — counting it would
+            # multiply one client request across every backend tried
+            # during exactly the churn the router exists to smooth.
+            self._settle(backend.name, failed=True)
+            out.put(("fail", backend.name, None, None))
+        else:
+            metrics_mod.serve_router_requests_total.labels(
+                replica=backend.name).inc()
+            self._settle(backend.name, failed=False)
+            self._state.observe_latency((time.monotonic() - t0) * 1e3)
+            out.put(("answer", backend.name, status, payload))
+
+    def route(self, method: str, path: str, body: bytes | None,
+              session_key: str | None = None) -> tuple[int, bytes]:
+        """Forward to the session's home replica (when a session key
+        rides the request) or the least-loaded ready replica, failing
+        over to the next one when the chosen replica dies mid-request
+        (socket errors only — an HTTP status from the server, even a
+        5xx, IS the answer and is relayed verbatim). A backend that
+        accepted the request but exceeded request_timeout_s answers 504
+        WITHOUT failover or readiness gating: the work is likely still
+        running there, and replaying it on an equally loaded survivor
+        amplifies exactly the overload that caused the slowness.
+
+        With hedging armed (serving.hedgeAfterMs), a primary that is
+        quiet past max(hedgeAfterMs, EW p95) earns ONE duplicate on the
+        next-least-loaded replica — first answer wins — unless the tier
+        is saturated (suppressed) or the slowness already graduated to
+        a read-timeout (never hedge after a timeout: that is retry
+        amplification wearing a different hat)."""
+        st = self._state
         tried: set[str] = set()
+        hedged = False
         while True:
-            backend = self._pick(tried)
+            backend = self._pick(tried, session_key=session_key)
             if backend is None:
                 return 503, json.dumps(
                     {"error": f"no ready replica for {self.service} "
                               f"({len(tried)} tried)"}).encode()
-            try:
-                status, payload = self._forward(backend, method, path,
-                                                body)
-            except _ReadTimeout:
-                # The request WAS handed over (and may still execute
-                # there): it counts as a forward to this backend.
+            budget_ms = None if hedged else st.hedge_budget_ms(
+                self.request_timeout_s)
+            if budget_ms is None:
+                # The plain (pre-tier) path: inline, no extra thread.
+                try:
+                    status, payload = self._forward(backend, method, path,
+                                                    body)
+                except _ReadTimeout:
+                    metrics_mod.serve_router_requests_total.labels(
+                        replica=backend.name).inc()
+                    self._settle(backend.name, failed=True, gate=False,
+                                 timed_out=True)
+                    return 504, self._timeout_body(backend.name)
+                except Exception:  # noqa: BLE001 — socket-level: failover
+                    self._settle(backend.name, failed=True)
+                    tried.add(backend.name)
+                    continue
                 metrics_mod.serve_router_requests_total.labels(
                     replica=backend.name).inc()
-                self._settle(backend.name, failed=True, gate=False,
-                             timed_out=True)
-                return 504, json.dumps(
-                    {"error": f"backend {backend.name} timed out after "
-                              f"{self.request_timeout_s}s (request may "
-                              "still be executing; not retried)"}).encode()
-            except Exception:  # noqa: BLE001 — socket-level: failover
-                # Nothing was answered and likely nothing executed: a
-                # failed attempt is NOT a forward — counting it would
-                # multiply one client request across every backend tried
-                # during exactly the churn the router exists to smooth.
-                self._settle(backend.name, failed=True)
-                tried.add(backend.name)
-                continue
-            metrics_mod.serve_router_requests_total.labels(
-                replica=backend.name).inc()
-            self._settle(backend.name, failed=False)
-            return status, payload
+                self._settle(backend.name, failed=False)
+                return status, payload
+            kind, payload, hedge_launched = self._route_hedged(
+                backend, tried, method, path, body, budget_ms)
+            # <=1 hedge per REQUEST: only an actually-launched duplicate
+            # burns the allowance (a primary that socket-failed before
+            # the budget never hedged — the retry stays eligible).
+            hedged = hedged or hedge_launched
+            if kind == "answer":
+                return payload
+            if kind == "timeout":
+                return 504, self._timeout_body(payload)
+            # kind == "fail": every attempt died at the socket level —
+            # continue the ordinary failover loop past all of them.
+            tried.update(payload)
+
+    def _route_hedged(self, primary: _Backend, tried: set[str],
+                      method: str, path: str, body: bytes | None,
+                      budget_ms: float):
+        """One primary attempt with at most one hedge. Returns
+        (kind, payload, hedge_launched) where kind/payload is
+        ('answer', (status, payload)) | ('timeout', backend_name) |
+        ('fail', {names that socket-failed})."""
+        st = self._state
+        outcomes: queue_mod.SimpleQueue = queue_mod.SimpleQueue()
+        threading.Thread(
+            target=self._attempt, args=(primary, method, path, body,
+                                        outcomes),
+            daemon=True, name=f"serve-hedge-primary-{self.service}").start()
+        try:
+            first = outcomes.get(timeout=budget_ms / 1000.0)
+        except queue_mod.Empty:
+            first = None
+        hedge: _Backend | None = None
+        if first is None:
+            # Budget exceeded with the primary still quiet — the hedge
+            # moment. The saturation guard turns it into a no-op while
+            # every replica already has a queue.
+            if st.saturated():
+                metrics_mod.serve_router_hedges_total.labels(
+                    result="suppressed").inc()
+            else:
+                hedge = self._pick(tried | {primary.name})
+                if hedge is not None:
+                    threading.Thread(
+                        target=self._attempt,
+                        args=(hedge, method, path, body, outcomes),
+                        daemon=True,
+                        name=f"serve-hedge-{self.service}").start()
+            first = outcomes.get()
+        failed: set[str] = set()
+        timeout_name: str | None = None
+        pending = 2 if hedge is not None else 1
+        outcome = first
+        while True:
+            pending -= 1
+            kind, name, status, payload = outcome
+            if kind == "answer":
+                if hedge is not None:
+                    won = name == hedge.name
+                    metrics_mod.serve_router_hedges_total.labels(
+                        result="won" if won else "lost").inc()
+                    st.emit("router.hedge", primary=primary.name,
+                            hedge=hedge.name,
+                            result="won" if won else "lost",
+                            budget_ms=round(budget_ms, 1))
+                return "answer", (status, payload), hedge is not None
+            if kind == "timeout":
+                timeout_name = name
+            else:
+                failed.add(name)
+            if pending == 0:
+                break
+            # A hedge is still in flight: its answer beats returning a
+            # 504 or re-picking — and waiting costs no new work.
+            outcome = outcomes.get()
+        if timeout_name is not None:
+            return "timeout", timeout_name, hedge is not None
+        return "fail", failed, hedge is not None
+
+    def _timeout_body(self, name: str) -> bytes:
+        return json.dumps(
+            {"error": f"backend {name} timed out after "
+                      f"{self.request_timeout_s}s (request may "
+                      "still be executing; not retried)"}).encode()
 
     # -------------------------------------------------------------- http
 
@@ -366,6 +713,7 @@ class FrontEndRouter:
                     self._send(200 if ready else 503, json.dumps({
                         "ok": ready > 0,
                         "service": router.service,
+                        "router": router.name,
                         "ready_replicas": ready,
                         "backends": router.backends(),
                     }).encode())
@@ -375,7 +723,9 @@ class FrontEndRouter:
             def do_POST(self):  # noqa: N802
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n) if n else None
-                code, payload = router.route("POST", self.path, body)
+                code, payload = router.route(
+                    "POST", self.path, body,
+                    session_key=_session_key(self.headers, body))
                 self._send(code, payload)
 
         return Handler
@@ -383,7 +733,9 @@ class FrontEndRouter:
     # ------------------------------------------------------------- close
 
     def close(self) -> None:
-        self._stop.set()
+        self._closed = True
+        if self._owns_state:
+            self._stop.set()
         if self._httpd is None:
             return
         try:
@@ -391,6 +743,194 @@ class FrontEndRouter:
             self._httpd.server_close()
         except OSError:  # already closed: teardown is idempotent
             pass
+
+
+def _session_key(headers, body: bytes | None) -> str | None:
+    """The request's session id: X-Session-Id header first (no body
+    parse), else a top-level "sessionId" body field — probed with a
+    bytes scan before paying for json.loads, so the common keyless
+    request costs nothing."""
+    key = headers.get("X-Session-Id")
+    if key:
+        return str(key)
+    if body and b'"sessionId"' in body:
+        try:
+            v = json.loads(body).get("sessionId")
+        except Exception:  # noqa: BLE001 — malformed body: no affinity
+            return None
+        if v is not None:
+            return str(v)
+    return None
+
+
+class RouterTier:
+    """N front-door listeners over ONE shared _TierState. The
+    controller sizes it from spec.serving.routers every reconcile
+    (`ensure`), which also replaces any listener that died since the
+    last tick — the tier's own failover — and reports the lifecycle as
+    journal-able events. replicas=1 is the pre-tier single router,
+    bit-for-bit: same state shape, same probe, one listener."""
+
+    def __init__(self, service: str, replicas: int = 1,
+                 probe_interval_s: float = 0.25,
+                 request_timeout_s: float = 30.0,
+                 hedge_after_ms: float | None = None,
+                 saturation_target: float | None = None,
+                 on_event=None):
+        self.service = service
+        self.probe_interval_s = probe_interval_s
+        self.request_timeout_s = request_timeout_s
+        self._state = _TierState(service, probe_interval_s=probe_interval_s,
+                                 hedge_after_ms=hedge_after_ms,
+                                 saturation_target=saturation_target)
+        self._state.on_event = on_event
+        # Shared-state aliases (same contract as FrontEndRouter's):
+        # tests and the autoscale wire reach through the tier directly.
+        self._lock = self._state.lock
+        self._backends = self._state.backends
+        # Guards the member LIST (open/replace/kill); the state lock
+        # stays request-path-only so membership churn never blocks a
+        # forward.
+        self._members_lock = threading.Lock()
+        self._routers: list[FrontEndRouter] = []
+        self._state.start_probe()
+        self.ensure(replicas)
+
+    # --------------------------------------------------------- membership
+
+    def _new_member(self, index: int) -> FrontEndRouter:
+        return FrontEndRouter(
+            self.service, probe_interval_s=self.probe_interval_s,
+            request_timeout_s=self.request_timeout_s, serve_http=True,
+            state=self._state, probe=False, name=f"r{index}")
+
+    def ensure(self, replicas: int) -> list[tuple[str, dict]]:
+        """Reconcile the member set to `replicas` live listeners:
+        open missing ones, close extras, and REPLACE any member that
+        died since the last tick (a fresh listener on a fresh port —
+        clients meanwhile fail over across the survivors). Returns
+        (event, attrs) pairs: router.open / router.close /
+        router.failover."""
+        replicas = max(1, int(replicas))
+        events: list[tuple[str, dict]] = []
+        with self._members_lock:
+            for i, r in enumerate(self._routers):
+                if i >= replicas:
+                    break
+                if r.closed:
+                    nr = self._new_member(i)
+                    self._routers[i] = nr
+                    events.append(("router.failover", {
+                        "router": nr.name, "dead": r.endpoint,
+                        "endpoint": nr.endpoint}))
+            while len(self._routers) < replicas:
+                nr = self._new_member(len(self._routers))
+                self._routers.append(nr)
+                events.append(("router.open", {
+                    "router": nr.name, "endpoint": nr.endpoint}))
+            while len(self._routers) > replicas:
+                r = self._routers.pop()
+                if not r.closed:
+                    r.close()
+                    events.append(("router.close", {
+                        "router": r.name, "endpoint": r.endpoint}))
+        for event, attrs in events:
+            self._state.emit(event, **attrs)
+        return events
+
+    def kill(self, index: int = 0) -> str | None:
+        """Chaos hook: close ONE listener (its port goes dead, exactly
+        like a crashed router process) without touching the shared
+        state — siblings keep serving, the controller replaces it on
+        its next tick. Returns the dead endpoint."""
+        with self._members_lock:
+            if index >= len(self._routers):
+                return None
+            r = self._routers[index]
+            if r.closed:
+                return None
+            r.close()
+            return r.endpoint
+
+    def routers(self) -> list[FrontEndRouter]:
+        with self._members_lock:
+            return list(self._routers)
+
+    def endpoints(self) -> list[str]:
+        """Every member's address, dead or alive, in slot order —
+        endpoint 0 is the legacy routerEndpoint. Dead slots are
+        replaced (new port) by the next controller tick; until then
+        clients' connect-phase failover skips them."""
+        with self._members_lock:
+            return [r.endpoint for r in self._routers]
+
+    def alive_count(self) -> int:
+        with self._members_lock:
+            return sum(1 for r in self._routers if not r.closed)
+
+    @property
+    def endpoint(self) -> str:
+        eps = self.endpoints()
+        return eps[0] if eps else ""
+
+    # ------------------------------------------------- shared-state views
+
+    def set_backends(self, backends: dict[str, str]) -> None:
+        self._delegate().set_backends(backends)
+
+    def backends(self) -> dict[str, dict]:
+        return self._delegate().backends()
+
+    def ready_count(self) -> int:
+        return self._delegate().ready_count()
+
+    def load(self) -> dict[str, float]:
+        return self._delegate().load()
+
+    def _delegate(self) -> FrontEndRouter:
+        # Any member works: these methods only touch the SHARED state,
+        # never the member's listener — a closed member still answers.
+        with self._members_lock:
+            return self._routers[0]
+
+    def configure(self, hedge_after_ms: float | None,
+                  saturation_target: float | None) -> None:
+        """Re-arm the hedging knobs from the (possibly edited) spec —
+        control-tier settings, applied live, never rolling a replica."""
+        st = self._state
+        st.hedge_after_ms = hedge_after_ms
+        st.saturation_target = saturation_target
+
+    def snapshot(self) -> dict:
+        """The /debug/state view: per-router liveness, the shared
+        backend table, the session ring's membership, and the hedge
+        budget — enough to read router churn off a timeline."""
+        with self._state.lock:
+            ring_members = self._state.ring.members()
+            p95 = round(self._state.lat_p95_ms, 2)
+        return {
+            "endpoint": self.endpoint,        # legacy single-router key
+            "endpoints": self.endpoints(),
+            "routers": [
+                {"name": r.name, "endpoint": r.endpoint,
+                 "alive": not r.closed}
+                for r in self.routers()
+            ],
+            "backends": self.backends(),
+            "session_ring": {"members": ring_members},
+            "hedge": {"after_ms": self._state.hedge_after_ms,
+                      "ew_p95_ms": p95},
+        }
+
+    # -------------------------------------------------------------- close
+
+    def close(self) -> None:
+        self._state.stop.set()
+        with self._members_lock:
+            members = list(self._routers)
+        for r in members:
+            if not r.closed:
+                r.close()
 
 
 def local_endpoint_resolver(runtime):

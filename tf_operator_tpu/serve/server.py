@@ -397,6 +397,12 @@ class InferenceServer:
         self.ready = threading.Event()
         self._hb = HeartbeatWriter.from_env()
         self._stats_path = os.environ.get(ENV_STATS_FILE)
+        # Chaos/bench knob (docs/serving.md): sleep this long in the
+        # request handler before queueing — how exp_serve manufactures a
+        # deterministically SLOW replica for the hedging stage. 0 = off
+        # (production); never set by the controller.
+        self._inject_delay_ms = float(
+            os.environ.get("TPUJOB_SERVE_INJECT_DELAY_MS", "0") or 0)
         self._stats_lock = threading.Lock()
         self._latencies_ms: list[float] = []  # bounded ring, see _note
         self._requests = 0
@@ -1226,6 +1232,8 @@ class InferenceServer:
                     return self._send({"error": "not found"}, 404)
                 if not server.ready.is_set() or server.stop.is_set():
                     return self._send({"error": "not serving"}, 503)
+                if server._inject_delay_ms > 0:
+                    time.sleep(server._inject_delay_ms / 1000.0)
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n))

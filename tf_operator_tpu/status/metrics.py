@@ -497,6 +497,29 @@ serve_router_requests_total = DEFAULT.counter(
     "(least-time-averaged-inflight choice over READY replicas)",
     labels_only=True,
 )
+serve_router_hedges_total = DEFAULT.counter(
+    "tpujob_serve_router_hedges_total",
+    "Hedged sends at the front-end router tier (result: won = the "
+    "duplicate answered first | lost = the primary did | suppressed = "
+    "the budget expired but the tier was saturated, so no duplicate "
+    "was launched). Read-timeouts never hedge",
+    labels_only=True,
+)
+serve_router_affinity_total = DEFAULT.counter(
+    "tpujob_serve_router_affinity_total",
+    "Session-keyed routing decisions (result: hit = the consistent-hash "
+    "ring's home replica was ready and chosen | miss = no ready home, "
+    "fell back to least-loaded). hit/(hit+miss) is the affinity hit "
+    "ratio — it should stay ~1 outside replica churn",
+    labels_only=True,
+)
+serve_router_ready = DEFAULT.gauge(
+    "tpujob_serve_router_ready",
+    "Live front-end routers in the service's tier (of "
+    "spec.serving.routers; below target means a router died and the "
+    "controller is replacing it on the next tick)",
+    labels_only=True,
+)
 serve_ckpt_follow_total = DEFAULT.counter(
     "tpujob_serve_ckpt_follow_total",
     "Checkpoint-follow hot-swaps (result: swapped | error). A swap "

@@ -42,7 +42,10 @@ the schema): ``submit`` ``validate`` ``queue.enter`` ``queue.blocked``
 ``queue.exit``
 ``slice.admit`` ``slice.release`` ``slice.upgrade`` ``pod.create``
 ``pod.delete`` ``condition`` ``gang.roll`` ``reshape``
-``preempt.latch`` ``preempt.requeue`` ``status.flush`` ``deleted``.
+``preempt.latch`` ``preempt.requeue`` ``status.flush`` ``deleted``
+``router.open`` ``router.close`` ``router.failover`` ``router.hedge``
+(the serve controller's front-end tier lifecycle; hedge resolutions
+arrive from router handler threads, so they carry no reconcile wave).
 """
 
 from __future__ import annotations

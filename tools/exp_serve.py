@@ -42,6 +42,22 @@ Stages:
   (follow mode), and the stage asserts every sequence completed with
   zero errors across it.
 
+  router_kill (round 19): the router-tier robustness story. A service
+  runs with serving.routers=2 — two listeners over ONE shared backend
+  table — and one listener is killed mid-ramp. Clients resolve the
+  address per request (LocalSession.service_address: round-robin over
+  status.routerEndpoints with a connect-phase probe), so the kill
+  costs the surviving sibling's address, not an error; the controller
+  replaces the dead listener before the stage ends (tier_healed).
+
+  hedging (round 19): the hedged-sends tail story. Three standalone
+  replicas, one slowed by TPUJOB_SERVE_INJECT_DELAY_MS; the same
+  closed-loop load runs hedging-off then hedging-on (hedgeAfterMs=30)
+  through fresh one-router tiers. Hedging-on, a request whose primary
+  is quiet past max(hedgeAfterMs, EW p95) earns one duplicate on the
+  next-least-loaded replica, first answer wins — the straggler's
+  delay leaves the client p99 while the hedge RATE stays tiny.
+
 Gates (exit 1 on violation): --gate-p99-ms on the FINAL stage's p99,
 --gate-scale-to on the max desired reached (also requires ZERO request
 errors across the ramp — the router's readiness gate makes scale-out
@@ -49,7 +65,11 @@ clean), --gate-pad-efficiency on the bucketed light-load stage,
 --gate-light-speedup on p50_padmax/p50_bucketed, --gate-decode-speedup
 on the decode stage's tokens_per_sec_speedup (also requires the
 continuous variant's SHORT-request p99 to be equal-or-better — the
-head-of-line-blocking number — and zero errors/incomplete sequences). This is the "millions of users" story's
+head-of-line-blocking number — and zero errors/incomplete sequences),
+--gate-router-kill-errors on the router-kill stage's client errors
+(plus the tier healing), and --gate-hedge-rate on the hedging stage
+(hedged p99 strictly under unhedged, at least one hedge fired, rate
+bounded). This is the "millions of users" story's
 measurable surface — the `serving` bench point runs it in a small
 configuration (bench.py), CI's serve-smoke stage gates it.
 
@@ -68,6 +88,7 @@ import sys
 import tempfile
 import threading
 import time
+import urllib.error
 import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -153,7 +174,8 @@ def _free_port() -> int:
 def serve_manifest(name: str, ckpt_dir: str, max_replicas: int,
                    target: float, stabilization: float,
                    batch_timeout_ms: float, min_replicas: int = 1,
-                   batch_max: int = 8, bucketing: bool = True):
+                   batch_max: int = 8, bucketing: bool = True,
+                   routers: int = 1):
     from tf_operator_tpu.api import compat
 
     return compat.infsvc_from_dict({
@@ -164,7 +186,8 @@ def serve_manifest(name: str, ckpt_dir: str, max_replicas: int,
             "serving": {"batchMaxSize": batch_max,
                         "batchTimeoutMs": batch_timeout_ms,
                         "port": 8500,
-                        "bucketing": bucketing},
+                        "bucketing": bucketing,
+                        "routers": routers},
             "autoscale": {
                 "minReplicas": min_replicas, "maxReplicas": max_replicas,
                 "targetInflightPerReplica": target,
@@ -392,6 +415,275 @@ def light_load_point(session, ckpt_dir: str, seconds: float,
     return out
 
 
+def router_kill_point(session, ckpt_dir: str, seconds: float = 6.0,
+                      qps: float = 40.0) -> dict:
+    """The router-tier robustness number (round 19): TWO front-door
+    listeners over one shared backend table, one of them KILLED mid-ramp
+    (its port goes dead like a crashed router process). Clients resolve
+    the address per request through LocalSession.service_address —
+    round-robin over status.routerEndpoints with a connect-phase probe —
+    so the kill costs the next sibling's address, not an error. A
+    connect-REFUSED attempt retries once against a fresh resolution
+    (nothing was handed over; that is ordinary client failover, the
+    same rule the router itself applies to its backends); any failure
+    after the request was sent counts as a client error with NO retry.
+    The gate is zero such errors, plus the controller replacing the dead
+    listener (tier_healed) before the stage ends."""
+    from tf_operator_tpu.api.types import JobConditionType
+
+    import numpy as np
+
+    name = "bench-routerkill"
+    session.submit_service(serve_manifest(
+        name, ckpt_dir, max_replicas=1, target=4.0, stabilization=60,
+        batch_timeout_ms=0.0, min_replicas=1, routers=2))
+    session.wait_for_service_condition(
+        "default", name, (JobConditionType.RUNNING,), timeout=120)
+    wait_router(session, name)
+    deadline = time.monotonic() + 30
+    while (len(session.service_addresses(name)) < 2
+           and time.monotonic() < deadline):
+        time.sleep(0.1)
+    endpoints_before = session.service_addresses(name)
+    if len(endpoints_before) < 2:
+        session.delete_service("default", name)
+        raise RuntimeError("router-kill stage: tier never published "
+                           f"2 endpoints (got {endpoints_before})")
+
+    row = np.random.default_rng(11).normal(
+        size=(1, 28, 28)).astype(np.float32).tolist()
+    body = json.dumps({"instances": row}).encode()
+    lock = threading.Lock()
+    ok = [0]
+    errors = [0]
+    connect_retries = [0]
+    lats: list[float] = []
+
+    def fire() -> None:
+        t0 = time.monotonic()
+        for attempt in range(3):
+            addr = session.service_address(name)
+            try:
+                req = urllib.request.Request(
+                    f"http://{addr}/predict", data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=15) as r:
+                    r.read()
+            except urllib.error.URLError as e:
+                # Refused connect = the listener died between the probe
+                # and the request; no work was handed over, so failing
+                # over to a sibling is safe — and is the point.
+                if (isinstance(getattr(e, "reason", None),
+                               ConnectionRefusedError)
+                        and attempt < 2):
+                    with lock:
+                        connect_retries[0] += 1
+                    continue
+                with lock:
+                    errors[0] += 1
+                return
+            except Exception:  # noqa: BLE001 — counted, not raised
+                with lock:
+                    errors[0] += 1
+                return
+            with lock:
+                ok[0] += 1
+                lats.append((time.monotonic() - t0) * 1000.0)
+            return
+
+    killed = [None]
+    interval = 1.0 / max(qps, 0.001)
+    t_start = time.monotonic()
+    t_end = t_start + seconds
+    kill_at = t_start + seconds / 3.0
+    next_fire = t_start
+    threads: list[threading.Thread] = []
+    while time.monotonic() < t_end:
+        now = time.monotonic()
+        if killed[0] is None and now >= kill_at:
+            killed[0] = session.kill_router(name, index=0)
+            log(f"  router-kill: killed {killed[0]} mid-ramp")
+        if now >= next_fire:
+            t = threading.Thread(target=fire, daemon=True)
+            t.start()
+            threads.append(t)
+            next_fire += interval
+            if now - next_fire > 2.0:
+                next_fire = now
+        time.sleep(min(0.002, max(0.0, next_fire - time.monotonic())))
+    for t in threads:
+        t.join(timeout=20)
+
+    # The controller must have replaced the dead listener: two endpoints
+    # again, every one accepting connections on a LIVE port.
+    import socket as socket_mod
+
+    healed = False
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and not healed:
+        eps = session.service_addresses(name)
+        if len(eps) >= 2 and killed[0] not in eps:
+            alive = 0
+            for addr in eps:
+                host, _, port = addr.rpartition(":")
+                try:
+                    socket_mod.create_connection(
+                        (host, int(port)), timeout=0.5).close()
+                    alive += 1
+                except OSError:
+                    pass
+            healed = alive == len(eps)
+        if not healed:
+            time.sleep(0.2)
+    endpoints_after = session.service_addresses(name)
+    session.delete_service("default", name)
+    lats.sort()
+    out = {
+        "routers": 2, "qps": qps, "seconds": seconds,
+        "requests": ok[0] + errors[0],
+        "ok": ok[0], "errors": errors[0],
+        "connect_retries": connect_retries[0],
+        "latency_p50_ms": round(lats[len(lats) // 2], 3) if lats else None,
+        "latency_p99_ms": (round(lats[int(len(lats) * 0.99)], 3)
+                           if lats else None),
+        "killed_endpoint": killed[0],
+        "endpoints_before": endpoints_before,
+        "endpoints_after": endpoints_after,
+        "tier_healed": healed,
+    }
+    log(f"  router-kill: ok={out['ok']} errors={out['errors']} "
+        f"connect_retries={out['connect_retries']} "
+        f"p99={out['latency_p99_ms']}ms healed={healed}")
+    return out
+
+
+def hedging_point(ckpt_dir: str, seconds: float = 6.0, qps: float = 10.0,
+                  delay_ms: float = 250.0) -> dict:
+    """The hedged-sends tail number (round 19): three standalone
+    replicas serve the same checkpoint, one slowed by
+    TPUJOB_SERVE_INJECT_DELAY_MS (a straggler, not a corpse — /healthz
+    stays fast, so the readiness probe keeps admitting it). The same
+    closed-loop load runs twice through a fresh one-router tier:
+    hedging off (the straggler's delay lands in the client p99) and
+    hedging on (hedgeAfterMs=30; a quiet primary earns ONE duplicate on
+    the next-least-loaded replica, first answer wins). Gated: hedged
+    p99 strictly under unhedged p99, with the hedge RATE — (won+lost)
+    over requests — bounded, because a router that hedges everything
+    is a load doubler wearing a latency costume."""
+    import subprocess
+
+    import numpy as np
+
+    from tf_operator_tpu.serve.router import RouterTier
+
+    row = np.random.default_rng(13).normal(
+        size=(1, 28, 28)).astype(np.float32).tolist()
+    body = json.dumps({"instances": row}).encode()
+    out: dict = {"delay_ms": delay_ms, "qps": qps, "seconds": seconds,
+                 "slow_replica": "bench-hedge-0"}
+    procs: list = []
+    backends: dict[str, str] = {}
+    try:
+        for i in range(3):
+            port = _free_port()
+            env = {
+                **os.environ, **ONE_DEV,
+                "TPUJOB_SERVE_MODEL": "mnist-mlp",
+                "TPUJOB_SERVE_CHECKPOINT_DIR": ckpt_dir,
+                "TPUJOB_SERVE_PORT": str(port),
+                "TPUJOB_SERVE_LISTEN_PORT": str(port),
+                "TPUJOB_SERVE_BATCH_MAX": "8",
+                "TPUJOB_SERVE_BATCH_TIMEOUT_MS": "0.0",
+                "TPUJOB_POD_NAME": f"bench-hedge-{i}",
+            }
+            if i == 0:
+                env["TPUJOB_SERVE_INJECT_DELAY_MS"] = str(delay_ms)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "tf_operator_tpu.serve.server"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT))
+            backends[f"bench-hedge-{i}"] = f"127.0.0.1:{port}"
+        for addr in backends.values():
+            wait_healthy(addr)
+
+        for variant, hedge_ms in (("unhedged", None), ("hedged", 30.0)):
+            # Fresh tier per pass: the EW-p95 hedge budget must not
+            # carry the unhedged pass's straggler samples into the
+            # hedged one.
+            events: list = []
+            tier = RouterTier(
+                "bench-hedge", replicas=1, hedge_after_ms=hedge_ms,
+                on_event=lambda ev, _evs=events, **at:
+                    _evs.append((ev, at)))
+            try:
+                tier.set_backends(backends)
+                deadline = time.monotonic() + 30
+                while (tier.ready_count() < 3
+                       and time.monotonic() < deadline):
+                    time.sleep(0.1)
+                if tier.ready_count() < 3:
+                    raise RuntimeError("hedge stage: backends never all "
+                                       "became ready at the router")
+                addr = tier.endpoint
+                lats: list[float] = []
+                errors = 0
+                interval = 1.0 / qps
+                t_end = time.monotonic() + seconds
+                while time.monotonic() < t_end:
+                    t0 = time.monotonic()
+                    try:
+                        req = urllib.request.Request(
+                            f"http://{addr}/predict", data=body,
+                            headers={"Content-Type": "application/json"},
+                            method="POST")
+                        with urllib.request.urlopen(req, timeout=15) as r:
+                            r.read()
+                        lats.append((time.monotonic() - t0) * 1000.0)
+                    except Exception:  # noqa: BLE001 — counted, not raised
+                        errors += 1
+                    time.sleep(max(0.0,
+                                   interval - (time.monotonic() - t0)))
+            finally:
+                tier.close()
+            lats.sort()
+            won = sum(1 for ev, at in events
+                      if ev == "router.hedge" and at.get("result") == "won")
+            lost = sum(1 for ev, at in events
+                       if ev == "router.hedge"
+                       and at.get("result") == "lost")
+            out[variant] = {
+                "requests": len(lats) + errors, "errors": errors,
+                "latency_p50_ms": (round(lats[len(lats) // 2], 3)
+                                   if lats else None),
+                "latency_p99_ms": (round(lats[int(len(lats) * 0.99)], 3)
+                                   if lats else None),
+            }
+            if variant == "hedged":
+                total = max(1, len(lats) + errors)
+                out[variant].update({
+                    "hedges_won": won, "hedges_lost": lost,
+                    "hedge_rate": round((won + lost) / total, 4),
+                })
+            log(f"  hedge {variant}: p50={out[variant]['latency_p50_ms']}"
+                f"ms p99={out[variant]['latency_p99_ms']}ms "
+                f"errors={errors}"
+                + (f" won={won} lost={lost}" if variant == "hedged"
+                   else ""))
+    finally:
+        for proc in procs:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except Exception:  # noqa: BLE001 — last resort
+                proc.kill()
+    p_un = (out.get("unhedged") or {}).get("latency_p99_ms")
+    p_h = (out.get("hedged") or {}).get("latency_p99_ms")
+    out["p99_improvement"] = (round(p_un / p_h, 2)
+                              if p_un and p_h else None)
+    return out
+
+
 def decode_point(work: str, *, seconds: float = 6.0,
                  short_clients: int = 12, long_clients: int = 2,
                  short_new: int = 8, long_new: int = 112) -> dict:
@@ -601,7 +893,12 @@ def run_serve_bench(qps_ramp: list[float], stage_seconds: float,
                     drain_seconds: float = 25.0,
                     light_seconds: float = 4.0,
                     light_qps: float = 10.0,
-                    decode: bool = True) -> dict:
+                    decode: bool = True,
+                    kill_seconds: float = 6.0,
+                    kill_qps: float = 40.0,
+                    hedge_seconds: float = 6.0,
+                    hedge_qps: float = 10.0,
+                    hedge_delay_ms: float = 250.0) -> dict:
     from tf_operator_tpu.api.types import JobConditionType
     from tf_operator_tpu.runtime.session import LocalSession
 
@@ -630,6 +927,20 @@ def run_serve_bench(qps_ramp: list[float], stage_seconds: float,
             log("exp_serve: decode stage (continuous batching vs "
                 "run-to-completion, mixed short/long workload)")
             result["decode"] = decode_point(work)
+
+        if kill_seconds > 0:
+            log(f"exp_serve: router-kill stage (2 routers, one killed "
+                f"mid-ramp, {kill_qps:g} QPS for {kill_seconds:g}s)")
+            result["router_kill"] = router_kill_point(
+                session, ckpt_dir, seconds=kill_seconds, qps=kill_qps)
+
+        if hedge_seconds > 0:
+            log(f"exp_serve: hedging stage (injected "
+                f"{hedge_delay_ms:g}ms straggler, hedged vs unhedged, "
+                f"{hedge_qps:g} QPS for {hedge_seconds:g}s per pass)")
+            result["hedging"] = hedging_point(
+                ckpt_dir, seconds=hedge_seconds, qps=hedge_qps,
+                delay_ms=hedge_delay_ms)
 
         name = "bench-serve"
         session.submit_service(serve_manifest(
@@ -722,6 +1033,18 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--decode", type=int, choices=(0, 1), default=1,
                     help="1 = run the continuous-batching decode stage "
                          "(transformer-lm subprocess replicas), 0 skips")
+    ap.add_argument("--kill-seconds", type=float, default=6.0,
+                    help="seconds for the mid-ramp router-kill stage "
+                         "(2 routers, one killed); 0 disables")
+    ap.add_argument("--kill-qps", type=float, default=40.0)
+    ap.add_argument("--hedge-seconds", type=float, default=6.0,
+                    help="seconds PER PASS (unhedged + hedged) for the "
+                         "tail-hedging stage; 0 disables")
+    ap.add_argument("--hedge-qps", type=float, default=10.0)
+    ap.add_argument("--hedge-delay-ms", type=float, default=250.0,
+                    help="injected straggler delay for the hedging "
+                         "stage (TPUJOB_SERVE_INJECT_DELAY_MS on one "
+                         "of three replicas)")
     ap.add_argument("--checkpoint-dir", default=None,
                     help="serve an existing checkpoint dir instead of "
                          "producing one")
@@ -747,15 +1070,34 @@ def main(argv: list[str] | None = None) -> int:
                          "equal-or-better short-request p99, zero errors, "
                          "and zero incomplete sequences (a checkpoint "
                          "swap lands mid-stage)")
+    ap.add_argument("--gate-router-kill-errors", type=int, default=None,
+                    help="fail unless the router-kill stage saw at most "
+                         "this many client errors AND the controller "
+                         "replaced the dead listener (tier healed)")
+    ap.add_argument("--gate-hedge-rate", type=float, default=None,
+                    help="fail unless the hedging stage's hedged p99 "
+                         "beats the unhedged p99, at least one hedge "
+                         "actually fired, and the hedge rate "
+                         "(won+lost over requests) stays at or under "
+                         "this bound")
     args = ap.parse_args(argv)
     ramp = [float(x) for x in args.qps_ramp.split(",") if x.strip()]
+    kill_seconds = args.kill_seconds
+    if args.gate_router_kill_errors is not None and kill_seconds <= 0:
+        kill_seconds = 6.0
+    hedge_seconds = args.hedge_seconds
+    if args.gate_hedge_rate is not None and hedge_seconds <= 0:
+        hedge_seconds = 6.0
     result = run_serve_bench(
         ramp, args.stage_seconds, max_replicas=args.max_replicas,
         target=args.target_inflight, stabilization=args.stabilization,
         batch_timeout_ms=args.batch_timeout_ms,
         ckpt_dir=args.checkpoint_dir, train=args.train,
         light_seconds=args.light_seconds, light_qps=args.light_qps,
-        decode=bool(args.decode) or args.gate_decode_speedup is not None)
+        decode=bool(args.decode) or args.gate_decode_speedup is not None,
+        kill_seconds=kill_seconds, kill_qps=args.kill_qps,
+        hedge_seconds=hedge_seconds, hedge_qps=args.hedge_qps,
+        hedge_delay_ms=args.hedge_delay_ms)
     print(json.dumps(result, indent=2))
     if not result.get("ok"):
         return 1
@@ -821,6 +1163,41 @@ def main(argv: list[str] | None = None) -> int:
         if (dec.get("continuous") or {}).get("served_step_final") != 2:
             log("GATE FAILED: the mid-stage checkpoint swap never landed "
                 "on the continuous variant")
+            rc = 1
+    if args.gate_router_kill_errors is not None:
+        rk = result.get("router_kill") or {}
+        if rk.get("killed_endpoint") is None:
+            log("GATE FAILED: router-kill stage never killed a router")
+            rc = 1
+        elif rk.get("errors", 1) > args.gate_router_kill_errors:
+            log(f"GATE FAILED: router-kill stage saw {rk.get('errors')} "
+                f"client error(s) > {args.gate_router_kill_errors} — "
+                f"killing one router of two must stay client-invisible")
+            rc = 1
+        elif not rk.get("tier_healed"):
+            log("GATE FAILED: the controller never replaced the killed "
+                "router (tier did not heal)")
+            rc = 1
+    if args.gate_hedge_rate is not None:
+        hd = result.get("hedging") or {}
+        hedged = hd.get("hedged") or {}
+        p_un = (hd.get("unhedged") or {}).get("latency_p99_ms")
+        p_h = hedged.get("latency_p99_ms")
+        fired = (hedged.get("hedges_won", 0)
+                 + hedged.get("hedges_lost", 0))
+        rate = hedged.get("hedge_rate")
+        if p_un is None or p_h is None or p_h >= p_un:
+            log(f"GATE FAILED: hedged p99 {p_h}ms not under unhedged "
+                f"p99 {p_un}ms")
+            rc = 1
+        elif fired < 1:
+            log("GATE FAILED: no hedge ever fired — the stage proved "
+                "nothing about the tail")
+            rc = 1
+        elif rate is None or rate > args.gate_hedge_rate:
+            log(f"GATE FAILED: hedge rate {rate} > "
+                f"{args.gate_hedge_rate} — hedging must stay a tail "
+                f"tool, not a load doubler")
             rc = 1
     return rc
 
